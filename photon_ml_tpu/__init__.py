@@ -1,0 +1,32 @@
+"""photon_ml_tpu — a TPU-native framework with the capabilities of Photon ML.
+
+Training and scoring of generalized linear models (linear / logistic / Poisson
+regression, smoothed-hinge linear SVM) and GAME/GLMix mixed-effect models
+(fixed-effect coordinate + per-entity random-effect coordinates trained by
+coordinate descent), rebuilt JAX/XLA-first: batch-sharded value_and_grad with
+all-reduce over ICI replaces Spark treeAggregate, vmapped second-order solvers
+over entity-packed blocks replace per-executor local optimization, and
+pjit/shard_map over a TPU mesh replaces the Spark cluster.
+
+See SURVEY.md at the repository root for the structural analysis of the
+reference (mqwu/photon-ml) this build follows.
+"""
+
+from photon_ml_tpu.types import (
+    NormalizationType,
+    OptimizerType,
+    RegularizationType,
+    TaskType,
+    VarianceComputationType,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "NormalizationType",
+    "OptimizerType",
+    "RegularizationType",
+    "TaskType",
+    "VarianceComputationType",
+    "__version__",
+]

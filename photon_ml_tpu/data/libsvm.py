@@ -1,0 +1,115 @@
+"""LibSVM text reader.
+
+Counterpart of photon-client io/deprecated/LibSVMInputDataFormat.scala and the
+dev-script `libsvm_text_to_trainingexample_avro.py` flow (README.md:330-334):
+parses `label idx:val idx:val ...` lines into host CSR, optionally appending
+an intercept column, ready for packing into device blocks
+(data.containers.pack_csr_to_ell) or a dense design matrix.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class CSRDataset:
+    """Host-side CSR design matrix + label/offset/weight columns."""
+
+    indptr: np.ndarray
+    indices: np.ndarray
+    values: np.ndarray
+    labels: np.ndarray
+    dim: int
+    offsets: Optional[np.ndarray] = None
+    weights: Optional[np.ndarray] = None
+
+    @property
+    def num_rows(self) -> int:
+        return len(self.indptr) - 1
+
+    def to_dense(self) -> np.ndarray:
+        X = np.zeros((self.num_rows, self.dim), dtype=self.values.dtype)
+        for r in range(self.num_rows):
+            lo, hi = self.indptr[r], self.indptr[r + 1]
+            X[r, self.indices[lo:hi]] = self.values[lo:hi]
+        return X
+
+
+def read_libsvm(
+    path: str,
+    *,
+    num_features: Optional[int] = None,
+    add_intercept: bool = True,
+    zero_based: bool = False,
+    binary_labels_to_01: bool = True,
+    dtype=np.float32,
+) -> CSRDataset:
+    """Parse a LibSVM file.
+
+    LibSVM labels for classification are {-1, +1}; the reference maps them to
+    {0, 1} responses (TrainingExampleAvro `response`), controlled here by
+    `binary_labels_to_01`. The intercept, when requested, is appended as the
+    last column (index `dim-1`) with value 1.0 — matching the reference's
+    INTERCEPT pseudo-feature added per feature shard
+    (AvroDataReader.readFeaturesFromRecord).
+    """
+    labels = []
+    indptr = [0]
+    indices: list = []
+    values: list = []
+    max_idx = -1
+    with open(path) as f:
+        for line in f:
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            labels.append(float(parts[0]))
+            for tok in parts[1:]:
+                k, v = tok.split(":")
+                idx = int(k) - (0 if zero_based else 1)
+                indices.append(idx)
+                values.append(float(v))
+                max_idx = max(max_idx, idx)
+            indptr.append(len(indices))
+
+    base_dim = (max_idx + 1) if num_features is None else num_features
+    dim = base_dim + (1 if add_intercept else 0)
+    y = np.asarray(labels, dtype)
+    if binary_labels_to_01 and set(np.unique(y)) <= {-1.0, 1.0}:
+        y = (y > 0).astype(dtype)
+
+    indptr_a = np.asarray(indptr, np.int64)
+    indices_a = np.asarray(indices, np.int32)
+    values_a = np.asarray(values, dtype)
+    if add_intercept:
+        n = len(y)
+        new_indptr = indptr_a + np.arange(n + 1, dtype=np.int64)
+        new_indices = np.empty(len(indices_a) + n, np.int32)
+        new_values = np.empty(len(values_a) + n, dtype)
+        for r in range(n):
+            lo, hi = indptr_a[r], indptr_a[r + 1]
+            nlo = new_indptr[r]
+            new_indices[nlo : nlo + (hi - lo)] = indices_a[lo:hi]
+            new_values[nlo : nlo + (hi - lo)] = values_a[lo:hi]
+            new_indices[nlo + (hi - lo)] = dim - 1
+            new_values[nlo + (hi - lo)] = 1.0
+        indptr_a, indices_a, values_a = new_indptr, new_indices, new_values
+
+    return CSRDataset(indptr_a, indices_a, values_a, y, dim)
+
+
+def write_libsvm(path: str, data: CSRDataset, *, zero_based: bool = False) -> None:
+    off = 0 if zero_based else 1
+    with open(path, "w") as f:
+        for r in range(data.num_rows):
+            lo, hi = data.indptr[r], data.indptr[r + 1]
+            feats = " ".join(
+                f"{int(i) + off}:{v:g}"
+                for i, v in zip(data.indices[lo:hi], data.values[lo:hi])
+            )
+            f.write(f"{data.labels[r]:g} {feats}\n")

@@ -1,0 +1,122 @@
+"""Per-feature summary statistics.
+
+Counterpart of photon-lib stat/FeatureDataStatistics.scala:44-139, which wraps
+spark.mllib's MultivariateStatisticalSummary. Here the summary is one jitted
+reduction over the (sharded) design matrix — count, mean, variance, numNonzeros,
+max, min, normL1, normL2, meanAbs per feature — feeding normalization contexts
+and the feature-summary output file.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.data.containers import Features, SparseFeatures
+
+Array = jax.Array
+
+
+class FeatureDataStatistics(NamedTuple):
+    count: Array  # scalar: number of (weighted) rows
+    mean: Array  # (D,)
+    variance: Array  # (D,)
+    num_nonzeros: Array  # (D,)
+    max: Array  # (D,)
+    min: Array  # (D,)
+    norm_l1: Array  # (D,)
+    norm_l2: Array  # (D,)
+    mean_abs: Array  # (D,)
+    intercept_index: Optional[int] = None
+
+    @property
+    def max_abs(self) -> Array:
+        return jnp.maximum(jnp.abs(self.max), jnp.abs(self.min))
+
+
+def summarize(features: Features, *, intercept_index: Optional[int] = None) -> FeatureDataStatistics:
+    """Compute the summary. Unweighted, matching the reference (it summarizes
+    raw feature vectors before weighting — FeatureDataStatistics.scala:100-113).
+
+    For sparse input, absent entries count as zeros (spark.mllib semantics):
+    min/max consider implicit zeros whenever a feature has any zero entry.
+    """
+    if isinstance(features, SparseFeatures):
+        return _summarize_sparse(features, intercept_index)
+    X = features
+    n = X.shape[0]
+    count = jnp.asarray(float(n), X.dtype)
+    mean = jnp.mean(X, axis=0)
+    # Sample variance matching mllib (unbiased, n-1 denominator).
+    var = jnp.var(X, axis=0) * (n / max(n - 1, 1))
+    nnz = jnp.sum(X != 0.0, axis=0).astype(X.dtype)
+    return FeatureDataStatistics(
+        count=count,
+        mean=mean,
+        variance=var,
+        num_nonzeros=nnz,
+        max=jnp.max(X, axis=0),
+        min=jnp.min(X, axis=0),
+        norm_l1=jnp.sum(jnp.abs(X), axis=0),
+        norm_l2=jnp.sqrt(jnp.sum(jnp.square(X), axis=0)),
+        mean_abs=jnp.mean(jnp.abs(X), axis=0),
+        intercept_index=intercept_index,
+    )
+
+
+def _summarize_sparse(
+    features: SparseFeatures, intercept_index: Optional[int]
+) -> FeatureDataStatistics:
+    """Sparse-native summary: segment reductions over the ELL entries plus
+    implicit-zero arithmetic — never densifies (the spark.mllib summarizer the
+    reference wraps is likewise sparse-aware). Padding slots (value 0) drop
+    out of every sum and of the nonzero max/min via masking."""
+    n = features.values.shape[0]
+    dim = features.dim
+    dtype = features.values.dtype
+    idx = features.indices.reshape(-1)
+    val = features.values.reshape(-1)
+    nonzero = val != 0.0
+
+    seg = lambda v: jax.ops.segment_sum(v, idx, num_segments=dim)
+    sum_x = seg(val)
+    sum_x2 = seg(jnp.square(val))
+    sum_abs = seg(jnp.abs(val))
+    nnz = seg(nonzero.astype(dtype))
+
+    neg_inf = jnp.asarray(-jnp.inf, dtype)
+    max_nz = jax.ops.segment_max(
+        jnp.where(nonzero, val, neg_inf), idx, num_segments=dim
+    )
+    min_nz = -jax.ops.segment_max(
+        jnp.where(nonzero, -val, neg_inf), idx, num_segments=dim
+    )
+    has_implicit_zero = nnz < n
+    has_nz = nnz > 0
+    maximum = jnp.where(
+        has_nz,
+        jnp.where(has_implicit_zero, jnp.maximum(max_nz, 0.0), max_nz),
+        0.0,
+    )
+    minimum = jnp.where(
+        has_nz,
+        jnp.where(has_implicit_zero, jnp.minimum(min_nz, 0.0), min_nz),
+        0.0,
+    )
+
+    mean = sum_x / n
+    var = (sum_x2 - n * jnp.square(mean)) / max(n - 1, 1)
+    return FeatureDataStatistics(
+        count=jnp.asarray(float(n), dtype),
+        mean=mean,
+        variance=jnp.maximum(var, 0.0),
+        num_nonzeros=nnz,
+        max=maximum,
+        min=minimum,
+        norm_l1=sum_abs,
+        norm_l2=jnp.sqrt(sum_x2),
+        mean_abs=sum_abs / n,
+        intercept_index=intercept_index,
+    )

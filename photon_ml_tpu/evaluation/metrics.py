@@ -1,0 +1,133 @@
+"""Core metric computations as weighted, mask-aware jax reductions.
+
+Counterpart of the reference's evaluator set (photon-api evaluation/
+AreaUnderROCCurveEvaluator.scala:39, AreaUnderPRCurveEvaluator.scala,
+RMSEEvaluator.scala:38, LogisticLossEvaluator.scala:40,
+PoissonLossEvaluator.scala:40, SquaredLossEvaluator.scala,
+SmoothedHingeLossEvaluator.scala, AreaUnderROCCurveLocalEvaluator.scala:30-72,
+PrecisionAtKLocalEvaluator.scala:76). Where the reference computes AUC with
+Spark's BinaryClassificationMetrics (distributed sort + trapezoid), here AUC
+is a rank-statistic computed with one sort — O(n log n) on device, exact for
+distinct scores and tie-corrected, equivalent to the weighted trapezoid rule.
+
+All metrics accept a weight vector that doubles as the padding mask, so the
+same code evaluates ragged per-group blocks under vmap (the MultiEvaluator
+path in evaluation/suite.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.ops import losses
+
+Array = jax.Array
+
+
+def _masked_weights(weights: Array | None, like: Array) -> Array:
+    if weights is None:
+        return jnp.ones_like(like)
+    return weights.astype(like.dtype)
+
+
+def area_under_roc_curve(scores: Array, labels: Array, weights: Array | None = None) -> Array:
+    """Weighted AUC-ROC via the rank statistic with tie correction.
+
+    AUC = (sum over positives of average rank weight below) / (W+ * W-);
+    equivalent to the trapezoid AUC the reference computes
+    (AreaUnderROCCurveLocalEvaluator.scala:30-72 sorts by score descending and
+    applies trapezoid areas, handling ties by grouping — the rank-with-ties
+    formulation below is the same quantity).
+    """
+    w = _masked_weights(weights, scores)
+    pos = jnp.where(labels > 0.5, w, 0.0)
+    neg = jnp.where(labels > 0.5, 0.0, w)
+    order = jnp.argsort(scores)
+    s = scores[order]
+    p = pos[order]
+    ng = neg[order]
+    # cumulative negative weight strictly below + half the tied negative weight
+    cneg = jnp.cumsum(ng)
+    # group ties: for each element, total negative weight at equal score and
+    # negative weight strictly below.
+    # Using segment boundaries: same-score runs share the same "below" value.
+    is_new = jnp.concatenate([jnp.ones(1, bool), s[1:] > s[:-1]])
+    run_id = jnp.cumsum(is_new) - 1
+    # strictly-below cumulative negative weight at the start of each run
+    run_start_cneg = jnp.where(is_new, cneg - ng, 0.0)
+    below_run = jax.ops.segment_max(
+        jnp.where(is_new, run_start_cneg, -jnp.inf), run_id, num_segments=s.shape[0]
+    )[run_id]
+    total_neg_in_run = jax.ops.segment_sum(ng, run_id, num_segments=s.shape[0])[run_id]
+    auc_num = jnp.sum(p * (below_run + 0.5 * total_neg_in_run))
+    denom = jnp.sum(pos) * jnp.sum(neg)
+    return jnp.where(denom > 0.0, auc_num / denom, 0.5)
+
+
+def area_under_pr_curve(scores: Array, labels: Array, weights: Array | None = None) -> Array:
+    """Weighted area under the precision-recall curve (average-precision style,
+    linear interpolation matching spark mllib's AreaUnderPRCurve trapezoid)."""
+    w = _masked_weights(weights, scores)
+    order = jnp.argsort(-scores)
+    lab = labels[order] > 0.5
+    ww = w[order]
+    tp = jnp.cumsum(jnp.where(lab, ww, 0.0))
+    fp = jnp.cumsum(jnp.where(lab, 0.0, ww))
+    total_pos = tp[-1]
+    precision = jnp.where(tp + fp > 0.0, tp / (tp + fp), 1.0)
+    recall = jnp.where(total_pos > 0.0, tp / total_pos, 0.0)
+    # Spark prepends (0, p(first)) — trapezoid over recall steps.
+    prev_recall = jnp.concatenate([jnp.zeros(1, recall.dtype), recall[:-1]])
+    prev_precision = jnp.concatenate([precision[:1], precision[:-1]])
+    area = jnp.sum((recall - prev_recall) * 0.5 * (precision + prev_precision))
+    return jnp.where(total_pos > 0.0, area, 0.0)
+
+
+def rmse(scores: Array, labels: Array, weights: Array | None = None) -> Array:
+    """Weighted root-mean-squared error (RMSEEvaluator.scala:38)."""
+    w = _masked_weights(weights, scores)
+    tot = jnp.sum(w)
+    mse = jnp.sum(w * jnp.square(scores - labels)) / jnp.maximum(tot, 1e-30)
+    return jnp.sqrt(mse)
+
+
+def _mean_pointwise(loss_fn, scores, labels, weights):
+    w = _masked_weights(weights, scores)
+    tot = jnp.sum(w)
+    return jnp.sum(w * loss_fn(scores, labels)) / jnp.maximum(tot, 1e-30)
+
+
+def logistic_loss(scores: Array, labels: Array, weights: Array | None = None) -> Array:
+    """Mean weighted logistic loss on raw margins (LogisticLossEvaluator.scala:40)."""
+    return _mean_pointwise(losses.LOGISTIC.loss, scores, labels, weights)
+
+
+def poisson_loss(scores: Array, labels: Array, weights: Array | None = None) -> Array:
+    return _mean_pointwise(losses.POISSON.loss, scores, labels, weights)
+
+
+def squared_loss(scores: Array, labels: Array, weights: Array | None = None) -> Array:
+    return _mean_pointwise(losses.SQUARED.loss, scores, labels, weights)
+
+
+def smoothed_hinge_loss(scores: Array, labels: Array, weights: Array | None = None) -> Array:
+    return _mean_pointwise(losses.SMOOTHED_HINGE.loss, scores, labels, weights)
+
+
+def precision_at_k(
+    k: int, scores: Array, labels: Array, weights: Array | None = None
+) -> Array:
+    """Precision@k for one group (PrecisionAtKLocalEvaluator.scala:76).
+
+    Weights serve only as the padding mask here (masked rows rank last); the
+    denominator is k unconditionally, matching the reference — a group with
+    fewer than k rows is penalized, it does not renormalize.
+    """
+    w = _masked_weights(weights, scores)
+    masked_scores = jnp.where(w > 0.0, scores, -jnp.inf)
+    order = jnp.argsort(-masked_scores)
+    topk = order[:k]
+    valid = w[topk] > 0.0
+    hits = jnp.sum(jnp.where(valid & (labels[topk] > 0.5), 1.0, 0.0))
+    return hits / k

@@ -1,0 +1,146 @@
+"""GAME model containers: coefficients, per-coordinate models, composite model.
+
+Counterpart of:
+  - photon-lib model/Coefficients.scala:31 (means + optional variances)
+  - photon-api model/FixedEffectModel.scala:33 (broadcast GLM)
+  - photon-api model/RandomEffectModel.scala:36-239 (RDD[(REId, GLM)])
+  - photon-lib model/GameModel.scala:32-110 (Map[CoordinateId -> model],
+    score = sum of coordinate scores)
+  - photon-api supervised/* link-function wrappers (GeneralizedLinearModel.scala:33)
+
+TPU-native translation: a random-effect model is not a distributed collection
+of tiny JVM objects but one dense (num_entities, dim) coefficient matrix
+sharded over the mesh's entity axis; scoring is a gather of per-row entity
+indices + batched dot products instead of an RDD join. The fixed-effect model
+is a single replicated vector. A GameModel scores a dataset by summing
+coordinate scores in a fixed sample order — the reference's by-uid score-RDD
+joins become pure elementwise adds because every coordinate shares the same
+static sample layout.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Mapping, Optional
+
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.data.containers import LabeledData
+from photon_ml_tpu.ops import objective
+from photon_ml_tpu.ops.losses import mean_for_task
+from photon_ml_tpu.types import TaskType
+
+Array = jax.Array
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class Coefficients:
+    """Model coefficients: means + optional variances (Coefficients.scala:31).
+
+    The leading axes may be batched: (D,) for a fixed effect, (E, D) for a
+    random-effect block.
+    """
+
+    means: Array
+    variances: Optional[Array] = None
+
+    @property
+    def dim(self) -> int:
+        return self.means.shape[-1]
+
+    def compute_score(self, x: Array) -> Array:
+        """means . x (Coefficients.computeScore, Coefficients.scala:53-60)."""
+        return jnp.einsum("...d,...d->...", self.means, x)
+
+
+def zero_coefficients(dim: int, dtype=jnp.float32) -> Coefficients:
+    return Coefficients(jnp.zeros((dim,), dtype))
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class FixedEffectModel:
+    """One GLM applied to every sample (FixedEffectModel.scala:33).
+
+    `task` determines the link function for mean-response scoring
+    (GeneralizedLinearModel.computeMean).
+    """
+
+    coefficients: Coefficients
+    task: TaskType = dataclasses.field(metadata=dict(static=True))
+
+    def score(self, data: LabeledData) -> Array:
+        """Raw margins x.w (no offset), matching DatumScoringModel semantics —
+        offsets/other-coordinate scores are added by the caller."""
+        return objective.compute_margins(
+            self.coefficients.means,
+            dataclasses.replace(data, offsets=jnp.zeros_like(data.offsets)),
+            None,
+        )
+
+    def predict_mean(self, data: LabeledData) -> Array:
+        return mean_for_task(self.task, self.score(data) + data.offsets)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class RandomEffectModel:
+    """Per-entity GLMs as one (num_entities, dim) matrix
+    (RandomEffectModel.scala:36-239).
+
+    Row e holds the coefficients of entity e in this coordinate's (projected)
+    feature space. Samples carry an `entity_row` index; scoring gathers the
+    matching coefficient row per sample — the RDD re-key + join of the
+    reference (RandomEffectModel.scala:239+) becomes a gather. Samples whose
+    entity was unseen at training time use row `num_entities` which is pinned
+    to zeros (the reference scores those with the prior/zero model).
+    """
+
+    coefficients_matrix: Array  # (E + 1, D); last row all-zero for unseen
+    variances_matrix: Optional[Array]
+    task: TaskType = dataclasses.field(metadata=dict(static=True))
+
+    @property
+    def num_entities(self) -> int:
+        return self.coefficients_matrix.shape[0] - 1
+
+    @property
+    def dim(self) -> int:
+        return self.coefficients_matrix.shape[-1]
+
+    def score_rows(self, features: Array, entity_rows: Array) -> Array:
+        """Score dense per-sample features (N, D) against their entity rows."""
+        w = self.coefficients_matrix[entity_rows]
+        return jnp.einsum("nd,nd->n", features, w)
+
+
+@dataclasses.dataclass
+class GameModel:
+    """coordinate id -> model (GameModel.scala:32); host-side container.
+
+    Scoring sums per-coordinate scores over a shared sample layout
+    (GameModel.scala:99-110); done by GameTransformer / scoring drivers which
+    own the per-coordinate datasets.
+    """
+
+    models: Dict[str, object]
+
+    def __getitem__(self, cid: str):
+        return self.models[cid]
+
+    def __contains__(self, cid: str) -> bool:
+        return cid in self.models
+
+    def items(self):
+        return self.models.items()
+
+    def updated(self, cid: str, model) -> "GameModel":
+        new = dict(self.models)
+        new[cid] = model
+        return GameModel(new)
+
+    @property
+    def coordinate_ids(self):
+        return list(self.models.keys())

@@ -1,0 +1,152 @@
+"""Pointwise GLM losses l(z, y) with first and second derivatives in z.
+
+TPU-native counterpart of the reference's `PointwiseLossFunction` hierarchy
+(photon-api function/glm/LogisticLossFunction.scala:45-90,
+PoissonLossFunction.scala:40-52, SquaredLossFunction.scala:42-54,
+function/svm/SmoothedHingeLossFunction.scala:33-43). Instead of per-datum
+Scala methods called inside a Spark aggregator, each loss here is a set of
+vectorized jax functions over a whole margin array; value/gradient/Hessian
+reductions are built on top of these in `photon_ml_tpu.ops.objective`.
+
+All functions take `z` (margin = x.w + offset) and `y` (label) arrays of equal
+shape and return an array of the same shape. Classification labels are {0, 1}
+(values > 0.5 treated as positive, mirroring MathConst.POSITIVE_RESPONSE_THRESHOLD).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.types import TaskType
+
+Array = jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class PointwiseLoss:
+    """l(z, y) plus dl/dz and d2l/dz2, all elementwise-vectorized.
+
+    `has_hessian=False` marks losses usable only with first-order optimizers
+    (the reference restricts smoothed hinge to LBFGS the same way —
+    DistributedSmoothedHingeLossFunction.scala:41 is only a DiffFunction).
+    """
+
+    name: str
+    loss: Callable[[Array, Array], Array]
+    d1: Callable[[Array, Array], Array]
+    d2: Callable[[Array, Array], Array]
+    has_hessian: bool = True
+
+
+def _logistic_loss(z: Array, y: Array) -> Array:
+    # log(1 + exp(-s*z)) with s = +-1, computed as softplus(-s*z) which is
+    # numerically stable for large |z| (reference uses MathUtils.log1pExp).
+    s = jnp.where(y > 0.5, 1.0, -1.0).astype(z.dtype)
+    return jax.nn.softplus(-s * z)
+
+
+def _logistic_d1(z: Array, y: Array) -> Array:
+    # dl/dz = sigmoid(z) - 1 for positives, sigmoid(z) for negatives.
+    pos = jnp.where(y > 0.5, 1.0, 0.0).astype(z.dtype)
+    return jax.nn.sigmoid(z) - pos
+
+
+def _logistic_d2(z: Array, y: Array) -> Array:
+    del y
+    s = jax.nn.sigmoid(z)
+    return s * (1.0 - s)
+
+
+def _squared_loss(z: Array, y: Array) -> Array:
+    d = z - y
+    return 0.5 * d * d
+
+
+def _squared_d1(z: Array, y: Array) -> Array:
+    return z - y
+
+
+def _squared_d2(z: Array, y: Array) -> Array:
+    return jnp.ones_like(z)
+
+
+def _poisson_loss(z: Array, y: Array) -> Array:
+    return jnp.exp(z) - y * z
+
+
+def _poisson_d1(z: Array, y: Array) -> Array:
+    return jnp.exp(z) - y
+
+
+def _poisson_d2(z: Array, y: Array) -> Array:
+    del y
+    return jnp.exp(z)
+
+
+def _smoothed_hinge_loss(z: Array, y: Array) -> Array:
+    # Rennie's smoothed hinge on the signed margin m = s*z
+    # (reference SmoothedHingeLossFunction.scala:33-43):
+    #   m <= 0      -> 0.5 - m
+    #   0 < m < 1   -> 0.5 * (1 - m)^2
+    #   m >= 1      -> 0
+    s = jnp.where(y > 0.5, 1.0, -1.0).astype(z.dtype)
+    m = s * z
+    return jnp.where(m <= 0.0, 0.5 - m, jnp.where(m < 1.0, 0.5 * (1.0 - m) ** 2, 0.0))
+
+
+def _smoothed_hinge_d1(z: Array, y: Array) -> Array:
+    # dl/dm in {-1, m-1, 0}; chain rule dl/dz = s * dl/dm.
+    s = jnp.where(y > 0.5, 1.0, -1.0).astype(z.dtype)
+    m = s * z
+    dm = jnp.where(m < 0.0, -1.0, jnp.where(m < 1.0, m - 1.0, 0.0))
+    return s * dm
+
+
+def _smoothed_hinge_d2(z: Array, y: Array) -> Array:
+    # Second derivative exists a.e.: 1 on (0, 1), else 0. The reference never
+    # uses it (smoothed hinge is first-order only); provided for completeness.
+    s = jnp.where(y > 0.5, 1.0, -1.0).astype(z.dtype)
+    m = s * z
+    return jnp.where((m > 0.0) & (m < 1.0), 1.0, 0.0)
+
+
+LOGISTIC = PointwiseLoss("logistic", _logistic_loss, _logistic_d1, _logistic_d2)
+SQUARED = PointwiseLoss("squared", _squared_loss, _squared_d1, _squared_d2)
+POISSON = PointwiseLoss("poisson", _poisson_loss, _poisson_d1, _poisson_d2)
+SMOOTHED_HINGE = PointwiseLoss(
+    "smoothed_hinge",
+    _smoothed_hinge_loss,
+    _smoothed_hinge_d1,
+    _smoothed_hinge_d2,
+    has_hessian=False,
+)
+
+_TASK_LOSSES = {
+    TaskType.LOGISTIC_REGRESSION: LOGISTIC,
+    TaskType.LINEAR_REGRESSION: SQUARED,
+    TaskType.POISSON_REGRESSION: POISSON,
+    TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM: SMOOTHED_HINGE,
+}
+
+
+def loss_for_task(task: TaskType) -> PointwiseLoss:
+    """TaskType -> loss, mirroring GLMLossFunction.scala:24-34."""
+    return _TASK_LOSSES[task]
+
+
+def mean_for_task(task: TaskType, z: Array) -> Array:
+    """Link-function mean response given margins.
+
+    Mirrors GeneralizedLinearModel.computeMean overrides: sigmoid for logistic,
+    identity for linear, exp for Poisson, raw margin for smoothed hinge
+    (photon-api supervised/*Model.scala).
+    """
+    if task == TaskType.LOGISTIC_REGRESSION:
+        return jax.nn.sigmoid(z)
+    if task == TaskType.POISSON_REGRESSION:
+        return jnp.exp(z)
+    return z

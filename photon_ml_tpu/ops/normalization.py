@@ -1,0 +1,134 @@
+"""Feature normalization as coefficient algebra.
+
+TPU-native counterpart of NormalizationContext.scala:37-107 and
+NormalizationType.scala:26-41. The key trick is preserved from the reference
+(ValueAndGradientAggregator.scala:36-80): training never materializes
+normalized feature data. For the affine transform x' = (x - shift) * factor
+(intercept exempt), margins over *raw* data are computed with
+
+    z = x . (w * factor) - shift . (w * factor) + w_intercept-term
+
+so normalization costs one elementwise multiply of the coefficient vector per
+objective evaluation instead of a rewrite of the dataset. On TPU this keeps
+the design matrix immutable in HBM and lets the effective-coefficient product
+fuse into the matmul.
+
+Coefficients learned in normalized space are mapped back with
+`model_to_original_space` (reference modelToOriginalSpace,
+NormalizationContext.scala:73-107).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.types import NormalizationType
+
+Array = jax.Array
+
+
+class NormalizationContext(NamedTuple):
+    """Affine feature transform x' = (x - shifts) * factors.
+
+    `factors`/`shifts` are None for the identity transform (NONE). The
+    intercept column, if any, must have factor 1 and shift 0 — enforced by
+    `from_feature_stats`. A None context is also accepted everywhere.
+    """
+
+    factors: Optional[Array] = None
+    shifts: Optional[Array] = None
+    intercept_index: Optional[int] = None
+
+    @property
+    def is_identity(self) -> bool:
+        return self.factors is None and self.shifts is None
+
+    def effective_coefficients(self, w: Array) -> Array:
+        """w * factors (identity if no factors)."""
+        return w if self.factors is None else w * self.factors
+
+    def margin_shift(self, w: Array) -> Array:
+        """Scalar shift term -shifts . (w * factors), added to every margin."""
+        if self.shifts is None:
+            return jnp.zeros((), dtype=w.dtype)
+        return -jnp.dot(self.shifts, self.effective_coefficients(w))
+
+    def model_to_original_space(self, w: Array) -> Array:
+        """Map coefficients trained in normalized space to original space.
+
+        Original-space weights are w*factor; the shift contribution folds into
+        the intercept (reference NormalizationContext.scala:73-90).
+        """
+        if self.is_identity:
+            return w
+        w_orig = self.effective_coefficients(w)
+        if self.shifts is not None:
+            if self.intercept_index is None:
+                raise ValueError("Normalization with shifts requires an intercept")
+            w_orig = w_orig.at[self.intercept_index].add(-jnp.dot(self.shifts, w_orig))
+        return w_orig
+
+    def model_to_transformed_space(self, w: Array) -> Array:
+        """Inverse of `model_to_original_space` (reference :91-107)."""
+        if self.is_identity:
+            return w
+        w_t = w
+        if self.shifts is not None:
+            if self.intercept_index is None:
+                raise ValueError("Normalization with shifts requires an intercept")
+            w_t = w_t.at[self.intercept_index].add(jnp.dot(self.shifts, w))
+        return w_t / self.factors if self.factors is not None else w_t
+
+
+def no_normalization() -> NormalizationContext:
+    return NormalizationContext(None, None, None)
+
+
+def from_feature_stats(
+    norm_type: NormalizationType,
+    *,
+    mean: Array,
+    variance: Array,
+    max_abs: Array,
+    intercept_index: Optional[int] = None,
+) -> NormalizationContext:
+    """Build a context from per-feature statistics.
+
+    Mirrors NormalizationContext.apply(NormalizationType, FeatureDataStatistics)
+    — NormalizationContext.scala:116-150:
+      SCALE_WITH_STANDARD_DEVIATION: factor = 1/std
+      SCALE_WITH_MAX_MAGNITUDE:      factor = 1/max|x|
+      STANDARDIZATION:               factor = 1/std, shift = mean
+    Zero std/max features get factor 1 (avoid division by zero). The intercept
+    column is exempted (factor 1, shift 0).
+    """
+    if norm_type == NormalizationType.NONE:
+        return no_normalization()
+
+    std = jnp.sqrt(variance)
+    if norm_type == NormalizationType.SCALE_WITH_STANDARD_DEVIATION:
+        factors, shifts = _safe_inv(std), None
+    elif norm_type == NormalizationType.SCALE_WITH_MAX_MAGNITUDE:
+        factors, shifts = _safe_inv(max_abs), None
+    elif norm_type == NormalizationType.STANDARDIZATION:
+        if intercept_index is None:
+            raise ValueError(
+                "STANDARDIZATION requires an intercept column "
+                "(reference NormalizationContext.scala:139-144)"
+            )
+        factors, shifts = _safe_inv(std), mean
+    else:
+        raise ValueError(f"Unknown normalization type {norm_type}")
+
+    if intercept_index is not None:
+        factors = factors.at[intercept_index].set(1.0)
+        if shifts is not None:
+            shifts = shifts.at[intercept_index].set(0.0)
+    return NormalizationContext(factors, shifts, intercept_index)
+
+
+def _safe_inv(x: Array) -> Array:
+    return jnp.where(x > 0.0, 1.0 / jnp.where(x > 0.0, x, 1.0), 1.0)

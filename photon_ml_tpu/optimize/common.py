@@ -1,0 +1,111 @@
+"""Optimizer state, results, and convergence criteria.
+
+Counterpart of the reference's Optimizer template
+(photon-lib optimization/Optimizer.scala:36-249, OptimizerState.scala:35,
+util/ConvergenceReason.scala, OptimizationStatesTracker.scala). The JVM
+template-method loop becomes: each optimizer is a pure function
+`minimize(fun, w0, ...) -> OptResult` built on lax.while_loop, with
+convergence encoded as an integer reason code inside the carry so the whole
+thing jits and vmaps. State tracking (per-iteration loss/time history kept by
+OptimizationStatesTracker) is returned as fixed-size arrays when requested.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+class ConvergenceReason(enum.IntEnum):
+    """Why optimization stopped (reference util/ConvergenceReason.scala).
+
+    Values are stable — they are stored in OptResult arrays.
+    """
+
+    NOT_CONVERGED = 0
+    MAX_ITERATIONS = 1
+    FUNCTION_VALUES_CONVERGED = 2
+    GRADIENT_CONVERGED = 3
+    OBJECTIVE_NOT_IMPROVING = 4
+
+
+class OptResult(NamedTuple):
+    """Terminal optimizer state (reference OptimizerState + convergenceReason).
+
+    All fields are arrays so a vmapped solve returns per-problem results.
+    `loss_history` is all-NaN-padded beyond `iterations` when tracking is on,
+    otherwise a zero-length array (reference isTrackingState,
+    Optimizer.scala:46-99).
+    """
+
+    coefficients: Array
+    loss: Array
+    gradient_norm: Array
+    iterations: Array
+    reason: Array  # int32 ConvergenceReason code
+    loss_history: Array
+
+    @property
+    def converged(self) -> Array:
+        return self.reason != ConvergenceReason.NOT_CONVERGED
+
+
+def check_convergence(
+    *,
+    loss: Array,
+    prev_loss: Array,
+    init_loss: Array,
+    grad_norm: Array,
+    init_grad_norm: Array,
+    iteration: Array,
+    max_iterations: int,
+    tolerance: float,
+) -> Array:
+    """Reference Optimizer.scala:135-149 convergence tests, as a reason code.
+
+    - FUNCTION_VALUES_CONVERGED: |loss - prev_loss| <= tolerance * |init_loss|
+    - GRADIENT_CONVERGED:        ||g||_2 <= tolerance * ||g0||_2
+    - MAX_ITERATIONS:            iteration >= max_iterations
+    Priority mirrors the reference's check order (function values first).
+    """
+    dtype = loss.dtype
+    tol = jnp.asarray(tolerance, dtype)
+    func_conv = jnp.abs(loss - prev_loss) <= tol * jnp.abs(init_loss)
+    grad_conv = grad_norm <= tol * init_grad_norm
+    reason = jnp.where(
+        func_conv,
+        ConvergenceReason.FUNCTION_VALUES_CONVERGED,
+        jnp.where(
+            grad_conv,
+            ConvergenceReason.GRADIENT_CONVERGED,
+            jnp.where(
+                iteration >= max_iterations,
+                ConvergenceReason.MAX_ITERATIONS,
+                ConvergenceReason.NOT_CONVERGED,
+            ),
+        ),
+    )
+    return reason.astype(jnp.int32)
+
+
+def record_loss(history: Array, iteration: Array, loss: Array) -> Array:
+    """Append to the fixed-size loss history if tracking is enabled."""
+    if history.shape[0] == 0:
+        return history
+    return history.at[iteration].set(loss)
+
+
+def empty_history(max_iterations: int, tracking: bool, dtype) -> Array:
+    n = max_iterations + 1 if tracking else 0
+    return jnp.full((n,), jnp.nan, dtype=dtype)
+
+
+def safe_div(a: Array, b: Array, eps: float = 0.0) -> Array:
+    """a / b with 0 where |b| is (near-)zero — guards CG/line-search ratios."""
+    bad = jnp.abs(b) <= eps
+    return jnp.where(bad, 0.0, a / jnp.where(bad, 1.0, b))

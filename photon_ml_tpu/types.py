@@ -1,0 +1,97 @@
+"""Shared type aliases and enums.
+
+Mirrors the reference's shared enums/aliases (photon-lib Types.scala:9-25,
+TaskType.scala:20-24) in Python form. Entity/coordinate ids are strings on the
+host side; on device everything is integer-indexed.
+"""
+
+from __future__ import annotations
+
+import enum
+
+# Host-side aliases (device-side everything is an int index).
+UniqueSampleId = int
+CoordinateId = str
+RandomEffectType = str
+RandomEffectId = str
+FeatureShardId = str
+
+
+class TaskType(enum.Enum):
+    """Training objective family (reference: TaskType.scala:20-24)."""
+
+    LINEAR_REGRESSION = "LINEAR_REGRESSION"
+    POISSON_REGRESSION = "POISSON_REGRESSION"
+    LOGISTIC_REGRESSION = "LOGISTIC_REGRESSION"
+    SMOOTHED_HINGE_LOSS_LINEAR_SVM = "SMOOTHED_HINGE_LOSS_LINEAR_SVM"
+
+    @classmethod
+    def parse(cls, name: str) -> "TaskType":
+        return cls[name.strip().upper()]
+
+
+class OptimizerType(enum.Enum):
+    """Reference: OptimizerType.scala."""
+
+    LBFGS = "LBFGS"
+    OWLQN = "OWLQN"  # selected automatically when L1 regularization is active
+    LBFGSB = "LBFGSB"  # box-constrained (projected) LBFGS
+    TRON = "TRON"
+
+    @classmethod
+    def parse(cls, name: str) -> "OptimizerType":
+        return cls[name.strip().upper()]
+
+
+class RegularizationType(enum.Enum):
+    """Reference: RegularizationType.scala."""
+
+    NONE = "NONE"
+    L1 = "L1"
+    L2 = "L2"
+    ELASTIC_NET = "ELASTIC_NET"
+
+    @classmethod
+    def parse(cls, name: str) -> "RegularizationType":
+        return cls[name.strip().upper()]
+
+
+class NormalizationType(enum.Enum):
+    """Reference: NormalizationType.scala:26-41."""
+
+    NONE = "NONE"
+    SCALE_WITH_STANDARD_DEVIATION = "SCALE_WITH_STANDARD_DEVIATION"
+    SCALE_WITH_MAX_MAGNITUDE = "SCALE_WITH_MAX_MAGNITUDE"
+    STANDARDIZATION = "STANDARDIZATION"
+
+    @classmethod
+    def parse(cls, name: str) -> "NormalizationType":
+        return cls[name.strip().upper()]
+
+
+class VarianceComputationType(enum.Enum):
+    """Reference: VarianceComputationType.scala (NONE/SIMPLE/FULL)."""
+
+    NONE = "NONE"
+    SIMPLE = "SIMPLE"  # 1 / diag(Hessian)
+    FULL = "FULL"  # diag(inverse Hessian) via Cholesky
+
+    @classmethod
+    def parse(cls, name: str) -> "VarianceComputationType":
+        return cls[name.strip().upper()]
+
+
+class DataValidationType(enum.Enum):
+    """Reference: DataValidationType.scala."""
+
+    VALIDATE_FULL = "VALIDATE_FULL"
+    VALIDATE_SAMPLE = "VALIDATE_SAMPLE"
+    VALIDATE_DISABLED = "VALIDATE_DISABLED"
+
+
+class ProjectorType(enum.Enum):
+    """Reference: ProjectorType.scala (INDEX_MAP | RANDOM | IDENTITY)."""
+
+    INDEX_MAP = "INDEX_MAP"
+    RANDOM = "RANDOM"
+    IDENTITY = "IDENTITY"

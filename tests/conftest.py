@@ -1,0 +1,35 @@
+"""Test harness configuration.
+
+Mirrors the reference's SparkTestUtils strategy (photon-test-utils
+SparkTestUtils.scala:55-75): where the reference spins up a local[*] Spark
+cluster so shuffles/broadcasts/treeAggregate run the real code paths with
+threads as executors, we force an 8-device virtual CPU mesh so pjit/shard_map
+and the XLA collectives run the real multi-chip code paths on one host.
+
+Env vars must be set before jax initializes a backend. Some environments
+additionally install a TPU plugin that re-forces `jax_platforms` at interpreter
+startup (sitecustomize), so the config is also overridden after import —
+that keeps backend init strictly on the virtual CPU mesh.
+"""
+
+import os
+
+_PLATFORM = os.environ.get("PHOTON_TEST_PLATFORM", "cpu")
+os.environ["JAX_PLATFORMS"] = _PLATFORM
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", _PLATFORM)
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(20260729)

@@ -1,0 +1,150 @@
+"""Evaluation tests with sklearn as the external oracle (the reference's
+equivalent role is played by spark.mllib BinaryClassificationMetrics)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn import metrics as skm
+
+from photon_ml_tpu.evaluation import metrics
+from photon_ml_tpu.evaluation.suite import (
+    EvaluationSuite,
+    EvaluatorType,
+    better_than,
+    build_grouped_index,
+    default_evaluator_for_task,
+)
+from photon_ml_tpu.types import TaskType
+
+
+def test_auc_matches_sklearn(rng):
+    n = 500
+    scores = rng.normal(size=n).astype(np.float32)
+    labels = (rng.uniform(size=n) > 0.4).astype(np.float32)
+    ours = metrics.area_under_roc_curve(jnp.asarray(scores), jnp.asarray(labels))
+    ref = skm.roc_auc_score(labels, scores)
+    np.testing.assert_allclose(float(ours), ref, rtol=1e-5)
+
+
+def test_auc_with_ties_and_weights(rng):
+    n = 300
+    scores = rng.integers(0, 5, size=n).astype(np.float32)  # heavy ties
+    labels = (rng.uniform(size=n) > 0.5).astype(np.float32)
+    weights = rng.uniform(0.5, 3.0, size=n).astype(np.float32)
+    ours = metrics.area_under_roc_curve(
+        jnp.asarray(scores), jnp.asarray(labels), jnp.asarray(weights)
+    )
+    ref = skm.roc_auc_score(labels, scores, sample_weight=weights)
+    np.testing.assert_allclose(float(ours), ref, rtol=1e-5)
+
+
+def test_auc_padding_mask(rng):
+    n = 100
+    scores = rng.normal(size=n).astype(np.float32)
+    labels = (rng.uniform(size=n) > 0.5).astype(np.float32)
+    base = metrics.area_under_roc_curve(jnp.asarray(scores), jnp.asarray(labels))
+    # Add garbage rows with zero weight.
+    s2 = np.concatenate([scores, rng.normal(size=20).astype(np.float32)])
+    l2 = np.concatenate([labels, np.ones(20, np.float32)])
+    w2 = np.concatenate([np.ones(n, np.float32), np.zeros(20, np.float32)])
+    padded = metrics.area_under_roc_curve(jnp.asarray(s2), jnp.asarray(l2), jnp.asarray(w2))
+    np.testing.assert_allclose(float(padded), float(base), rtol=1e-5)
+
+
+def test_aupr_close_to_sklearn(rng):
+    n = 400
+    scores = rng.normal(size=n).astype(np.float32)
+    labels = (rng.uniform(size=n) > 0.6).astype(np.float32)
+    ours = metrics.area_under_pr_curve(jnp.asarray(scores), jnp.asarray(labels))
+    # sklearn's average_precision is the step-function integral; our trapezoid
+    # matches spark mllib. They agree loosely on smooth data.
+    ref = skm.average_precision_score(labels, scores)
+    assert abs(float(ours) - ref) < 0.02
+
+
+def test_rmse_and_losses(rng):
+    n = 200
+    scores = rng.normal(size=n).astype(np.float32)
+    labels = rng.normal(size=n).astype(np.float32)
+    np.testing.assert_allclose(
+        float(metrics.rmse(jnp.asarray(scores), jnp.asarray(labels))),
+        np.sqrt(np.mean((scores - labels) ** 2)),
+        rtol=1e-5,
+    )
+    y = (labels > 0).astype(np.float32)
+    ll = float(metrics.logistic_loss(jnp.asarray(scores), jnp.asarray(y)))
+    ref_ll = np.mean(np.log1p(np.exp(-(2 * y - 1) * scores)))
+    np.testing.assert_allclose(ll, ref_ll, rtol=1e-4)
+
+
+def test_precision_at_k():
+    scores = jnp.asarray([5.0, 4.0, 3.0, 2.0, 1.0])
+    labels = jnp.asarray([1.0, 0.0, 1.0, 1.0, 0.0])
+    np.testing.assert_allclose(float(metrics.precision_at_k(2, scores, labels)), 0.5)
+    np.testing.assert_allclose(float(metrics.precision_at_k(4, scores, labels)), 0.75)
+
+
+def test_evaluator_type_parsing():
+    assert EvaluatorType.parse("AUC") == EvaluatorType("AUC")
+    assert EvaluatorType.parse("rmse").name == "RMSE"
+    g = EvaluatorType.parse("AUC:queryId")
+    assert g.is_grouped and g.id_tag == "queryId"
+    p = EvaluatorType.parse("PRECISION@5:documentId")
+    assert p.k == 5 and p.id_tag == "documentId"
+    assert str(p) == "PRECISION@5:documentId"
+    with pytest.raises(ValueError):
+        EvaluatorType.parse("NOT_A_METRIC")
+
+
+def test_better_than_directions():
+    auc = EvaluatorType("AUC")
+    rmse_t = EvaluatorType("RMSE")
+    assert better_than(auc, 0.9, 0.8) and not better_than(auc, 0.7, 0.8)
+    assert better_than(rmse_t, 0.1, 0.2) and not better_than(rmse_t, 0.3, 0.2)
+    assert better_than(auc, 0.1, None)
+
+
+def test_grouped_auc_equals_per_group_mean(rng):
+    n, g = 300, 7
+    gids = rng.integers(0, g, size=n)
+    scores = rng.normal(size=n).astype(np.float32)
+    labels = (rng.uniform(size=n) > 0.5).astype(np.float32)
+    suite = EvaluationSuite(
+        [EvaluatorType.parse("AUC:q")],
+        jnp.asarray(labels),
+        id_tag_values={"q": gids},
+    )
+    res = suite.evaluate(jnp.asarray(scores))
+    per_group = []
+    for gid in np.unique(gids):
+        m = gids == gid
+        if len(np.unique(labels[m])) < 2:
+            per_group.append(0.5)
+        else:
+            per_group.append(skm.roc_auc_score(labels[m], scores[m]))
+    np.testing.assert_allclose(res.primary_value, np.mean(per_group), rtol=1e-4)
+
+
+def test_suite_multiple_metrics(rng):
+    n = 100
+    labels = (rng.uniform(size=n) > 0.5).astype(np.float32)
+    scores = rng.normal(size=n).astype(np.float32)
+    suite = EvaluationSuite(
+        [EvaluatorType("AUC"), EvaluatorType("LOGISTIC_LOSS")], jnp.asarray(labels)
+    )
+    res = suite.evaluate(jnp.asarray(scores))
+    assert set(res.results) == {"AUC", "LOGISTIC_LOSS"}
+    assert res.primary == EvaluatorType("AUC")
+
+
+def test_default_evaluators():
+    assert default_evaluator_for_task(TaskType.LOGISTIC_REGRESSION).name == "AUC"
+    assert default_evaluator_for_task(TaskType.LINEAR_REGRESSION).name == "RMSE"
+    assert default_evaluator_for_task(TaskType.POISSON_REGRESSION).name == "POISSON_LOSS"
+
+
+def test_build_grouped_index_shapes(rng):
+    gids = np.array([3, 1, 3, 3, 2, 1])
+    idx = build_grouped_index(gids)
+    assert idx.gather.shape == (3, 3)
+    assert float(idx.mask.sum()) == 6.0

@@ -1,0 +1,91 @@
+"""Unit tests for pointwise losses — derivative consistency and known values.
+
+Counterpart of the reference's loss unit tests
+(photon-api src/test/.../function/glm/*LossFunctionTest.scala): values match
+the closed forms, and d1/d2 match autodiff of the loss.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.ops import losses
+from photon_ml_tpu.types import TaskType
+
+ALL_LOSSES = [losses.LOGISTIC, losses.SQUARED, losses.POISSON, losses.SMOOTHED_HINGE]
+
+
+def _labels_for(loss):
+    if loss.name == "poisson":
+        return np.array([0.0, 1.0, 3.0, 7.0])
+    if loss.name == "squared":
+        return np.array([-1.3, 0.0, 2.5, 4.0])
+    return np.array([0.0, 1.0, 0.0, 1.0])
+
+
+@pytest.mark.parametrize("loss", ALL_LOSSES, ids=lambda l: l.name)
+def test_d1_matches_autodiff(loss):
+    z = jnp.linspace(-3.0, 3.0, 25)
+    y = jnp.asarray(np.resize(_labels_for(loss), 25), jnp.float32)
+    auto = jax.vmap(jax.grad(lambda zz, yy: loss.loss(zz, yy)))(z, y)
+    np.testing.assert_allclose(loss.d1(z, y), auto, rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize(
+    "loss", [losses.LOGISTIC, losses.SQUARED, losses.POISSON], ids=lambda l: l.name
+)
+def test_d2_matches_autodiff(loss):
+    z = jnp.linspace(-3.0, 3.0, 25)
+    y = jnp.asarray(np.resize(_labels_for(loss), 25), jnp.float32)
+    auto = jax.vmap(jax.grad(jax.grad(lambda zz, yy: loss.loss(zz, yy))))(z, y)
+    np.testing.assert_allclose(loss.d2(z, y), auto, rtol=1e-4, atol=1e-4)
+
+
+def test_logistic_values():
+    # l(0, y) = log 2 for either label; stable at extreme margins.
+    z = jnp.array([0.0, 0.0, 50.0, -50.0, 500.0])
+    y = jnp.array([1.0, 0.0, 1.0, 0.0, 0.0])
+    vals = losses.LOGISTIC.loss(z, y)
+    np.testing.assert_allclose(vals[:2], np.log(2.0), rtol=1e-6)
+    np.testing.assert_allclose(vals[2:4], 0.0, atol=1e-6)
+    assert np.isfinite(vals[4]) and vals[4] == pytest.approx(500.0)
+
+
+def test_poisson_values():
+    z = jnp.array([0.0, 1.0])
+    y = jnp.array([2.0, 1.0])
+    np.testing.assert_allclose(
+        losses.POISSON.loss(z, y), [1.0, np.e - 1.0], rtol=1e-5
+    )
+
+
+def test_smoothed_hinge_piecewise():
+    # Positive sample: margin z = m directly.
+    y = jnp.ones(4)
+    z = jnp.array([-1.0, 0.0, 0.5, 2.0])
+    np.testing.assert_allclose(
+        losses.SMOOTHED_HINGE.loss(z, y), [1.5, 0.5, 0.125, 0.0], rtol=1e-5
+    )
+    # Negative sample mirrors.
+    np.testing.assert_allclose(
+        losses.SMOOTHED_HINGE.loss(-z, jnp.zeros(4)), [1.5, 0.5, 0.125, 0.0], rtol=1e-5
+    )
+
+
+def test_task_routing():
+    assert losses.loss_for_task(TaskType.LOGISTIC_REGRESSION) is losses.LOGISTIC
+    assert losses.loss_for_task(TaskType.LINEAR_REGRESSION) is losses.SQUARED
+    assert losses.loss_for_task(TaskType.POISSON_REGRESSION) is losses.POISSON
+    assert not losses.loss_for_task(TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM).has_hessian
+
+
+def test_mean_for_task():
+    z = jnp.array([0.0, 1.0])
+    np.testing.assert_allclose(
+        losses.mean_for_task(TaskType.LOGISTIC_REGRESSION, z), [0.5, 1 / (1 + np.exp(-1))]
+    )
+    np.testing.assert_allclose(losses.mean_for_task(TaskType.LINEAR_REGRESSION, z), z)
+    np.testing.assert_allclose(
+        losses.mean_for_task(TaskType.POISSON_REGRESSION, z), np.exp([0.0, 1.0])
+    )

@@ -1,0 +1,183 @@
+"""Optimizer tests: convergence on analytic + GLM problems, OWLQN sparsity,
+box projection, TRON vs LBFGS agreement, and vmapped batched solves.
+
+Counterpart of the reference's OptimizerIntegTest / IntegTestObjective
+(photon-lib src/integTest/.../optimization): analytic objectives with known
+optima, plus sklearn as an external oracle for logistic regression.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.data.containers import dense_data
+from photon_ml_tpu.ops import losses, objective
+from photon_ml_tpu.optimize.common import ConvergenceReason
+from photon_ml_tpu.optimize.lbfgs import minimize_lbfgs
+from photon_ml_tpu.optimize.tron import minimize_tron
+
+
+def _quadratic(center, scale=1.0):
+    c = jnp.asarray(center)
+
+    def vg(w):
+        diff = w - c
+        return 0.5 * scale * jnp.dot(diff, diff), scale * diff
+
+    return vg
+
+
+def _rosenbrock_vg(w):
+    f = lambda x: jnp.sum(100.0 * (x[1:] - x[:-1] ** 2) ** 2 + (1.0 - x[:-1]) ** 2)
+    return f(w), jax.grad(f)(w)
+
+
+def _logistic_problem(rng, n=200, d=8, l2=1e-3):
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    w_true = rng.normal(size=d).astype(np.float32)
+    p = 1.0 / (1.0 + np.exp(-(X @ w_true)))
+    y = (rng.uniform(size=n) < p).astype(np.float32)
+    data = dense_data(X, y)
+    vg = lambda w: objective.value_and_gradient(losses.LOGISTIC, w, data, None, l2)
+    hvp = lambda w, v: objective.hessian_vector(losses.LOGISTIC, w, v, data, None, l2)
+    return data, vg, hvp
+
+
+def test_lbfgs_quadratic():
+    center = jnp.arange(5.0, dtype=jnp.float32)
+    res = minimize_lbfgs(_quadratic(center), jnp.zeros(5, jnp.float32))
+    np.testing.assert_allclose(res.coefficients, center, atol=1e-4)
+    assert int(res.reason) in (
+        ConvergenceReason.FUNCTION_VALUES_CONVERGED,
+        ConvergenceReason.GRADIENT_CONVERGED,
+    )
+    assert int(res.iterations) < 10
+
+
+def test_lbfgs_rosenbrock():
+    res = minimize_lbfgs(
+        _rosenbrock_vg, jnp.zeros(4, jnp.float32), max_iterations=300, tolerance=1e-10
+    )
+    np.testing.assert_allclose(res.coefficients, jnp.ones(4), atol=2e-2)
+
+
+def test_lbfgs_logistic_matches_sklearn(rng):
+    from sklearn.linear_model import LogisticRegression
+
+    n, d, l2 = 200, 8, 1e-2
+    _, vg, _ = _logistic_problem(rng, n, d, l2)
+    # Rebuild the same data for sklearn (regenerate with same seed path).
+    rng2 = np.random.default_rng(20260729)
+    X = rng2.normal(size=(n, d)).astype(np.float32)
+    w_true = rng2.normal(size=d).astype(np.float32)
+    p = 1.0 / (1.0 + np.exp(-(X @ w_true)))
+    y = (rng2.uniform(size=n) < p).astype(np.float32)
+
+    res = minimize_lbfgs(vg, jnp.zeros(d, jnp.float32), tolerance=1e-9)
+    skl = LogisticRegression(
+        C=1.0 / l2, fit_intercept=False, tol=1e-10, max_iter=2000
+    ).fit(X, y)
+    np.testing.assert_allclose(res.coefficients, skl.coef_[0], rtol=2e-2, atol=2e-3)
+
+
+def test_owlqn_produces_sparse_solution(rng):
+    _, vg, _ = _logistic_problem(rng, n=150, d=20, l2=0.0)
+    dense_res = minimize_lbfgs(vg, jnp.zeros(20, jnp.float32))
+    sparse_res = minimize_lbfgs(vg, jnp.zeros(20, jnp.float32), l1_weight=8.0)
+    n_zero_dense = int(jnp.sum(jnp.abs(dense_res.coefficients) < 1e-8))
+    n_zero_sparse = int(jnp.sum(jnp.abs(sparse_res.coefficients) < 1e-8))
+    assert n_zero_sparse > n_zero_dense
+    assert n_zero_sparse >= 5
+    # The OWLQN objective value (smooth + L1) must beat the L1 value of the
+    # dense solution.
+    l1_of = lambda w: 8.0 * float(jnp.sum(jnp.abs(w)))
+    f_sparse = float(vg(sparse_res.coefficients)[0]) + l1_of(sparse_res.coefficients)
+    f_dense = float(vg(dense_res.coefficients)[0]) + l1_of(dense_res.coefficients)
+    assert f_sparse <= f_dense + 1e-3
+
+
+def test_owlqn_zero_l1_close_to_lbfgs(rng):
+    _, vg, _ = _logistic_problem(rng, n=100, d=6, l2=1e-2)
+    a = minimize_lbfgs(vg, jnp.zeros(6, jnp.float32), tolerance=1e-9)
+    b = minimize_lbfgs(vg, jnp.zeros(6, jnp.float32), l1_weight=0.0, tolerance=1e-9)
+    np.testing.assert_allclose(a.coefficients, b.coefficients, atol=5e-3)
+
+
+def test_box_constraints():
+    center = jnp.asarray([2.0, -3.0, 0.5], jnp.float32)
+    res = minimize_lbfgs(
+        _quadratic(center),
+        jnp.zeros(3, jnp.float32),
+        lower_bounds=jnp.asarray([-1.0, -1.0, -1.0], jnp.float32),
+        upper_bounds=jnp.asarray([1.0, 1.0, 1.0], jnp.float32),
+    )
+    np.testing.assert_allclose(res.coefficients, [1.0, -1.0, 0.5], atol=1e-4)
+
+
+def test_tron_quadratic():
+    center = jnp.arange(4.0, dtype=jnp.float32)
+    vg = _quadratic(center, scale=2.0)
+    hvp = lambda w, v: 2.0 * v
+    res = minimize_tron(vg, hvp, jnp.zeros(4, jnp.float32))
+    np.testing.assert_allclose(res.coefficients, center, atol=1e-4)
+    # Newton on a quadratic: one step.
+    assert int(res.iterations) <= 3
+
+
+def test_tron_matches_lbfgs_on_logistic(rng):
+    _, vg, hvp = _logistic_problem(rng, l2=0.1)
+    a = minimize_tron(vg, hvp, jnp.zeros(8, jnp.float32), tolerance=1e-9)
+    b = minimize_lbfgs(vg, jnp.zeros(8, jnp.float32), tolerance=1e-9)
+    np.testing.assert_allclose(a.coefficients, b.coefficients, rtol=5e-3, atol=5e-4)
+    assert int(a.iterations) <= 15
+
+
+def test_vmapped_lbfgs_batched_problems(rng):
+    """Many independent problems in one kernel — the random-effect pattern."""
+    B, d = 16, 4
+    centers = jnp.asarray(rng.normal(size=(B, d)).astype(np.float32))
+
+    def one(w0, center):
+        vg = lambda w: (
+            0.5 * jnp.dot(w - center, w - center),
+            w - center,
+        )
+        return minimize_lbfgs(vg, w0)
+
+    res = jax.vmap(one)(jnp.zeros((B, d), jnp.float32), centers)
+    np.testing.assert_allclose(res.coefficients, centers, atol=1e-3)
+    assert res.reason.shape == (B,)
+    assert bool(jnp.all(res.reason != ConvergenceReason.NOT_CONVERGED))
+
+
+def test_vmapped_tron_batched_glms(rng):
+    """vmapped TRON over per-entity GLM blocks with padding rows."""
+    B, n, d = 8, 30, 3
+    X = rng.normal(size=(B, n, d)).astype(np.float32)
+    w_true = rng.normal(size=(B, d)).astype(np.float32)
+    p = 1.0 / (1.0 + np.exp(-np.einsum("bnd,bd->bn", X, w_true)))
+    y = (rng.uniform(size=(B, n)) < p).astype(np.float32)
+    weights = np.ones((B, n), np.float32)
+    weights[:, 25:] = 0.0  # simulate ragged entities via padding
+
+    def solve(Xb, yb, wb):
+        data = dense_data(Xb, yb, weights=wb)
+        vg = lambda w: objective.value_and_gradient(losses.LOGISTIC, w, data, None, 0.5)
+        hvp = lambda w, v: objective.hessian_vector(losses.LOGISTIC, w, v, data, None, 0.5)
+        return minimize_tron(vg, hvp, jnp.zeros(d, jnp.float32))
+
+    res = jax.vmap(solve)(jnp.asarray(X), jnp.asarray(y), jnp.asarray(weights))
+    assert res.coefficients.shape == (B, d)
+    # Each batched solution must match its individually-solved counterpart.
+    single = solve(jnp.asarray(X[0]), jnp.asarray(y[0]), jnp.asarray(weights[0]))
+    np.testing.assert_allclose(res.coefficients[0], single.coefficients, atol=1e-4)
+
+
+def test_tracking_records_monotone_losses(rng):
+    _, vg, _ = _logistic_problem(rng)
+    res = minimize_lbfgs(vg, jnp.zeros(8, jnp.float32), tracking=True)
+    hist = np.asarray(res.loss_history)
+    valid = hist[~np.isnan(hist)]
+    assert len(valid) == int(res.iterations) + 1
+    assert np.all(np.diff(valid) <= 1e-5)  # non-increasing losses
