@@ -1,0 +1,177 @@
+"""Benchmark: GLMix (fixed + per-entity random effects) training throughput.
+
+The reference publishes no benchmark numbers (BASELINE.md: no benchmarks/
+dir; the README's claim is qualitative scale). The measurable protocol from
+BASELINE.json is self-measured GLMix training wall-clock. This bench trains
+one full coordinate-descent pass of a synthetic GLMix logistic problem sized
+for a single chip:
+
+    1,048,576 samples x 512 dense fixed-effect features (MXU-heavy DP solve,
+    40 L-BFGS iterations) + 8,192 entities x up-to-128 rows x 16 features of
+    random effects (vmapped entity solves), one CD pass.
+
+Metric: samples-solved-per-second through the full pass
+(samples * optimizer-iterations / wall-clock would flatter; we report plain
+samples/s of the pass). `vs_baseline` is wall-clock speedup vs the pinned
+reference point BASELINE_WALL_S — an estimated Spark local[*] wall-clock for
+the same problem (the reference's own integ-test execution mode), recorded
+once here so rounds are comparable.
+
+Prints exactly one JSON line. Runs the measurement in a subprocess with a
+watchdog so a wedged accelerator tunnel degrades to the CPU backend instead
+of hanging the driver.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+# Estimated wall-clock for the same GLMix pass on the reference's Spark
+# local[*] path (its integ-test mode, SparkTestUtils.scala): O(10 min) for
+# 1M x 512 dense logistic + 8k entity subproblems based on the reference's
+# per-iteration treeAggregate structure. Fixed constant across rounds.
+BASELINE_WALL_S = 600.0
+
+_CHILD = "--run-child"
+
+
+def _child() -> None:
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.data.game_dataset import (
+        GameDataset,
+        RandomEffectDataConfig,
+        build_random_effect_dataset,
+    )
+    from photon_ml_tpu.game.coordinate import (
+        FixedEffectCoordinate,
+        RandomEffectCoordinate,
+    )
+    from photon_ml_tpu.game.coordinate_descent import run_coordinate_descent
+    from photon_ml_tpu.optimize.config import (
+        L2,
+        CoordinateOptimizationConfig,
+        OptimizerConfig,
+    )
+    from photon_ml_tpu.types import TaskType
+
+    platform = jax.devices()[0].platform
+    scale = float(os.environ.get("BENCH_SCALE", "1.0"))
+    n = int(1 << 20 * 1)
+    n = int(n * scale)
+    d_fixed, d_re = 512, 16
+    n_entities = max(64, int(8192 * scale))
+
+    key = jax.random.PRNGKey(0)
+    kx, ke, kw, ku, kl = jax.random.split(key, 5)
+    Xf = jax.random.normal(kx, (n, d_fixed), jnp.float32)
+    Xe = jax.random.normal(ke, (n, d_re), jnp.float32)
+    entity = np.asarray(jax.random.randint(kl, (n,), 0, n_entities))
+    w = jax.random.normal(kw, (d_fixed,)) * 0.1
+    u = jax.random.normal(ku, (n_entities, d_re)) * 0.5
+    margin = Xf @ w + jnp.einsum("nd,nd->n", Xe, u[jnp.asarray(entity)])
+    y = (jax.random.uniform(key, (n,)) < jax.nn.sigmoid(margin)).astype(jnp.float32)
+
+    ds = GameDataset.build(
+        {"global": Xf, "per_entity": Xe}, y, id_tags={"entityId": entity}
+    )
+    red = build_random_effect_dataset(
+        ds,
+        RandomEffectDataConfig(
+            "entityId", "per_entity", active_upper_bound=128, min_bucket=32
+        ),
+    )
+    cfg_f = CoordinateOptimizationConfig(
+        optimizer=OptimizerConfig(max_iterations=40, tolerance=1e-8),
+        regularization=L2,
+        reg_weight=1.0,
+    )
+    cfg_r = CoordinateOptimizationConfig(
+        optimizer=OptimizerConfig(max_iterations=20, tolerance=1e-7),
+        regularization=L2,
+        reg_weight=10.0,
+    )
+    fixed = FixedEffectCoordinate(ds, "global", cfg_f, TaskType.LOGISTIC_REGRESSION)
+    rand = RandomEffectCoordinate(ds, red, cfg_r, TaskType.LOGISTIC_REGRESSION)
+    coords = {"fixed": fixed, "per-entity": rand}
+
+    # Warm-up: compile everything once (compile time excluded, as the
+    # reference's JIT-warm JVM would be).
+    run_coordinate_descent(coords, 1)
+
+    t0 = time.perf_counter()
+    result = run_coordinate_descent(coords, 1)
+    jax.block_until_ready(result.model["fixed"].coefficients.means)
+    jax.block_until_ready(result.model["per-entity"].coefficients_matrix)
+    wall = time.perf_counter() - t0
+
+    print(
+        json.dumps(
+            dict(
+                metric="glmix_train_samples_per_s",
+                value=round(n / wall, 1),
+                unit="samples/s",
+                vs_baseline=round(BASELINE_WALL_S * scale / wall, 2),
+                wall_s=round(wall, 3),
+                platform=platform,
+                n_samples=n,
+                d_fixed=d_fixed,
+                n_entities=n_entities,
+            )
+        )
+    )
+
+
+def main() -> None:
+    if _CHILD in sys.argv:
+        _child()
+        return
+
+    def attempt(extra_env, timeout):
+        env = dict(os.environ)
+        env.update(extra_env)
+        try:
+            out = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), _CHILD],
+                capture_output=True,
+                text=True,
+                timeout=timeout,
+                env=env,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            )
+        except subprocess.TimeoutExpired:
+            return None
+        for line in out.stdout.splitlines():
+            if line.startswith("{"):
+                return line
+        sys.stderr.write(out.stderr[-2000:] + "\n")
+        return None
+
+    # Try the default (TPU) backend first; fall back to CPU (smaller scale)
+    # if the accelerator path hangs or fails.
+    line = attempt({}, timeout=1800)
+    if line is None:
+        sys.stderr.write("bench: accelerator path failed; falling back to CPU\n")
+        line = attempt(
+            {
+                "JAX_PLATFORMS": "cpu",
+                "PALLAS_AXON_POOL_IPS": "",
+                "BENCH_SCALE": "0.02",
+            },
+            timeout=1800,
+        )
+    if line is None:
+        line = json.dumps(
+            dict(metric="glmix_train_samples_per_s", value=0.0, unit="samples/s", vs_baseline=0.0)
+        )
+    print(line)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,285 @@
+"""GAME datasets: columnar samples + entity-blocked random-effect layout.
+
+Counterpart of photon-api data/ (GameConverters.scala:44-129,
+FixedEffectDataset.scala:31-152, RandomEffectDataset.scala:45-466,
+RandomEffectDatasetPartitioner.scala:44-171, LocalDataset.scala:35-329,
+CoordinateDataConfiguration.scala) and photon-lib data/GameDatum.scala:38.
+
+Structural translation (the central TPU design decision of this framework):
+
+* The reference represents a GAME dataset as RDD[(uid, GameDatum)] and builds
+  per-coordinate views by shuffling — groupByKey per entity for random
+  effects, with a frequency-balanced partitioner, per-entity reservoir caps,
+  and an active (train+score) / passive (score-only) split.
+
+* Here every sample lives at a fixed slot in a device-resident sample axis
+  (uid = row index). A fixed-effect view is just (shard features, labels,
+  offsets, weights). A random-effect view is built ONCE, host-side, as
+  *entity blocks*: entities are bucketed by padded size (power-of-two
+  capacities), each bucket holding a (num_entities_in_bucket, bucket_size)
+  gather matrix into the sample axis plus a validity mask. Training gathers
+  rows into dense (E, S, D) blocks and vmaps the solver; scoring gathers a
+  per-sample entity row. The groupByKey shuffle, the partitioner, and the
+  MinHeap reservoir all collapse into this one static indexing structure,
+  and the per-iteration residual exchange becomes pure gathers/scatters.
+
+* Active/passive: rows beyond a per-entity cap (numActiveDataPointsUpperBound,
+  RandomEffectDataset.scala:339-408) are excluded from the gather blocks
+  (training) but still scored via the per-sample entity-row index — the
+  passive-data path (:410) costs nothing here. The reservoir choice of which
+  rows stay active is deterministic per entity (seeded by a stable hash,
+  mirroring the byteswap64-keyed heap's fault-tolerance determinism,
+  RandomEffectDataset.scala:375-384).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+from typing import Dict, List, Mapping, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.data.containers import Features, LabeledData, SparseFeatures
+
+Array = jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class FixedEffectDataConfig:
+    """FixedEffectDataConfiguration (CoordinateDataConfiguration.scala:37)."""
+
+    feature_shard: str
+
+
+@dataclasses.dataclass(frozen=True)
+class RandomEffectDataConfig:
+    """RandomEffectDataConfiguration (CoordinateDataConfiguration.scala:59-66).
+
+    active_upper_bound caps rows per entity used for training (overflow is
+    scored only); active_lower_bound drops entities with too few rows from
+    training entirely; min_bucket is the smallest padded block size (TPU
+    lane-friendly).
+    """
+
+    random_effect_type: str
+    feature_shard: str
+    active_upper_bound: Optional[int] = None
+    active_lower_bound: Optional[int] = None
+    min_bucket: int = 8
+
+
+@dataclasses.dataclass
+class GameDataset:
+    """Columnar GAME data in fixed sample order (GameDatum.scala:38 columns).
+
+    `id_tags` holds host-side per-sample entity/grouping keys (userId,
+    movieId, queryId, ...) — the idTagToValueMap of the reference, columnar.
+    """
+
+    shards: Dict[str, Features]
+    labels: Array
+    offsets: Array
+    weights: Array
+    id_tags: Dict[str, np.ndarray]
+
+    @property
+    def num_samples(self) -> int:
+        return int(self.labels.shape[0])
+
+    def labeled_data(self, shard: str, offsets: Optional[Array] = None) -> LabeledData:
+        """Fixed-effect view for one feature shard (FixedEffectDataset)."""
+        return LabeledData(
+            self.shards[shard],
+            self.labels,
+            self.offsets if offsets is None else offsets,
+            self.weights,
+        )
+
+    @classmethod
+    def build(
+        cls,
+        shards: Mapping[str, Features],
+        labels,
+        *,
+        offsets=None,
+        weights=None,
+        id_tags: Optional[Mapping[str, Sequence]] = None,
+        dtype=jnp.float32,
+    ) -> "GameDataset":
+        labels = jnp.asarray(labels, dtype)
+        n = labels.shape[0]
+        offsets = jnp.zeros(n, dtype) if offsets is None else jnp.asarray(offsets, dtype)
+        weights = jnp.ones(n, dtype) if weights is None else jnp.asarray(weights, dtype)
+        tags = {k: np.asarray(v) for k, v in (id_tags or {}).items()}
+        for k, v in tags.items():
+            if len(v) != n:
+                raise ValueError(f"id tag {k!r} has {len(v)} values for {n} samples")
+        return cls(dict(shards), labels, offsets, weights, tags)
+
+
+def _stable_entity_seed(entity_key) -> int:
+    """Deterministic per-entity seed (stands in for the reference's
+    byteswap64(hash) reservoir keys — same run-to-run reproducibility)."""
+    h = hashlib.blake2b(str(entity_key).encode(), digest_size=8).digest()
+    return int.from_bytes(h, "little")
+
+
+class EntityBlocks:
+    """One padded bucket of entities with equal block capacity."""
+
+    def __init__(self, gather: np.ndarray, mask: np.ndarray, entity_rows: np.ndarray):
+        self.gather = jnp.asarray(gather, jnp.int32)  # (E, S) sample rows
+        self.mask = jnp.asarray(mask, jnp.float32)  # (E, S)
+        self.entity_rows = jnp.asarray(entity_rows, jnp.int32)  # (E,)
+
+    @property
+    def num_entities(self) -> int:
+        return int(self.gather.shape[0])
+
+    @property
+    def capacity(self) -> int:
+        return int(self.gather.shape[1])
+
+
+@dataclasses.dataclass
+class RandomEffectDataset:
+    """Entity-blocked view of a GameDataset for one random-effect coordinate.
+
+    - `entity_index`: host map entity key -> row in the coefficient matrix.
+    - `buckets`: padded gather blocks for training (active data only).
+    - `sample_entity_rows`: per-sample coefficient row for scoring; unseen
+      entities point at row `num_entities` (the pinned zero row).
+    """
+
+    config: RandomEffectDataConfig
+    entity_index: Dict[object, int]
+    buckets: List[EntityBlocks]
+    sample_entity_rows: Array  # (N,) int32
+    num_active_samples: int
+    num_passive_samples: int
+
+    @property
+    def num_entities(self) -> int:
+        return len(self.entity_index)
+
+    @property
+    def feature_shard(self) -> str:
+        return self.config.feature_shard
+
+
+def build_random_effect_dataset(
+    dataset: GameDataset, config: RandomEffectDataConfig
+) -> RandomEffectDataset:
+    """Host-side one-time construction of the entity-blocked layout.
+
+    Replaces RandomEffectDataset builder + partitioner + reservoir
+    (RandomEffectDataset.scala:230-447, RandomEffectDatasetPartitioner
+    .scala:118-136): bucketing by padded size is the load-balancing here —
+    within a bucket every entity costs identical FLOPs, so there is no
+    straggler problem to partition around.
+    """
+    tag = config.random_effect_type
+    if tag not in dataset.id_tags:
+        raise ValueError(f"id tag {tag!r} not present in dataset")
+    keys = dataset.id_tags[tag]
+    n = len(keys)
+
+    # Group sample rows by entity (host; stable order).
+    order = np.argsort(keys, kind="stable")
+    sorted_keys = keys[order]
+    uniq, starts = np.unique(sorted_keys, return_index=True)
+    bounds = np.append(starts, n)
+
+    lower = config.active_lower_bound or 0
+    cap = config.active_upper_bound
+
+    entity_index: Dict[object, int] = {}
+    entity_rows_of_sample = np.full(n, -1, np.int64)
+    active_lists: List[np.ndarray] = []
+    kept_entities: List[int] = []
+    num_active = 0
+
+    for i, ent in enumerate(uniq):
+        rows = order[bounds[i] : bounds[i + 1]]
+        row_id = len(entity_index)
+        entity_index[ent.item() if hasattr(ent, "item") else ent] = row_id
+        entity_rows_of_sample[rows] = row_id
+        if len(rows) < lower:
+            continue  # too few samples: entity scored with zero model only
+        if cap is not None and len(rows) > cap:
+            rng = np.random.default_rng(_stable_entity_seed(ent))
+            rows = rng.choice(rows, size=cap, replace=False)
+        active_lists.append(np.sort(rows))
+        kept_entities.append(row_id)
+        num_active += len(rows)
+
+    num_entities = len(entity_index)
+    # Unseen entities (scoring time) use the pinned zero row = num_entities.
+    entity_rows_of_sample[entity_rows_of_sample < 0] = num_entities
+
+    # Bucket by padded capacity (power of two >= size, floor min_bucket).
+    def bucket_size(sz: int) -> int:
+        b = max(config.min_bucket, 1)
+        while b < sz:
+            b *= 2
+        return b
+
+    by_capacity: Dict[int, List[int]] = {}
+    for j, rows in enumerate(active_lists):
+        by_capacity.setdefault(bucket_size(len(rows)), []).append(j)
+
+    buckets = []
+    for capacity in sorted(by_capacity):
+        members = by_capacity[capacity]
+        e = len(members)
+        gather = np.zeros((e, capacity), np.int64)
+        mask = np.zeros((e, capacity), np.float32)
+        ent_rows = np.zeros(e, np.int64)
+        for bi, j in enumerate(members):
+            rows = active_lists[j]
+            gather[bi, : len(rows)] = rows
+            mask[bi, : len(rows)] = 1.0
+            ent_rows[bi] = kept_entities[j]
+        buckets.append(EntityBlocks(gather, mask, ent_rows))
+
+    return RandomEffectDataset(
+        config=config,
+        entity_index=entity_index,
+        buckets=buckets,
+        sample_entity_rows=jnp.asarray(entity_rows_of_sample, jnp.int32),
+        num_active_samples=num_active,
+        num_passive_samples=n - num_active,
+    )
+
+
+def gather_block_features(features: Features, gather: Array) -> Features:
+    """Materialize per-bucket feature blocks: (E, S, D) dense or (E, S, K) ELL."""
+    if isinstance(features, SparseFeatures):
+        return SparseFeatures(
+            jnp.take(features.indices, gather, axis=0),
+            jnp.take(features.values, gather, axis=0),
+            features.dim,
+        )
+    return jnp.take(features, gather, axis=0)
+
+
+def gather_block_data(
+    dataset: GameDataset,
+    shard: str,
+    blocks: EntityBlocks,
+    offsets: Optional[Array] = None,
+) -> LabeledData:
+    """Build the (E, S, ...) LabeledData blocks for one bucket. Offsets default
+    to the dataset's; pass per-sample residual-adjusted offsets during
+    coordinate descent. Padding slots get weight 0 (mask folded into weights).
+    """
+    offs = dataset.offsets if offsets is None else offsets
+    return LabeledData(
+        features=gather_block_features(dataset.shards[shard], blocks.gather),
+        labels=jnp.take(dataset.labels, blocks.gather, axis=0),
+        offsets=jnp.take(offs, blocks.gather, axis=0),
+        weights=jnp.take(dataset.weights, blocks.gather, axis=0) * blocks.mask,
+    )

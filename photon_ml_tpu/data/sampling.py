@@ -1,0 +1,60 @@
+"""Down-sampling as weight masking.
+
+Counterpart of photon-lib sampling/ (DownSampler.scala:45,
+BinaryClassificationDownSampler.scala:32, DefaultDownSampler.scala:28) and
+DownSamplerHelper.scala:23. The reference physically filters the RDD per
+optimize call; on TPU shapes must stay static, so down-sampling multiplies
+the weight column by bernoulli(rate)/rate — dropped rows get weight 0
+(inert in every reduction), kept rows are rescaled so the objective stays an
+unbiased estimate, exactly the 1/rate reweighting the reference applies.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.data.containers import LabeledData
+from photon_ml_tpu.types import TaskType
+
+Array = jax.Array
+
+
+def down_sample_weights(
+    key: jax.Array,
+    labels: Array,
+    weights: Array,
+    rate: float | Array,
+    *,
+    negatives_only: bool,
+) -> Array:
+    """New weight vector with rows dropped at probability 1-rate.
+
+    negatives_only=True mirrors BinaryClassificationDownSampler (positives
+    always kept); False mirrors DefaultDownSampler (uniform).
+    """
+    keep = jax.random.bernoulli(key, rate, labels.shape)
+    rescaled = jnp.where(keep, weights / rate, 0.0)
+    if negatives_only:
+        return jnp.where(labels > 0.5, weights, rescaled)
+    return rescaled
+
+
+def down_sampler_for_task(task: TaskType) -> bool:
+    """Task -> negatives_only flag (DownSamplerHelper.scala:23: logistic and
+    smoothed-hinge use the binary-classification sampler)."""
+    return task in (
+        TaskType.LOGISTIC_REGRESSION,
+        TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM,
+    )
+
+
+def down_sample(
+    key: jax.Array, data: LabeledData, rate: float, task: TaskType
+) -> LabeledData:
+    import dataclasses
+
+    new_w = down_sample_weights(
+        key, data.labels, data.weights, rate, negatives_only=down_sampler_for_task(task)
+    )
+    return dataclasses.replace(data, weights=new_w)

@@ -1,0 +1,126 @@
+"""Optimization configuration model.
+
+Counterpart of photon-api optimization configs: OptimizerConfig.scala:47,
+RegularizationContext.scala:31-134, RegularizationType.scala,
+OptimizerType.scala, OptimizerFactory.scala:46-74,
+game/CoordinateOptimizationConfiguration.scala:34-99 and
+VarianceComputationType.scala. Plain frozen dataclasses consumed by
+`optimize.problem` — the host-side "what to run" description, kept separate
+from the jitted kernels.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+from photon_ml_tpu.types import OptimizerType, RegularizationType, VarianceComputationType
+
+
+@dataclasses.dataclass(frozen=True)
+class RegularizationContext:
+    """Splits a total regularization weight into L1/L2 parts
+    (RegularizationContext.scala:31-134).
+
+    ELASTIC_NET with mixing alpha: L1 = alpha * weight,
+    L2 = (1 - alpha) * weight.
+    """
+
+    reg_type: RegularizationType = RegularizationType.NONE
+    elastic_net_alpha: Optional[float] = None
+
+    def __post_init__(self):
+        if self.reg_type == RegularizationType.ELASTIC_NET:
+            a = self.elastic_net_alpha
+            if a is None or not (0.0 <= a <= 1.0):
+                raise ValueError(
+                    f"ELASTIC_NET requires alpha in [0, 1], got {self.elastic_net_alpha}"
+                )
+        elif self.elastic_net_alpha is not None:
+            raise ValueError("elastic_net_alpha only applies to ELASTIC_NET")
+
+    def l1_weight(self, reg_weight: float) -> float:
+        if self.reg_type == RegularizationType.L1:
+            return reg_weight
+        if self.reg_type == RegularizationType.ELASTIC_NET:
+            return self.elastic_net_alpha * reg_weight
+        return 0.0
+
+    def l2_weight(self, reg_weight: float) -> float:
+        if self.reg_type == RegularizationType.L2:
+            return reg_weight
+        if self.reg_type == RegularizationType.ELASTIC_NET:
+            return (1.0 - self.elastic_net_alpha) * reg_weight
+        return 0.0
+
+
+L2 = RegularizationContext(RegularizationType.L2)
+L1 = RegularizationContext(RegularizationType.L1)
+NO_REG = RegularizationContext(RegularizationType.NONE)
+
+
+def elastic_net(alpha: float) -> RegularizationContext:
+    return RegularizationContext(RegularizationType.ELASTIC_NET, alpha)
+
+
+@dataclasses.dataclass(frozen=True)
+class OptimizerConfig:
+    """Which optimizer, how long, how tight (OptimizerConfig.scala:47).
+
+    `box_constraints` is an optional (lower, upper) pair of per-feature host
+    arrays (the reference's constraintMap).
+    """
+
+    optimizer_type: OptimizerType = OptimizerType.LBFGS
+    max_iterations: int = 100
+    tolerance: float = 1e-7
+    box_constraints: Optional[Tuple[object, object]] = None
+
+    def validate(self, reg: RegularizationContext) -> None:
+        """Mirror OptimizerFactory's constraints (OptimizerFactory.scala:46-74):
+        TRON requires a twice-differentiable objective and supports L2/NONE
+        only; L1/elastic-net requires the OWLQN path."""
+        if self.optimizer_type == OptimizerType.TRON and reg.reg_type in (
+            RegularizationType.L1,
+            RegularizationType.ELASTIC_NET,
+        ):
+            raise ValueError("TRON supports only L2/NONE regularization")
+
+
+@dataclasses.dataclass(frozen=True)
+class CoordinateOptimizationConfig:
+    """Per-coordinate optimization settings
+    (game/CoordinateOptimizationConfiguration.scala:34-99).
+
+    `down_sampling_rate` < 1 applies only to fixed-effect coordinates
+    (FixedEffectOptimizationConfiguration's downSamplingRate).
+    """
+
+    optimizer: OptimizerConfig = OptimizerConfig()
+    regularization: RegularizationContext = NO_REG
+    reg_weight: float = 0.0
+    down_sampling_rate: float = 1.0
+    variance_computation: VarianceComputationType = VarianceComputationType.NONE
+
+    def __post_init__(self):
+        if not (0.0 < self.down_sampling_rate <= 1.0):
+            raise ValueError("down_sampling_rate must be in (0, 1]")
+        # reg_weight may be a traced jax scalar inside jit (the reg-weight
+        # sweep passes it as an argument to avoid recompiles) — only validate
+        # concrete host values.
+        if isinstance(self.reg_weight, (int, float)) and self.reg_weight < 0.0:
+            raise ValueError("reg_weight must be non-negative")
+        self.optimizer.validate(self.regularization)
+
+    def with_reg_weight(self, w: float) -> "CoordinateOptimizationConfig":
+        """The regularization sweep mutates only the weight
+        (DistributedOptimizationProblem.updateRegularizationWeight)."""
+        return dataclasses.replace(self, reg_weight=w)
+
+    @property
+    def l1_weight(self) -> float:
+        return self.regularization.l1_weight(self.reg_weight)
+
+    @property
+    def l2_weight(self) -> float:
+        return self.regularization.l2_weight(self.reg_weight)

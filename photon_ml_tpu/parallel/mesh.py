@@ -1,0 +1,157 @@
+"""Device mesh + sharding layout for GAME training.
+
+Counterpart of the reference's distribution machinery (SURVEY.md §2.7): Spark
+treeAggregate/broadcast/co-partitioned joins become XLA collectives over a
+`jax.sharding.Mesh`. The layout (SURVEY §2.6 mapping):
+
+  * data parallelism ("data" axis): the fixed-effect coordinate shards the
+    SAMPLE axis of (features, labels, offsets, weights); coefficients stay
+    replicated. Gradient reductions inside the jitted optimizer become
+    psum/all-reduce over ICI — the treeAggregate equivalent
+    (ValueAndGradientAggregator.scala:248-252) with no driver in the loop.
+  * entity sharding (expert-parallel analog, same mesh axis): random-effect
+    buckets shard the ENTITY axis of their (E, S, ...) blocks; each device
+    solves its own entities' independent problems, no collectives needed in
+    the solve at all (the reference's co-partitioned join,
+    RandomEffectCoordinate.scala:100-103).
+  * residual exchange: per-sample score vectors share the fixed-effect
+    sample sharding; entity-block gathers cross shard boundaries and XLA
+    lowers them to all-gathers on ICI — replacing the by-uid RDD joins.
+
+Everything goes through jit with sharded inputs (GSPMD propagation); there is
+no hand-written collective in the framework. Multi-host (DCN) uses the same
+code: initialize jax.distributed and build the mesh over all processes'
+devices with the batch axis laid out so sample shards stay within a slice.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from photon_ml_tpu.data.containers import LabeledData, SparseFeatures
+from photon_ml_tpu.data.game_dataset import EntityBlocks, GameDataset, RandomEffectDataset
+
+DATA_AXIS = "data"
+
+
+def make_mesh(devices: Optional[Sequence] = None, axis_name: str = DATA_AXIS) -> Mesh:
+    """1-D mesh over all (or given) devices — DP+entity sharding share it."""
+    devs = np.asarray(devices if devices is not None else jax.devices())
+    return Mesh(devs, (axis_name,))
+
+
+def batch_sharding(mesh: Mesh, ndim: int = 1) -> NamedSharding:
+    """Shard the leading (sample or entity) axis; replicate the rest."""
+    return NamedSharding(mesh, P(mesh.axis_names[0], *([None] * (ndim - 1))))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def pad_game_dataset(dataset: GameDataset, multiple: int) -> GameDataset:
+    """Pad the sample axis to a multiple with weight-0 rows (inert everywhere).
+
+    Run BEFORE building random-effect datasets so entity indices refer to the
+    padded layout. Padding rows get a sentinel id-tag value (dtype-correct
+    extreme / reserved string) so they group into their OWN pseudo-entity: its
+    rows have weight 0, so its trained model is exactly zero and it never
+    competes with real entities for reservoir caps. Real data using the
+    sentinel value itself is the only (pathological) collision case.
+    """
+    n = dataset.num_samples
+    rem = (-n) % multiple
+    if rem == 0:
+        return dataset
+
+    def pad_feat(f):
+        if isinstance(f, SparseFeatures):
+            return SparseFeatures(
+                jnp.pad(f.indices, ((0, rem), (0, 0))),
+                jnp.pad(f.values, ((0, rem), (0, 0))),
+                f.dim,
+            )
+        return jnp.pad(f, ((0, rem), (0, 0)))
+
+    shards = {k: pad_feat(v) for k, v in dataset.shards.items()}
+    id_tags = {}
+    for k, v in dataset.id_tags.items():
+        if v.dtype.kind == "i":
+            fill = np.full(rem, np.iinfo(v.dtype).min, dtype=v.dtype)
+        elif v.dtype.kind == "u":
+            fill = np.full(rem, np.iinfo(v.dtype).max, dtype=v.dtype)
+        elif v.dtype.kind == "f":
+            fill = np.full(rem, -np.inf, dtype=v.dtype)
+        else:
+            fill = np.full(rem, "\x00__pad__", dtype=v.dtype)
+        id_tags[k] = np.concatenate([v, fill])
+    return GameDataset(
+        shards=shards,
+        labels=jnp.pad(dataset.labels, (0, rem)),
+        offsets=jnp.pad(dataset.offsets, (0, rem)),
+        weights=jnp.pad(dataset.weights, (0, rem)),  # zeros: inert
+        id_tags=id_tags,
+    )
+
+
+def shard_game_dataset(dataset: GameDataset, mesh: Mesh) -> GameDataset:
+    """device_put the sample axis over the mesh (padding first if needed)."""
+    ndev = mesh.devices.size
+    dataset = pad_game_dataset(dataset, ndev)
+    s1 = batch_sharding(mesh, 1)
+    s2 = batch_sharding(mesh, 2)
+
+    def put_feat(f):
+        if isinstance(f, SparseFeatures):
+            return SparseFeatures(
+                jax.device_put(f.indices, s2), jax.device_put(f.values, s2), f.dim
+            )
+        return jax.device_put(f, s2)
+
+    return GameDataset(
+        shards={k: put_feat(v) for k, v in dataset.shards.items()},
+        labels=jax.device_put(dataset.labels, s1),
+        offsets=jax.device_put(dataset.offsets, s1),
+        weights=jax.device_put(dataset.weights, s1),
+        id_tags=dataset.id_tags,
+    )
+
+
+def shard_random_effect_dataset(
+    red: RandomEffectDataset, mesh: Mesh
+) -> RandomEffectDataset:
+    """Shard each bucket's entity axis; pad entity counts to the device count.
+
+    Padding entities gather row 0 with mask 0 and write their (zero) solution
+    into the pinned unseen row — harmless by construction (weight-0 data plus
+    L2 keeps a zero warm start at zero).
+    """
+    ndev = mesh.devices.size
+    s1 = batch_sharding(mesh, 1)
+    s2 = batch_sharding(mesh, 2)
+    pinned_row = red.num_entities
+
+    buckets = []
+    for b in red.buckets:
+        e = b.num_entities
+        rem = (-e) % ndev
+        gather = jnp.pad(b.gather, ((0, rem), (0, 0)))
+        mask = jnp.pad(b.mask, ((0, rem), (0, 0)))
+        entity_rows = jnp.pad(b.entity_rows, (0, rem), constant_values=pinned_row)
+        nb = EntityBlocks.__new__(EntityBlocks)
+        nb.gather = jax.device_put(gather, s2)
+        nb.mask = jax.device_put(mask, s2)
+        nb.entity_rows = jax.device_put(entity_rows, s1)
+        buckets.append(nb)
+
+    return dataclasses.replace(
+        red,
+        buckets=buckets,
+        sample_entity_rows=jax.device_put(red.sample_entity_rows, s1),
+    )
