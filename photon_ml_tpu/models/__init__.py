@@ -1,0 +1,16 @@
+"""Supervised GLM model classes and the legacy training workflow."""
+
+from photon_ml_tpu.models.glm import (
+    BinaryClassifier,
+    GeneralizedLinearModel,
+    LinearRegressionModel,
+    LogisticRegressionModel,
+    PoissonRegressionModel,
+    SmoothedHingeLossLinearSVMModel,
+    create_model,
+)
+from photon_ml_tpu.models.training import (
+    SweepResult,
+    select_best_model,
+    train_glm_sweep,
+)

@@ -1,0 +1,135 @@
+"""Supervised GLM model classes with link functions.
+
+Counterpart of photon-api supervised/** :
+  - model/GeneralizedLinearModel.scala:33-51 (abstract `computeMean`)
+  - classification/LogisticRegressionModel.scala:31 (sigmoid link,
+    0.5 posterior threshold via BinaryClassifier)
+  - classification/SmoothedHingeLossLinearSVMModel.scala (margin sign)
+  - regression/LinearRegressionModel.scala (identity link)
+  - regression/PoissonRegressionModel.scala (exp link)
+  - classification/BinaryClassifier.scala (predictClassWithThreshold)
+
+A model is a frozen pytree (Coefficients + static task tag), so it passes
+through jit/vmap; the per-task classes only pin the link function and add the
+classifier surface. `create_model` is the `glmConstructor` lambda the
+estimator wires per task (GameEstimator.scala:714-720).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.data.containers import Features, LabeledData, SparseFeatures
+from photon_ml_tpu.game.model import Coefficients
+from photon_ml_tpu.ops.losses import mean_for_task
+from photon_ml_tpu.types import TaskType
+
+Array = jax.Array
+
+# MathConst.POSITIVE_RESPONSE_THRESHOLD equivalent for binary classification.
+DEFAULT_THRESHOLD = 0.5
+
+
+def _margins(features: Features, w: Array, offsets: Optional[Array]) -> Array:
+    if isinstance(features, SparseFeatures):
+        z = features.matvec(w)
+    else:
+        z = features @ w
+    if offsets is not None:
+        z = z + offsets
+    return z
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class GeneralizedLinearModel:
+    """Coefficients + task-specific mean link (GeneralizedLinearModel.scala:33).
+
+    `compute_score` is the raw margin x.w (+offset); `compute_mean` applies
+    the task link function (:51).
+    """
+
+    coefficients: Coefficients
+    task: TaskType = dataclasses.field(metadata=dict(static=True))
+
+    @property
+    def dim(self) -> int:
+        return self.coefficients.dim
+
+    def compute_score(
+        self, features: Features, offsets: Optional[Array] = None
+    ) -> Array:
+        return _margins(features, self.coefficients.means, offsets)
+
+    def compute_mean(
+        self, features: Features, offsets: Optional[Array] = None
+    ) -> Array:
+        return mean_for_task(self.task, self.compute_score(features, offsets))
+
+    def predict(self, features: Features, offsets: Optional[Array] = None) -> Array:
+        """Mean response (GeneralizedLinearModel.predictWithOffset)."""
+        return self.compute_mean(features, offsets)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class BinaryClassifier(GeneralizedLinearModel):
+    """Adds class prediction at a posterior threshold
+    (BinaryClassifier.scala predictClassWithThreshold)."""
+
+    def predict_class(
+        self,
+        features: Features,
+        offsets: Optional[Array] = None,
+        threshold: float = DEFAULT_THRESHOLD,
+    ) -> Array:
+        # >= threshold is positive (BinaryClassifier.scala: "greater than or
+        # equal to this threshold is identified as positive").
+        return (self.compute_mean(features, offsets) >= threshold).astype(jnp.float32)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class LogisticRegressionModel(BinaryClassifier):
+    """Sigmoid link (LogisticRegressionModel.scala:31)."""
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class SmoothedHingeLossLinearSVMModel(BinaryClassifier):
+    """Margin-based classifier; 'mean' is the raw margin and the class
+    threshold applies to it (SmoothedHingeLossLinearSVMModel.scala)."""
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class LinearRegressionModel(GeneralizedLinearModel):
+    """Identity link (LinearRegressionModel.scala)."""
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class PoissonRegressionModel(GeneralizedLinearModel):
+    """Exponential link (PoissonRegressionModel.scala)."""
+
+
+_MODEL_CLASS = {
+    TaskType.LOGISTIC_REGRESSION: LogisticRegressionModel,
+    TaskType.LINEAR_REGRESSION: LinearRegressionModel,
+    TaskType.POISSON_REGRESSION: PoissonRegressionModel,
+    TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM: SmoothedHingeLossLinearSVMModel,
+}
+
+
+def create_model(
+    task: TaskType, coefficients: Union[Coefficients, Array]
+) -> GeneralizedLinearModel:
+    """TaskType -> concrete model (the estimator's glmConstructor,
+    GameEstimator.scala:714-720)."""
+    if not isinstance(coefficients, Coefficients):
+        coefficients = Coefficients(jnp.asarray(coefficients))
+    return _MODEL_CLASS[task](coefficients=coefficients, task=task)

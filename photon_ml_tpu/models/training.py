@@ -1,0 +1,114 @@
+"""Legacy single-GLM training workflow: reg-weight sweep + model selection.
+
+Counterpart of photon-api ModelTraining.scala:34-213 and photon-client
+ModelSelection.scala:26-92. The reference builds ONE
+DistributedOptimizationProblem, sorts the regularization weights descending,
+and foldLefts over them with warm start (ModelTraining.scala:175-213,
+updateRegularizationWeight per step). Here the solve kernel is jitted once
+with the reg weight as a traced argument, so the whole sweep reuses one XLA
+executable — the TPU translation of "one problem object, mutate the weight".
+
+Model selection (ModelSelection.scala): best weight by AUC for classifiers
+(larger better), by RMSE / Poisson loss for regressions (smaller better).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.data.containers import LabeledData
+from photon_ml_tpu.evaluation.suite import (
+    EvaluationSuite,
+    better_than,
+    default_evaluator_for_task,
+)
+from photon_ml_tpu.game.model import Coefficients
+from photon_ml_tpu.models.glm import GeneralizedLinearModel, create_model
+from photon_ml_tpu.ops.losses import loss_for_task
+from photon_ml_tpu.ops.normalization import NormalizationContext
+from photon_ml_tpu.optimize.common import OptResult
+from photon_ml_tpu.optimize.config import CoordinateOptimizationConfig
+from photon_ml_tpu.optimize.problem import compute_variances, solve
+from photon_ml_tpu.types import TaskType, VarianceComputationType
+
+Array = jax.Array
+
+
+@dataclasses.dataclass
+class SweepResult:
+    """Per-regularization-weight trained models + optimizer diagnostics."""
+
+    models: Dict[float, GeneralizedLinearModel]
+    results: Dict[float, OptResult]
+
+    def weights_descending(self) -> List[float]:
+        return sorted(self.models, reverse=True)
+
+
+def train_glm_sweep(
+    data: LabeledData,
+    task: TaskType,
+    config: CoordinateOptimizationConfig,
+    reg_weights: Sequence[float],
+    *,
+    norm: Optional[NormalizationContext] = None,
+    initial: Optional[Array] = None,
+    warm_start: bool = True,
+) -> SweepResult:
+    """Train one GLM per regularization weight with warm start across the
+    descending-sorted sweep (ModelTraining.scala:175-213).
+
+    The solve is jitted with reg_weight as a traced scalar: every weight in
+    the sweep reuses the same compiled program.
+    """
+    loss = loss_for_task(task)
+    dim = data.feature_dim
+    w0 = jnp.zeros((dim,), jnp.float32) if initial is None else jnp.asarray(initial)
+
+    @jax.jit
+    def _solve(w_init: Array, reg_weight: Array) -> OptResult:
+        cfg = config.with_reg_weight(reg_weight)
+        return solve(loss, data, cfg, w_init, norm)
+
+    models: Dict[float, GeneralizedLinearModel] = {}
+    results: Dict[float, OptResult] = {}
+    w = w0
+    for rw in sorted(reg_weights, reverse=True):
+        res = _solve(w, jnp.asarray(float(rw), jnp.float32))
+        results[rw] = res
+        variances = None
+        if config.variance_computation != VarianceComputationType.NONE:
+            variances = compute_variances(
+                loss, data, config.with_reg_weight(float(rw)), res.coefficients, norm
+            )
+        models[rw] = create_model(task, Coefficients(res.coefficients, variances))
+        if warm_start:
+            w = res.coefficients
+    return SweepResult(models=models, results=results)
+
+
+def select_best_model(
+    sweep: SweepResult,
+    validation: LabeledData,
+    task: TaskType,
+) -> Tuple[float, GeneralizedLinearModel, float]:
+    """Pick the best reg weight on validation data by the task's default
+    metric (ModelSelection.scala:26-92: AUC for binary tasks, error loss for
+    regressions). Returns (weight, model, metric value)."""
+    et = default_evaluator_for_task(task)
+    suite = EvaluationSuite([et], validation.labels, validation.weights)
+    best: Optional[Tuple[float, GeneralizedLinearModel, float]] = None
+    for rw, model in sweep.models.items():
+        # Evaluators consume raw margins (the convention of the validation
+        # path in game/coordinate_descent.py); POISSON_LOSS in particular is
+        # l(z, y), not l(mean, y).
+        scores = model.compute_score(validation.features, validation.offsets)
+        value = suite.evaluate(scores).primary_value
+        if best is None or better_than(et, value, best[2]):
+            best = (rw, model, value)
+    assert best is not None
+    return best

@@ -1,0 +1,134 @@
+"""Supervised GLM wrappers + legacy sweep workflow tests.
+
+Counterpart of the reference's supervised integ tests (photon-api
+src/integTest/.../supervised/BaseGLMIntegTest.scala with property
+validators) and ModelTraining/ModelSelection behavior: link functions,
+class prediction thresholds, warm-started reg-weight sweep, best-model
+selection direction per task.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.data.containers import dense_data
+from photon_ml_tpu.models import (
+    LinearRegressionModel,
+    LogisticRegressionModel,
+    PoissonRegressionModel,
+    create_model,
+    select_best_model,
+    train_glm_sweep,
+)
+from photon_ml_tpu.optimize.config import (
+    L2,
+    CoordinateOptimizationConfig,
+    OptimizerConfig,
+)
+from photon_ml_tpu.types import TaskType, VarianceComputationType
+
+
+def _binary_problem(rng, n=400, d=6):
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    w = rng.normal(size=d).astype(np.float32)
+    p = 1.0 / (1.0 + np.exp(-(X @ w)))
+    y = (rng.uniform(size=n) < p).astype(np.float32)
+    return X, y, w
+
+
+def test_link_functions(rng):
+    w = jnp.asarray(rng.normal(size=4).astype(np.float32))
+    X = jnp.asarray(rng.normal(size=(10, 4)).astype(np.float32))
+    z = X @ w
+
+    logistic = create_model(TaskType.LOGISTIC_REGRESSION, w)
+    assert isinstance(logistic, LogisticRegressionModel)
+    np.testing.assert_allclose(
+        logistic.compute_mean(X), 1.0 / (1.0 + np.exp(-np.asarray(z))), rtol=1e-5
+    )
+
+    linear = create_model(TaskType.LINEAR_REGRESSION, w)
+    assert isinstance(linear, LinearRegressionModel)
+    np.testing.assert_allclose(linear.compute_mean(X), np.asarray(z), rtol=1e-5)
+
+    poisson = create_model(TaskType.POISSON_REGRESSION, w)
+    assert isinstance(poisson, PoissonRegressionModel)
+    np.testing.assert_allclose(poisson.compute_mean(X), np.exp(np.asarray(z)), rtol=1e-4)
+
+
+def test_predict_class_threshold(rng):
+    X, y, w = _binary_problem(rng)
+    model = create_model(TaskType.LOGISTIC_REGRESSION, jnp.asarray(w))
+    classes = np.asarray(model.predict_class(jnp.asarray(X)))
+    assert set(np.unique(classes)).issubset({0.0, 1.0})
+    # Threshold 0 -> everything positive.
+    all_pos = np.asarray(model.predict_class(jnp.asarray(X), threshold=0.0))
+    assert all_pos.min() == 1.0
+
+
+def test_offsets_shift_margin(rng):
+    w = jnp.asarray(rng.normal(size=3).astype(np.float32))
+    X = jnp.asarray(rng.normal(size=(5, 3)).astype(np.float32))
+    off = jnp.asarray(np.arange(5, dtype=np.float32))
+    m = create_model(TaskType.LINEAR_REGRESSION, w)
+    np.testing.assert_allclose(
+        m.compute_score(X, off), np.asarray(X @ w) + np.arange(5), rtol=1e-5
+    )
+
+
+def test_sweep_warm_start_and_selection(rng):
+    X, y, w_true = _binary_problem(rng, n=600)
+    Xv, yv, _ = _binary_problem(rng, n=300)
+    # Same generating coefficients for validation.
+    pv = 1.0 / (1.0 + np.exp(-(Xv @ w_true)))
+    yv = (rng.uniform(size=300) < pv).astype(np.float32)
+
+    data = dense_data(X, y)
+    val = dense_data(Xv, yv)
+    cfg = CoordinateOptimizationConfig(
+        optimizer=OptimizerConfig(max_iterations=100, tolerance=1e-9),
+        regularization=L2,
+    )
+    sweep = train_glm_sweep(
+        data, TaskType.LOGISTIC_REGRESSION, cfg, [1000.0, 10.0, 0.1]
+    )
+    assert set(sweep.models) == {1000.0, 10.0, 0.1}
+    # Heavier regularization shrinks the solution norm monotonically.
+    norms = [
+        float(jnp.linalg.norm(sweep.models[rw].coefficients.means))
+        for rw in [1000.0, 10.0, 0.1]
+    ]
+    assert norms[0] < norms[1] < norms[2]
+
+    rw, best, auc = select_best_model(sweep, val, TaskType.LOGISTIC_REGRESSION)
+    assert rw in (10.0, 0.1)  # the absurd weight should lose
+    assert auc > 0.7
+
+
+def test_sweep_variances(rng):
+    X, y, _ = _binary_problem(rng, n=200, d=4)
+    data = dense_data(X, y)
+    cfg = CoordinateOptimizationConfig(
+        optimizer=OptimizerConfig(max_iterations=50),
+        regularization=L2,
+        variance_computation=VarianceComputationType.SIMPLE,
+    )
+    sweep = train_glm_sweep(data, TaskType.LOGISTIC_REGRESSION, cfg, [1.0])
+    coeffs = sweep.models[1.0].coefficients
+    assert coeffs.variances is not None
+    assert bool(jnp.all(coeffs.variances > 0.0))
+
+
+def test_linear_regression_sweep_selection(rng):
+    n, d = 500, 5
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    w = rng.normal(size=d).astype(np.float32)
+    y = (X @ w + 0.01 * rng.normal(size=n)).astype(np.float32)
+    data = dense_data(X, y)
+    cfg = CoordinateOptimizationConfig(
+        optimizer=OptimizerConfig(max_iterations=100), regularization=L2
+    )
+    sweep = train_glm_sweep(data, TaskType.LINEAR_REGRESSION, cfg, [100.0, 0.01])
+    rw, model, rmse = select_best_model(sweep, data, TaskType.LINEAR_REGRESSION)
+    assert rw == 0.01  # smaller-is-better direction for RMSE
+    assert rmse < 0.1
