@@ -1,0 +1,16 @@
+"""Host-side I/O: Avro codec, training-data ingestion, model + score stores."""
+
+from photon_ml_tpu.io.avro import read_container, read_directory, write_container
+from photon_ml_tpu.io.avro_data import (
+    FeatureShardConfig,
+    read_game_dataset,
+    write_training_examples,
+)
+from photon_ml_tpu.io.model_store import (
+    FixedEffectArtifact,
+    GameModelArtifact,
+    RandomEffectArtifact,
+    load_game_model,
+    save_game_model,
+)
+from photon_ml_tpu.io.score_store import ScoredItem, load_scores, save_scores

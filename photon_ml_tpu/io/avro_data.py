@@ -1,0 +1,192 @@
+"""Avro training-data ingestion: records -> GameDataset feature shards.
+
+Counterpart of photon-client data/avro/AvroDataReader.scala:54-490 (+
+DataReader.scala:27, FeatureShardConfiguration.scala:26, AvroDataWriter.scala
+and GameConverters.scala:44-129). The reference reads Avro GenericRecords
+into a DataFrame with one sparse vector column per feature shard, unioning
+the feature bags each shard configuration lists and appending an intercept;
+GameConverters then turns rows into GameDatum objects. Here records go
+straight to the columnar GameDataset: host-side CSR accumulation per shard,
+packed to the TPU-friendly padded ELL layout, labels/offsets/weights as
+columns, id tags captured from record fields or metadataMap.
+
+Feature keys are `name + DELIMITER + term` ("nameterm" union key,
+readFeaturesFromRecord:274-352); index maps are built per shard on first read
+(generateIndexMapLoaders:223-244) or supplied for reuse (scoring path).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+from photon_ml_tpu.data.containers import pack_csr_to_ell
+from photon_ml_tpu.data.game_dataset import GameDataset
+from photon_ml_tpu.data.index_map import DELIMITER, INTERCEPT_KEY, IndexMap, feature_key
+from photon_ml_tpu.io import avro as avro_io
+from photon_ml_tpu.io import schemas
+
+# InputColumnsNames defaults (photon-api data/InputColumnsNames.scala:65-73).
+RESPONSE = "response"
+LABEL = "label"
+OFFSET = "offset"
+WEIGHT = "weight"
+UID = "uid"
+META_DATA_MAP = "metadataMap"
+_RESERVED = {RESPONSE, LABEL, OFFSET, WEIGHT, UID, META_DATA_MAP}
+
+
+@dataclasses.dataclass(frozen=True)
+class FeatureShardConfig:
+    """One feature shard = union of feature bags + optional intercept
+    (FeatureShardConfiguration.scala:26)."""
+
+    feature_bags: Tuple[str, ...] = ("features",)
+    has_intercept: bool = True
+
+
+def _record_features(record: dict, bags: Sequence[str]) -> List[Tuple[str, float]]:
+    out: List[Tuple[str, float]] = []
+    for bag in bags:
+        for f in record.get(bag) or ():
+            out.append((feature_key(f["name"], f.get("term", "")), float(f["value"])))
+    return out
+
+
+def read_game_dataset(
+    path: str,
+    shard_configs: Mapping[str, FeatureShardConfig],
+    *,
+    index_maps: Optional[Mapping[str, IndexMap]] = None,
+    id_tag_fields: Sequence[str] = (),
+    response_field: str = RESPONSE,
+) -> Tuple[GameDataset, Dict[str, IndexMap]]:
+    """AvroDataReader.readMerged (:85-220) + GameConverters: Avro file/dir ->
+    (GameDataset, per-shard IndexMaps).
+
+    `id_tag_fields` names record fields (or metadataMap keys) to capture as
+    id tags (entity/grouping keys). When `index_maps` is given, unseen
+    features are dropped (the scoring path); otherwise maps are built from
+    the data (the training path).
+    """
+    _, records = avro_io.read_directory(path)
+    n = len(records)
+    if n == 0:
+        raise ValueError(f"no records found under {path}")
+
+    # Parse feature bags once per shard; index maps built from the parsed
+    # lists when not supplied (feature parsing dominates host ETL cost).
+    parsed: Dict[str, List[List[Tuple[str, float]]]] = {
+        shard: [_record_features(rec, cfg.feature_bags) for rec in records]
+        for shard, cfg in shard_configs.items()
+    }
+    built: Dict[str, IndexMap] = {}
+    for shard, cfg in shard_configs.items():
+        if index_maps is not None and shard in index_maps:
+            built[shard] = index_maps[shard]
+        else:
+            keys: set = set()
+            for row in parsed[shard]:
+                keys.update(k for k, _ in row)
+            built[shard] = IndexMap.from_feature_names(keys, add_intercept=cfg.has_intercept)
+
+    # Labels / offsets / weights / uid / tags.
+    def _get(rec: dict, field: str, default: float) -> float:
+        v = rec.get(field)
+        return default if v is None else float(v)
+
+    labels = np.empty(n, np.float32)
+    offsets = np.empty(n, np.float32)
+    weights = np.empty(n, np.float32)
+    for i, rec in enumerate(records):
+        if response_field in rec:
+            labels[i] = _get(rec, response_field, 0.0)
+        else:
+            labels[i] = _get(rec, LABEL, 0.0)
+        offsets[i] = _get(rec, OFFSET, 0.0)
+        weights[i] = _get(rec, WEIGHT, 1.0)
+
+    id_tags: Dict[str, np.ndarray] = {}
+    for tag in id_tag_fields:
+        vals = []
+        for rec in records:
+            v = rec.get(tag)
+            if v is None:
+                v = (rec.get(META_DATA_MAP) or {}).get(tag, "")
+            vals.append(str(v))
+        id_tags[tag] = np.asarray(vals)
+    uids = [rec.get(UID) for rec in records]
+    if any(u is not None for u in uids):
+        id_tags[UID] = np.asarray([str(u) if u is not None else "" for u in uids])
+
+    # Per-shard CSR -> ELL.
+    shards = {}
+    for shard, cfg in shard_configs.items():
+        imap = built[shard]
+        intercept_idx = imap.intercept_index
+        indptr = np.zeros(n + 1, np.int64)
+        idx_buf: List[int] = []
+        val_buf: List[float] = []
+        for i, row in enumerate(parsed[shard]):
+            for key, value in row:
+                j = imap.get_index(key)
+                if j >= 0:
+                    idx_buf.append(j)
+                    val_buf.append(value)
+            if cfg.has_intercept and intercept_idx is not None:
+                idx_buf.append(intercept_idx)
+                val_buf.append(1.0)
+            indptr[i + 1] = len(idx_buf)
+        shards[shard] = pack_csr_to_ell(
+            indptr,
+            np.asarray(idx_buf, np.int64),
+            np.asarray(val_buf, np.float32),
+            imap.size,
+        )
+
+    ds = GameDataset.build(shards, labels, offsets=offsets, weights=weights, id_tags=id_tags)
+    return ds, built
+
+
+def write_training_examples(
+    path: str,
+    features: Sequence[Sequence[Tuple[str, float]]],
+    labels: Sequence[float],
+    *,
+    offsets: Optional[Sequence[float]] = None,
+    weights: Optional[Sequence[float]] = None,
+    uids: Optional[Sequence[str]] = None,
+    id_tags: Optional[Mapping[str, Sequence]] = None,
+) -> int:
+    """AvroDataWriter equivalent: write TrainingExampleAvro records.
+
+    `features[i]` is a list of (feature_key, value); keys are split back into
+    (name, term) on the reference DELIMITER.
+    """
+
+    def records():
+        for i, label in enumerate(labels):
+            feats = []
+            for key, value in features[i]:
+                if DELIMITER in key:
+                    name, term = key.split(DELIMITER, 1)
+                else:
+                    name, term = key, ""
+                if key == INTERCEPT_KEY:
+                    continue  # intercept is appended at read time
+                feats.append({"name": name, "term": term, "value": float(value)})
+            meta = None
+            if id_tags:
+                meta = {k: str(v[i]) for k, v in id_tags.items()}
+            yield {
+                "uid": None if uids is None else str(uids[i]),
+                "label": float(label),
+                "features": feats,
+                "weight": 1.0 if weights is None else float(weights[i]),
+                "offset": 0.0 if offsets is None else float(offsets[i]),
+                "metadataMap": meta,
+            }
+
+    return avro_io.write_container(path, schemas.TRAINING_EXAMPLE, records())

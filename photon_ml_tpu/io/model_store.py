@@ -1,0 +1,292 @@
+"""GAME model persistence in the reference's HDFS directory layout.
+
+Counterpart of photon-client data/avro/ModelProcessingUtils.scala:59-625 and
+AvroConstants.scala. Layout written/read here (identical to the reference so
+model artifacts interoperate):
+
+    <dir>/model-metadata.json                      (saveGameModelMetadataToHDFS:489)
+    <dir>/fixed-effect/<coordinateId>/id-info      (one line: featureShardId)
+    <dir>/fixed-effect/<coordinateId>/coefficients/part-00000.avro
+         (single BayesianLinearModelAvro record, saveModelToHDFS:300-320)
+    <dir>/random-effect/<coordinateId>/id-info     (lines: randomEffectType, featureShardId)
+    <dir>/random-effect/<coordinateId>/coefficients/part-<k>.avro
+         (one BayesianLinearModelAvro per entity, modelId = entity id,
+          saveModelsRDDToHDFS:354-378)
+
+Coefficients are written as (name, term, value) records resolved through the
+feature IndexMap in both directions, filtered by `sparsity_threshold`
+(|value| <= threshold dropped, like the reference's VectorUtils filter);
+variances ride along when present. The metadata JSON carries the task type
+under "modelType" plus the per-coordinate optimization configs
+(gameOptConfigToJson:408-487).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import json
+import os
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+from photon_ml_tpu.data.index_map import DELIMITER, IndexMap
+from photon_ml_tpu.io import avro as avro_io
+from photon_ml_tpu.io import schemas
+from photon_ml_tpu.types import TaskType
+
+FIXED_EFFECT = "fixed-effect"
+RANDOM_EFFECT = "random-effect"
+COEFFICIENTS = "coefficients"
+ID_INFO = "id-info"
+METADATA_FILE = "model-metadata.json"
+MODEL_TYPE = "modelType"
+DEFAULT_AVRO_FILE = "part-00000.avro"
+
+# modelClass strings the reference writes (AvroUtils.convertGLMModelTo...);
+# kept verbatim for artifact-level compatibility.
+_MODEL_CLASS = {
+    TaskType.LOGISTIC_REGRESSION: "com.linkedin.photon.ml.supervised.classification.LogisticRegressionModel",
+    TaskType.LINEAR_REGRESSION: "com.linkedin.photon.ml.supervised.regression.LinearRegressionModel",
+    TaskType.POISSON_REGRESSION: "com.linkedin.photon.ml.supervised.regression.PoissonRegressionModel",
+    TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM: "com.linkedin.photon.ml.supervised.classification.SmoothedHingeLossLinearSVMModel",
+}
+_CLASS_TO_TASK = {v: k for k, v in _MODEL_CLASS.items()}
+
+
+def _split_key(key: str) -> Tuple[str, str]:
+    if DELIMITER in key:
+        name, term = key.split(DELIMITER, 1)
+        return name, term
+    return key, ""
+
+
+def _coeffs_to_ntv(
+    vector: np.ndarray, index_map: IndexMap, threshold: float
+) -> List[dict]:
+    out = []
+    for idx in np.flatnonzero(np.abs(vector) > threshold):
+        key = index_map.get_feature_name(int(idx))
+        if key is None:
+            continue
+        name, term = _split_key(key)
+        out.append({"name": name, "term": term, "value": float(vector[idx])})
+    return out
+
+
+def _ntv_to_coeffs(records: Sequence[dict], index_map: IndexMap) -> np.ndarray:
+    vec = np.zeros(index_map.size, np.float64)
+    from photon_ml_tpu.data.index_map import feature_key
+
+    for r in records:
+        idx = index_map.get_index(feature_key(r["name"], r["term"]))
+        if idx >= 0:
+            vec[idx] = r["value"]
+    return vec
+
+
+def _glm_record(
+    model_id: str,
+    task: Optional[TaskType],
+    means: np.ndarray,
+    variances: Optional[np.ndarray],
+    index_map: IndexMap,
+    threshold: float,
+) -> dict:
+    rec = {
+        "modelId": model_id,
+        "modelClass": _MODEL_CLASS.get(task) if task else None,
+        "means": _coeffs_to_ntv(means, index_map, threshold),
+        "variances": None,
+        "lossFunction": None,
+    }
+    if variances is not None:
+        finite = np.where(np.isfinite(variances), variances, 0.0)
+        rec["variances"] = _coeffs_to_ntv(finite, index_map, 0.0)
+    return rec
+
+
+@dataclasses.dataclass
+class FixedEffectArtifact:
+    """Host-side fixed-effect coordinate payload for save/load."""
+
+    feature_shard: str
+    means: np.ndarray
+    variances: Optional[np.ndarray] = None
+
+
+@dataclasses.dataclass
+class RandomEffectArtifact:
+    """Host-side random-effect coordinate payload: one row per entity id."""
+
+    random_effect_type: str
+    feature_shard: str
+    entity_ids: List[str]
+    means: np.ndarray  # (E, D)
+    variances: Optional[np.ndarray] = None  # (E, D)
+
+
+@dataclasses.dataclass
+class GameModelArtifact:
+    """A GAME model as saved/loaded: coordinate id -> artifact + metadata."""
+
+    task: TaskType
+    coordinates: Dict[str, object]  # FixedEffectArtifact | RandomEffectArtifact
+    opt_configs: Dict[str, dict] = dataclasses.field(default_factory=dict)
+
+
+def save_game_model(
+    output_dir: str,
+    artifact: GameModelArtifact,
+    index_maps: Mapping[str, IndexMap],
+    *,
+    sparsity_threshold: float = 0.0,
+    random_effect_file_limit: Optional[int] = None,
+    records_per_file: int = 100_000,
+) -> None:
+    """saveGameModelToHDFS (ModelProcessingUtils.scala:77-141)."""
+    os.makedirs(output_dir, exist_ok=True)
+    _save_metadata(output_dir, artifact)
+
+    for cid, coord in artifact.coordinates.items():
+        if isinstance(coord, FixedEffectArtifact):
+            cdir = os.path.join(output_dir, FIXED_EFFECT, cid)
+            os.makedirs(os.path.join(cdir, COEFFICIENTS), exist_ok=True)
+            with open(os.path.join(cdir, ID_INFO), "w") as f:
+                f.write(coord.feature_shard + "\n")
+            rec = _glm_record(
+                FIXED_EFFECT,
+                artifact.task,
+                coord.means,
+                coord.variances,
+                index_maps[coord.feature_shard],
+                sparsity_threshold,
+            )
+            avro_io.write_container(
+                os.path.join(cdir, COEFFICIENTS, DEFAULT_AVRO_FILE),
+                schemas.BAYESIAN_LINEAR_MODEL,
+                [rec],
+            )
+        elif isinstance(coord, RandomEffectArtifact):
+            cdir = os.path.join(output_dir, RANDOM_EFFECT, cid)
+            os.makedirs(os.path.join(cdir, COEFFICIENTS), exist_ok=True)
+            with open(os.path.join(cdir, ID_INFO), "w") as f:
+                f.write(coord.random_effect_type + "\n" + coord.feature_shard + "\n")
+            imap = index_maps[coord.feature_shard]
+            recs = (
+                _glm_record(
+                    str(eid),
+                    artifact.task,
+                    coord.means[i],
+                    None if coord.variances is None else coord.variances[i],
+                    imap,
+                    sparsity_threshold,
+                )
+                for i, eid in enumerate(coord.entity_ids)
+            )
+            avro_io.write_part_files(
+                os.path.join(cdir, COEFFICIENTS),
+                schemas.BAYESIAN_LINEAR_MODEL,
+                recs,
+                len(coord.entity_ids),
+                records_per_file=records_per_file,
+                file_limit=random_effect_file_limit,
+            )
+        else:
+            raise TypeError(f"unknown coordinate artifact {type(coord)} for {cid!r}")
+
+
+def load_game_model(
+    models_dir: str,
+    index_maps: Mapping[str, IndexMap],
+    *,
+    coordinates_to_load: Optional[Sequence[str]] = None,
+) -> GameModelArtifact:
+    """loadGameModelFromHDFS (ModelProcessingUtils.scala:143-265)."""
+    task = _load_metadata_task(models_dir)
+    wanted = set(coordinates_to_load) if coordinates_to_load else None
+    coords: Dict[str, object] = {}
+
+    fe_dir = os.path.join(models_dir, FIXED_EFFECT)
+    if os.path.isdir(fe_dir):
+        for cid in sorted(os.listdir(fe_dir)):
+            if wanted is not None and cid not in wanted:
+                continue
+            cdir = os.path.join(fe_dir, cid)
+            with open(os.path.join(cdir, ID_INFO)) as f:
+                shard = f.read().split()[0]
+            imap = index_maps[shard]
+            _, recs = avro_io.read_container(
+                os.path.join(cdir, COEFFICIENTS, DEFAULT_AVRO_FILE)
+            )
+            rec = recs[0]
+            means = _ntv_to_coeffs(rec["means"], imap)
+            variances = (
+                _ntv_to_coeffs(rec["variances"], imap)
+                if rec.get("variances")
+                else None
+            )
+            coords[cid] = FixedEffectArtifact(shard, means, variances)
+
+    re_dir = os.path.join(models_dir, RANDOM_EFFECT)
+    if os.path.isdir(re_dir):
+        for cid in sorted(os.listdir(re_dir)):
+            if wanted is not None and cid not in wanted:
+                continue
+            cdir = os.path.join(re_dir, cid)
+            with open(os.path.join(cdir, ID_INFO)) as f:
+                lines = f.read().split()
+            re_type, shard = lines[0], lines[1]
+            imap = index_maps[shard]
+            entity_ids: List[str] = []
+            rows: List[np.ndarray] = []
+            var_rows: List[Optional[np.ndarray]] = []
+            for part in sorted(glob.glob(os.path.join(cdir, COEFFICIENTS, "*.avro"))):
+                _, recs = avro_io.read_container(part)
+                for rec in recs:
+                    entity_ids.append(rec["modelId"])
+                    rows.append(_ntv_to_coeffs(rec["means"], imap))
+                    var_rows.append(
+                        _ntv_to_coeffs(rec["variances"], imap)
+                        if rec.get("variances")
+                        else None
+                    )
+            means = np.stack(rows) if rows else np.zeros((0, imap.size))
+            variances = (
+                np.stack([v for v in var_rows])
+                if var_rows and all(v is not None for v in var_rows)
+                else None
+            )
+            coords[cid] = RandomEffectArtifact(re_type, shard, entity_ids, means, variances)
+
+    if not coords:
+        raise FileNotFoundError(f"No models could be loaded from: {models_dir}")
+    return GameModelArtifact(
+        task=task, coordinates=coords, opt_configs=_load_metadata_opt_configs(models_dir)
+    )
+
+
+def _save_metadata(output_dir: str, artifact: GameModelArtifact) -> None:
+    """saveGameModelMetadataToHDFS (:489-514) + gameOptConfigToJson (:408-487)."""
+    doc = {
+        MODEL_TYPE: artifact.task.value,
+        "optimizationConfigurations": artifact.opt_configs,
+    }
+    with open(os.path.join(output_dir, METADATA_FILE), "w") as f:
+        json.dump(doc, f, indent=2)
+
+
+def _load_metadata_task(models_dir: str) -> TaskType:
+    """loadGameModelMetadataFromHDFS (:608+): extract "modelType"."""
+    path = os.path.join(models_dir, METADATA_FILE)
+    with open(path) as f:
+        doc = json.load(f)
+    if MODEL_TYPE not in doc:
+        raise RuntimeError(f"Couldn't find '{MODEL_TYPE}' in metadata file: {path}")
+    return TaskType(doc[MODEL_TYPE])
+
+
+def _load_metadata_opt_configs(models_dir: str) -> Dict[str, dict]:
+    with open(os.path.join(models_dir, METADATA_FILE)) as f:
+        return json.load(f).get("optimizationConfigurations", {})

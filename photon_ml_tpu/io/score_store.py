@@ -1,0 +1,83 @@
+"""Score persistence: ScoredItem <-> ScoringResultAvro.
+
+Counterpart of photon-client data/avro/ScoreProcessingUtils.scala:29-88 and
+cli/game/scoring/ScoredItem.scala:37. Scores are written as one Avro
+container directory of ScoringResultAvro records (the GameScoringDriver's
+saveScoresToHDFS output format, GameScoringDriver.scala:229-260).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from photon_ml_tpu.io import avro as avro_io
+from photon_ml_tpu.io import schemas
+
+
+@dataclasses.dataclass
+class ScoredItem:
+    """One scored datum (ScoredItem.scala:37)."""
+
+    prediction_score: float
+    uid: Optional[str] = None
+    label: Optional[float] = None
+    weight: Optional[float] = None
+    ids: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+def save_scores(
+    output_dir: str,
+    scores: np.ndarray,
+    model_id: str,
+    *,
+    uids: Optional[Sequence[str]] = None,
+    labels: Optional[np.ndarray] = None,
+    weights: Optional[np.ndarray] = None,
+    id_tags: Optional[Dict[str, Sequence]] = None,
+    records_per_file: int = 500_000,
+) -> int:
+    """Write scores as ScoringResultAvro part files; returns record count."""
+    os.makedirs(output_dir, exist_ok=True)
+    n = len(scores)
+
+    def records() -> Iterator[dict]:
+        for i in range(n):
+            meta = None
+            if id_tags:
+                meta = {k: str(v[i]) for k, v in id_tags.items()}
+            yield {
+                "uid": None if uids is None else str(uids[i]),
+                "label": None if labels is None else float(labels[i]),
+                "modelId": model_id,
+                "predictionScore": float(scores[i]),
+                "weight": None if weights is None else float(weights[i]),
+                "metadataMap": meta,
+            }
+
+    return avro_io.write_part_files(
+        output_dir,
+        schemas.SCORING_RESULT,
+        records(),
+        n,
+        records_per_file=records_per_file,
+    )
+
+
+def load_scores(path: str) -> List[ScoredItem]:
+    """Read ScoringResultAvro records back into ScoredItems
+    (ScoreProcessingUtils.loadScoredItemsFromHDFS)."""
+    _, recs = avro_io.read_directory(path)
+    return [
+        ScoredItem(
+            prediction_score=r["predictionScore"],
+            uid=r.get("uid"),
+            label=r.get("label"),
+            weight=r.get("weight"),
+            ids=r.get("metadataMap") or {},
+        )
+        for r in recs
+    ]

@@ -1,0 +1,303 @@
+"""I/O layer tests: Avro codec round-trips, model store layout + round-trip,
+score store, training-data reader.
+
+Counterpart of the reference's Avro/model-processing integ tests
+(photon-client src/integTest/.../data/avro/ModelProcessingUtilsIntegTest,
+AvroDataReaderIntegTest): write -> read -> exact content equality, directory
+layout assertions, and a reader path driven off writer output (golden-file
+self-consistency, since the reference's .avro fixtures are not portable).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.data.index_map import IndexMap, feature_key
+from photon_ml_tpu.io import avro as avro_io
+from photon_ml_tpu.io import schemas
+from photon_ml_tpu.io.avro_data import (
+    FeatureShardConfig,
+    read_game_dataset,
+    write_training_examples,
+)
+from photon_ml_tpu.io.model_store import (
+    FixedEffectArtifact,
+    GameModelArtifact,
+    RandomEffectArtifact,
+    load_game_model,
+    save_game_model,
+)
+from photon_ml_tpu.io.score_store import load_scores, save_scores
+from photon_ml_tpu.types import TaskType
+
+
+# ---------------------------------------------------------------------------
+# Avro codec
+
+
+def test_avro_primitives_roundtrip(tmp_path):
+    schema = {
+        "name": "T",
+        "type": "record",
+        "fields": [
+            {"name": "l", "type": "long"},
+            {"name": "i", "type": "int"},
+            {"name": "f", "type": "float"},
+            {"name": "d", "type": "double"},
+            {"name": "s", "type": "string"},
+            {"name": "b", "type": "boolean"},
+            {"name": "by", "type": "bytes"},
+            {"name": "n", "type": ["null", "string"], "default": None},
+            {"name": "arr", "type": {"type": "array", "items": "long"}},
+            {"name": "m", "type": {"type": "map", "values": "double"}},
+        ],
+    }
+    recs = [
+        {
+            "l": -(2**40),
+            "i": -1,
+            "f": 1.5,
+            "d": 2.25,
+            "s": "héllox",
+            "b": True,
+            "by": b"\x00\xff",
+            "n": None,
+            "arr": [0, -1, 2**33],
+            "m": {"a": 1.0, "b": -2.5},
+        },
+        {
+            "l": 0,
+            "i": 2**30,
+            "f": -0.25,
+            "d": 1e300,
+            "s": "",
+            "b": False,
+            "by": b"",
+            "n": "x",
+            "arr": [],
+            "m": {},
+        },
+    ]
+    p = str(tmp_path / "t.avro")
+    for codec in ("null", "deflate"):
+        avro_io.write_container(p, schema, recs, codec=codec)
+        rschema, out = avro_io.read_container(p)
+        assert rschema == schema
+        assert out[0]["l"] == recs[0]["l"]
+        assert out[0]["s"] == recs[0]["s"]
+        assert out[0]["arr"] == recs[0]["arr"]
+        assert out[1]["n"] == "x"
+        np.testing.assert_allclose(out[0]["f"], 1.5)
+        assert out[0]["by"] == b"\x00\xff"
+
+
+def test_avro_zigzag_edge_values(tmp_path):
+    schema = {"name": "L", "type": "record", "fields": [{"name": "v", "type": "long"}]}
+    vals = [0, -1, 1, 63, 64, -64, -65, 2**62, -(2**62)]
+    p = str(tmp_path / "l.avro")
+    avro_io.write_container(p, schema, [{"v": v} for v in vals])
+    _, out = avro_io.read_container(p)
+    assert [r["v"] for r in out] == vals
+
+
+def test_avro_multiblock(tmp_path):
+    schema = {"name": "R", "type": "record", "fields": [{"name": "v", "type": "long"}]}
+    recs = [{"v": i} for i in range(10_000)]
+    p = str(tmp_path / "many.avro")
+    avro_io.write_container(p, schema, recs, block_records=256)
+    _, out = avro_io.read_container(p)
+    assert [r["v"] for r in out] == list(range(10_000))
+
+
+def test_bayesian_model_record_roundtrip(tmp_path):
+    rec = {
+        "modelId": "fixed-effect",
+        "modelClass": "com.linkedin.photon.ml.supervised.classification.LogisticRegressionModel",
+        "means": [{"name": "f1", "term": "t", "value": 0.5}],
+        "variances": None,
+        "lossFunction": None,
+    }
+    p = str(tmp_path / "m.avro")
+    avro_io.write_container(p, schemas.BAYESIAN_LINEAR_MODEL, [rec])
+    _, out = avro_io.read_container(p)
+    assert out[0]["modelId"] == "fixed-effect"
+    assert out[0]["means"][0]["value"] == 0.5
+    assert out[0]["variances"] is None
+
+
+# ---------------------------------------------------------------------------
+# Model store
+
+
+def _index_map(d):
+    return IndexMap.from_feature_names(
+        [feature_key(f"f{i}", "t") for i in range(d - 1)], add_intercept=True
+    )
+
+
+def test_model_store_roundtrip(tmp_path, rng):
+    d = 6
+    imap = _index_map(d)
+    fe = FixedEffectArtifact(
+        "globalShard",
+        rng.normal(size=d),
+        np.abs(rng.normal(size=d)),
+    )
+    ents = [f"user{i}" for i in range(5)]
+    re = RandomEffectArtifact(
+        "userId", "globalShard", ents, rng.normal(size=(5, d)), None
+    )
+    art = GameModelArtifact(
+        task=TaskType.LOGISTIC_REGRESSION,
+        coordinates={"global": fe, "per-user": re},
+        opt_configs={"global": {"regularizationWeight": 1.0}},
+    )
+    out = str(tmp_path / "model")
+    save_game_model(out, art, {"globalShard": imap})
+
+    # Reference directory layout (ModelProcessingUtils/AvroConstants).
+    assert os.path.exists(os.path.join(out, "model-metadata.json"))
+    assert os.path.exists(os.path.join(out, "fixed-effect", "global", "id-info"))
+    assert os.path.exists(
+        os.path.join(out, "fixed-effect", "global", "coefficients", "part-00000.avro")
+    )
+    assert os.path.isdir(os.path.join(out, "random-effect", "per-user", "coefficients"))
+    meta = json.load(open(os.path.join(out, "model-metadata.json")))
+    assert meta["modelType"] == "LOGISTIC_REGRESSION"
+
+    loaded = load_game_model(out, {"globalShard": imap})
+    assert loaded.task == TaskType.LOGISTIC_REGRESSION
+    lfe = loaded.coordinates["global"]
+    np.testing.assert_allclose(lfe.means, fe.means, rtol=1e-12)
+    np.testing.assert_allclose(lfe.variances, fe.variances, rtol=1e-12)
+    lre = loaded.coordinates["per-user"]
+    assert lre.random_effect_type == "userId"
+    assert sorted(lre.entity_ids) == sorted(ents)
+    order = [lre.entity_ids.index(e) for e in ents]
+    np.testing.assert_allclose(lre.means[order], re.means, rtol=1e-12)
+
+
+def test_model_store_sparsity_threshold(tmp_path):
+    imap = _index_map(4)
+    means = np.array([1.0, 1e-9, -2.0, 0.0])
+    art = GameModelArtifact(
+        TaskType.LINEAR_REGRESSION,
+        {"g": FixedEffectArtifact("s", means)},
+    )
+    out = str(tmp_path / "m")
+    save_game_model(out, art, {"s": imap}, sparsity_threshold=1e-6)
+    loaded = load_game_model(out, {"s": imap})
+    got = loaded.coordinates["g"].means
+    np.testing.assert_allclose(got, [1.0, 0.0, -2.0, 0.0])
+
+
+def test_model_store_partial_load(tmp_path, rng):
+    imap = _index_map(3)
+    art = GameModelArtifact(
+        TaskType.LINEAR_REGRESSION,
+        {
+            "a": FixedEffectArtifact("s", rng.normal(size=3)),
+            "b": FixedEffectArtifact("s", rng.normal(size=3)),
+        },
+    )
+    out = str(tmp_path / "m")
+    save_game_model(out, art, {"s": imap})
+    loaded = load_game_model(out, {"s": imap}, coordinates_to_load=["a"])
+    assert set(loaded.coordinates) == {"a"}
+
+
+def test_random_effect_file_limit(tmp_path, rng):
+    imap = _index_map(3)
+    ents = [f"e{i}" for i in range(10)]
+    art = GameModelArtifact(
+        TaskType.LINEAR_REGRESSION,
+        {"r": RandomEffectArtifact("uid", "s", ents, rng.normal(size=(10, 3)))},
+    )
+    out = str(tmp_path / "m")
+    save_game_model(out, art, {"s": imap}, random_effect_file_limit=3)
+    parts = os.listdir(os.path.join(out, "random-effect", "r", "coefficients"))
+    assert len(parts) == 3
+    loaded = load_game_model(out, {"s": imap})
+    assert len(loaded.coordinates["r"].entity_ids) == 10
+
+
+# ---------------------------------------------------------------------------
+# Scores
+
+
+def test_score_store_roundtrip(tmp_path, rng):
+    n = 1000
+    scores = rng.normal(size=n)
+    labels = (rng.uniform(size=n) > 0.5).astype(np.float64)
+    out = str(tmp_path / "scores")
+    count = save_scores(
+        out,
+        scores,
+        "my-model",
+        uids=[f"u{i}" for i in range(n)],
+        labels=labels,
+        id_tags={"userId": [f"user{i % 7}" for i in range(n)]},
+        records_per_file=300,
+    )
+    assert count == n
+    items = load_scores(out)
+    assert len(items) == n
+    by_uid = {it.uid: it for it in items}
+    np.testing.assert_allclose(by_uid["u3"].prediction_score, scores[3])
+    assert by_uid["u3"].ids["userId"] == "user3"
+
+
+# ---------------------------------------------------------------------------
+# Training data reader
+
+
+def test_training_data_roundtrip(tmp_path, rng):
+    n, d = 50, 8
+    keys = [feature_key(f"f{j}", "") for j in range(d)]
+    feats = []
+    labels = []
+    users = []
+    for i in range(n):
+        nnz = rng.integers(1, d)
+        cols = rng.choice(d, size=nnz, replace=False)
+        feats.append([(keys[c], float(rng.normal())) for c in cols])
+        labels.append(float(rng.integers(0, 2)))
+        users.append(f"user{i % 5}")
+    p = str(tmp_path / "train.avro")
+    write_training_examples(
+        p, feats, labels, uids=[str(i) for i in range(n)], id_tags={"userId": users}
+    )
+
+    ds, imaps = read_game_dataset(
+        p,
+        {"global": FeatureShardConfig(feature_bags=("features",), has_intercept=True)},
+        id_tag_fields=["userId"],
+        response_field="label",
+    )
+    assert ds.num_samples == n
+    assert list(ds.id_tags["userId"]) == users
+    imap = imaps["global"]
+    assert imap.intercept_index is not None
+    # Spot-check: densify row 0 and compare against written features.
+    dense = np.asarray(ds.shards["global"].to_dense())
+    for key, value in feats[0]:
+        np.testing.assert_allclose(dense[0, imap.get_index(key)], value, rtol=1e-6)
+    np.testing.assert_allclose(dense[:, imap.intercept_index], 1.0)
+    np.testing.assert_allclose(np.asarray(ds.labels), labels)
+
+
+def test_reader_with_fixed_index_map_drops_unseen(tmp_path):
+    p = str(tmp_path / "t.avro")
+    write_training_examples(p, [[("known", 1.0), ("unknown", 2.0)]], [1.0])
+    imap = IndexMap.from_feature_names(["known"], add_intercept=False)
+    ds, maps = read_game_dataset(
+        p,
+        {"g": FeatureShardConfig(has_intercept=False)},
+        index_maps={"g": imap},
+        response_field="label",
+    )
+    dense = np.asarray(ds.shards["g"].to_dense())
+    assert dense.shape == (1, 1)
+    np.testing.assert_allclose(dense[0, 0], 1.0)
