@@ -1,0 +1,19 @@
+"""Hyperparameter auto-tuning: Sobol random + GP/EI Bayesian search."""
+
+from photon_ml_tpu.hyperparameter.gp import GaussianProcessModel, fit_gp
+from photon_ml_tpu.hyperparameter.search import (
+    GaussianProcessSearch,
+    HyperparameterConfig,
+    Observation,
+    RandomSearch,
+    SearchResult,
+    backward_scale,
+    config_from_json,
+    forward_scale,
+    priors_from_json,
+)
+from photon_ml_tpu.hyperparameter.tuner import (
+    HyperparameterTuner,
+    HyperparameterTuningMode,
+    get_tuner,
+)

@@ -1,0 +1,107 @@
+"""Stationary GP kernels: RBF and Matern 5/2.
+
+Counterpart of photon-lib hyperparameter/estimators/kernels/
+(StationaryKernel.scala, RBF.scala, Matern52.scala). Kernels carry
+(amplitude, noise, length-scales) hyperparameters; `matrix` builds the Gram
+matrix with noise on the diagonal, `cross` the test/train covariance. All
+math is jax so the marginal likelihood is differentiable (the reference fits
+by slice sampling; we support both sampling and gradient fits).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+_JITTER = 1e-8
+
+
+def _sq_dists(xa: Array, xb: Array, lengthscales: Array) -> Array:
+    a = xa / lengthscales
+    b = xb / lengthscales
+    d2 = (
+        jnp.sum(a * a, -1)[:, None]
+        + jnp.sum(b * b, -1)[None, :]
+        - 2.0 * (a @ b.T)
+    )
+    return jnp.maximum(d2, 0.0)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class KernelParams:
+    """log-space hyperparameters (positivity by construction)."""
+
+    log_amplitude: Array
+    log_noise: Array
+    log_lengthscales: Array  # (D,) ARD
+
+    @property
+    def amplitude(self) -> Array:
+        return jnp.exp(self.log_amplitude)
+
+    @property
+    def noise(self) -> Array:
+        return jnp.exp(self.log_noise)
+
+    @property
+    def lengthscales(self) -> Array:
+        return jnp.exp(self.log_lengthscales)
+
+    def as_vector(self) -> Array:
+        return jnp.concatenate(
+            [self.log_amplitude[None], self.log_noise[None], self.log_lengthscales]
+        )
+
+    @classmethod
+    def from_vector(cls, v: Array) -> "KernelParams":
+        return cls(v[0], v[1], v[2:])
+
+    @classmethod
+    def default(cls, dim: int) -> "KernelParams":
+        return cls(
+            jnp.asarray(0.0), jnp.asarray(jnp.log(1e-2)), jnp.zeros((dim,))
+        )
+
+
+def rbf(params: KernelParams, xa: Array, xb: Array) -> Array:
+    d2 = _sq_dists(xa, xb, params.lengthscales)
+    return params.amplitude * jnp.exp(-0.5 * d2)
+
+
+def matern52(params: KernelParams, xa: Array, xb: Array) -> Array:
+    d2 = _sq_dists(xa, xb, params.lengthscales)
+    d = jnp.sqrt(d2 + 1e-24)
+    s5 = jnp.sqrt(5.0)
+    return params.amplitude * (1.0 + s5 * d + (5.0 / 3.0) * d2) * jnp.exp(-s5 * d)
+
+
+KernelFn = Callable[[KernelParams, Array, Array], Array]
+
+KERNELS = {"rbf": rbf, "matern52": matern52}
+
+
+def gram(kernel: KernelFn, params: KernelParams, x: Array) -> Array:
+    k = kernel(params, x, x)
+    n = x.shape[0]
+    return k + (params.noise + _JITTER) * jnp.eye(n, dtype=k.dtype)
+
+
+def log_marginal_likelihood(
+    kernel: KernelFn, params: KernelParams, x: Array, y: Array
+) -> Array:
+    """Standard GP evidence: -1/2 (y^T K^-1 y + log|K| + n log 2pi)."""
+    K = gram(kernel, params, x)
+    chol = jnp.linalg.cholesky(K)
+    alpha = jax.scipy.linalg.cho_solve((chol, True), y)
+    n = y.shape[0]
+    return -0.5 * (
+        y @ alpha
+        + 2.0 * jnp.sum(jnp.log(jnp.diagonal(chol)))
+        + n * jnp.log(2.0 * jnp.pi)
+    )

@@ -1,0 +1,214 @@
+"""Random (Sobol) and Bayesian (GP + EI) hyperparameter search.
+
+Counterpart of photon-lib hyperparameter/search/ (RandomSearch.scala:34-183,
+GaussianProcessSearch.scala:52-197) plus VectorRescaling.scala and
+HyperparameterSerialization.scala. Candidates are drawn from a Sobol
+quasi-random sequence in the unit cube (the reference uses commons-math3's
+SobolSequenceGenerator; here scipy.stats.qmc.Sobol), rescaled to each
+parameter's range with optional log transform, and evaluated through a
+user evaluation function. Bayesian mode fits a GP to all observations and
+picks the argmax of Expected Improvement over a 250-candidate Sobol pool
+(candidatePoolSize, GaussianProcessSearch.scala:52-113).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+from scipy.stats import qmc
+
+from photon_ml_tpu.hyperparameter.gp import fit_gp
+
+EvaluationFunction = Callable[[np.ndarray], float]
+
+CANDIDATE_POOL_SIZE = 250  # GaussianProcessSearch.scala:52
+
+
+@dataclasses.dataclass(frozen=True)
+class HyperparameterConfig:
+    """One tunable parameter (HyperparameterConfig.scala + tuning JSON doc).
+
+    `transform`: None or "LOG" (VectorRescaling log-scale handling).
+    """
+
+    name: str
+    min_value: float
+    max_value: float
+    transform: Optional[str] = None
+    discrete: bool = False
+
+
+def forward_scale(values: np.ndarray, configs: Sequence[HyperparameterConfig]) -> np.ndarray:
+    """Parameter space -> unit cube (VectorRescaling.scaleForward)."""
+    out = np.empty_like(values, dtype=np.float64)
+    for i, c in enumerate(configs):
+        lo, hi = c.min_value, c.max_value
+        v = values[..., i]
+        if c.transform == "LOG":
+            lo, hi = np.log10(lo), np.log10(hi)
+            v = np.log10(v)
+        out[..., i] = (v - lo) / (hi - lo)
+    return out
+
+
+def backward_scale(unit: np.ndarray, configs: Sequence[HyperparameterConfig]) -> np.ndarray:
+    """Unit cube -> parameter space (VectorRescaling.scaleBackward)."""
+    out = np.empty_like(unit, dtype=np.float64)
+    for i, c in enumerate(configs):
+        lo, hi = c.min_value, c.max_value
+        if c.transform == "LOG":
+            llo, lhi = np.log10(lo), np.log10(hi)
+            v = 10.0 ** (unit[..., i] * (lhi - llo) + llo)
+        else:
+            v = unit[..., i] * (hi - lo) + lo
+        if c.discrete:
+            v = np.clip(np.round(v), lo, hi)
+        out[..., i] = v
+    return out
+
+
+@dataclasses.dataclass
+class Observation:
+    point: np.ndarray  # parameter space
+    value: float
+
+
+@dataclasses.dataclass
+class SearchResult:
+    observations: List[Observation]
+    best_point: np.ndarray
+    best_value: float
+
+
+class RandomSearch:
+    """Sobol quasi-random search (RandomSearch.scala:34-110)."""
+
+    def __init__(
+        self,
+        configs: Sequence[HyperparameterConfig],
+        evaluation_function: EvaluationFunction,
+        *,
+        maximize: bool = False,
+        seed: int = 1,
+    ):
+        self.configs = list(configs)
+        self.evaluation_function = evaluation_function
+        self.maximize = maximize
+        self.dim = len(self.configs)
+        self._sobol = qmc.Sobol(d=self.dim, scramble=True, seed=seed)
+        self.observations: List[Observation] = []
+        self.prior_observations: List[Observation] = []
+
+    # -- candidate proposal (overridden by the GP search) --------------------
+
+    def propose(self) -> np.ndarray:
+        return backward_scale(self._sobol.random(1)[0], self.configs)
+
+    def on_observation(self, obs: Observation) -> None:
+        pass
+
+    # -- drive loop (findWithPriors / findWithPriorObservations / find) ------
+
+    def find(self, n: int) -> SearchResult:
+        for _ in range(n):
+            point = self.propose()
+            value = float(self.evaluation_function(point))
+            obs = Observation(point, value)
+            self.observations.append(obs)
+            self.on_observation(obs)
+        return self._result()
+
+    def find_with_priors(
+        self, n: int, priors: Sequence[Tuple[np.ndarray, float]]
+    ) -> SearchResult:
+        """Seed the search with observations from earlier runs
+        (findWithPriors, RandomSearch.scala:61-90)."""
+        for p, v in priors:
+            obs = Observation(np.asarray(p, np.float64), float(v))
+            self.prior_observations.append(obs)
+            self.on_observation(obs)
+        return self.find(n)
+
+    def _result(self) -> SearchResult:
+        if not self.observations:
+            raise ValueError("no observations")
+        key = (lambda o: -o.value) if self.maximize else (lambda o: o.value)
+        best = min(self.observations, key=key)
+        return SearchResult(self.observations, best.point, best.value)
+
+
+class GaussianProcessSearch(RandomSearch):
+    """Bayesian search: GP posterior + Expected Improvement over a Sobol
+    candidate pool (GaussianProcessSearch.scala:52-197)."""
+
+    def __init__(
+        self,
+        configs: Sequence[HyperparameterConfig],
+        evaluation_function: EvaluationFunction,
+        *,
+        maximize: bool = False,
+        seed: int = 1,
+        candidate_pool_size: int = CANDIDATE_POOL_SIZE,
+        min_observations: int = 2,
+        kernel: str = "matern52",
+    ):
+        super().__init__(configs, evaluation_function, maximize=maximize, seed=seed)
+        self.candidate_pool_size = candidate_pool_size
+        self.min_observations = min_observations
+        self.kernel = kernel
+        self._rng = np.random.default_rng(seed)
+
+    def propose(self) -> np.ndarray:
+        all_obs = self.prior_observations + self.observations
+        if len(all_obs) < self.min_observations:
+            return super().propose()
+        x = np.stack([forward_scale(o.point, self.configs) for o in all_obs])
+        y = np.asarray([o.value for o in all_obs])
+        model = fit_gp(
+            x,
+            y,
+            kernel=self.kernel,
+            maximize=self.maximize,
+            seed=int(self._rng.integers(1 << 31)),
+        )
+        pool = self._sobol.random(self.candidate_pool_size)
+        ei = model.expected_improvement(pool)
+        return backward_scale(pool[int(np.argmax(ei))], self.configs)
+
+
+# ---------------------------------------------------------------------------
+# Config serialization (HyperparameterSerialization.scala:27-120)
+
+
+def config_from_json(doc: str | dict) -> List[HyperparameterConfig]:
+    """Parse the tuning JSON document: {"variables": [{"name", "min", "max",
+    "transform"?}, ...]} (HyperparameterSerialization.configFromJson)."""
+    if isinstance(doc, str):
+        doc = json.loads(doc)
+    out = []
+    for var in doc["variables"]:
+        out.append(
+            HyperparameterConfig(
+                name=var["name"],
+                min_value=float(var["min"]),
+                max_value=float(var["max"]),
+                transform=var.get("transform"),
+                discrete=var.get("type", "").upper() == "DISCRETE",
+            )
+        )
+    return out
+
+
+def priors_from_json(doc: str | dict, configs: Sequence[HyperparameterConfig]):
+    """Parse prior observations: {"records": [{"<name>": value, ...,
+    "evaluationValue": v}]} (priorFromJson)."""
+    if isinstance(doc, str):
+        doc = json.loads(doc)
+    priors = []
+    for rec in doc.get("records", []):
+        point = np.asarray([float(rec[c.name]) for c in configs])
+        priors.append((point, float(rec["evaluationValue"])))
+    return priors
