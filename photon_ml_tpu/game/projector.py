@@ -1,0 +1,278 @@
+"""Random-effect feature-space projectors.
+
+Counterpart of photon-api projector/* — Projector.scala:58,
+IndexMapProjector.scala:92, IndexMapProjectorRDD.scala:36-218,
+ProjectionMatrix.scala:32-99, ProjectionMatrixBroadcast.scala:32-131,
+IdentityProjector.scala, ProjectorType.scala, RandomEffectProjector.scala:74
+and model/RandomEffectModelInProjectedSpace.scala:129.
+
+Purpose (same as the reference): shrink each entity's feature space so the
+per-entity random-effect models are dense-small. The reference builds one
+projector per entity as an RDD keyed by REId, each with its own projected
+dimension. On TPU the per-entity coefficient store is ONE (E+1, D_proj)
+matrix, so every entity shares a common padded projected dimension:
+
+  * IndexMapProjector: per-entity index compaction. For each entity, the
+    distinct global feature indices appearing in its samples (active +
+    passive, IndexMapProjectorRDD.scala:60-90) are assigned local slots
+    0..k_e-1; D_proj = max_e k_e (padded). Projection rewrites the ELL
+    `indices` arrays host-side ONCE at dataset-build time — on device nothing
+    changes except that gathers/scatters run over D_proj instead of the full
+    shard width. Back-projection scatters each row through its entity's
+    slot->global table.
+  * RandomProjector: a shared Gaussian matrix P (D, d) with N(0, 1/d)
+    entries (ProjectionMatrix.scala:99); features are densified through the
+    MXU (X @ P), models live in projected space, and back-projection is
+    w_orig = P w_proj (the reference's projectCoefficients transpose map).
+  * IdentityProjector: no-op.
+
+All projectors expose the same surface: `project_features` (global ->
+projected sample features), `back_project_matrix` (projected coefficient
+matrix -> original-space rows, for saving/inspection), and `projected_dim`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.data.containers import Features, SparseFeatures
+from photon_ml_tpu.types import ProjectorType
+
+Array = jax.Array
+
+
+class IdentityProjector:
+    """ProjectorType.IDENTITY — original space == projected space
+    (IdentityProjector.scala)."""
+
+    def __init__(self, dim: int):
+        self.original_dim = dim
+        self.projected_dim = dim
+
+    def project_features(self, features: Features, entity_rows: np.ndarray) -> Features:
+        return features
+
+    def back_project_matrix(self, matrix: Array) -> Array:
+        return matrix
+
+
+class IndexMapProjector:
+    """Per-entity index compaction (IndexMapProjectorRDD.scala:36-218).
+
+    `slot_tables[e, j]` = global feature index occupying local slot j of
+    entity e (or -1 for padding). Row E (the unseen-entity row) has an empty
+    table. Built host-side from the samples' sparse indices; the projected
+    dimension is the max per-entity distinct-feature count, optionally
+    rounded up to a multiple of 8 for TPU lane alignment.
+    """
+
+    def __init__(self, slot_tables: np.ndarray, original_dim: int):
+        self.slot_tables = slot_tables  # (E + 1, D_proj) int64, -1 = pad
+        self.original_dim = int(original_dim)
+        self.projected_dim = int(slot_tables.shape[1])
+
+    @classmethod
+    def build(
+        cls,
+        features: SparseFeatures,
+        entity_rows: np.ndarray,
+        num_entities: int,
+        *,
+        pad_multiple: int = 8,
+    ) -> "IndexMapProjector":
+        """Collect each entity's distinct active feature indices
+        (IndexMapProjectorRDD.scala:60-90 unions active+passive; here
+        `entity_rows` covers every sample so both are included)."""
+        idx = np.asarray(features.indices)
+        val = np.asarray(features.values)
+        ent = np.asarray(entity_rows)
+        # Flatten to (entity, global-index) pairs for nonzero entries and
+        # take per-entity distinct indices in one vectorized pass.
+        ent_flat = np.repeat(ent, idx.shape[1])
+        idx_flat = idx.reshape(-1)
+        keep = (val.reshape(-1) != 0.0) & (ent_flat < num_entities)
+        pairs = np.unique(
+            np.stack([ent_flat[keep], idx_flat[keep]], axis=1), axis=0
+        )
+        counts = np.bincount(pairs[:, 0], minlength=num_entities)
+        d_proj = max(1, int(counts.max()) if len(counts) else 1)
+        if pad_multiple > 1:
+            d_proj = ((d_proj + pad_multiple - 1) // pad_multiple) * pad_multiple
+        tables = np.full((num_entities + 1, d_proj), -1, np.int64)
+        # pairs is sorted by (entity, global); slot j of entity e is the j-th
+        # distinct global index of e.
+        starts = np.searchsorted(pairs[:, 0], np.arange(num_entities))
+        slot = np.arange(len(pairs)) - starts[pairs[:, 0]]
+        tables[pairs[:, 0], slot] = pairs[:, 1]
+        return cls(tables, features.dim)
+
+    def project_features(
+        self, features: SparseFeatures, entity_rows: np.ndarray
+    ) -> SparseFeatures:
+        """Rewrite global ELL indices to per-entity local slots (host-side,
+        one-time). Entries whose feature is absent from the entity's table
+        (value-0 padding, or unseen entities) are zeroed out."""
+        idx = np.asarray(features.indices)
+        val = np.asarray(features.values).copy()
+        ent = np.asarray(entity_rows)
+        out = np.zeros_like(idx)
+        # Group sample rows by entity and remap each group with one
+        # searchsorted over the entity's sorted slot table.
+        num_rows = self.slot_tables.shape[0]
+        order = np.argsort(ent, kind="stable")
+        bounds = np.searchsorted(ent[order], np.arange(num_rows + 1))
+        for e in range(num_rows):
+            rows = order[bounds[e] : bounds[e + 1]]
+            if len(rows) == 0:
+                continue
+            table = self.slot_tables[e]
+            valid = table[table >= 0]
+            if len(valid) == 0:
+                val[rows] = 0.0
+                out[rows] = 0
+                continue
+            g = idx[rows]
+            pos = np.searchsorted(valid, g)
+            pos_c = np.minimum(pos, len(valid) - 1)
+            hit = (valid[pos_c] == g) & (val[rows] != 0.0)
+            out[rows] = np.where(hit, pos_c, 0)
+            val[rows] = np.where(hit, val[rows], 0.0)
+        return SparseFeatures(
+            jnp.asarray(out, jnp.int32), jnp.asarray(val), self.projected_dim
+        )
+
+    def back_project_matrix(self, matrix: Array) -> Array:
+        """(E+1, D_proj) -> (E+1, D) scatter through the slot tables
+        (projectCoefficients direction, IndexMapProjectorRDD.scala:96-120).
+        Padding slots scatter into a dummy extra column that is dropped."""
+        m = np.asarray(matrix)
+        e1, _ = m.shape
+        out = np.zeros((e1, self.original_dim + 1), m.dtype)
+        cols = np.where(self.slot_tables >= 0, self.slot_tables, self.original_dim)
+        np.add.at(out, (np.arange(e1)[:, None], cols), m)
+        return jnp.asarray(out[:, : self.original_dim])
+
+    def entity_coefficients(self, matrix: Array, entity_row: int) -> Dict[int, float]:
+        """One entity's model as {global feature index: weight} (sparse save
+        path, ModelProcessingUtils.saveModelsRDDToHDFS)."""
+        row = np.asarray(matrix[entity_row])
+        table = self.slot_tables[entity_row]
+        return {int(g): float(w) for g, w in zip(table, row) if g >= 0 and w != 0.0}
+
+
+class RandomProjector:
+    """Shared Gaussian random projection (ProjectionMatrix.scala:32-99,
+    ProjectionMatrixBroadcast.scala).
+
+    P has i.i.d. N(0, 1/d_proj) entries (ProjectionMatrix.scala:99's
+    Gaussian generation); projection is a dense matmul so sparse shards are
+    densified through the MXU. The reference broadcasts P to executors; here
+    it is a replicated device array.
+    """
+
+    def __init__(self, matrix: Array):
+        self.matrix = matrix  # (D, d_proj)
+        self.original_dim = int(matrix.shape[0])
+        self.projected_dim = int(matrix.shape[1])
+
+    @classmethod
+    def build(cls, original_dim: int, projected_dim: int, seed: int = 0) -> "RandomProjector":
+        key = jax.random.PRNGKey(seed)
+        p = jax.random.normal(key, (original_dim, projected_dim)) / jnp.sqrt(
+            jnp.asarray(projected_dim, jnp.float32)
+        )
+        return cls(p)
+
+    def project_features(self, features: Features, entity_rows: np.ndarray) -> Array:
+        if isinstance(features, SparseFeatures):
+            # Sparse x P: gather P rows at the ELL indices and reduce —
+            # avoids densifying X itself.
+            rows = jnp.take(self.matrix, features.indices, axis=0)  # (N, K, d)
+            return jnp.einsum("nk,nkd->nd", features.values, rows)
+        return features @ self.matrix
+
+    def back_project_matrix(self, matrix: Array) -> Array:
+        """w_orig = P w_proj per entity row (ProjectionMatrix
+        projectCoefficients)."""
+        return matrix @ self.matrix.T
+
+
+Projector = object  # IdentityProjector | IndexMapProjector | RandomProjector
+
+
+def build_projector(
+    projector_type: ProjectorType,
+    features: Features,
+    entity_rows: np.ndarray,
+    num_entities: int,
+    *,
+    projected_dim: Optional[int] = None,
+    seed: int = 0,
+) -> Projector:
+    """RandomEffectProjector.build (RandomEffectProjector.scala:74). The
+    default for random-effect coordinates is INDEX_MAP
+    (CoordinateDataConfiguration.scala:59-66)."""
+    if isinstance(features, SparseFeatures):
+        dim = features.dim
+    else:
+        dim = int(features.shape[-1])
+    if projector_type == ProjectorType.IDENTITY:
+        return IdentityProjector(dim)
+    if projector_type == ProjectorType.RANDOM:
+        if projected_dim is None:
+            raise ValueError("RANDOM projector requires projected_dim")
+        return RandomProjector.build(dim, projected_dim, seed)
+    if projector_type == ProjectorType.INDEX_MAP:
+        if not isinstance(features, SparseFeatures):
+            # Dense shards have nothing to compact per entity; identity.
+            return IdentityProjector(dim)
+        return IndexMapProjector.build(features, entity_rows, num_entities)
+    raise ValueError(f"unknown projector type {projector_type}")
+
+
+@dataclasses.dataclass
+class ProjectedShard:
+    """A projected feature shard + its projector, registered on the dataset
+    under `shard_name` for the owning random-effect coordinate."""
+
+    shard_name: str
+    projector: Projector
+
+
+def project_shard(
+    dataset,
+    re_dataset,
+    projector_type: ProjectorType,
+    *,
+    projected_dim: Optional[int] = None,
+    seed: int = 0,
+) -> ProjectedShard:
+    """Create the projected view of `re_dataset`'s feature shard and register
+    it on the GameDataset under '<shard>@<re_type>' — the per-coordinate
+    projected space of RandomEffectCoordinateInProjectedSpace.scala:31. The
+    RandomEffectDataset is repointed at the projected shard; its gather
+    blocks are unchanged (projection is per-sample, not per-slot).
+    """
+    shard = re_dataset.feature_shard
+    entity_rows = np.asarray(re_dataset.sample_entity_rows)
+    projector = build_projector(
+        projector_type,
+        dataset.shards[shard],
+        entity_rows,
+        re_dataset.num_entities,
+        projected_dim=projected_dim,
+        seed=seed,
+    )
+    if isinstance(projector, IdentityProjector):
+        return ProjectedShard(shard, projector)
+    new_name = f"{shard}@{re_dataset.config.random_effect_type}"
+    dataset.shards[new_name] = projector.project_features(
+        dataset.shards[shard], entity_rows
+    )
+    re_dataset.config = dataclasses.replace(re_dataset.config, feature_shard=new_name)
+    return ProjectedShard(new_name, projector)

@@ -1,0 +1,142 @@
+"""Projector tests (reference: photon-api projector/* behavior)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.data.containers import SparseFeatures, pack_csr_to_ell
+from photon_ml_tpu.data.game_dataset import (
+    GameDataset,
+    RandomEffectDataConfig,
+    build_random_effect_dataset,
+)
+from photon_ml_tpu.game.projector import (
+    IdentityProjector,
+    IndexMapProjector,
+    RandomProjector,
+    build_projector,
+    project_shard,
+)
+from photon_ml_tpu.types import ProjectorType
+
+
+def _sparse_fixture():
+    # 6 samples, 3 entities, global dim 10. Each entity touches few features.
+    rows = [
+        [(0, 1.0), (7, 2.0)],  # entity 0
+        [(7, 3.0)],  # entity 0
+        [(2, 1.5), (3, -1.0)],  # entity 1
+        [(3, 4.0)],  # entity 1
+        [(9, 1.0)],  # entity 2
+        [(9, -2.0), (1, 0.5)],  # entity 2
+    ]
+    indptr = np.cumsum([0] + [len(r) for r in rows])
+    indices = np.array([i for r in rows for i, _ in r])
+    values = np.array([v for r in rows for _, v in r], np.float32)
+    feats = pack_csr_to_ell(indptr, indices, values, dim=10)
+    entity_rows = np.array([0, 0, 1, 1, 2, 2])
+    return feats, entity_rows
+
+
+class TestIndexMapProjector:
+    def test_margins_preserved(self):
+        feats, ent = _sparse_fixture()
+        proj = IndexMapProjector.build(feats, ent, num_entities=3, pad_multiple=1)
+        assert proj.projected_dim == 2  # max distinct features per entity
+        pfeats = proj.project_features(feats, ent)
+        assert pfeats.dim == 2
+        # Margins in projected space with projected weights must equal
+        # original-space margins with the back-projected weights.
+        w_proj = jnp.asarray(np.random.default_rng(0).normal(size=(4, 2)), jnp.float32)
+        w_orig = proj.back_project_matrix(w_proj)
+        m_proj = np.array(
+            [float(pfeats.matvec(w_proj[e])[r]) for r, e in enumerate(ent)]
+        )
+        m_orig = np.array(
+            [float(feats.matvec(w_orig[e])[r]) for r, e in enumerate(ent)]
+        )
+        np.testing.assert_allclose(m_proj, m_orig, rtol=1e-6)
+
+    def test_pad_multiple(self):
+        feats, ent = _sparse_fixture()
+        proj = IndexMapProjector.build(feats, ent, num_entities=3, pad_multiple=8)
+        assert proj.projected_dim == 8
+
+    def test_back_project_scatter(self):
+        feats, ent = _sparse_fixture()
+        proj = IndexMapProjector.build(feats, ent, num_entities=3, pad_multiple=1)
+        w = jnp.ones((4, proj.projected_dim), jnp.float32)
+        back = np.asarray(proj.back_project_matrix(w))
+        # entity 0 used features {0, 7}; entity 1 {2, 3}; entity 2 {1, 9}.
+        assert back.shape == (4, 10)
+        np.testing.assert_array_equal(np.nonzero(back[0])[0], [0, 7])
+        np.testing.assert_array_equal(np.nonzero(back[1])[0], [2, 3])
+        np.testing.assert_array_equal(np.nonzero(back[2])[0], [1, 9])
+        assert back[3].sum() == 0  # unseen row empty
+
+    def test_unseen_entity_rows_zeroed(self):
+        # Samples mapped to the unseen-entity row (empty slot table) must be
+        # zeroed, not crash (regression: empty-table searchsorted).
+        feats = SparseFeatures(
+            jnp.asarray([[0], [1]], jnp.int32), jnp.asarray([[1.0], [2.0]]), 5
+        )
+        proj = IndexMapProjector.build(
+            feats, np.array([0, 1]), num_entities=1, pad_multiple=1
+        )
+        pfeats = proj.project_features(feats, np.array([0, 1]))
+        assert float(pfeats.values[1, 0]) == 0.0
+
+    def test_entity_coefficients_sparse_map(self):
+        feats, ent = _sparse_fixture()
+        proj = IndexMapProjector.build(feats, ent, num_entities=3, pad_multiple=1)
+        m = jnp.asarray([[1.0, 2.0], [0.0, 3.0], [4.0, 0.0], [0.0, 0.0]])
+        assert proj.entity_coefficients(m, 0) == {0: 1.0, 7: 2.0}
+        assert proj.entity_coefficients(m, 1) == {3: 3.0}
+
+
+class TestRandomProjector:
+    def test_shapes_and_consistency(self):
+        feats, ent = _sparse_fixture()
+        proj = RandomProjector.build(10, 4, seed=1)
+        pfeats = proj.project_features(feats, ent)
+        assert pfeats.shape == (6, 4)
+        # Projecting sparse == densify-then-matmul.
+        dense = np.asarray(feats.to_dense())
+        np.testing.assert_allclose(
+            np.asarray(pfeats), dense @ np.asarray(proj.matrix), rtol=1e-5, atol=1e-6
+        )
+        # Back-projection consistency: score in projected space equals
+        # original-space score with P @ w.
+        w = jnp.asarray(np.random.default_rng(2).normal(size=(4,)), jnp.float32)
+        s_proj = np.asarray(pfeats) @ np.asarray(w)
+        w_orig = np.asarray(proj.matrix) @ np.asarray(w)
+        np.testing.assert_allclose(s_proj, dense @ w_orig, rtol=1e-4, atol=1e-5)
+
+
+class TestBuildAndWire:
+    def test_identity_for_dense(self):
+        X = jnp.ones((4, 3))
+        proj = build_projector(ProjectorType.INDEX_MAP, X, np.zeros(4, int), 1)
+        assert isinstance(proj, IdentityProjector)
+
+    def test_random_requires_dim(self):
+        feats, ent = _sparse_fixture()
+        with pytest.raises(ValueError):
+            build_projector(ProjectorType.RANDOM, feats, ent, 3)
+
+    def test_project_shard_rewires_dataset(self):
+        feats, ent = _sparse_fixture()
+        ds = GameDataset.build(
+            {"re_shard": feats},
+            np.zeros(6, np.float32),
+            id_tags={"memberId": ent},
+        )
+        red = build_random_effect_dataset(
+            ds, RandomEffectDataConfig("memberId", "re_shard", min_bucket=2)
+        )
+        ps = project_shard(ds, red, ProjectorType.INDEX_MAP)
+        assert ps.shard_name == "re_shard@memberId"
+        assert ps.shard_name in ds.shards
+        assert red.feature_shard == ps.shard_name
+        assert ds.shards[ps.shard_name].dim == ps.projector.projected_dim
+        assert ps.projector.projected_dim < 10
