@@ -43,6 +43,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from photon_ml_tpu.data.containers import Features, LabeledData, SparseFeatures
+from photon_ml_tpu.types import ProjectorType
 
 Array = jax.Array
 
@@ -69,6 +70,11 @@ class RandomEffectDataConfig:
     active_upper_bound: Optional[int] = None
     active_lower_bound: Optional[int] = None
     min_bucket: int = 8
+    # Feature-space projection for the per-entity models; default INDEX_MAP
+    # as in the reference (CoordinateDataConfiguration.scala:59-66).
+    # projected_dim applies to RANDOM projection only.
+    projector_type: ProjectorType = ProjectorType.INDEX_MAP
+    projected_dim: Optional[int] = None
 
 
 @dataclasses.dataclass

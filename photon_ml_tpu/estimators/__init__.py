@@ -1,0 +1,4 @@
+from photon_ml_tpu.estimators.game_estimator import (  # noqa: F401
+    GameEstimator,
+    GameResult,
+)

@@ -1,0 +1,388 @@
+"""GameEstimator: the spark.ml-style fit() entry of the GAME layer.
+
+Counterpart of photon-api estimators/GameEstimator.scala:54-773:
+  * validates coordinate configurations against the update sequence
+    (validateInput);
+  * builds per-coordinate training datasets ONCE and reuses them across every
+    optimization configuration (prepareTrainingDatasets:453-557 — here:
+    entity-blocked RandomEffectDatasets + projected shards + normalization
+    contexts);
+  * builds the validation dataset and EvaluationSuite
+    (prepareValidationDatasetAndEvaluators:567, default evaluator per task
+    :614-625);
+  * for each GameOptimizationConfiguration runs coordinate descent via the
+    Coordinate objects (train:698-753), warm-starting each configuration from
+    the previous one's model (fit:214-230);
+  * returns (model, config, evaluation) triples for model selection by the
+    driver.
+
+Coordinate objects are cached across the sweep keyed by their *static*
+configuration (everything but the regularization weight, which is a traced
+scalar) so a reg-weight sweep reuses the same compiled XLA programs — the
+TPU version of the reference's single mutable opt problem reused across the
+sweep (ModelTraining.scala:165-213).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Dict, List, Mapping, Optional, Sequence, Set, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.data.containers import SparseFeatures
+from photon_ml_tpu.data.game_dataset import (
+    FixedEffectDataConfig,
+    GameDataset,
+    RandomEffectDataConfig,
+    RandomEffectDataset,
+    build_random_effect_dataset,
+)
+from photon_ml_tpu.data.stats import summarize
+from photon_ml_tpu.evaluation.suite import (
+    EvaluationResults,
+    EvaluationSuite,
+    EvaluatorType,
+    default_evaluator_for_task,
+)
+from photon_ml_tpu.game.coordinate import FixedEffectCoordinate, RandomEffectCoordinate
+from photon_ml_tpu.game.coordinate_descent import run_coordinate_descent
+from photon_ml_tpu.game.model import GameModel
+from photon_ml_tpu.game.projector import project_shard
+from photon_ml_tpu.ops.normalization import NormalizationContext, from_feature_stats
+from photon_ml_tpu.optimize.config import CoordinateOptimizationConfig
+from photon_ml_tpu.transformers.game_transformer import (
+    CoordinateScoringSpec,
+    GameTransformer,
+    coordinate_margins,
+    prepare_coordinate_data,
+)
+from photon_ml_tpu.types import NormalizationType, TaskType
+
+logger = logging.getLogger(__name__)
+
+GameOptimizationConfiguration = Mapping[str, CoordinateOptimizationConfig]
+
+
+@dataclasses.dataclass
+class GameResult:
+    """One (GameModel, configuration, evaluation) triple
+    (GameEstimator.fit's Seq element, GameEstimator.scala:169-172)."""
+
+    model: GameModel
+    config: Dict[str, CoordinateOptimizationConfig]
+    evaluation: Optional[EvaluationResults]
+    best_model: GameModel
+    timing: Dict[str, float]
+
+
+@dataclasses.dataclass
+class _PreparedCoordinate:
+    """Training-time artifacts for one coordinate, reused across configs."""
+
+    data_config: object
+    original_shard: str
+    shard: str  # projected shard name for REs
+    norm: Optional[NormalizationContext]
+    re_dataset: Optional[RandomEffectDataset] = None
+    projector: Optional[object] = None
+
+
+class GameEstimator:
+    """fit(data, validation, configs) -> [GameResult] (GameEstimator.scala:54).
+
+    `coordinate_data_configs` is an ORDERED mapping coordinate id ->
+    FixedEffectDataConfig | RandomEffectDataConfig; its order is the
+    coordinate update sequence unless `update_sequence` overrides it.
+    """
+
+    def __init__(
+        self,
+        task: TaskType,
+        coordinate_data_configs: Mapping[str, object],
+        *,
+        update_sequence: Optional[Sequence[str]] = None,
+        coordinate_descent_iterations: int = 1,
+        normalization: NormalizationType = NormalizationType.NONE,
+        validation_evaluators: Optional[Sequence[EvaluatorType]] = None,
+        locked_coordinates: Optional[Set[str]] = None,
+        intercept_indices: Optional[Mapping[str, int]] = None,
+        seed: int = 0,
+    ):
+        self.task = task
+        self.data_configs = dict(coordinate_data_configs)
+        self.update_sequence = list(update_sequence or self.data_configs.keys())
+        unknown = [c for c in self.update_sequence if c not in self.data_configs]
+        if unknown:
+            raise ValueError(f"update sequence names unknown coordinates {unknown}")
+        missing = [c for c in self.data_configs if c not in self.update_sequence]
+        if missing:
+            raise ValueError(f"coordinates missing from update sequence {missing}")
+        self.cd_iterations = coordinate_descent_iterations
+        self.normalization = normalization
+        self.validation_evaluators = list(validation_evaluators or [])
+        self.locked = set(locked_coordinates or ())
+        self.intercept_indices = dict(intercept_indices or {})
+        self.seed = seed
+        self._prepared: Optional[Dict[str, _PreparedCoordinate]] = None
+        self._prepared_dataset: Optional[GameDataset] = None
+        self._coordinate_cache: Dict[Tuple, object] = {}
+
+    # ------------------------------------------------------------------ prep
+
+    def _norm_for_shard(
+        self,
+        dataset: GameDataset,
+        shard: str,
+        *,
+        intercept_shard: Optional[str] = None,
+        projected: bool = False,
+    ) -> Optional[NormalizationContext]:
+        """`intercept_shard` is the ORIGINAL shard name users configure
+        intercepts under; `shard` may be its projected view. In a projected
+        space the intercept lands in a different slot per entity, so
+        shift-based normalization is not expressible there — factor-only
+        types are safe (a constant column gets factor 1 via the zero-variance
+        guard)."""
+        if self.normalization == NormalizationType.NONE:
+            return None
+        intercept = self.intercept_indices.get(intercept_shard or shard)
+        if projected:
+            if self.normalization == NormalizationType.STANDARDIZATION:
+                raise ValueError(
+                    "STANDARDIZATION is not supported on projected random-effect "
+                    "shards (per-entity intercept slots); use a factor-only "
+                    "normalization type or IDENTITY projection"
+                )
+            intercept = None
+        stats = summarize(dataset.shards[shard], intercept_index=intercept)
+        return from_feature_stats(
+            self.normalization,
+            mean=stats.mean,
+            variance=stats.variance,
+            max_abs=stats.max_abs,
+            intercept_index=intercept,
+        )
+
+    def prepare(self, dataset: GameDataset) -> Dict[str, _PreparedCoordinate]:
+        """Build per-coordinate datasets/projections/normalizations once
+        (prepareTrainingDatasets + prepareNormalizationContextWrappers).
+        Bound to the first dataset seen — an estimator instance trains one
+        dataset (as in the reference, where datasets are fit() arguments but
+        coordinates cache RDD views)."""
+        if self._prepared is not None:
+            if dataset is not self._prepared_dataset:
+                raise ValueError(
+                    "This GameEstimator already prepared a different training "
+                    "dataset; create a new estimator per training dataset"
+                )
+            return self._prepared
+        self._prepared_dataset = dataset
+        prepared: Dict[str, _PreparedCoordinate] = {}
+        for cid in self.update_sequence:
+            cfg = self.data_configs[cid]
+            if isinstance(cfg, RandomEffectDataConfig):
+                red = build_random_effect_dataset(dataset, cfg)
+                original_shard = cfg.feature_shard
+                ps = project_shard(
+                    dataset,
+                    red,
+                    cfg.projector_type,
+                    projected_dim=cfg.projected_dim,
+                    seed=self.seed,
+                )
+                norm = self._norm_for_shard(
+                    dataset,
+                    ps.shard_name,
+                    intercept_shard=original_shard,
+                    projected=ps.shard_name != original_shard,
+                )
+                prepared[cid] = _PreparedCoordinate(
+                    cfg, original_shard, ps.shard_name, norm, red, ps.projector
+                )
+                logger.info(
+                    "coordinate %s: %d entities, %d active / %d passive samples, "
+                    "projected dim %d",
+                    cid,
+                    red.num_entities,
+                    red.num_active_samples,
+                    red.num_passive_samples,
+                    ps.projector.projected_dim,
+                )
+            elif isinstance(cfg, FixedEffectDataConfig):
+                norm = self._norm_for_shard(dataset, cfg.feature_shard)
+                prepared[cid] = _PreparedCoordinate(
+                    cfg, cfg.feature_shard, cfg.feature_shard, norm
+                )
+            else:
+                raise TypeError(f"unknown data config for {cid}: {type(cfg)}")
+        self._prepared = prepared
+        return prepared
+
+    # ----------------------------------------------------------- coordinates
+
+    def _coordinate_for(
+        self,
+        dataset: GameDataset,
+        cid: str,
+        prep: _PreparedCoordinate,
+        opt_config: CoordinateOptimizationConfig,
+    ):
+        """CoordinateFactory.build (CoordinateFactory.scala:51) with a cache
+        keyed by the static parts of the config — the reg weight is traced, so
+        sweep steps share compiled programs."""
+        static_cfg = dataclasses.replace(opt_config, reg_weight=0.0)
+        key = (cid, repr(static_cfg))
+        coord = self._coordinate_cache.get(key)
+        if coord is None:
+            if prep.re_dataset is not None:
+                coord = RandomEffectCoordinate(
+                    dataset, prep.re_dataset, static_cfg, self.task, prep.norm
+                )
+            else:
+                coord = FixedEffectCoordinate(
+                    dataset, prep.shard, static_cfg, self.task, prep.norm
+                )
+            self._coordinate_cache[key] = coord
+        return coord
+
+    # ------------------------------------------------------------ validation
+
+    def _make_transformer(self, model: GameModel) -> GameTransformer:
+        specs = self.scoring_specs()
+        return GameTransformer(model, specs, self.task)
+
+    def scoring_specs(self) -> Dict[str, CoordinateScoringSpec]:
+        """Scoring metadata for the trained coordinates (consumed by
+        GameTransformer and by model save)."""
+        if self._prepared is None:
+            raise RuntimeError("fit()/prepare() must run first")
+        specs = {}
+        for cid, prep in self._prepared.items():
+            if prep.re_dataset is not None:
+                specs[cid] = CoordinateScoringSpec(
+                    shard=prep.original_shard,
+                    norm=prep.norm,
+                    random_effect_type=prep.re_dataset.config.random_effect_type,
+                    entity_index=prep.re_dataset.entity_index,
+                    projector=prep.projector,
+                )
+            else:
+                specs[cid] = CoordinateScoringSpec(shard=prep.shard, norm=prep.norm)
+        return specs
+
+    def _validation_suite(self, validation: GameDataset) -> EvaluationSuite:
+        evaluators = self.validation_evaluators or [
+            default_evaluator_for_task(self.task)
+        ]
+        return EvaluationSuite(
+            evaluators,
+            validation.labels,
+            validation.weights,
+            id_tag_values=validation.id_tags,
+        )
+
+    # ------------------------------------------------------------------- fit
+
+    def fit(
+        self,
+        data: GameDataset,
+        validation_data: Optional[GameDataset],
+        opt_configs: Sequence[GameOptimizationConfiguration],
+        *,
+        initial_model: Optional[GameModel] = None,
+    ) -> List[GameResult]:
+        """Train one GameModel per optimization configuration
+        (GameEstimator.fit:169-230), warm-starting successive configurations.
+
+        `initial_model` seeds the first configuration (the driver's warm-start
+        path, GameTrainingDriver.scala:370-378) and must contain every locked
+        coordinate's model.
+        """
+        if not opt_configs:
+            raise ValueError("at least one optimization configuration required")
+        prepared = self.prepare(data)
+        for cfgs in opt_configs:
+            missing = [c for c in self.update_sequence if c not in cfgs and c not in self.locked]
+            if missing:
+                raise ValueError(f"optimization config missing coordinates {missing}")
+
+        suite = self._validation_suite(validation_data) if validation_data is not None else None
+        specs = self.scoring_specs()
+
+        # One-time host prep of the validation dataset per coordinate
+        # (projection + entity-row resolution) reused across every CD step.
+        val_prep = None
+        if validation_data is not None:
+            val_prep = {
+                cid: prepare_coordinate_data(specs[cid], validation_data)
+                for cid in self.update_sequence
+            }
+
+        results: List[GameResult] = []
+        prev_model: Optional[GameModel] = initial_model
+        default_cfg = CoordinateOptimizationConfig()
+        for ci, cfgs in enumerate(opt_configs):
+            coordinates = {
+                cid: self._coordinate_for(
+                    data, cid, prepared[cid], cfgs.get(cid, default_cfg)
+                )
+                for cid in self.update_sequence
+            }
+            reg_weights = {cid: cfgs[cid].reg_weight for cid in cfgs}
+
+            validation_scorer = None
+            if validation_data is not None:
+                def validation_scorer(cid, model):
+                    return coordinate_margins(specs[cid], model, val_prep[cid])
+
+            cd = run_coordinate_descent(
+                coordinates,
+                self.cd_iterations,
+                initial_models=prev_model,
+                locked_coordinates=self.locked or None,
+                validation_scorer=validation_scorer,
+                validation_suite=suite,
+                validation_offsets=(
+                    validation_data.offsets if validation_data is not None else None
+                ),
+                reg_weights=reg_weights,
+                seed=self.seed + ci,
+            )
+            evaluation = None
+            if validation_data is not None and suite is not None:
+                transformer = self._make_transformer(cd.model)
+                evaluation = transformer.evaluate(validation_data, suite, val_prep)
+            results.append(
+                GameResult(
+                    model=cd.model,
+                    config=dict(cfgs),
+                    evaluation=evaluation,
+                    best_model=cd.best_model,
+                    timing=cd.timing,
+                )
+            )
+            prev_model = cd.model
+            logger.info(
+                "configuration %d/%d trained%s",
+                ci + 1,
+                len(opt_configs),
+                f": {evaluation.results}" if evaluation else "",
+            )
+        return results
+
+
+def select_best_result(
+    results: Sequence[GameResult],
+) -> Tuple[int, GameResult]:
+    """Pick the configuration whose validation metric is best
+    (GameTrainingDriver.selectModels:683-710); falls back to the last result
+    when no validation ran."""
+    best_i = len(results) - 1
+    best: Optional[EvaluationResults] = None
+    for i, r in enumerate(results):
+        if r.evaluation is not None and r.evaluation.better_than(best):
+            best, best_i = r.evaluation, i
+    return best_i, results[best_i]

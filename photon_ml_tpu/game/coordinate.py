@@ -210,22 +210,9 @@ class RandomEffectCoordinate:
 
         @jax.jit
         def score_fn(features, entity_rows, matrix):
-            # Normalization is folded in once per entity row (same algebra the
-            # training objective uses), for BOTH dense and sparse paths.
-            shift = None
-            if norm is not None and not norm.is_identity:
-                matrix = jax.vmap(norm.effective_coefficients)(matrix)
-                if norm.shifts is not None:
-                    shift = -(matrix @ norm.shifts)  # (E+1,) margin shifts
-            if isinstance(features, SparseFeatures):
-                # (N, K) gather out of the (E+1, D) matrix, then sparse dot.
-                rows = matrix[entity_rows[:, None], features.indices]
-                out = jnp.sum(rows * features.values, axis=-1)
-            else:
-                out = jnp.einsum("nd,nd->n", features, matrix[entity_rows])
-            if shift is not None:
-                out = out + shift[entity_rows]
-            return out
+            from photon_ml_tpu.game.model import random_effect_margins
+
+            return random_effect_margins(features, entity_rows, matrix, norm)
 
         self._train_bucket = train_bucket
         self._variance_bucket = variance_bucket

@@ -50,6 +50,7 @@ def run_coordinate_descent(
     locked_coordinates: Optional[Set[str]] = None,
     validation_scorer=None,
     validation_suite: Optional[EvaluationSuite] = None,
+    validation_offsets=None,
     reg_weights: Optional[Mapping[str, float]] = None,
     seed: int = 0,
 ) -> CoordinateDescentResult:
@@ -126,7 +127,9 @@ def run_coordinate_descent(
 
             if validation_scorer is not None and validation_suite is not None:
                 val_scores[cid] = validation_scorer(cid, model)
-                total = None
+                # Seed with the validation offsets so selection uses the same
+                # score definition as the final reported evaluation.
+                total = validation_offsets
                 for s in val_scores.values():
                     total = s if total is None else total + s
                 results = validation_suite.evaluate(total)

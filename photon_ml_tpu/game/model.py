@@ -84,6 +84,30 @@ class FixedEffectModel:
         return mean_for_task(self.task, self.score(data) + data.offsets)
 
 
+def random_effect_margins(features, entity_rows: Array, matrix: Array, norm) -> Array:
+    """Per-sample random-effect margins: gather each sample's coefficient row
+    and dot, with normalization folded in once per entity row (the same
+    algebra the training objective uses), for BOTH dense and sparse features.
+    Shared by RandomEffectCoordinate scoring and GameTransformer. jit-safe.
+    """
+    from photon_ml_tpu.data.containers import SparseFeatures as _SF
+
+    shift = None
+    if norm is not None and not norm.is_identity:
+        matrix = jax.vmap(norm.effective_coefficients)(matrix)
+        if norm.shifts is not None:
+            shift = -(matrix @ norm.shifts)  # (E+1,) margin shifts
+    if isinstance(features, _SF):
+        # (N, K) gather out of the (E+1, D) matrix, then sparse dot.
+        rows = matrix[entity_rows[:, None], features.indices]
+        out = jnp.sum(rows * features.values, axis=-1)
+    else:
+        out = jnp.einsum("nd,nd->n", features, matrix[entity_rows])
+    if shift is not None:
+        out = out + shift[entity_rows]
+    return out
+
+
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass(frozen=True)
 class RandomEffectModel:

@@ -271,6 +271,12 @@ def project_shard(
     if isinstance(projector, IdentityProjector):
         return ProjectedShard(shard, projector)
     new_name = f"{shard}@{re_dataset.config.random_effect_type}"
+    # Never overwrite an existing projected shard (two coordinates may share
+    # (shard, re_type) with different projector configs).
+    suffix = 2
+    while new_name in dataset.shards:
+        new_name = f"{shard}@{re_dataset.config.random_effect_type}#{suffix}"
+        suffix += 1
     dataset.shards[new_name] = projector.project_features(
         dataset.shards[shard], entity_rows
     )

@@ -1,0 +1,8 @@
+from photon_ml_tpu.transformers.game_transformer import (  # noqa: F401
+    CoordinateScoringSpec,
+    GameTransformer,
+    PreparedCoordinateData,
+    TransformResult,
+    coordinate_margins,
+    prepare_coordinate_data,
+)

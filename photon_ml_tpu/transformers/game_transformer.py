@@ -1,0 +1,212 @@
+"""GameTransformer: batch scoring of a GAME model over a GameDataset.
+
+Counterpart of photon-api transformers/GameTransformer.scala:39-318 and the
+model scoring paths it drives (GameModel.scala:99-110,
+FixedEffectModel.score — broadcast + mapValues dot products;
+RandomEffectModel.score — re-key by REId + join, RandomEffectModel.scala:239+).
+
+TPU translation: scoring a dataset is one jitted program per coordinate —
+fixed effects are a (sharded) matvec, random effects a coefficient-row gather
+plus batched dot products; the per-coordinate score RDD join becomes an
+elementwise sum because every coordinate scores the same static sample axis.
+
+The transformer also owns the *data plumbing* that scoring a NEW dataset
+needs (which the reference rebuilds inside transform():150-263):
+  * mapping each sample's entity key to a coefficient row through the
+    training-time entity index (unseen entities -> the pinned zero row);
+  * projecting the random-effect feature shard through the training-time
+    projector (scoring happens in projected space — same math as training,
+    avoiding RandomEffectModelInProjectedSpace back-projection);
+  * folding normalization into effective coefficients.
+
+That plumbing is host-side and dataset-bound, so it is factored into
+`prepare_coordinate_data` and done ONCE per (coordinate, dataset) — repeated
+scoring of the same dataset (the coordinate-descent validation loop) reuses
+the prepared features/entity rows.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Mapping, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.data.containers import Features, LabeledData, SparseFeatures
+from photon_ml_tpu.data.game_dataset import GameDataset
+from photon_ml_tpu.evaluation.suite import EvaluationResults, EvaluationSuite
+from photon_ml_tpu.game.model import (
+    FixedEffectModel,
+    GameModel,
+    RandomEffectModel,
+    random_effect_margins,
+)
+from photon_ml_tpu.ops import objective
+from photon_ml_tpu.ops.losses import mean_for_task
+from photon_ml_tpu.ops.normalization import NormalizationContext
+from photon_ml_tpu.types import TaskType
+
+Array = jax.Array
+
+
+@dataclasses.dataclass
+class CoordinateScoringSpec:
+    """Everything needed to score one coordinate on a fresh dataset.
+
+    `shard` is the ORIGINAL feature-shard name as it appears in incoming
+    datasets; `projector`/`entity_index` are the training-time artifacts for
+    random-effect coordinates (None for fixed effects).
+    """
+
+    shard: str
+    norm: Optional[NormalizationContext] = None
+    random_effect_type: Optional[str] = None
+    entity_index: Optional[Dict[object, int]] = None
+    projector: Optional[object] = None
+
+    @property
+    def is_random_effect(self) -> bool:
+        return self.random_effect_type is not None
+
+
+@dataclasses.dataclass
+class PreparedCoordinateData:
+    """One coordinate's scoring view of one dataset: (projected) features +
+    per-sample entity rows (None for fixed effects)."""
+
+    features: Features
+    entity_rows: Optional[Array]
+
+
+def entity_rows_for_dataset(
+    dataset: GameDataset, spec: CoordinateScoringSpec
+) -> np.ndarray:
+    """Per-sample coefficient-row indices through the training entity index;
+    unseen entities get the pinned zero row (the reference's prior-model
+    scoring of new entities)."""
+    keys = dataset.id_tags[spec.random_effect_type]
+    index = spec.entity_index
+    unseen = len(index)
+    return np.fromiter(
+        (index.get(k, unseen) for k in keys.tolist()), np.int64, count=len(keys)
+    )
+
+
+def prepare_coordinate_data(
+    spec: CoordinateScoringSpec, dataset: GameDataset
+) -> PreparedCoordinateData:
+    """Host-side, once per (coordinate, dataset): resolve entity rows and run
+    the projector. Everything downstream is pure device compute."""
+    if not spec.is_random_effect:
+        return PreparedCoordinateData(dataset.shards[spec.shard], None)
+    rows = entity_rows_for_dataset(dataset, spec)
+    feats = dataset.shards[spec.shard]
+    if spec.projector is not None:
+        feats = spec.projector.project_features(feats, rows)
+    return PreparedCoordinateData(feats, jnp.asarray(rows, jnp.int32))
+
+
+@jax.jit
+def _re_margins(features: Features, entity_rows: Array, matrix: Array, norm) -> Array:
+    return random_effect_margins(features, entity_rows, matrix, norm)
+
+
+@jax.jit
+def _fe_margins(features: Features, w: Array, norm) -> Array:
+    n = features.values.shape[0] if isinstance(features, SparseFeatures) else features.shape[0]
+    zeros = jnp.zeros((n,), w.dtype)
+    return objective.compute_margins(w, LabeledData(features, zeros, zeros, zeros), norm)
+
+
+def coordinate_margins(
+    spec: CoordinateScoringSpec, model, prepared: PreparedCoordinateData
+) -> Array:
+    """Score one coordinate's model over prepared data."""
+    if spec.is_random_effect:
+        assert isinstance(model, RandomEffectModel)
+        return _re_margins(
+            prepared.features, prepared.entity_rows, model.coefficients_matrix, spec.norm
+        )
+    assert isinstance(model, FixedEffectModel)
+    return _fe_margins(prepared.features, model.coefficients.means, spec.norm)
+
+
+@dataclasses.dataclass
+class TransformResult:
+    """ModelDataScores equivalent: raw summed margins (incl. offsets) plus the
+    task-link mean response (ScoredGameDatum fields)."""
+
+    scores: Array
+    means: Array
+    per_coordinate: Dict[str, Array]
+
+
+class GameTransformer:
+    """Scores GameDatasets with a trained GAME model (GameTransformer.scala).
+
+    `specs` must cover every coordinate of the model; built by GameEstimator
+    (training) or reconstructed from a model store (scoring driver).
+    """
+
+    def __init__(
+        self,
+        model: GameModel,
+        specs: Mapping[str, CoordinateScoringSpec],
+        task: TaskType,
+    ):
+        missing = [c for c in model.coordinate_ids if c not in specs]
+        if missing:
+            raise ValueError(f"No scoring spec for coordinates {missing}")
+        self.model = model
+        self.specs = dict(specs)
+        self.task = task
+
+    def prepare(self, dataset: GameDataset) -> Dict[str, PreparedCoordinateData]:
+        """One-time host prep of `dataset` for every coordinate; pass the
+        result to transform() when scoring the same dataset repeatedly."""
+        return {
+            cid: prepare_coordinate_data(self.specs[cid], dataset)
+            for cid in self.model.coordinate_ids
+        }
+
+    def score_coordinate(
+        self,
+        cid: str,
+        dataset: GameDataset,
+        prepared: Optional[PreparedCoordinateData] = None,
+    ) -> Array:
+        spec = self.specs[cid]
+        if prepared is None:
+            prepared = prepare_coordinate_data(spec, dataset)
+        return coordinate_margins(spec, self.model[cid], prepared)
+
+    def transform(
+        self,
+        dataset: GameDataset,
+        prepared: Optional[Dict[str, PreparedCoordinateData]] = None,
+    ) -> TransformResult:
+        """GameTransformer.transform:150 / scoreGameDataset:263 — sum of
+        coordinate scores + offsets, and the link-function mean."""
+        if prepared is None:
+            prepared = self.prepare(dataset)
+        per_coordinate = {
+            cid: coordinate_margins(self.specs[cid], self.model[cid], prepared[cid])
+            for cid in self.model.coordinate_ids
+        }
+        total = dataset.offsets
+        for s in per_coordinate.values():
+            total = total + s
+        means = mean_for_task(self.task, total)
+        return TransformResult(scores=total, means=means, per_coordinate=per_coordinate)
+
+    def evaluate(
+        self,
+        dataset: GameDataset,
+        suite: EvaluationSuite,
+        prepared: Optional[Dict[str, PreparedCoordinateData]] = None,
+    ) -> EvaluationResults:
+        """Optional validation path of the transformer (GameTransformer.scala
+        logValidationMetrics)."""
+        return suite.evaluate(self.transform(dataset, prepared).scores)

@@ -1,0 +1,165 @@
+"""GameEstimator / GameTransformer tests (reference: GameEstimator.scala,
+GameTransformer.scala behavior)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.data.game_dataset import (
+    FixedEffectDataConfig,
+    GameDataset,
+    RandomEffectDataConfig,
+)
+from photon_ml_tpu.estimators.game_estimator import GameEstimator, select_best_result
+from photon_ml_tpu.evaluation.suite import EvaluatorType
+from photon_ml_tpu.game.model import GameModel
+from photon_ml_tpu.optimize.config import (
+    L2,
+    CoordinateOptimizationConfig,
+    OptimizerConfig,
+)
+from photon_ml_tpu.types import NormalizationType, TaskType
+
+
+_TRUTH_RNG = np.random.default_rng(12345)
+_W_TRUE = _TRUTH_RNG.normal(size=4)
+_B_TRUE = _TRUTH_RNG.normal(size=(20, 3))
+
+
+def _glmix_data(seed, n=400, n_entities=10, d_fixed=4, d_re=3):
+    """Draws from ONE shared ground-truth GLMix model so train/validation
+    measure generalization of the same signal."""
+    rng = np.random.default_rng(seed)
+    Xf = rng.normal(size=(n, d_fixed)).astype(np.float32)
+    Xe = rng.normal(size=(n, d_re)).astype(np.float32)
+    entity = rng.integers(0, n_entities, size=n)
+    margins = Xf @ _W_TRUE + np.einsum("nd,nd->n", Xe, _B_TRUE[entity])
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-margins))).astype(np.float32)
+    return GameDataset.build(
+        {"global": jnp.asarray(Xf), "per_entity": jnp.asarray(Xe)},
+        y,
+        id_tags={"memberId": entity},
+    )
+
+
+DATA_CONFIGS = {
+    "fixed": FixedEffectDataConfig("global"),
+    "per-member": RandomEffectDataConfig("memberId", "per_entity", min_bucket=4),
+}
+
+
+def _opt_config(weight):
+    cfg = CoordinateOptimizationConfig(
+        optimizer=OptimizerConfig(max_iterations=30),
+        regularization=L2,
+        reg_weight=weight,
+    )
+    return {"fixed": cfg, "per-member": cfg}
+
+
+class TestGameEstimator:
+    def test_fit_sweep_with_validation(self):
+        train = _glmix_data(0)
+        val = _glmix_data(1)
+        est = GameEstimator(
+            TaskType.LOGISTIC_REGRESSION,
+            DATA_CONFIGS,
+            coordinate_descent_iterations=2,
+            validation_evaluators=[EvaluatorType("AUC")],
+        )
+        results = est.fit(train, val, [_opt_config(10.0), _opt_config(0.1)])
+        assert len(results) == 2
+        for r in results:
+            assert r.evaluation is not None
+            assert set(r.model.coordinate_ids) == {"fixed", "per-member"}
+        # AUC must beat random on both configs.
+        assert all(r.evaluation.primary_value > 0.6 for r in results)
+        i, best = select_best_result(results)
+        assert best.evaluation.primary_value == max(
+            r.evaluation.primary_value for r in results
+        )
+
+    def test_transform_scores_holdout_with_unseen_entities(self):
+        train = _glmix_data(0, n_entities=10)
+        est = GameEstimator(TaskType.LOGISTIC_REGRESSION, DATA_CONFIGS)
+        results = est.fit(train, None, [_opt_config(1.0)])
+        transformer = est.scoring_specs()
+        from photon_ml_tpu.transformers.game_transformer import GameTransformer
+
+        t = GameTransformer(results[0].model, transformer, TaskType.LOGISTIC_REGRESSION)
+        # Hold-out set with entity ids 0..19 — half unseen at training time.
+        holdout = _glmix_data(7, n_entities=20)
+        out = t.transform(holdout)
+        assert out.scores.shape == (holdout.num_samples,)
+        means = np.asarray(out.means)
+        assert np.all((means > 0) & (means < 1))
+        # Unseen entities score with the zero RE model: their RE contribution
+        # must be exactly zero.
+        unseen = np.asarray(holdout.id_tags["memberId"]) >= 10
+        assert unseen.any()
+        re_scores = np.asarray(out.per_coordinate["per-member"])
+        np.testing.assert_allclose(re_scores[unseen], 0.0, atol=1e-6)
+
+    def test_locked_coordinate_partial_retrain(self):
+        train = _glmix_data(0)
+        est0 = GameEstimator(TaskType.LOGISTIC_REGRESSION, DATA_CONFIGS)
+        base = est0.fit(train, None, [_opt_config(1.0)])[0].model
+
+        est = GameEstimator(
+            TaskType.LOGISTIC_REGRESSION,
+            DATA_CONFIGS,
+            locked_coordinates={"fixed"},
+        )
+        results = est.fit(
+            train,
+            None,
+            [{"per-member": _opt_config(0.5)["per-member"]}],
+            initial_model=base,
+        )
+        model = results[0].model
+        # Locked coordinate unchanged.
+        np.testing.assert_array_equal(
+            np.asarray(model["fixed"].coefficients.means),
+            np.asarray(base["fixed"].coefficients.means),
+        )
+        # Retrained coordinate differs.
+        assert not np.allclose(
+            np.asarray(model["per-member"].coefficients_matrix),
+            np.asarray(base["per-member"].coefficients_matrix),
+        )
+
+    def test_normalization_path(self):
+        train = _glmix_data(0)
+        # Scale a feature badly to make normalization matter.
+        shards = dict(train.shards)
+        shards["global"] = shards["global"] * jnp.asarray([100.0, 1.0, 0.01, 1.0])
+        train = GameDataset(shards, train.labels, train.offsets, train.weights, train.id_tags)
+        est = GameEstimator(
+            TaskType.LOGISTIC_REGRESSION,
+            DATA_CONFIGS,
+            normalization=NormalizationType.SCALE_WITH_STANDARD_DEVIATION,
+            validation_evaluators=[EvaluatorType("AUC")],
+        )
+        results = est.fit(train, train, [_opt_config(0.1)])
+        assert results[0].evaluation.primary_value > 0.7
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError):
+            GameEstimator(
+                TaskType.LOGISTIC_REGRESSION,
+                DATA_CONFIGS,
+                update_sequence=["fixed", "nope"],
+            )
+        est = GameEstimator(TaskType.LOGISTIC_REGRESSION, DATA_CONFIGS)
+        with pytest.raises(ValueError):
+            est.fit(_glmix_data(0), None, [])
+        with pytest.raises(ValueError):
+            est.fit(_glmix_data(0), None, [{"fixed": _opt_config(1.0)["fixed"]}])
+
+    def test_warm_start_chain_reuses_compiled(self):
+        train = _glmix_data(0)
+        est = GameEstimator(TaskType.LOGISTIC_REGRESSION, DATA_CONFIGS)
+        est.fit(train, None, [_opt_config(10.0), _opt_config(1.0), _opt_config(0.1)])
+        # One compiled coordinate object per (cid, static config): the sweep
+        # must not grow the cache beyond 2.
+        assert len(est._coordinate_cache) == 2
