@@ -1,0 +1,401 @@
+"""GAME training driver: the end-to-end train CLI.
+
+Counterpart of photon-client cli/game/training/GameTrainingDriver.scala:55-855
+(see SURVEY.md §3.1 for the reference call stack). Pipeline:
+
+    parse args -> read training/validation Avro data -> (warm-start model)
+    -> GameEstimator.fit over the expanded reg-weight sweep
+    -> optional hyperparameter tuning (RANDOM | BAYESIAN)
+    -> model selection -> save models + metadata under the output root.
+
+Output layout mirrors ModelProcessingUtils.saveGameModelToHDFS:
+    <root>/models/best/...               (unless output mode NONE)
+    <root>/models/explicit-<i>/...       (EXPLICIT | ALL)
+    <root>/models/tuned-<i>/...          (TUNED | ALL)
+Option names match the reference's scopt surface (kebab-cased Param names,
+e.g. --coordinate-configurations with the compound mini-DSL of
+ScoptParserHelpers — README.md:283-292 examples parse verbatim).
+
+Usage: python -m photon_ml_tpu.cli.train --help
+"""
+
+from __future__ import annotations
+
+import argparse
+import enum
+import json
+import logging
+import os
+import sys
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from photon_ml_tpu.cli.config import (
+    CoordinateConfiguration,
+    coordinate_config_to_string,
+    expand_game_opt_configs,
+    feature_shard_config_to_string,
+    parse_coordinate_config,
+    parse_feature_shard_config,
+)
+from photon_ml_tpu.data.game_dataset import RandomEffectDataConfig
+from photon_ml_tpu.estimators.game_estimator import (
+    GameEstimator,
+    GameResult,
+    select_best_result,
+)
+from photon_ml_tpu.evaluation.suite import EvaluatorType, better_than
+from photon_ml_tpu.hyperparameter.search import HyperparameterConfig
+from photon_ml_tpu.hyperparameter.tuner import HyperparameterTuningMode, get_tuner
+from photon_ml_tpu.io import avro_data, model_bridge, model_store
+from photon_ml_tpu.types import (
+    DataValidationType,
+    NormalizationType,
+    RegularizationType,
+    TaskType,
+    VarianceComputationType,
+)
+
+logger = logging.getLogger("photon_ml_tpu.cli.train")
+
+# Default tuning range for regularization weights (the reference's tuning
+# JSON defaults, GameHyperparameterDefaults.scala:20: log-scale weights).
+TUNING_REG_WEIGHT_RANGE = (1e-4, 1e4)
+
+
+class ModelOutputMode(enum.Enum):
+    """Reference: io/ModelOutputMode.scala."""
+
+    NONE = "NONE"
+    BEST = "BEST"
+    EXPLICIT = "EXPLICIT"
+    TUNED = "TUNED"
+    ALL = "ALL"
+
+    @classmethod
+    def parse(cls, name: str) -> "ModelOutputMode":
+        return cls[name.strip().upper()]
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="photon_ml_tpu.cli.train",
+        description="Train GAME/GLMix models (TPU-native Photon ML)",
+    )
+    p.add_argument("--training-task", required=True, type=TaskType.parse,
+                   help="LINEAR_REGRESSION | LOGISTIC_REGRESSION | POISSON_REGRESSION | "
+                        "SMOOTHED_HINGE_LOSS_LINEAR_SVM")
+    p.add_argument("--input-data-directories", required=True, nargs="+",
+                   help="training data dirs/files (Avro TrainingExample records)")
+    p.add_argument("--validation-data-directories", nargs="*", default=[],
+                   help="validation data dirs/files")
+    p.add_argument("--root-output-directory", required=True)
+    p.add_argument("--override-output-directory", action="store_true",
+                   help="overwrite an existing output directory")
+    p.add_argument("--feature-shard-configurations", required=True, nargs="+",
+                   metavar="DSL",
+                   help='e.g. "name=globalShard,feature.bags=features|context,intercept=true"')
+    p.add_argument("--coordinate-configurations", required=True, nargs="+",
+                   metavar="DSL",
+                   help='e.g. "name=global,feature.shard=globalShard,optimizer=LBFGS,'
+                        'tolerance=1.0E-6,max.iter=50,regularization=L2,reg.weights=0.1|1|10"')
+    p.add_argument("--coordinate-update-sequence", default=None,
+                   help="comma-separated coordinate ids (default: config order)")
+    p.add_argument("--coordinate-descent-iterations", type=int, default=1)
+    p.add_argument("--normalization", type=NormalizationType.parse,
+                   default=NormalizationType.NONE)
+    p.add_argument("--validation-evaluators", nargs="*", default=[],
+                   help="e.g. AUC RMSE PRECISION@5:queryId AUC:documentId")
+    p.add_argument("--model-input-directory", default=None,
+                   help="warm-start / partial-retrain model directory")
+    p.add_argument("--partial-retrain-locked-coordinates", default=None,
+                   help="comma-separated coordinate ids to lock (reuse from "
+                        "--model-input-directory)")
+    p.add_argument("--variance-computation-type", type=VarianceComputationType.parse,
+                   default=VarianceComputationType.NONE)
+    p.add_argument("--data-validation", type=lambda s: DataValidationType[s.strip().upper()],
+                   default=DataValidationType.VALIDATE_FULL)
+    p.add_argument("--output-mode", type=ModelOutputMode.parse, default=ModelOutputMode.BEST)
+    p.add_argument("--model-sparsity-threshold", type=float, default=0.0)
+    p.add_argument("--hyper-parameter-tuning", type=HyperparameterTuningMode.parse,
+                   default=HyperparameterTuningMode.NONE)
+    p.add_argument("--hyper-parameter-tuning-iter", type=int, default=20)
+    p.add_argument("--random-seed", type=int, default=0)
+    p.add_argument("--logging-level", default="INFO")
+    p.add_argument("--application-name", default="photon-ml-tpu-training")
+    return p
+
+
+def _read_data(args, coordinate_configs: Dict[str, CoordinateConfiguration]):
+    """readTrainingData/readValidationData (GameTrainingDriver.scala:503-547)."""
+    shard_configs = dict(
+        parse_feature_shard_config(s) for s in args.feature_shard_configurations
+    )
+    id_tags = [
+        c.data_config.random_effect_type
+        for c in coordinate_configs.values()
+        if isinstance(c.data_config, RandomEffectDataConfig)
+    ]
+    for ev in args.validation_evaluators:
+        et = EvaluatorType.parse(ev)
+        if et.is_grouped and et.id_tag not in id_tags:
+            id_tags.append(et.id_tag)
+
+    if len(args.input_data_directories) > 1:
+        raise NotImplementedError("multiple input directories: concatenate upstream")
+    train, index_maps = avro_data.read_game_dataset(
+        args.input_data_directories[0], shard_configs, id_tag_fields=id_tags
+    )
+
+    validation = None
+    if args.validation_data_directories:
+        if len(args.validation_data_directories) > 1:
+            raise NotImplementedError("multiple validation directories")
+        validation, _ = avro_data.read_game_dataset(
+            args.validation_data_directories[0],
+            shard_configs,
+            index_maps=index_maps,
+            id_tag_fields=id_tags,
+        )
+    return train, validation, index_maps, shard_configs
+
+
+def _validate_rows(dataset, task: TaskType, mode: DataValidationType) -> None:
+    """DataValidators.sanityCheckDataFrameForTraining (DataValidators.scala:32)."""
+    from photon_ml_tpu.data.validators import validate_game_dataset
+
+    validate_game_dataset(dataset, task, mode)
+
+
+def _tuning_dimensions(
+    coordinate_configs: Dict[str, CoordinateConfiguration],
+    tunable_ids,
+) -> List[HyperparameterConfig]:
+    """One LOG-scale dimension per regularized TRAINABLE coordinate
+    (GameEstimatorEvaluationFunction.configurationToVector:152); locked
+    coordinates have no config entry in the sweep and are not tuned."""
+    dims = []
+    for cid, cfg in coordinate_configs.items():
+        if cid not in tunable_ids:
+            continue
+        if cfg.opt_config.regularization.reg_type != RegularizationType.NONE:
+            dims.append(
+                HyperparameterConfig(
+                    name=cid,
+                    min_value=TUNING_REG_WEIGHT_RANGE[0],
+                    max_value=TUNING_REG_WEIGHT_RANGE[1],
+                    transform="LOG",
+                )
+            )
+    return dims
+
+
+def run(args) -> Dict[str, object]:
+    logging.basicConfig(
+        level=getattr(logging, args.logging_level.upper(), logging.INFO),
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    out_root = args.root_output_directory
+    models_root = os.path.join(out_root, "models")
+    if os.path.exists(models_root):
+        if not args.override_output_directory:
+            raise FileExistsError(
+                f"{models_root} exists; pass --override-output-directory to replace"
+            )
+        # Clean replace — never mix stale model subdirs into the new run
+        # (cleanOutputDirs, GameTrainingDriver.scala:487).
+        import shutil
+
+        shutil.rmtree(models_root)
+    os.makedirs(out_root, exist_ok=True)
+
+    coordinate_configs = {}
+    for s in args.coordinate_configurations:
+        cfg = parse_coordinate_config(s)
+        coordinate_configs[cfg.name] = cfg
+    update_sequence = (
+        [c.strip() for c in args.coordinate_update_sequence.split(",")]
+        if args.coordinate_update_sequence
+        else list(coordinate_configs.keys())
+    )
+    locked = (
+        {c.strip() for c in args.partial_retrain_locked_coordinates.split(",")}
+        if args.partial_retrain_locked_coordinates
+        else set()
+    )
+
+    # Log the effective config back out (the scopt parsers' round-trip print).
+    logger.info("effective feature shard configurations:")
+    shard_configs_parsed = dict(
+        parse_feature_shard_config(s) for s in args.feature_shard_configurations
+    )
+    for name, fc in shard_configs_parsed.items():
+        logger.info("  %s", feature_shard_config_to_string(name, fc))
+    logger.info("effective coordinate configurations:")
+    for cfg in coordinate_configs.values():
+        logger.info("  %s", coordinate_config_to_string(cfg))
+
+    train, validation, index_maps, shard_configs = _read_data(args, coordinate_configs)
+    logger.info(
+        "training data: %d samples, shards %s",
+        train.num_samples,
+        {k: v.size for k, v in index_maps.items()},
+    )
+    _validate_rows(train, args.training_task, args.data_validation)
+    if validation is not None:
+        _validate_rows(validation, args.training_task, args.data_validation)
+
+    # Per-coordinate variance type (driver-level param applied to every
+    # coordinate, GameTrainingDriver varianceComputationType).
+    if args.variance_computation_type != VarianceComputationType.NONE:
+        import dataclasses as _dc
+
+        for cfg in coordinate_configs.values():
+            cfg.opt_config = _dc.replace(
+                cfg.opt_config, variance_computation=args.variance_computation_type
+            )
+
+    estimator = GameEstimator(
+        args.training_task,
+        {cid: c.data_config for cid, c in coordinate_configs.items()},
+        update_sequence=update_sequence,
+        coordinate_descent_iterations=args.coordinate_descent_iterations,
+        normalization=args.normalization,
+        validation_evaluators=[EvaluatorType.parse(e) for e in args.validation_evaluators],
+        locked_coordinates=locked or None,
+        intercept_indices={
+            shard: index_maps[shard].intercept_index
+            for shard in index_maps
+            if index_maps[shard].intercept_index is not None
+        },
+        seed=args.random_seed,
+    )
+
+    # Warm start / partial retrain (GameTrainingDriver.scala:370-409).
+    initial_model = None
+    if args.model_input_directory:
+        artifact = model_store.load_game_model(
+            os.path.join(args.model_input_directory), index_maps
+        )
+        estimator.prepare(train)
+        initial_model = model_bridge.warm_start_model_for_estimator(
+            artifact, estimator.scoring_specs()
+        )
+        logger.info("warm start from %s", args.model_input_directory)
+    elif locked:
+        raise ValueError("--partial-retrain-locked-coordinates requires "
+                         "--model-input-directory")
+
+    sweep = expand_game_opt_configs(
+        {cid: coordinate_configs[cid] for cid in update_sequence if cid not in locked}
+    )
+    logger.info("training %d explicit configuration(s)", len(sweep))
+    explicit_results = estimator.fit(
+        train, validation, sweep, initial_model=initial_model
+    )
+
+    # Hyperparameter tuning (GameTrainingDriver.runHyperparameterTuning:643).
+    tuned_results: List[GameResult] = []
+    if (
+        args.hyper_parameter_tuning != HyperparameterTuningMode.NONE
+        and validation is not None
+    ):
+        dims = _tuning_dimensions(coordinate_configs, set(explicit_results[0].config))
+        if dims:
+            _, base = select_best_result(explicit_results)
+            evaluator = base.evaluation.primary
+            maximize = better_than(evaluator, 1.0, 0.0)
+
+            def evaluate(point: np.ndarray) -> float:
+                cfgs = dict(base.config)
+                for d, cid in zip(point, [c.name for c in dims]):
+                    import dataclasses as _dc
+
+                    cfgs[cid] = _dc.replace(cfgs[cid], reg_weight=float(d))
+                res = estimator.fit(
+                    train, validation, [cfgs], initial_model=base.model
+                )[0]
+                tuned_results.append(res)
+                return res.evaluation.primary_value
+
+            tuner = get_tuner(args.hyper_parameter_tuning)
+            tuner.search(
+                args.hyper_parameter_tuning_iter,
+                dims,
+                args.hyper_parameter_tuning,
+                evaluate,
+                maximize=maximize,
+                seed=args.random_seed + 1,
+            )
+            logger.info("hyperparameter tuning: %d trials", len(tuned_results))
+
+    # Model selection + save (GameTrainingDriver.scala:683-779).
+    all_results = explicit_results + tuned_results
+    best_i, best = select_best_result(all_results)
+    specs = estimator.scoring_specs()
+    summary: Dict[str, object] = {
+        "num_explicit": len(explicit_results),
+        "num_tuned": len(tuned_results),
+        "best_index": best_i,
+        "best_evaluation": None if best.evaluation is None else best.evaluation.results,
+    }
+
+    def _save(result: GameResult, subdir: str) -> None:
+        artifact = model_bridge.artifact_from_game_model(
+            result.model,
+            specs,
+            args.training_task,
+            opt_configs={
+                cid: {
+                    "optimizer": c.optimizer.optimizer_type.value,
+                    "max_iterations": c.optimizer.max_iterations,
+                    "tolerance": c.optimizer.tolerance,
+                    "regularization": c.regularization.reg_type.value,
+                    "reg_weight": c.reg_weight,
+                }
+                for cid, c in result.config.items()
+            },
+        )
+        mdir = os.path.join(models_root, subdir)
+        model_store.save_game_model(
+            mdir,
+            artifact,
+            index_maps,
+            sparsity_threshold=args.model_sparsity_threshold,
+        )
+        # Ship the feature index maps with the model so the scoring driver
+        # resolves names identically (stands in for the off-heap index dir).
+        idx_dir = os.path.join(mdir, "feature-indexes")
+        os.makedirs(idx_dir, exist_ok=True)
+        for shard, imap in index_maps.items():
+            imap.save(os.path.join(idx_dir, f"{shard}.json"))
+
+    mode = args.output_mode
+    if mode != ModelOutputMode.NONE:
+        _save(best, "best")
+        if mode in (ModelOutputMode.EXPLICIT, ModelOutputMode.ALL):
+            for i, r in enumerate(explicit_results):
+                _save(r, f"explicit-{i}")
+        if mode in (ModelOutputMode.TUNED, ModelOutputMode.ALL):
+            for i, r in enumerate(tuned_results):
+                _save(r, f"tuned-{i}")
+
+    with open(os.path.join(out_root, "training-summary.json"), "w") as f:
+        json.dump(summary, f, indent=2, default=str)
+    for i, r in enumerate(all_results):
+        logger.info(
+            "config %d%s: %s",
+            i,
+            " (best)" if i == best_i else "",
+            None if r.evaluation is None else r.evaluation.results,
+        )
+    return summary
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    run(build_parser().parse_args(argv))
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -60,6 +60,9 @@ class IdentityProjector:
     def back_project_matrix(self, matrix: Array) -> Array:
         return matrix
 
+    def project_matrix(self, matrix: Array) -> Array:
+        return matrix
+
 
 class IndexMapProjector:
     """Per-entity index compaction (IndexMapProjectorRDD.scala:36-218).
@@ -157,6 +160,16 @@ class IndexMapProjector:
         np.add.at(out, (np.arange(e1)[:, None], cols), m)
         return jnp.asarray(out[:, : self.original_dim])
 
+    def project_matrix(self, matrix: Array) -> Array:
+        """(E+1, D) original-space rows -> (E+1, D_proj) projected rows (the
+        warm-start direction: gather each entity's slots). Exact inverse of
+        back_project_matrix on this projector's support."""
+        m = np.asarray(matrix)
+        cols = np.where(self.slot_tables >= 0, self.slot_tables, 0)
+        out = np.take_along_axis(m, cols, axis=1)
+        out[self.slot_tables < 0] = 0.0
+        return jnp.asarray(out)
+
     def entity_coefficients(self, matrix: Array, entity_row: int) -> Dict[int, float]:
         """One entity's model as {global feature index: weight} (sparse save
         path, ModelProcessingUtils.saveModelsRDDToHDFS)."""
@@ -200,6 +213,13 @@ class RandomProjector:
         """w_orig = P w_proj per entity row (ProjectionMatrix
         projectCoefficients)."""
         return matrix @ self.matrix.T
+
+    def project_matrix(self, matrix: Array) -> Array:
+        """Approximate original->projected coefficient map (warm start only):
+        least-squares through P, i.e. w_proj = (P^T P)^-1 P^T w_orig."""
+        p = self.matrix
+        gram = p.T @ p
+        return jnp.linalg.solve(gram, p.T @ matrix.T).T
 
 
 Projector = object  # IdentityProjector | IndexMapProjector | RandomProjector

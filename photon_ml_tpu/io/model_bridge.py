@@ -1,0 +1,183 @@
+"""Bridging trained GAME models <-> persisted model artifacts.
+
+The reference saves models in ORIGINAL feature space with feature names
+resolved through the index maps (ModelProcessingUtils.scala:77-141); training
+happens in normalized and (for random effects) projected space. This module
+owns the space conversions on the way in and out of the model store:
+
+  save:  transformed/projected device matrices -> original-space numpy rows
+         (normalization folded out via modelToOriginalSpace —
+         NormalizationContext.scala:73-90 — and projections reversed through
+         the projector).
+  load:  original-space artifact -> GameModel scoring in original space
+         (no norm/projector needed), OR -> warm-start matrices re-projected
+         into an estimator's training representation
+         (GameTrainingDriver.scala:370-378 warm-start path).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Mapping, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.game.model import (
+    Coefficients,
+    FixedEffectModel,
+    GameModel,
+    RandomEffectModel,
+)
+from photon_ml_tpu.io.model_store import (
+    FixedEffectArtifact,
+    GameModelArtifact,
+    RandomEffectArtifact,
+)
+from photon_ml_tpu.transformers.game_transformer import CoordinateScoringSpec
+from photon_ml_tpu.types import TaskType
+
+
+def _ordered_entity_ids(entity_index: Mapping[object, int]) -> list:
+    out = [None] * len(entity_index)
+    for k, i in entity_index.items():
+        out[i] = k
+    return out
+
+
+def artifact_from_game_model(
+    model: GameModel,
+    specs: Mapping[str, CoordinateScoringSpec],
+    task: TaskType,
+    *,
+    opt_configs: Optional[Dict[str, dict]] = None,
+) -> GameModelArtifact:
+    """Convert a trained GameModel (+ its scoring specs) to the persistable
+    original-space artifact."""
+    coords: Dict[str, object] = {}
+    for cid, m in model.items():
+        spec = specs[cid]
+        norm = spec.norm
+        if isinstance(m, FixedEffectModel):
+            means = m.coefficients.means
+            variances = m.coefficients.variances
+            if norm is not None and not norm.is_identity:
+                means = norm.model_to_original_space(means)
+                if variances is not None and norm.factors is not None:
+                    # var scales quadratically under w -> w * factor.
+                    variances = variances * jnp.square(norm.factors)
+            coords[cid] = FixedEffectArtifact(
+                spec.shard,
+                np.asarray(means),
+                None if variances is None else np.asarray(variances),
+            )
+        elif isinstance(m, RandomEffectModel):
+            matrix = m.coefficients_matrix
+            variances = m.variances_matrix
+            if norm is not None and not norm.is_identity:
+                # Row-wise modelToOriginalSpace: factors plus, for identity-
+                # projected shards with shifts, the intercept fold-in.
+                import jax
+
+                matrix = jax.vmap(norm.model_to_original_space)(jnp.asarray(matrix))
+                if variances is not None and norm.factors is not None:
+                    variances = variances * jnp.square(norm.factors)
+            if spec.projector is not None:
+                matrix = spec.projector.back_project_matrix(matrix)
+                if variances is not None:
+                    variances = spec.projector.back_project_matrix(variances)
+            # Drop the pinned zero row for unseen entities.
+            e = len(spec.entity_index)
+            coords[cid] = RandomEffectArtifact(
+                spec.random_effect_type,
+                spec.shard,
+                [str(k) for k in _ordered_entity_ids(spec.entity_index)],
+                np.asarray(matrix)[:e],
+                None if variances is None else np.asarray(variances)[:e],
+            )
+        else:
+            raise TypeError(f"unknown model type {type(m)} for coordinate {cid!r}")
+    return GameModelArtifact(task=task, coordinates=coords, opt_configs=opt_configs or {})
+
+
+def game_model_from_artifact(
+    artifact: GameModelArtifact,
+) -> Tuple[GameModel, Dict[str, CoordinateScoringSpec]]:
+    """Artifact -> (GameModel, scoring specs) in ORIGINAL feature space —
+    the scoring-driver path (GameScoringDriver loadModel -> GameTransformer).
+    """
+    models: Dict[str, object] = {}
+    specs: Dict[str, CoordinateScoringSpec] = {}
+    for cid, coord in artifact.coordinates.items():
+        if isinstance(coord, FixedEffectArtifact):
+            models[cid] = FixedEffectModel(
+                Coefficients(
+                    jnp.asarray(coord.means, jnp.float32),
+                    None
+                    if coord.variances is None
+                    else jnp.asarray(coord.variances, jnp.float32),
+                ),
+                artifact.task,
+            )
+            specs[cid] = CoordinateScoringSpec(shard=coord.feature_shard)
+        elif isinstance(coord, RandomEffectArtifact):
+            e, d = coord.means.shape
+            matrix = np.zeros((e + 1, d), np.float32)
+            matrix[:e] = coord.means
+            var_matrix = None
+            if coord.variances is not None:
+                var_matrix = np.zeros((e + 1, d), np.float32)
+                var_matrix[:e] = coord.variances
+            models[cid] = RandomEffectModel(
+                jnp.asarray(matrix),
+                None if var_matrix is None else jnp.asarray(var_matrix),
+                artifact.task,
+            )
+            specs[cid] = CoordinateScoringSpec(
+                shard=coord.feature_shard,
+                random_effect_type=coord.random_effect_type,
+                entity_index={k: i for i, k in enumerate(coord.entity_ids)},
+            )
+        else:
+            raise TypeError(f"unknown artifact type {type(coord)} for {cid!r}")
+    return GameModel(models), specs
+
+
+def warm_start_model_for_estimator(
+    artifact: GameModelArtifact,
+    specs: Mapping[str, CoordinateScoringSpec],
+) -> GameModel:
+    """Artifact -> GameModel in the ESTIMATOR's training representation
+    (transformed + projected spaces), aligned to the training dataset's
+    entity indexing. The reference's per-entity leftOuterJoin warm start
+    (RandomEffectCoordinate.scala:110-121): entities present in both keep
+    their coefficients; training-set-only entities start at zero; artifact-
+    only entities are dropped."""
+    models: Dict[str, object] = {}
+    for cid, coord in artifact.coordinates.items():
+        if cid not in specs:
+            continue
+        spec = specs[cid]
+        norm = spec.norm
+        if isinstance(coord, FixedEffectArtifact):
+            means = jnp.asarray(coord.means, jnp.float32)
+            if norm is not None and not norm.is_identity:
+                means = norm.model_to_transformed_space(means)
+            models[cid] = FixedEffectModel(Coefficients(means), artifact.task)
+        elif isinstance(coord, RandomEffectArtifact):
+            e_train = len(spec.entity_index)
+            d = coord.means.shape[1]
+            aligned = np.zeros((e_train + 1, d), np.float32)
+            art_rows = {k: i for i, k in enumerate(coord.entity_ids)}
+            for key, row in spec.entity_index.items():
+                i = art_rows.get(str(key))
+                if i is not None:
+                    aligned[row] = coord.means[i]
+            matrix = jnp.asarray(aligned)
+            if spec.projector is not None:
+                matrix = spec.projector.project_matrix(matrix)
+            if norm is not None and not norm.is_identity:
+                import jax
+
+                matrix = jax.vmap(norm.model_to_transformed_space)(matrix)
+            models[cid] = RandomEffectModel(jnp.asarray(matrix), None, artifact.task)
+    return GameModel(models)

@@ -89,8 +89,14 @@ def entity_rows_for_dataset(
     keys = dataset.id_tags[spec.random_effect_type]
     index = spec.entity_index
     unseen = len(index)
+    key_iter = keys.tolist()
+    # Entity ids are strings in persisted artifacts (REId = String,
+    # Types.scala:9-25) but may be ints in in-memory datasets; coerce lookup
+    # keys to the index's key type so reloaded models resolve entities.
+    if index and isinstance(next(iter(index)), str) and keys.dtype.kind not in "USO":
+        key_iter = (str(k) for k in key_iter)
     return np.fromiter(
-        (index.get(k, unseen) for k in keys.tolist()), np.int64, count=len(keys)
+        (index.get(k, unseen) for k in key_iter), np.int64, count=len(keys)
     )
 
 

@@ -1,0 +1,238 @@
+"""CLI tests: config DSL round-trips, sweep expansion, end-to-end
+train -> score drivers (reference: ScoptParserHelpers / GameTrainingDriver /
+GameScoringDriver behavior)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.cli import score as score_cli
+from photon_ml_tpu.cli import train as train_cli
+from photon_ml_tpu.cli.config import (
+    coordinate_config_to_string,
+    expand_game_opt_configs,
+    parse_coordinate_config,
+    parse_feature_shard_config,
+)
+from photon_ml_tpu.data.game_dataset import RandomEffectDataConfig
+from photon_ml_tpu.io.avro_data import write_training_examples
+from photon_ml_tpu.types import OptimizerType, ProjectorType, RegularizationType
+
+
+class TestConfigDSL:
+    def test_feature_shard_parse(self):
+        name, cfg = parse_feature_shard_config(
+            "name=globalShard,feature.bags=features|context,intercept=true"
+        )
+        assert name == "globalShard"
+        assert cfg.feature_bags == ("features", "context")
+        assert cfg.has_intercept
+
+    def test_feature_shard_defaults_and_errors(self):
+        name, cfg = parse_feature_shard_config("name=s")
+        assert cfg.feature_bags == ("features",) and cfg.has_intercept
+        with pytest.raises(ValueError):
+            parse_feature_shard_config("feature.bags=f1")
+        with pytest.raises(ValueError):
+            parse_feature_shard_config("name=s,bogus.key=1")
+
+    def test_coordinate_parse_readme_example(self):
+        # The README.md:283-292 example string parses verbatim.
+        cfg = parse_coordinate_config(
+            "name=global,feature.shard=globalShard,min.partitions=4,"
+            "optimizer=LBFGS,tolerance=1.0E-6,max.iter=50,"
+            "regularization=L2,reg.weights=0.1|1|10|100"
+        )
+        assert cfg.name == "global"
+        assert cfg.data_config.feature_shard == "globalShard"
+        assert cfg.opt_config.optimizer.optimizer_type == OptimizerType.LBFGS
+        assert cfg.opt_config.optimizer.tolerance == 1e-6
+        assert cfg.opt_config.optimizer.max_iterations == 50
+        assert cfg.opt_config.regularization.reg_type == RegularizationType.L2
+        assert set(cfg.reg_weights) == {0.1, 1.0, 10.0, 100.0}
+        # Descending expansion (CoordinateConfiguration.scala:71-77).
+        assert [c.reg_weight for c in cfg.expand()] == [100.0, 10.0, 1.0, 0.1]
+
+    def test_random_effect_coordinate_parse(self):
+        cfg = parse_coordinate_config(
+            "name=per-member,random.effect.type=memberId,feature.shard=memberShard,"
+            "active.data.lower.bound=2,active.data.upper.bound=100,"
+            "optimizer=TRON,regularization=L2,reg.weights=1,projector=RANDOM,"
+            "projected.dim=16,min.bucket=4"
+        )
+        dc = cfg.data_config
+        assert isinstance(dc, RandomEffectDataConfig)
+        assert dc.random_effect_type == "memberId"
+        assert dc.active_lower_bound == 2 and dc.active_upper_bound == 100
+        assert dc.projector_type == ProjectorType.RANDOM and dc.projected_dim == 16
+        assert dc.min_bucket == 4
+
+    def test_round_trip(self):
+        for s in [
+            "name=global,feature.shard=g,optimizer=OWLQN,tolerance=0.001,"
+            "max.iter=20,regularization=L1,reg.weights=0.5|2.0",
+            "name=re,random.effect.type=uid,feature.shard=s,optimizer=LBFGS,"
+            "tolerance=1e-07,max.iter=100,regularization=NONE",
+        ]:
+            cfg = parse_coordinate_config(s)
+            printed = coordinate_config_to_string(cfg)
+            cfg2 = parse_coordinate_config(printed)
+            assert cfg2.name == cfg.name
+            assert cfg2.reg_weights == cfg.reg_weights
+            assert cfg2.opt_config == cfg.opt_config
+            assert cfg2.data_config == cfg.data_config
+
+    def test_expand_cross_product(self):
+        a = parse_coordinate_config(
+            "name=a,feature.shard=s,regularization=L2,reg.weights=1|10"
+        )
+        b = parse_coordinate_config(
+            "name=b,feature.shard=s,regularization=L2,reg.weights=0.5"
+        )
+        combos = expand_game_opt_configs({"a": a, "b": b})
+        assert len(combos) == 2
+        assert [c["a"].reg_weight for c in combos] == [10.0, 1.0]
+        assert all(c["b"].reg_weight == 0.5 for c in combos)
+
+    def test_errors(self):
+        with pytest.raises(ValueError):
+            parse_coordinate_config("feature.shard=s")  # no name
+        with pytest.raises(ValueError):
+            parse_coordinate_config("name=a,feature.shard=s,regularization=L2")
+        with pytest.raises(ValueError):
+            parse_coordinate_config("name=a,feature.shard=s,nope=1")
+
+
+def _write_glmix_avro(path, seed, n, n_entities=8):
+    rng = np.random.default_rng(seed)
+    w_true = np.random.default_rng(99).normal(size=4)
+    b_true = np.random.default_rng(98).normal(size=(20, 2))
+    X = rng.normal(size=(n, 4))
+    entity = rng.integers(0, n_entities, size=n)
+    margins = X @ w_true + np.einsum("nd,nd->n", X[:, :2], b_true[entity])
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-margins))).astype(np.float32)
+    feats = [
+        [(f"f{j}", float(X[i, j])) for j in range(4)] for i in range(n)
+    ]
+    write_training_examples(
+        path,
+        feats,
+        y.tolist(),
+        uids=[f"uid{i}" for i in range(n)],
+        id_tags={"memberId": [f"m{e}" for e in entity]},
+    )
+
+
+class TestDriversEndToEnd:
+    def test_train_then_score(self, tmp_path):
+        train_avro = str(tmp_path / "train.avro")
+        val_avro = str(tmp_path / "val.avro")
+        _write_glmix_avro(train_avro, 0, 400)
+        _write_glmix_avro(val_avro, 1, 200)
+        out = str(tmp_path / "out")
+
+        train_cli.main([
+            "--training-task", "LOGISTIC_REGRESSION",
+            "--input-data-directories", train_avro,
+            "--validation-data-directories", val_avro,
+            "--root-output-directory", out,
+            "--feature-shard-configurations",
+            "name=globalShard,feature.bags=features,intercept=true",
+            "--coordinate-configurations",
+            "name=global,feature.shard=globalShard,optimizer=LBFGS,"
+            "tolerance=1e-7,max.iter=40,regularization=L2,reg.weights=0.1|10",
+            "name=per-member,random.effect.type=memberId,feature.shard=globalShard,"
+            "optimizer=LBFGS,max.iter=30,regularization=L2,reg.weights=1,min.bucket=4",
+            "--validation-evaluators", "AUC",
+            "--output-mode", "ALL",
+        ])
+
+        # Model layout (ModelProcessingUtils.scala:77-141).
+        best = os.path.join(out, "models", "best")
+        assert os.path.isfile(os.path.join(best, "model-metadata.json"))
+        assert os.path.isdir(os.path.join(best, "fixed-effect", "global"))
+        assert os.path.isdir(os.path.join(best, "random-effect", "per-member"))
+        assert os.path.isdir(os.path.join(out, "models", "explicit-1"))
+        summary = json.load(open(os.path.join(out, "training-summary.json")))
+        assert summary["num_explicit"] == 2
+        assert summary["best_evaluation"]["AUC"] > 0.6
+
+        # Score with the trained model.
+        score_out = str(tmp_path / "scores")
+        score_cli.main([
+            "--input-data-directories", val_avro,
+            "--model-input-directory", best,
+            "--root-output-directory", score_out,
+            "--feature-shard-configurations",
+            "name=globalShard,feature.bags=features,intercept=true",
+            "--evaluators", "AUC",
+        ])
+        ssum = json.load(open(os.path.join(score_out, "scoring-summary.json")))
+        assert ssum["num_scored"] == 200
+        # Scoring-side AUC must match the training driver's validation AUC
+        # (same model, same data, original-space scoring path).
+        assert abs(ssum["evaluation"]["AUC"] - summary["best_evaluation"]["AUC"]) < 5e-3
+
+        from photon_ml_tpu.io.score_store import load_scores
+        items = load_scores(os.path.join(score_out, "scores"))
+        assert len(items) == 200 and items[0].uid.startswith("uid")
+
+    def test_warm_start_and_partial_retrain(self, tmp_path):
+        train_avro = str(tmp_path / "train.avro")
+        _write_glmix_avro(train_avro, 0, 300)
+        out1 = str(tmp_path / "out1")
+        common = [
+            "--training-task", "LOGISTIC_REGRESSION",
+            "--input-data-directories", train_avro,
+            "--feature-shard-configurations",
+            "name=globalShard,feature.bags=features,intercept=true",
+        ]
+        train_cli.main(common + [
+            "--root-output-directory", out1,
+            "--coordinate-configurations",
+            "name=global,feature.shard=globalShard,max.iter=30,"
+            "regularization=L2,reg.weights=1",
+            "name=per-member,random.effect.type=memberId,feature.shard=globalShard,"
+            "max.iter=20,regularization=L2,reg.weights=1,min.bucket=4",
+        ])
+        # Partial retrain: lock the fixed effect, retrain only the RE.
+        out2 = str(tmp_path / "out2")
+        train_cli.main(common + [
+            "--root-output-directory", out2,
+            "--model-input-directory", os.path.join(out1, "models", "best"),
+            "--partial-retrain-locked-coordinates", "global",
+            "--coordinate-configurations",
+            "name=global,feature.shard=globalShard,max.iter=30,"
+            "regularization=L2,reg.weights=1",
+            "name=per-member,random.effect.type=memberId,feature.shard=globalShard,"
+            "max.iter=20,regularization=L2,reg.weights=0.1,min.bucket=4",
+        ])
+        assert os.path.isdir(os.path.join(out2, "models", "best", "fixed-effect"))
+
+
+class TestValidators:
+    def test_validation_catches_bad_rows(self, tmp_path):
+        import jax.numpy as jnp
+
+        from photon_ml_tpu.data.game_dataset import GameDataset
+        from photon_ml_tpu.data.validators import (
+            DataValidationError,
+            validate_game_dataset,
+        )
+        from photon_ml_tpu.types import DataValidationType, TaskType
+
+        ds = GameDataset.build(
+            {"s": jnp.asarray([[1.0], [np.nan]])},
+            [1.0, 3.0],
+            weights=[1.0, -1.0],
+        )
+        with pytest.raises(DataValidationError) as exc:
+            validate_game_dataset(ds, TaskType.LOGISTIC_REGRESSION, DataValidationType.VALIDATE_FULL)
+        names = [f[0] for f in exc.value.failures]
+        assert "positive weight" in names
+        assert "binary label" in names
+        assert any("finite features" in n for n in names)
+        # Disabled mode never raises.
+        validate_game_dataset(ds, TaskType.LOGISTIC_REGRESSION, DataValidationType.VALIDATE_DISABLED)

@@ -1,0 +1,94 @@
+"""Model bridge space-conversion invariants (reference:
+NormalizationContext.scala:73-107 modelToOriginalSpace/TransformedSpace,
+RandomEffectCoordinate warm start)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from photon_ml_tpu.data.game_dataset import (
+    FixedEffectDataConfig,
+    GameDataset,
+    RandomEffectDataConfig,
+)
+from photon_ml_tpu.estimators.game_estimator import GameEstimator
+from photon_ml_tpu.io import model_bridge
+from photon_ml_tpu.optimize.config import L2, CoordinateOptimizationConfig, OptimizerConfig
+from photon_ml_tpu.transformers.game_transformer import GameTransformer
+from photon_ml_tpu.types import NormalizationType, TaskType
+
+
+def _data(seed, n=300, n_entities=6):
+    rng = np.random.default_rng(seed)
+    X = np.concatenate(
+        [rng.normal(loc=3.0, scale=[5.0, 0.5, 1.0], size=(n, 3)), np.ones((n, 1))],
+        axis=1,
+    ).astype(np.float32)
+    entity = rng.integers(0, n_entities, size=n)
+    w = np.array([0.3, -2.0, 1.0, 0.5])
+    b = rng.normal(size=(n_entities, 4)) * 0.5
+    m = X @ w + np.einsum("nd,nd->n", X, b[entity])
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-m))).astype(np.float32)
+    return GameDataset.build(
+        {"s": jnp.asarray(X)}, y, id_tags={"memberId": entity}
+    )
+
+
+@pytest.mark.parametrize(
+    "norm",
+    [NormalizationType.STANDARDIZATION, NormalizationType.SCALE_WITH_STANDARD_DEVIATION],
+)
+def test_save_load_round_trip_with_normalization(norm):
+    """Scores from the training-space transformer and from the saved
+    original-space artifact must agree — including shift-based normalization
+    on an identity-projected (dense) RE shard."""
+    train = _data(0)
+    cfg = CoordinateOptimizationConfig(
+        optimizer=OptimizerConfig(max_iterations=25), regularization=L2, reg_weight=1.0
+    )
+    est = GameEstimator(
+        TaskType.LOGISTIC_REGRESSION,
+        {
+            "fixed": FixedEffectDataConfig("s"),
+            "per-m": RandomEffectDataConfig("memberId", "s", min_bucket=4),
+        },
+        normalization=norm,
+        intercept_indices={"s": 3},
+    )
+    model = est.fit(train, None, [{"fixed": cfg, "per-m": cfg}])[0].model
+    specs = est.scoring_specs()
+
+    holdout = _data(1)
+    trained_scores = np.asarray(
+        GameTransformer(model, specs, TaskType.LOGISTIC_REGRESSION)
+        .transform(holdout)
+        .scores
+    )
+
+    artifact = model_bridge.artifact_from_game_model(
+        model, specs, TaskType.LOGISTIC_REGRESSION
+    )
+    loaded_model, loaded_specs = model_bridge.game_model_from_artifact(artifact)
+    loaded_scores = np.asarray(
+        GameTransformer(loaded_model, loaded_specs, TaskType.LOGISTIC_REGRESSION)
+        .transform(holdout)
+        .scores
+    )
+    np.testing.assert_allclose(loaded_scores, trained_scores, rtol=1e-4, atol=1e-4)
+
+    # Warm-start direction: artifact re-imported into the estimator's
+    # training representation must reproduce the training-space matrices.
+    ws = model_bridge.warm_start_model_for_estimator(artifact, specs)
+    np.testing.assert_allclose(
+        np.asarray(ws["fixed"].coefficients.means),
+        np.asarray(model["fixed"].coefficients.means),
+        rtol=1e-4,
+        atol=1e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(ws["per-m"].coefficients_matrix),
+        np.asarray(model["per-m"].coefficients_matrix),
+        rtol=1e-4,
+        atol=1e-5,
+    )
