@@ -191,7 +191,7 @@ def _tuning_dimensions(
     return dims
 
 
-def run(args) -> Dict[str, object]:
+def run(args, event_emitter=None) -> Dict[str, object]:
     logging.basicConfig(
         level=getattr(logging, args.logging_level.upper(), logging.INFO),
         format="%(asctime)s %(name)s %(levelname)s %(message)s",
@@ -210,6 +210,43 @@ def run(args) -> Dict[str, object]:
         shutil.rmtree(models_root)
     os.makedirs(out_root, exist_ok=True)
 
+    # Job-scoped observability: file log under the output root (the
+    # reference's PhotonLogger HDFS file), timed sections, lifecycle events.
+    from photon_ml_tpu.utils.observability import (
+        PhotonLogger,
+        PhotonSetupEvent,
+        Timed,
+        TimingRegistry,
+        TrainingFinishEvent,
+        TrainingStartEvent,
+    )
+
+    timings = TimingRegistry()
+    job_logger = PhotonLogger(
+        os.path.join(out_root, "photon-ml-tpu.log"), level=args.logging_level
+    )
+    if event_emitter is not None:
+        event_emitter.send(PhotonSetupEvent(args=str(vars(args))))
+    try:
+        return _run_job(
+            args, event_emitter, out_root, models_root, timings, Timed,
+            TrainingStartEvent, TrainingFinishEvent,
+        )
+    except Exception as e:
+        from photon_ml_tpu.utils.observability import PhotonFailureEvent
+
+        logger.exception("training job failed")
+        if event_emitter is not None:
+            event_emitter.send(PhotonFailureEvent(error=repr(e)))
+        raise
+    finally:
+        job_logger.close()
+
+
+def _run_job(
+    args, event_emitter, out_root, models_root, timings, Timed,
+    TrainingStartEvent, TrainingFinishEvent,
+) -> Dict[str, object]:
     coordinate_configs = {}
     for s in args.coordinate_configurations:
         cfg = parse_coordinate_config(s)
@@ -236,15 +273,19 @@ def run(args) -> Dict[str, object]:
     for cfg in coordinate_configs.values():
         logger.info("  %s", coordinate_config_to_string(cfg))
 
-    train, validation, index_maps, shard_configs = _read_data(args, coordinate_configs)
+    with Timed("read data", registry=timings):
+        train, validation, index_maps, shard_configs = _read_data(args, coordinate_configs)
     logger.info(
         "training data: %d samples, shards %s",
         train.num_samples,
         {k: v.size for k, v in index_maps.items()},
     )
-    _validate_rows(train, args.training_task, args.data_validation)
-    if validation is not None:
-        _validate_rows(validation, args.training_task, args.data_validation)
+    with Timed("validate data", registry=timings):
+        _validate_rows(train, args.training_task, args.data_validation)
+        if validation is not None:
+            _validate_rows(validation, args.training_task, args.data_validation)
+    if event_emitter is not None:
+        event_emitter.send(TrainingStartEvent(num_samples=train.num_samples))
 
     # Per-coordinate variance type (driver-level param applied to every
     # coordinate, GameTrainingDriver varianceComputationType).
@@ -291,9 +332,10 @@ def run(args) -> Dict[str, object]:
         {cid: coordinate_configs[cid] for cid in update_sequence if cid not in locked}
     )
     logger.info("training %d explicit configuration(s)", len(sweep))
-    explicit_results = estimator.fit(
-        train, validation, sweep, initial_model=initial_model
-    )
+    with Timed("train explicit configurations", registry=timings):
+        explicit_results = estimator.fit(
+            train, validation, sweep, initial_model=initial_model
+        )
 
     # Hyperparameter tuning (GameTrainingDriver.runHyperparameterTuning:643).
     tuned_results: List[GameResult] = []
@@ -389,6 +431,21 @@ def run(args) -> Dict[str, object]:
             i,
             " (best)" if i == best_i else "",
             None if r.evaluation is None else r.evaluation.results,
+        )
+    # Fold per-coordinate descent timings into the job summary so profiling
+    # data from inside the estimator reaches the final report.
+    for r in all_results:
+        for section, seconds in r.timing.items():
+            timings.record(f"coordinate {section}", seconds)
+    logger.info("timing summary:\n%s", timings.summary())
+    if event_emitter is not None:
+        event_emitter.send(
+            TrainingFinishEvent(
+                num_configs=len(all_results),
+                best_metric=(
+                    None if best.evaluation is None else best.evaluation.primary_value
+                ),
+            )
         )
     return summary
 

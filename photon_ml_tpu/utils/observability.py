@@ -1,0 +1,239 @@
+"""Observability: section timing, job file logging, event bus, state summaries.
+
+Counterparts:
+  * `Timed` — photon-lib util/Timed.scala:33-60: wall-clock section profiling
+    wrapping every pipeline stage; here a context manager/decorator that logs
+    on exit and records into an optional registry for end-of-job summaries.
+  * `PhotonLogger` — photon-lib util/PhotonLogger.scala:34-120: per-job log
+    file with settable level (the reference writes to HDFS; here a local
+    file handler on the standard logging tree).
+  * `EventEmitter`/`Event` — photon-client event/ (EventEmitter.scala:24,
+    Event.scala:28, EventListener.scala): synchronous listener bus for job
+    lifecycle events.
+  * `summarize_opt_result` — OptimizationStatesTracker.toSummaryString
+    (OptimizationStatesTracker.scala:1-121): human-readable convergence
+    summary of an OptResult, including vmapped (per-entity) results.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from contextlib import ContextDecorator
+from typing import Callable, Dict, List, Optional, Type
+
+import numpy as np
+
+from photon_ml_tpu.optimize.common import ConvergenceReason, OptResult
+
+logger = logging.getLogger("photon_ml_tpu")
+
+
+# --------------------------------------------------------------------- Timed
+
+
+class TimingRegistry:
+    """Accumulates (section -> seconds) across a job for a final summary."""
+
+    def __init__(self) -> None:
+        self.sections: Dict[str, float] = {}
+        self.counts: Dict[str, int] = {}
+
+    def record(self, name: str, seconds: float) -> None:
+        self.sections[name] = self.sections.get(name, 0.0) + seconds
+        self.counts[name] = self.counts.get(name, 0) + 1
+
+    def summary(self) -> str:
+        if not self.sections:
+            return "(no timed sections)"
+        width = max(len(k) for k in self.sections)
+        lines = [
+            f"{k.ljust(width)}  {self.sections[k]:10.3f}s  x{self.counts[k]}"
+            for k in sorted(self.sections, key=self.sections.get, reverse=True)
+        ]
+        return "\n".join(lines)
+
+
+class Timed(ContextDecorator):
+    """`with Timed("read data"):` or `@Timed("fit")` — logs elapsed wall
+    clock on exit (Timed.scala usage throughout GameTrainingDriver:360-480).
+    """
+
+    def __init__(
+        self,
+        message: str,
+        *,
+        log: Optional[logging.Logger] = None,
+        registry: Optional[TimingRegistry] = None,
+        level: int = logging.INFO,
+    ):
+        self.message = message
+        self.log = log or logger
+        self.registry = registry
+        self.level = level
+        self.elapsed: Optional[float] = None
+
+    def __enter__(self) -> "Timed":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self.elapsed = time.perf_counter() - self._t0
+        status = "" if exc_type is None else f" (FAILED: {exc_type.__name__})"
+        self.log.log(self.level, "%s: %.3fs%s", self.message, self.elapsed, status)
+        if self.registry is not None:
+            self.registry.record(self.message, self.elapsed)
+        return False
+
+
+# -------------------------------------------------------------- PhotonLogger
+
+_LEVELS = {
+    "DEBUG": logging.DEBUG,
+    "INFO": logging.INFO,
+    "WARN": logging.WARNING,
+    "WARNING": logging.WARNING,
+    "ERROR": logging.ERROR,
+    "CRITICAL": logging.CRITICAL,
+    "FATAL": logging.CRITICAL,
+}
+
+
+def _resolve_level(level: str) -> int:
+    """Unknown levels fall back to INFO with a warning (the CLI tolerates
+    arbitrary --logging-level values; a typo must not abort a training job).
+    """
+    resolved = _LEVELS.get(level.upper())
+    if resolved is None:
+        logger.warning("unknown log level %r; falling back to INFO", level)
+        return logging.INFO
+    return resolved
+
+
+class PhotonLogger:
+    """Job-scoped file logger (PhotonLogger.scala:34-120): attaches a file
+    handler to the package logger for the job's lifetime; `close()` (or use
+    as a context manager) detaches, flushes, and restores the package logger
+    level."""
+
+    def __init__(self, log_path: str, level: str = "INFO"):
+        resolved = _resolve_level(level)  # before opening the file
+        self.log_path = log_path
+        self.handler = logging.FileHandler(log_path)
+        self.handler.setFormatter(
+            logging.Formatter("%(asctime)s %(levelname)s %(name)s - %(message)s")
+        )
+        self.handler.setLevel(resolved)
+        self._prev_logger_level = logger.level
+        logger.addHandler(self.handler)
+        if logger.level == logging.NOTSET or logger.level > resolved:
+            logger.setLevel(resolved)
+
+    def set_level(self, level: str) -> None:
+        self.handler.setLevel(_resolve_level(level))
+
+    def close(self) -> None:
+        logger.removeHandler(self.handler)
+        self.handler.close()
+        logger.setLevel(self._prev_logger_level)
+
+    def __enter__(self) -> "PhotonLogger":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
+
+
+# ----------------------------------------------------------------- EventBus
+
+
+@dataclasses.dataclass(frozen=True)
+class Event:
+    """Base lifecycle event (Event.scala:28)."""
+
+    timestamp: float = dataclasses.field(default_factory=time.time)
+
+
+@dataclasses.dataclass(frozen=True)
+class PhotonSetupEvent(Event):
+    args: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainingStartEvent(Event):
+    num_samples: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainingFinishEvent(Event):
+    num_configs: int = 0
+    best_metric: Optional[float] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class PhotonFailureEvent(Event):
+    error: str = ""
+
+
+class EventEmitter:
+    """Synchronous listener bus (EventEmitter.scala:24-58). Listeners
+    register per event type (or Event for all); send() dispatches in
+    registration order and never lets one listener's failure break the job.
+    """
+
+    def __init__(self) -> None:
+        self._listeners: List[tuple] = []
+
+    def register(
+        self, listener: Callable[[Event], None], event_type: Type[Event] = Event
+    ) -> None:
+        self._listeners.append((event_type, listener))
+
+    def send(self, event: Event) -> None:
+        for etype, listener in self._listeners:
+            if isinstance(event, etype):
+                try:
+                    listener(event)
+                except Exception:  # noqa: BLE001 — listener isolation
+                    logger.exception("event listener failed for %r", event)
+
+    def clear(self) -> None:
+        self._listeners.clear()
+
+
+# ------------------------------------------------- optimization summaries
+
+
+def summarize_opt_result(result: OptResult, name: str = "optimization") -> str:
+    """OptimizationStatesTracker.toSummaryString /
+    RandomEffectOptimizationTracker summaries (CoordinateDescent.scala:
+    230-251): convergence reasons, iteration stats, final loss stats. Works
+    for a single solve (scalar fields) and vmapped solves (leading axes)."""
+    its = np.atleast_1d(np.asarray(result.iterations))
+    loss = np.atleast_1d(np.asarray(result.loss))
+    gnorm = np.atleast_1d(np.asarray(result.gradient_norm))
+    reasons = np.atleast_1d(np.asarray(result.reason))
+    n = its.size
+    counts = {
+        ConvergenceReason(code).name: int((reasons == code).sum())
+        for code in np.unique(reasons)
+    }
+    lines = [
+        f"{name}: {n} problem(s)",
+        f"  convergence: {counts}",
+        f"  iterations:  mean {its.mean():.1f}  max {int(its.max())}",
+        f"  final loss:  mean {loss.mean():.6g}  max {loss.max():.6g}",
+        f"  |gradient|:  mean {gnorm.mean():.3g}  max {gnorm.max():.3g}",
+    ]
+    hist = np.asarray(result.loss_history)
+    if hist.size:
+        first = hist.reshape(-1, hist.shape[-1])[0]
+        valid = first[np.isfinite(first)]
+        if valid.size > 1:
+            lines.append(
+                f"  loss path:   {valid[0]:.6g} -> {valid[-1]:.6g} "
+                f"({valid.size} tracked iterations)"
+            )
+    return "\n".join(lines)

@@ -158,6 +158,10 @@ class TestDriversEndToEnd:
         summary = json.load(open(os.path.join(out, "training-summary.json")))
         assert summary["num_explicit"] == 2
         assert summary["best_evaluation"]["AUC"] > 0.6
+        # Job log file (PhotonLogger) written under the output root.
+        job_log = open(os.path.join(out, "photon-ml-tpu.log")).read()
+        assert "training 2 explicit configuration(s)" in job_log
+        assert "read data" in job_log  # Timed sections
 
         # Score with the trained model.
         score_out = str(tmp_path / "scores")

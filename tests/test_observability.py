@@ -1,0 +1,96 @@
+"""Observability tests (Timed / PhotonLogger / EventEmitter / summaries)."""
+
+import logging
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.optimize.common import ConvergenceReason, OptResult
+from photon_ml_tpu.utils.observability import (
+    Event,
+    EventEmitter,
+    PhotonFailureEvent,
+    PhotonLogger,
+    Timed,
+    TimingRegistry,
+    TrainingStartEvent,
+    summarize_opt_result,
+)
+
+
+class TestTimed:
+    def test_context_and_registry(self, caplog):
+        reg = TimingRegistry()
+        with caplog.at_level(logging.INFO, logger="photon_ml_tpu"):
+            with Timed("sectionA", registry=reg) as t:
+                pass
+            with Timed("sectionA", registry=reg):
+                pass
+        assert t.elapsed is not None and t.elapsed >= 0
+        assert reg.counts["sectionA"] == 2
+        assert "sectionA" in caplog.text
+        assert "sectionA" in reg.summary()
+
+    def test_decorator_and_failure_logged(self, caplog):
+        @Timed("work")
+        def boom():
+            raise RuntimeError("x")
+
+        with caplog.at_level(logging.INFO, logger="photon_ml_tpu"):
+            with pytest.raises(RuntimeError):
+                boom()
+        assert "FAILED" in caplog.text
+
+
+class TestPhotonLogger:
+    def test_writes_file_at_level(self, tmp_path):
+        path = str(tmp_path / "job.log")
+        prev = logging.getLogger("photon_ml_tpu").level
+        with PhotonLogger(path, level="INFO"):
+            logging.getLogger("photon_ml_tpu.test").info("hello-info")
+            logging.getLogger("photon_ml_tpu.test").debug("hello-debug")
+        text = open(path).read()
+        assert "hello-info" in text
+        assert "hello-debug" not in text
+        # Package logger level restored after close.
+        assert logging.getLogger("photon_ml_tpu").level == prev
+        # Unknown levels fall back to INFO instead of aborting the job.
+        with PhotonLogger(str(tmp_path / "x.log"), level="NOPE"):
+            logging.getLogger("photon_ml_tpu.test").info("still-works")
+        assert "still-works" in open(str(tmp_path / "x.log")).read()
+
+
+class TestEventEmitter:
+    def test_dispatch_by_type_and_isolation(self):
+        bus = EventEmitter()
+        seen = []
+        bus.register(lambda e: seen.append(("all", type(e).__name__)))
+        bus.register(lambda e: seen.append(("train", e.num_samples)), TrainingStartEvent)
+        bus.register(lambda e: 1 / 0, PhotonFailureEvent)  # must not break send
+        bus.send(TrainingStartEvent(num_samples=7))
+        bus.send(PhotonFailureEvent(error="e"))
+        assert ("train", 7) in seen
+        assert ("all", "TrainingStartEvent") in seen
+        assert ("all", "PhotonFailureEvent") in seen
+
+
+class TestSummaries:
+    def test_vmapped_summary(self):
+        result = OptResult(
+            coefficients=jnp.zeros((3, 2)),
+            loss=jnp.asarray([0.5, 0.2, 0.9]),
+            gradient_norm=jnp.asarray([1e-8, 1e-3, 1e-9]),
+            iterations=jnp.asarray([4, 100, 7]),
+            reason=jnp.asarray([
+                int(ConvergenceReason.GRADIENT_CONVERGED),
+                int(ConvergenceReason.MAX_ITERATIONS),
+                int(ConvergenceReason.GRADIENT_CONVERGED),
+            ]),
+            loss_history=jnp.zeros((3, 0)),
+        )
+        s = summarize_opt_result(result, "re-bucket")
+        assert "3 problem(s)" in s
+        assert "GRADIENT_CONVERGED" in s and "MAX_ITERATIONS" in s
+        assert "max 100" in s
