@@ -1,0 +1,155 @@
+"""Feature-indexing driver: build the persistent partitioned index store.
+
+Counterpart of photon-client index/FeatureIndexingDriver.scala:41-320 (see
+SURVEY.md §3.5): read training records, take the distinct feature keys per
+feature shard (union of the shard's feature bags, plus the intercept key when
+the shard has one), route each key to a hash partition, and build one
+memory-mapped store partition per hash bucket — `index-partition-<shard>-<k>
+.bin`, the PHIDX equivalent of the reference's `paldb-partition-<shard>-<n>
+.dat`. Where the reference shuffles the keys with a Spark HashPartitioner
+and writes PalDB stores per Spark partition, this is a host-side ETL pass:
+ingest is sequential Avro/LibSVM decode, the store build is the native C++
+writer (photon_ml_tpu/native/index_store.cc).
+
+Also accepts pre-extracted name-and-term text files (the
+NameAndTermFeatureBagsDriver output, cli/name_and_term.py) as input, the
+same coupling the reference has between its two indexing drivers.
+
+Usage:
+    python -m photon_ml_tpu.cli.build_index \
+        --input-data-directories data/train \
+        --feature-shard-configurations "name=globalShard,feature.bags=features" \
+        --num-partitions 4 --output-dir out/index
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+from typing import Dict, Iterable, List, Set
+
+from photon_ml_tpu.cli.config import parse_feature_shard_config
+from photon_ml_tpu.cli.name_and_term import read_name_and_term_file
+from photon_ml_tpu.data.index_map import INTERCEPT_KEY, feature_key
+from photon_ml_tpu.io import avro as avro_io
+from photon_ml_tpu.io.avro_data import FeatureShardConfig
+from photon_ml_tpu.native.index_store import build_partitioned_store
+
+logger = logging.getLogger("photon_ml_tpu.cli.build_index")
+
+METADATA_FILE = "_index_metadata.json"
+
+
+def collect_shard_keys(
+    records: Iterable[dict], shard_configs: Dict[str, FeatureShardConfig]
+) -> Dict[str, Set[str]]:
+    """Distinct feature keys per shard (FeatureIndexingDriver
+    partitionedUniqueFeatures:217-251, intercept injected like :243)."""
+    keys: Dict[str, Set[str]] = {name: set() for name in shard_configs}
+    for record in records:
+        for shard_name, cfg in shard_configs.items():
+            bucket = keys[shard_name]
+            for bag in cfg.feature_bags:
+                for f in record.get(bag) or ():
+                    bucket.add(feature_key(f["name"], f.get("term", "") or ""))
+    for shard_name, cfg in shard_configs.items():
+        if cfg.has_intercept:
+            keys[shard_name].add(INTERCEPT_KEY)
+    return keys
+
+
+def build_index_stores(
+    shard_keys: Dict[str, Set[str]],
+    output_dir: str,
+    num_partitions: int,
+) -> Dict[str, int]:
+    """Build one partitioned store per shard namespace + metadata JSON."""
+    os.makedirs(output_dir, exist_ok=True)
+    counts: Dict[str, int] = {}
+    for shard_name, keys in shard_keys.items():
+        counts[shard_name] = build_partitioned_store(
+            output_dir, sorted(keys), num_partitions, namespace=shard_name
+        )
+        logger.info(
+            "indexed %d features for shard %s (%d partitions)",
+            counts[shard_name],
+            shard_name,
+            num_partitions,
+        )
+    with open(os.path.join(output_dir, METADATA_FILE), "w") as f:
+        json.dump(
+            {
+                "num_partitions": num_partitions,
+                "shards": {name: {"num_features": n} for name, n in counts.items()},
+            },
+            f,
+            indent=2,
+        )
+    return counts
+
+
+def main(argv: List[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="photon-ml-tpu-build-index",
+        description="Build partitioned persistent feature-index stores "
+        "(FeatureIndexingDriver equivalent).",
+    )
+    parser.add_argument(
+        "--input-data-directories",
+        nargs="+",
+        default=[],
+        help="Avro training-data files or directories.",
+    )
+    parser.add_argument(
+        "--name-and-term-directory",
+        default=None,
+        help="Directory of per-bag name-and-term text files "
+        "(NameAndTermFeatureBagsDriver output) to index instead of raw data.",
+    )
+    parser.add_argument(
+        "--feature-shard-configurations",
+        nargs="+",
+        required=True,
+        help="Shard mini-DSL, e.g. 'name=globalShard,feature.bags=f1|f2'.",
+    )
+    parser.add_argument("--num-partitions", type=int, default=1)
+    parser.add_argument("--output-dir", required=True)
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO, format="%(levelname)s %(name)s: %(message)s")
+    shard_configs = dict(
+        parse_feature_shard_config(s) for s in args.feature_shard_configurations
+    )
+
+    if args.name_and_term_directory:
+        shard_keys: Dict[str, Set[str]] = {}
+        for shard_name, cfg in shard_configs.items():
+            bucket: Set[str] = set()
+            for bag in cfg.feature_bags:
+                path = os.path.join(args.name_and_term_directory, bag)
+                for name, term in read_name_and_term_file(path):
+                    bucket.add(feature_key(name, term))
+            if cfg.has_intercept:
+                bucket.add(INTERCEPT_KEY)
+            shard_keys[shard_name] = bucket
+    else:
+        if not args.input_data_directories:
+            parser.error(
+                "either --input-data-directories or --name-and-term-directory "
+                "is required"
+            )
+        records: List[dict] = []
+        for path in args.input_data_directories:
+            _, recs = avro_io.read_directory(path)
+            records.extend(recs)
+        shard_keys = collect_shard_keys(records, shard_configs)
+
+    build_index_stores(shard_keys, args.output_dir, args.num_partitions)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

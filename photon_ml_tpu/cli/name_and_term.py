@@ -1,0 +1,108 @@
+"""Name-and-term feature-bag extraction driver.
+
+Counterpart of photon-client data/avro/NameAndTermFeatureBagsDriver.scala:32
+with NameAndTerm.scala:25 / NameAndTermFeatureMapUtils.scala:26: scan the
+input Avro records and write the distinct (name, term) pairs of each feature
+bag as one merged text file `<output>/<bagName>` with tab-delimited lines
+(NameAndTerm.STRING_DELIMITER = "\\t", NameAndTerm.scala:39,63). These files
+feed the feature-indexing driver (cli/build_index.py) so index builds don't
+re-scan the raw data.
+
+Usage:
+    python -m photon_ml_tpu.cli.name_and_term \
+        --input-data-directories data/train \
+        --feature-bags-keys features songFeatures \
+        --output-dir out/name-and-term
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+from typing import Dict, Iterable, List, Set, Tuple
+
+from photon_ml_tpu.io import avro as avro_io
+
+logger = logging.getLogger("photon_ml_tpu.cli.name_and_term")
+
+STRING_DELIMITER = "\t"
+
+
+def extract_name_and_terms(
+    records: Iterable[dict], feature_bags: List[str]
+) -> Dict[str, Set[Tuple[str, str]]]:
+    """Distinct (name, term) per bag (NameAndTermFeatureMapUtils
+    readNameAndTermFeatureMapFromRawRecords role)."""
+    out: Dict[str, Set[Tuple[str, str]]] = {bag: set() for bag in feature_bags}
+    for record in records:
+        for bag in feature_bags:
+            for f in record.get(bag) or ():
+                out[bag].add((f["name"], f.get("term", "") or ""))
+    return out
+
+
+def write_name_and_term_file(path: str, pairs: Set[Tuple[str, str]]) -> None:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w") as f:
+        for name, term in sorted(pairs):
+            if "\t" in name or "\n" in name or "\t" in term or "\n" in term:
+                # The text format cannot represent delimiter characters; a
+                # silent write would corrupt the roundtrip and the index.
+                raise ValueError(
+                    f"feature (name, term) ({name!r}, {term!r}) contains "
+                    "tab/newline, unrepresentable in name-and-term text format"
+                )
+            f.write(f"{name}{STRING_DELIMITER}{term}\n")
+
+
+def read_name_and_term_file(path: str) -> List[Tuple[str, str]]:
+    """Parse the text format back (readNameAndTermRDDFromTextFiles:136-146:
+    1 field = name with empty term, 2 fields = name and term)."""
+    pairs: List[Tuple[str, str]] = []
+    with open(path) as f:
+        for line in f:
+            line = line.rstrip("\n")
+            if not line:
+                continue
+            parts = line.split(STRING_DELIMITER)
+            if len(parts) == 1:
+                pairs.append((parts[0], ""))
+            else:
+                pairs.append((parts[0], parts[1]))
+    return pairs
+
+
+def main(argv: List[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="photon-ml-tpu-name-and-term",
+        description="Extract distinct (name, term) feature sets per bag "
+        "(NameAndTermFeatureBagsDriver equivalent).",
+    )
+    parser.add_argument("--input-data-directories", nargs="+", required=True)
+    parser.add_argument(
+        "--feature-bags-keys",
+        nargs="+",
+        required=True,
+        help="Feature bag field names to extract.",
+    )
+    parser.add_argument("--output-dir", required=True)
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO, format="%(levelname)s %(name)s: %(message)s")
+    records: List[dict] = []
+    for path in args.input_data_directories:
+        _, recs = avro_io.read_directory(path)
+        records.extend(recs)
+
+    bags = extract_name_and_terms(records, list(args.feature_bags_keys))
+    for bag, pairs in bags.items():
+        out_path = os.path.join(args.output_dir, bag)
+        write_name_and_term_file(out_path, pairs)
+        logger.info("wrote %d distinct (name, term) pairs for bag %s", len(pairs), bag)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -107,6 +107,10 @@ def build_parser() -> argparse.ArgumentParser:
                    default=NormalizationType.NONE)
     p.add_argument("--validation-evaluators", nargs="*", default=[],
                    help="e.g. AUC RMSE PRECISION@5:queryId AUC:documentId")
+    p.add_argument("--offheap-indexmap-dir", default=None,
+                   help="directory of prebuilt persistent feature-index "
+                        "partitions (cli.build_index output; the reference's "
+                        "off-heap PalDB index dir, GameDriver.scala:231-236)")
     p.add_argument("--model-input-directory", default=None,
                    help="warm-start / partial-retrain model directory")
     p.add_argument("--partial-retrain-locked-coordinates", default=None,
@@ -142,10 +146,24 @@ def _read_data(args, coordinate_configs: Dict[str, CoordinateConfiguration]):
         if et.is_grouped and et.id_tag not in id_tags:
             id_tags.append(et.id_tag)
 
+    # prepareFeatureMaps (GameDriver.scala:231-236): prebuilt off-heap index
+    # partitions when given, else index maps derived from the data itself.
+    prebuilt = None
+    if getattr(args, "offheap_indexmap_dir", None):
+        from photon_ml_tpu.native.index_store import PartitionedIndexStore
+
+        prebuilt = {
+            shard: PartitionedIndexStore(args.offheap_indexmap_dir, shard)
+            for shard in shard_configs
+        }
+
     if len(args.input_data_directories) > 1:
         raise NotImplementedError("multiple input directories: concatenate upstream")
     train, index_maps = avro_data.read_game_dataset(
-        args.input_data_directories[0], shard_configs, id_tag_fields=id_tags
+        args.input_data_directories[0],
+        shard_configs,
+        index_maps=prebuilt,
+        id_tag_fields=id_tags,
     )
 
     validation = None

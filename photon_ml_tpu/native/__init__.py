@@ -1,0 +1,16 @@
+"""Native (C++) runtime components.
+
+The compute path of the framework is JAX/XLA; the runtime pieces around it
+that the reference implements on the JVM get native equivalents here, built
+on demand with the system toolchain and loaded through ctypes:
+
+  * index_store — memory-mapped persistent feature-index store, the
+    counterpart of the reference's PalDB off-heap index map
+    (photon-api index/PalDBIndexMap.scala:43).
+
+Every component ships a pure-Python fallback reading/writing the identical
+on-disk format, so the framework works without a compiler (and the two
+implementations cross-check each other in tests).
+"""
+
+from photon_ml_tpu.native.build import native_library_path  # noqa: F401

@@ -1,0 +1,65 @@
+"""On-demand compilation of the native library.
+
+Builds `libphoton_native.so` from the C++ sources in this directory with the
+system `g++` the first time it is needed and caches the result next to the
+sources (keyed by a content hash, so edits trigger a rebuild). Returns None
+when no compiler is available — callers fall back to the pure-Python
+implementations of the same on-disk formats.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SOURCES = ["index_store.cc"]
+_LOCK = threading.Lock()
+_CACHED: Optional[str] = None
+_ATTEMPTED = False
+
+
+def _source_hash() -> str:
+    h = hashlib.sha256()
+    for name in _SOURCES:
+        with open(os.path.join(_DIR, name), "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()[:16]
+
+
+def native_library_path() -> Optional[str]:
+    """Path to the compiled shared library, or None if unbuildable."""
+    global _CACHED, _ATTEMPTED
+    with _LOCK:
+        if _ATTEMPTED:
+            return _CACHED
+        _ATTEMPTED = True
+        build_dir = os.path.join(_DIR, "_build")
+        so_path = os.path.join(build_dir, f"libphoton_native-{_source_hash()}.so")
+        if os.path.exists(so_path):
+            _CACHED = so_path
+            return _CACHED
+        try:
+            os.makedirs(build_dir, exist_ok=True)
+            tmp = f"{so_path}.tmp.{os.getpid()}"  # per-process: concurrent
+            # first-time builds must not interleave into one tmp file
+            cmd = [
+                "g++",
+                "-O2",
+                "-std=c++17",
+                "-shared",
+                "-fPIC",
+                "-o",
+                tmp,
+            ] + [os.path.join(_DIR, s) for s in _SOURCES]
+            subprocess.run(
+                cmd, check=True, capture_output=True, timeout=120
+            )
+            os.replace(tmp, so_path)
+            _CACHED = so_path
+        except (OSError, subprocess.SubprocessError):
+            _CACHED = None
+        return _CACHED
