@@ -85,6 +85,17 @@ class FixedEffectCoordinate:
         self.task = task
         self.loss: PointwiseLoss = loss_for_task(task)
         self.norm = norm
+        # Decide the fused-Pallas objective path ONCE here, on the concrete
+        # array — its dtype/shape/sharding are all visible, unlike inside the
+        # jit trace where should_use would have to guess. The decision is
+        # closed over by the jitted train_fn (ragged tails are masked inside
+        # the kernel, so no alignment precondition).
+        from photon_ml_tpu.ops import pallas_glm
+
+        feats = dataset.shards[config_data_shard]
+        self._use_pallas = not isinstance(feats, SparseFeatures) and pallas_glm.should_use(
+            feats, jnp.zeros((feats.shape[-1],), feats.dtype)
+        )
         self._build_jits()
 
     def _build_jits(self) -> None:
@@ -93,6 +104,7 @@ class FixedEffectCoordinate:
         norm = self.norm
         task = self.task
         use_sampling = cfg.down_sampling_rate < 1.0
+        use_pallas = self._use_pallas
 
         @jax.jit
         def train_fn(features, labels, offsets, weights, w0, reg_weight, key):
@@ -106,7 +118,12 @@ class FixedEffectCoordinate:
                 )
             data = LabeledData(features, labels, offsets, weights)
             res = problem.solve(
-                loss, data, _config_with_traced_weight(cfg, reg_weight), w0, norm
+                loss,
+                data,
+                _config_with_traced_weight(cfg, reg_weight),
+                w0,
+                norm,
+                use_pallas=use_pallas,
             )
             return res
 
@@ -192,9 +209,17 @@ class RandomEffectCoordinate:
 
         @jax.jit
         def train_bucket(block_data: LabeledData, w0_block, reg_weight):
+            # use_pallas=False: the per-entity solves are vmapped; the fused
+            # kernels are single-problem programs and the vmapped XLA path is
+            # the one that batches these small solves efficiently.
             def one(data_e, w0_e):
                 return problem.solve(
-                    loss, data_e, _config_with_traced_weight(cfg, reg_weight), w0_e, norm
+                    loss,
+                    data_e,
+                    _config_with_traced_weight(cfg, reg_weight),
+                    w0_e,
+                    norm,
+                    use_pallas=False,
                 )
 
             return jax.vmap(one)(block_data, w0_block)

@@ -37,6 +37,7 @@ import jax
 import jax.numpy as jnp
 
 from photon_ml_tpu.data.containers import LabeledData, SparseFeatures
+from photon_ml_tpu.ops import pallas_glm
 from photon_ml_tpu.ops.losses import PointwiseLoss
 from photon_ml_tpu.ops.normalization import NormalizationContext
 
@@ -88,20 +89,41 @@ def value_and_gradient(
     data: LabeledData,
     norm: Optional[NormalizationContext] = None,
     l2: float | Array = 0.0,
+    use_pallas: Optional[bool] = None,
 ) -> Tuple[Array, Array]:
     """One fused pass: margins computed once, shared by value and gradient.
 
     Replaces ValueAndGradientAggregator.calculateValueAndGradient + its
     treeAggregate (lines 137-161, 240-255 of the reference file).
+
+    On TPU, large dense problems take the fused Pallas path
+    (ops/pallas_glm.py) that streams X from HBM once for both matmuls; the
+    sparse path and small (vmapped per-entity) problems stay on XLA.
+
+    `use_pallas` forces the decision: callers that know their data placement
+    (the fixed-effect coordinate decides once at construction on the
+    concrete array) pass True/False so the trace-time heuristic — which
+    cannot see sharding or vmap context — is bypassed. None = auto.
     """
     w_eff, shift = _eff(w, norm)
-    z = _matvec(data.features, w_eff) + shift + data.offsets
-    val = jnp.sum(data.weights * loss.loss(z, data.labels))
-    u = data.weights * loss.d1(z, data.labels)
-    g = _rmatvec(data.features, u)
+    if use_pallas is None:
+        use_pallas = pallas_glm.should_use(data.features, w_eff)
+    if use_pallas:
+        val, g, sum_u = pallas_glm.value_gradient_sums(
+            loss, w_eff, shift, data.features, data.labels, data.offsets,
+            data.weights, interpret=pallas_glm.FORCE_INTERPRET,
+        )
+    else:
+        z = _matvec(data.features, w_eff) + shift + data.offsets
+        val = jnp.sum(data.weights * loss.loss(z, data.labels))
+        u = data.weights * loss.d1(z, data.labels)
+        g = _rmatvec(data.features, u)
+        sum_u = None
     if norm is not None and not norm.is_identity:
         if norm.shifts is not None:
-            g = g - jnp.sum(u) * norm.shifts
+            if sum_u is None:
+                sum_u = jnp.sum(u)
+            g = g - sum_u * norm.shifts
         if norm.factors is not None:
             g = g * norm.factors
     return val + 0.5 * l2 * jnp.dot(w, w), g + l2 * w
@@ -124,22 +146,38 @@ def hessian_vector(
     data: LabeledData,
     norm: Optional[NormalizationContext] = None,
     l2: float | Array = 0.0,
+    use_pallas: Optional[bool] = None,
 ) -> Array:
     """Gauss-Newton/Hessian product H(w) v (HessianVectorAggregator.scala:23-142).
 
     Exact for the GLM losses here (their Hessian is X^T diag(weight*l'') X in
     the normalized space).
+
+    On TPU, large dense problems take the fused Pallas path: [w|v] is packed
+    into one [D, 2] right-hand side so both forward matvecs and the backward
+    contraction run in a single pass over X (ops/pallas_glm.py).
     """
     w_eff, shift = _eff(w, norm)
-    z = _matvec(data.features, w_eff) + shift + data.offsets
-    d2 = loss.d2(z, data.labels)
     v_eff, v_shift = _eff(v, norm)
-    q = _matvec(data.features, v_eff) + v_shift
-    r = data.weights * d2 * q
-    hv = _rmatvec(data.features, r)
+    if use_pallas is None:
+        use_pallas = pallas_glm.should_use(data.features, w_eff)
+    if use_pallas:
+        hv, sum_r = pallas_glm.hessian_vector_sums(
+            loss, w_eff, shift, v_eff, v_shift, data.features, data.labels,
+            data.offsets, data.weights, interpret=pallas_glm.FORCE_INTERPRET,
+        )
+    else:
+        z = _matvec(data.features, w_eff) + shift + data.offsets
+        d2 = loss.d2(z, data.labels)
+        q = _matvec(data.features, v_eff) + v_shift
+        r = data.weights * d2 * q
+        hv = _rmatvec(data.features, r)
+        sum_r = None
     if norm is not None and not norm.is_identity:
         if norm.shifts is not None:
-            hv = hv - jnp.sum(r) * norm.shifts
+            if sum_r is None:
+                sum_r = jnp.sum(r)
+            hv = hv - sum_r * norm.shifts
         if norm.factors is not None:
             hv = hv * norm.factors
     return hv + l2 * v

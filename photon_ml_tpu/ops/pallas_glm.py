@@ -1,0 +1,362 @@
+"""Pallas TPU kernels for the GLM hot loop: fused value+gradient and
+fused Hessian-vector product.
+
+Why these exist: the single hottest op in the framework is the fixed-effect
+objective evaluation — the TPU-native descendant of the reference's
+ValueAndGradientAggregator hot loop (photon-lib
+function/glm/ValueAndGradientAggregator.scala:137-161, reduced via
+RDD.treeAggregate at :248-252). Expressed as plain XLA
+(`ops.objective.value_and_gradient`), that op streams the design matrix X
+from HBM **twice** per evaluation — once for the forward matvec `z = X @ w`
+and once for the gradient `g = X^T u` — because XLA will not fuse two
+matmuls that share an operand into one pass. At 1M x 512 f32 that is ~4 GB
+of HBM traffic per L-BFGS iteration where ~2 GB suffices; the op is
+bandwidth-bound, so halving traffic ~doubles throughput.
+
+The kernels here stream each row-tile of X from HBM into VMEM **once** and
+run both MXU contractions on it while it is resident:
+
+    per row-tile T:
+        z_T   = X_T @ w                (MXU, [TILE_N, D] @ [D, 1])
+        u_T   = weight_T * l'(z_T, y_T)   (VPU)
+        val  += sum(weight_T * l(z_T, y_T))
+        g    += X_T^T @ u_T            (MXU, contraction over rows)
+
+The Hessian-vector kernel additionally packs [w | v] into a single
+[D, 2] right-hand side so the two forward matvecs TRON needs (margins and
+`q = X @ v`) cost one MXU pass:
+
+    zq_T  = X_T @ [w | v]              (MXU, [TILE_N, D] @ [D, 2])
+    r_T   = weight_T * l''(z_T, y_T) * q_T
+    hv   += X_T^T @ r_T
+
+Both kernels return *raw sums* (including `sum(u)` / `sum(r)`), so the
+normalization-as-coefficient-algebra trick (ops/normalization.py, mirroring
+ValueAndGradientAggregator.scala:36-80) stays entirely outside the kernel:
+callers pass the already-effective coefficient vector and fold shift/factor
+corrections into the returned sums. Grid steps on TPU execute sequentially
+per core, so accumulating into an output block whose index_map is constant
+is the standard safe reduction pattern.
+
+Dispatch policy (`should_use`): the kernels engage only for problems where
+the fusion pays — dense f32 X, N >= _MIN_ROWS, D >= _MIN_COLS, and a row
+tile that fits the VMEM budget. The vmapped random-effect entity solves
+(small N, small D per entity) and the sparse path fall through to XLA
+automatically; no flags thread through the optimizer stack. On non-TPU
+backends the kernels run only in interpret mode (tests); the XLA path is
+used otherwise.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # TPU memory spaces; absent on some CPU-only installs.
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+    _SMEM = pltpu.SMEM
+except Exception:  # pragma: no cover - exercised only without pallas-tpu
+    pltpu = None
+    _VMEM = None
+    _SMEM = None
+
+from photon_ml_tpu.ops.losses import PointwiseLoss
+
+Array = jax.Array
+
+# Row-tile height. 512 rows x 512 features x 4 B = 1 MB per X tile; with
+# double buffering and the [D, 1]/[D, 2] operands this stays well inside the
+# ~16 MB/core VMEM envelope up to D ~ 4096.
+_TILE_N = 512
+# VMEM budget for one X tile (bytes). Above this, fall back to XLA rather
+# than blocking the feature dimension (a D-blocked variant would need a
+# second pass for margins; XLA is already fine for very wide problems).
+_TILE_BYTES_LIMIT = 8 * 1024 * 1024
+_MIN_ROWS = 4 * _TILE_N
+_MIN_COLS = 128
+
+_DISABLE_ENV = "PHOTON_DISABLE_PALLAS"
+
+# Kill switch. Initialized from PHOTON_DISABLE_PALLAS at import; flip at
+# runtime with `set_enabled`. NOTE: `should_use` runs at *trace* time, so a
+# change only affects jit programs traced afterwards — already-compiled
+# coordinates keep their baked-in path. Set the env var before building
+# coordinates (or call set_enabled first) to be sure.
+_ENABLED = not bool(os.environ.get(_DISABLE_ENV, ""))
+
+# Test hook: when True, `should_use` accepts non-TPU backends and the
+# objective-layer dispatch passes interpret=True, so CPU CI exercises the
+# real kernel bodies (the conftest mesh stands in for multi-chip the same
+# way). Never set in production paths.
+FORCE_INTERPRET = False
+
+
+def set_enabled(on: bool) -> None:
+    """Enable/disable the fused kernels for jit programs traced after this
+    call (existing compiled programs are unaffected — see module note)."""
+    global _ENABLED
+    _ENABLED = bool(on)
+
+
+def is_enabled() -> bool:
+    return _ENABLED
+
+
+def _interpret_default() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def should_use(features, w: Array) -> bool:
+    """True when the fused kernels should replace the XLA objective path.
+
+    Beyond size/dtype gating, the kernels are single-device programs: under
+    GSPMD a pallas_call is an opaque custom call, so a sharded X would be
+    all-gathered onto every device — the opposite of the intended win.
+    Concrete arrays are accepted only when resident on one device; inside a
+    jit trace (tracers carry no committed sharding) the path is taken only
+    when a single device is visible, so single-chip runs fuse and multi-chip
+    meshes keep the XLA objective whose collectives GSPMD lays out properly.
+    Multi-chip fusion would mean invoking the kernel per-shard under
+    shard_map with a psum of the raw sums — future work.
+    """
+    if not _ENABLED:
+        return False
+    if _interpret_default() and not FORCE_INTERPRET:
+        # Interpret mode is for tests; never auto-engage it in production
+        # CPU runs (it is slower than XLA).
+        return False
+    if not isinstance(features, jax.Array) and not hasattr(features, "shape"):
+        return False
+    if getattr(features, "ndim", 0) != 2 or w.ndim != 1:
+        return False
+    n, d = features.shape
+    if n < _MIN_ROWS or d < _MIN_COLS:
+        return False
+    if features.dtype not in (jnp.float32, jnp.bfloat16):
+        return False
+    if _TILE_N * d * features.dtype.itemsize > _TILE_BYTES_LIMIT:
+        return False
+    try:
+        n_devices = len(features.sharding.device_set)
+    except Exception:
+        n_devices = None  # tracer or abstract sharding: unknown placement
+    if n_devices is not None:
+        if n_devices > 1:
+            return False
+    elif jax.device_count() > 1:
+        # Sharding unknown inside a trace; be conservative on multi-device
+        # hosts — the XLA path is the one GSPMD partitions correctly.
+        return False
+    return True
+
+
+def _row_mask(n: int) -> Array:
+    """(TILE_N, 1) validity mask for the current grid step's rows.
+
+    Array sizes need not divide the block shape: Pallas pads boundary-block
+    reads with undefined values, so every input is masked to exact zeros
+    before use (a zero row contributes exactly zero to each accumulated sum —
+    and masking x/y/offset as well as weight keeps NaN/Inf garbage from the
+    padded lanes out of 0*NaN traps in the losses).
+    """
+    base = pl.program_id(0) * _TILE_N
+    rows = base + jax.lax.broadcasted_iota(jnp.int32, (_TILE_N, 1), 0)
+    return rows < n
+
+
+def _value_grad_kernel(loss: PointwiseLoss, n: int, x_ref, y_ref, off_ref,
+                       wt_ref, w_ref, stats_ref, grad_ref):
+    i = pl.program_id(0)
+    valid = _row_mask(n)
+    x = jnp.where(valid, x_ref[:], 0.0)
+    z = jax.lax.dot_general(
+        x, w_ref[:], dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) + jnp.where(valid, off_ref[:], 0.0)
+    y = jnp.where(valid, y_ref[:], 0.0)
+    wt = jnp.where(valid, wt_ref[:], 0.0)
+    val = jnp.sum(wt * loss.loss(z, y))
+    u = wt * loss.d1(z, y)
+    g = jax.lax.dot_general(
+        x, u, dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    sum_u = jnp.sum(u)
+
+    @pl.when(i == 0)
+    def _():
+        stats_ref[0, 0] = val
+        stats_ref[0, 1] = sum_u
+        grad_ref[:] = g
+
+    @pl.when(i > 0)
+    def _():
+        stats_ref[0, 0] += val
+        stats_ref[0, 1] += sum_u
+        grad_ref[:] += g
+
+
+def _hvp_kernel(loss: PointwiseLoss, n: int, x_ref, y_ref, off_ref, wt_ref,
+                wv_ref, vshift_ref, stats_ref, hv_ref):
+    i = pl.program_id(0)
+    valid = _row_mask(n)
+    x = jnp.where(valid, x_ref[:], 0.0)
+    zq = jax.lax.dot_general(
+        x, wv_ref[:], dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    z = zq[:, 0:1] + jnp.where(valid, off_ref[:], 0.0)
+    q = zq[:, 1:2] + vshift_ref[0, 0]
+    r = jnp.where(valid, wt_ref[:], 0.0) * loss.d2(z, jnp.where(valid, y_ref[:], 0.0)) * q
+    hv = jax.lax.dot_general(
+        x, r, dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    sum_r = jnp.sum(r)
+
+    @pl.when(i == 0)
+    def _():
+        stats_ref[0, 0] = sum_r
+        hv_ref[:] = hv
+
+    @pl.when(i > 0)
+    def _():
+        stats_ref[0, 0] += sum_r
+        hv_ref[:] += hv
+
+
+@functools.partial(jax.jit, static_argnames=("loss", "interpret"))
+def value_gradient_sums(
+    loss: PointwiseLoss,
+    w_eff: Array,
+    shift: Array,
+    features: Array,
+    labels: Array,
+    offsets: Array,
+    weights: Array,
+    *,
+    interpret: bool = False,
+) -> Tuple[Array, Array, Array]:
+    """Raw fused sums for the weighted GLM objective.
+
+    Returns (value, grad_raw, sum_u) with
+        value    = sum_i weight_i * l(z_i, y_i),   z = X @ w_eff + shift + offset
+        grad_raw = X^T u,   u = weight * l'(z, y)
+        sum_u    = sum_i u_i
+    Normalization corrections (g = factor * (grad_raw - sum_u * shifts)) and
+    L2 terms are the caller's job (ops/objective.py), exactly as the raw
+    aggregator sums are post-processed in the reference.
+    """
+    n, d = features.shape
+    # Fold the scalar margin shift into offsets so the kernel sees one vector.
+    offsets = offsets + shift
+    grid = (pl.cdiv(n, _TILE_N),)
+
+    col = lambda a: a.reshape(n, 1).astype(jnp.float32)
+    kernel = functools.partial(_value_grad_kernel, loss, n)
+    row_spec = pl.BlockSpec((_TILE_N, 1), lambda i: (i, 0), memory_space=_VMEM)
+    stats, grad = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((_TILE_N, d), lambda i: (i, 0), memory_space=_VMEM),
+            row_spec,
+            row_spec,
+            row_spec,
+            pl.BlockSpec((d, 1), lambda i: (0, 0), memory_space=_VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 2), lambda i: (0, 0), memory_space=_SMEM),
+            pl.BlockSpec((d, 1), lambda i: (0, 0), memory_space=_VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, 2), jnp.float32),
+            jax.ShapeDtypeStruct((d, 1), jnp.float32),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=4 * n * d,
+            bytes_accessed=n * d * features.dtype.itemsize,
+            transcendentals=2 * n,
+        ),
+        interpret=interpret,
+    )(
+        features,
+        col(labels),
+        col(offsets),
+        col(weights),
+        w_eff.reshape(d, 1).astype(jnp.float32),
+    )
+    return stats[0, 0], grad[:, 0], stats[0, 1]
+
+
+@functools.partial(jax.jit, static_argnames=("loss", "interpret"))
+def hessian_vector_sums(
+    loss: PointwiseLoss,
+    w_eff: Array,
+    shift: Array,
+    v_eff: Array,
+    v_shift: Array,
+    features: Array,
+    labels: Array,
+    offsets: Array,
+    weights: Array,
+    *,
+    interpret: bool = False,
+) -> Tuple[Array, Array]:
+    """Raw fused sums for the Gauss-Newton Hessian-vector product.
+
+    Returns (hv_raw, sum_r) with
+        hv_raw = X^T r,   r = weight * l''(z, y) * (X @ v_eff + v_shift)
+        sum_r  = sum_i r_i
+    """
+    n, d = features.shape
+    offsets = offsets + shift
+    grid = (pl.cdiv(n, _TILE_N),)
+
+    col = lambda a: a.reshape(n, 1).astype(jnp.float32)
+    wv = jnp.stack(
+        [w_eff.astype(jnp.float32), v_eff.astype(jnp.float32)], axis=1
+    )  # [D, 2]
+    kernel = functools.partial(_hvp_kernel, loss, n)
+    row_spec = pl.BlockSpec((_TILE_N, 1), lambda i: (i, 0), memory_space=_VMEM)
+    stats, hv = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((_TILE_N, d), lambda i: (i, 0), memory_space=_VMEM),
+            row_spec,
+            row_spec,
+            row_spec,
+            pl.BlockSpec((d, 2), lambda i: (0, 0), memory_space=_VMEM),
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=_SMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=_SMEM),
+            pl.BlockSpec((d, 1), lambda i: (0, 0), memory_space=_VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, 1), jnp.float32),
+            jax.ShapeDtypeStruct((d, 1), jnp.float32),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=6 * n * d,
+            bytes_accessed=n * d * features.dtype.itemsize,
+            transcendentals=2 * n,
+        ),
+        interpret=interpret,
+    )(
+        features,
+        col(labels),
+        col(offsets),
+        col(weights),
+        wv,
+        jnp.asarray(v_shift, jnp.float32).reshape(1, 1),
+    )
+    return hv[:, 0], stats[0, 0]

@@ -39,6 +39,7 @@ def solve(
     config: CoordinateOptimizationConfig,
     w0: Array,
     norm: Optional[NormalizationContext] = None,
+    use_pallas: Optional[bool] = None,
 ) -> OptResult:
     """Run the configured optimizer on one GLM problem.
 
@@ -48,7 +49,7 @@ def solve(
     Hessian-vector products.
     """
     l2 = config.l2_weight
-    vg = lambda w: objective.value_and_gradient(loss, w, data, norm, l2)
+    vg = lambda w: objective.value_and_gradient(loss, w, data, norm, l2, use_pallas)
     opt = config.optimizer
     ot = opt.optimizer_type
 
@@ -58,7 +59,9 @@ def solve(
                 f"{loss.name} has no Hessian; TRON requires TwiceDiffFunction "
                 "(reference restricts smoothed hinge to LBFGS)"
             )
-        hvp = lambda w, v: objective.hessian_vector(loss, w, v, data, norm, l2)
+        hvp = lambda w, v: objective.hessian_vector(
+            loss, w, v, data, norm, l2, use_pallas
+        )
         return minimize_tron(
             vg, hvp, w0, max_iterations=opt.max_iterations, tolerance=opt.tolerance
         )
@@ -95,6 +98,7 @@ def solve_with_sampling(
     *,
     task: TaskType,
     key: Optional[jax.Array] = None,
+    use_pallas: Optional[bool] = None,
 ) -> OptResult:
     """DistributedOptimizationProblem.runWithSampling (:144-170): apply the
     coordinate's DownSampler before optimizing when rate < 1."""
@@ -102,7 +106,7 @@ def solve_with_sampling(
         if key is None:
             raise ValueError("down-sampling requires a PRNG key")
         data = down_sample(key, data, config.down_sampling_rate, task)
-    return solve(loss, data, config, w0, norm)
+    return solve(loss, data, config, w0, norm, use_pallas)
 
 
 def compute_variances(
