@@ -126,6 +126,15 @@ def read_game_dataset(
     for shard, cfg in shard_configs.items():
         imap = built[shard]
         intercept_idx = imap.intercept_index
+        if cfg.has_intercept and intercept_idx is None:
+            # A prebuilt (off-heap) index store that was created without the
+            # intercept key cannot honor has_intercept=True; training would
+            # silently fit without a bias term. Fail loudly instead.
+            raise ValueError(
+                f"feature shard '{shard}' is configured with an intercept but "
+                "the index map has no intercept entry — rebuild the index "
+                "store with the intercept key or set has_intercept=False"
+            )
         indptr = np.zeros(n + 1, np.int64)
         idx_buf: List[int] = []
         val_buf: List[float] = []

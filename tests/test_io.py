@@ -301,3 +301,19 @@ def test_reader_with_fixed_index_map_drops_unseen(tmp_path):
     dense = np.asarray(ds.shards["g"].to_dense())
     assert dense.shape == (1, 1)
     np.testing.assert_allclose(dense[0, 0], 1.0)
+
+
+def test_reader_rejects_intercept_shard_with_interceptless_index_map(tmp_path):
+    """A prebuilt index map without the intercept entry must fail loudly when
+    the shard is configured has_intercept=True — silently training without a
+    bias term is the failure mode this guards against."""
+    p = str(tmp_path / "t.avro")
+    write_training_examples(p, [[("f1", 1.0)]], [1.0])
+    imap = IndexMap.from_feature_names(["f1"], add_intercept=False)
+    with pytest.raises(ValueError, match="intercept"):
+        read_game_dataset(
+            p,
+            {"g": FeatureShardConfig(has_intercept=True)},
+            index_maps={"g": imap},
+            response_field="label",
+        )
