@@ -1,0 +1,53 @@
+#!/bin/bash
+# Fixed-effect logistic regression end to end — the TPU-native counterpart of
+# the reference tutorial flow (README.md:307-345: a1a LibSVM -> Avro ->
+# training driver -> model dir) and of examples/run_photon_ml_driver.sh.
+#
+# Usage: ./run_game_training.sh [working_root]
+#
+# Layout produced under working_root (default ./photon-demo):
+#     data/       train.libsvm test.libsvm + Avro conversions
+#     results/    trained models (models/best, models/explicit-*)
+#     scores/     scored test set + scoring-summary.json
+set -euo pipefail
+
+ROOT="${1:-./photon-demo}"
+REPO_DIR="$(cd "$(dirname "$0")/.." && pwd)"
+export PYTHONPATH="$REPO_DIR${PYTHONPATH:+:$PYTHONPATH}"
+DATA="$ROOT/data"
+mkdir -p "$DATA"
+
+echo "== 1/4 generate a1a-like dataset =="
+python "$REPO_DIR/examples/generate_dataset.py" "$DATA" --train 1600 --test 800
+
+echo "== 2/4 convert LibSVM -> TrainingExample Avro =="
+python -m photon_ml_tpu.cli.libsvm_to_avro "$DATA/train.libsvm" "$DATA/train.avro"
+python -m photon_ml_tpu.cli.libsvm_to_avro "$DATA/test.libsvm" "$DATA/test.avro"
+
+echo "== 3/4 train: logistic regression, L2 sweep 0.1|1|10|100 =="
+python -m photon_ml_tpu.cli.train \
+    --training-task LOGISTIC_REGRESSION \
+    --input-data-directories "$DATA/train.avro" \
+    --validation-data-directories "$DATA/test.avro" \
+    --root-output-directory "$ROOT/results" \
+    --override-output-directory \
+    --feature-shard-configurations \
+        "name=globalShard,feature.bags=features,intercept=true" \
+    --coordinate-configurations \
+        "name=global,feature.shard=globalShard,optimizer=LBFGS,tolerance=1.0E-7,max.iter=50,regularization=L2,reg.weights=0.1|1|10|100" \
+    --validation-evaluators AUC \
+    --output-mode ALL
+
+echo "== 4/4 score the held-out split with the selected model =="
+python -m photon_ml_tpu.cli.score \
+    --input-data-directories "$DATA/test.avro" \
+    --model-input-directory "$ROOT/results/models/best" \
+    --root-output-directory "$ROOT/scores" \
+    --feature-shard-configurations \
+        "name=globalShard,feature.bags=features,intercept=true" \
+    --evaluators AUC
+
+echo
+echo "model dir:      $ROOT/results/models/best"
+echo "train summary:  $ROOT/results/training-summary.json"
+echo "score summary:  $ROOT/scores/scoring-summary.json"

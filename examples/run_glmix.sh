@@ -1,0 +1,49 @@
+#!/bin/bash
+# GLMix (GAME) end to end: a fixed-effect coordinate plus a per-member
+# random-effect coordinate trained by coordinate descent — the per-entity
+# model structure from the GLMix paper (reference README.md:58-64), driven
+# through the same CLI surface as the reference's GameTrainingDriver
+# (coordinate mini-DSL per README.md:283-292).
+#
+# Usage: ./run_glmix.sh [working_root]
+set -euo pipefail
+
+ROOT="${1:-./photon-glmix-demo}"
+REPO_DIR="$(cd "$(dirname "$0")/.." && pwd)"
+export PYTHONPATH="$REPO_DIR${PYTHONPATH:+:$PYTHONPATH}"
+DATA="$ROOT/data"
+mkdir -p "$DATA"
+
+echo "== 1/3 generate dataset with 24 member entities =="
+python "$REPO_DIR/examples/generate_dataset.py" "$DATA" --train 2400 --test 800 --entities 24
+python -m photon_ml_tpu.cli.libsvm_to_avro --tag-comments "$DATA/train.libsvm" "$DATA/train.avro"
+python -m photon_ml_tpu.cli.libsvm_to_avro --tag-comments "$DATA/test.libsvm" "$DATA/test.avro"
+
+echo "== 2/3 train GAME: fixed effect + per-member random effect =="
+python -m photon_ml_tpu.cli.train \
+    --training-task LOGISTIC_REGRESSION \
+    --input-data-directories "$DATA/train.avro" \
+    --validation-data-directories "$DATA/test.avro" \
+    --root-output-directory "$ROOT/results" \
+    --override-output-directory \
+    --feature-shard-configurations \
+        "name=globalShard,feature.bags=features,intercept=true" \
+    --coordinate-configurations \
+        "name=global,feature.shard=globalShard,optimizer=LBFGS,tolerance=1.0E-7,max.iter=50,regularization=L2,reg.weights=1" \
+        "name=per-member,random.effect.type=memberId,feature.shard=globalShard,optimizer=LBFGS,max.iter=30,regularization=L2,reg.weights=10,min.bucket=8" \
+    --coordinate-descent-iterations 2 \
+    --validation-evaluators AUC \
+    --output-mode BEST
+
+echo "== 3/3 score =="
+python -m photon_ml_tpu.cli.score \
+    --input-data-directories "$DATA/test.avro" \
+    --model-input-directory "$ROOT/results/models/best" \
+    --root-output-directory "$ROOT/scores" \
+    --feature-shard-configurations \
+        "name=globalShard,feature.bags=features,intercept=true" \
+    --evaluators AUC
+
+echo
+echo "per-member models: $ROOT/results/models/best/random-effect/per-member"
+echo "score summary:     $ROOT/scores/scoring-summary.json"

@@ -10,9 +10,33 @@ an intercept column, ready for packing into device blocks
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional, Tuple
+from typing import List, Optional, Tuple
 
 import numpy as np
+
+
+def parse_libsvm_line(
+    line: str, *, zero_based: bool = False
+) -> Optional[Tuple[float, List[Tuple[int, float]], str]]:
+    """Parse one LibSVM line into (label, [(index, value), ...], raw_comment).
+
+    The single tokenizer shared by `read_libsvm` and the Avro converter
+    (cli/libsvm_to_avro.py) so index-base and comment handling cannot drift.
+    Returns None for blank/comment-only lines. Indices are normalized to
+    0-based. The comment is everything after '#', unstripped of key=value
+    structure (the converter's --tag-comments layer interprets it).
+    """
+    body, _, comment = line.partition("#")
+    body = body.strip()
+    if not body:
+        return None
+    parts = body.split()
+    label = float(parts[0])
+    pairs = []
+    for tok in parts[1:]:
+        k, v = tok.split(":")
+        pairs.append((int(k) - (0 if zero_based else 1), float(v)))
+    return label, pairs, comment.strip()
 
 
 @dataclasses.dataclass
@@ -64,16 +88,14 @@ def read_libsvm(
     max_idx = -1
     with open(path) as f:
         for line in f:
-            line = line.split("#", 1)[0].strip()
-            if not line:
+            parsed = parse_libsvm_line(line, zero_based=zero_based)
+            if parsed is None:
                 continue
-            parts = line.split()
-            labels.append(float(parts[0]))
-            for tok in parts[1:]:
-                k, v = tok.split(":")
-                idx = int(k) - (0 if zero_based else 1)
+            label, pairs, _ = parsed
+            labels.append(label)
+            for idx, v in pairs:
                 indices.append(idx)
-                values.append(float(v))
+                values.append(v)
                 max_idx = max(max_idx, idx)
             indptr.append(len(indices))
 

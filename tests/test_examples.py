@@ -1,0 +1,135 @@
+"""The examples must stay runnable end to end (reference parity: the tutorial
+flow of README.md:307-345 is exercised by the driver integ tests)."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "examples"))
+
+from photon_ml_tpu.cli import libsvm_to_avro, score as score_cli, train as train_cli
+from photon_ml_tpu.io.avro_data import FeatureShardConfig, read_game_dataset
+
+
+def test_libsvm_converter_roundtrip(tmp_path):
+    src = tmp_path / "t.libsvm"
+    src.write_text(
+        "+1 1:0.5 3:2.0 # memberId=m1\n"
+        "-1 2:1.0  # memberId=m2,country=us\n"
+        "\n"
+        "+1 1:1.5\n"
+    )
+    out = str(tmp_path / "t.avro")
+    n = libsvm_to_avro.convert(str(src), out, tag_comments=True)
+    assert n == 3
+    ds, maps = read_game_dataset(
+        out,
+        {"g": FeatureShardConfig(has_intercept=False)},
+        id_tag_fields=["memberId", "country"],
+        response_field="label",
+    )
+    assert ds.num_samples == 3
+    np.testing.assert_allclose(np.asarray(ds.labels), [1.0, 0.0, 1.0])
+    assert list(ds.id_tags["memberId"]) == ["m1", "m2", ""]
+    assert list(ds.id_tags["country"]) == ["", "us", ""]
+    dense = np.asarray(ds.shards["g"].to_dense())
+    assert dense[0, maps["g"].get_index("0")] == 0.5
+    assert dense[0, maps["g"].get_index("2")] == 2.0
+
+
+def test_generator_is_deterministic(tmp_path):
+    import generate_dataset
+
+    p1 = tmp_path / "a.libsvm"
+    p2 = tmp_path / "b.libsvm"
+    generate_dataset.generate(str(p1), 50, seed=0, entities=4)
+    generate_dataset.generate(str(p2), 50, seed=0, entities=4)
+    assert p1.read_text() == p2.read_text()
+    assert "# memberId=m" in p1.read_text()
+
+
+def test_fixed_effect_example_flow(tmp_path):
+    """The run_game_training.sh stages, driven in-process at reduced size."""
+    import generate_dataset
+
+    data = tmp_path / "data"
+    data.mkdir()
+    generate_dataset.generate(str(data / "train.libsvm"), 600, seed=0)
+    generate_dataset.generate(str(data / "test.libsvm"), 300, seed=1)
+    libsvm_to_avro.main([str(data / "train.libsvm"), str(data / "train.avro")])
+    libsvm_to_avro.main([str(data / "test.libsvm"), str(data / "test.avro")])
+
+    out = str(tmp_path / "results")
+    train_cli.main([
+        "--training-task", "LOGISTIC_REGRESSION",
+        "--input-data-directories", str(data / "train.avro"),
+        "--validation-data-directories", str(data / "test.avro"),
+        "--root-output-directory", out,
+        "--feature-shard-configurations",
+        "name=globalShard,feature.bags=features,intercept=true",
+        "--coordinate-configurations",
+        "name=global,feature.shard=globalShard,optimizer=LBFGS,"
+        "tolerance=1.0E-7,max.iter=50,regularization=L2,reg.weights=0.1|1|10",
+        "--validation-evaluators", "AUC",
+        "--output-mode", "BEST",
+    ])
+    summary = json.load(open(os.path.join(out, "training-summary.json")))
+    assert summary["best_evaluation"]["AUC"] > 0.75
+
+    scores = str(tmp_path / "scores")
+    score_cli.main([
+        "--input-data-directories", str(data / "test.avro"),
+        "--model-input-directory", os.path.join(out, "models", "best"),
+        "--root-output-directory", scores,
+        "--feature-shard-configurations",
+        "name=globalShard,feature.bags=features,intercept=true",
+        "--evaluators", "AUC",
+    ])
+    ssum = json.load(open(os.path.join(scores, "scoring-summary.json")))
+    assert abs(ssum["evaluation"]["AUC"] - summary["best_evaluation"]["AUC"]) < 5e-3
+
+
+def test_example_shell_scripts_are_wellformed():
+    """Guard the scripts against referencing CLIs/flags that do not exist."""
+    for script in ("run_game_training.sh", "run_glmix.sh"):
+        text = open(os.path.join(REPO, "examples", script)).read()
+        assert "set -euo pipefail" in text
+        for mod in ("cli.libsvm_to_avro", "cli.train", "cli.score"):
+            assert mod in text
+    # Flags used by the scripts must parse.
+    parser = train_cli.build_parser()
+    known = {a for action in parser._actions for a in action.option_strings}
+    for script in ("run_game_training.sh", "run_glmix.sh"):
+        text = open(os.path.join(REPO, "examples", script)).read()
+        in_train = False
+        for line in text.splitlines():
+            line = line.strip().rstrip("\\").strip()
+            if "cli.train" in line:
+                in_train = True
+                continue
+            if in_train:
+                if line.startswith("--"):
+                    flag = line.split()[0]
+                    assert flag in known, f"{script}: unknown train flag {flag}"
+                elif not line.startswith('"') and not line.startswith("'"):
+                    in_train = False
+
+
+def test_converter_label_mapping_is_whole_file(tmp_path):
+    """Regression files containing some ±1 labels must pass through unmapped,
+    matching read_libsvm's whole-file rule."""
+    src = tmp_path / "r.libsvm"
+    src.write_text("2.5 1:1\n-1 1:1\n")
+    out = str(tmp_path / "r.avro")
+    libsvm_to_avro.convert(str(src), out)
+    ds, _ = read_game_dataset(
+        out, {"g": FeatureShardConfig(has_intercept=False)}, response_field="label"
+    )
+    np.testing.assert_allclose(np.asarray(ds.labels), [2.5, -1.0])
