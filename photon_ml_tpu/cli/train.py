@@ -125,6 +125,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--hyper-parameter-tuning", type=HyperparameterTuningMode.parse,
                    default=HyperparameterTuningMode.NONE)
     p.add_argument("--hyper-parameter-tuning-iter", type=int, default=20)
+    p.add_argument("--hyper-parameter-tuning-batch-size", type=int, default=1,
+                   help="trials proposed per round (>1: constant-liar qEI for "
+                        "BAYESIAN, Sobol batches for RANDOM); evaluations run "
+                        "sequentially in this driver but proposals are batched")
     p.add_argument("--random-seed", type=int, default=0)
     p.add_argument("--logging-level", default="INFO")
     p.add_argument("--application-name", default="photon-ml-tpu-training")
@@ -387,6 +391,7 @@ def _run_job(
                 evaluate,
                 maximize=maximize,
                 seed=args.random_seed + 1,
+                batch_size=args.hyper_parameter_tuning_batch_size,
             )
             logger.info("hyperparameter tuning: %d trials", len(tuned_results))
 

@@ -23,6 +23,10 @@ from scipy.stats import qmc
 from photon_ml_tpu.hyperparameter.gp import fit_gp
 
 EvaluationFunction = Callable[[np.ndarray], float]
+# Batch evaluation: (k, dim) candidate matrix -> k values. How trials run in
+# parallel (vmapped fits, one per device of a pod slice, threads) is the
+# caller's choice; the searchers only need the values back.
+BatchEvaluationFunction = Callable[[np.ndarray], Sequence[float]]
 
 CANDIDATE_POOL_SIZE = 250  # GaussianProcessSearch.scala:52
 
@@ -107,6 +111,11 @@ class RandomSearch:
     def propose(self) -> np.ndarray:
         return backward_scale(self._sobol.random(1)[0], self.configs)
 
+    def propose_batch(self, k: int) -> np.ndarray:
+        """k candidates for one parallel round. Sobol draws are quasi-random
+        and space-filling, so a plain batch is already diverse."""
+        return backward_scale(self._sobol.random(k), self.configs)
+
     def on_observation(self, obs: Observation) -> None:
         pass
 
@@ -121,15 +130,59 @@ class RandomSearch:
             self.on_observation(obs)
         return self._result()
 
+    def find_batched(
+        self,
+        n: int,
+        batch_size: int,
+        batch_evaluation_function: Optional[BatchEvaluationFunction] = None,
+    ) -> SearchResult:
+        """Run ~n trials in rounds of `batch_size` parallel evaluations.
+
+        The reference's search loop is inherently serial — one full training
+        run per observation (GameTrainingDriver.scala:643-680); on TPU the
+        trials themselves can be batched (vmapped fits, or one trial per pod
+        slice), so the searchers support proposing a whole round at once.
+        `batch_evaluation_function` evaluates a (k, dim) candidate matrix;
+        when omitted, candidates are mapped through the scalar evaluation
+        function one by one (same results, no parallelism). With
+        batch_size <= 1, a provided batch function still evaluates each
+        single-candidate round (it is never silently dropped).
+        """
+        if batch_size <= 1 and batch_evaluation_function is None:
+            return self.find(n)
+        batch_size = max(batch_size, 1)
+        done = 0
+        while done < n:
+            k = min(batch_size, n - done)
+            points = self.propose_batch(k)
+            if batch_evaluation_function is not None:
+                values = list(batch_evaluation_function(points))
+                if len(values) != k:
+                    raise ValueError(
+                        f"batch evaluation returned {len(values)} values for {k} candidates"
+                    )
+            else:
+                values = [float(self.evaluation_function(p)) for p in points]
+            for p, v in zip(points, values):
+                obs = Observation(np.asarray(p, np.float64), float(v))
+                self.observations.append(obs)
+                self.on_observation(obs)
+            done += k
+        return self._result()
+
+    def seed_priors(self, priors: Sequence[Tuple[np.ndarray, float]]) -> None:
+        """Record observations from earlier runs without evaluating them."""
+        for p, v in priors:
+            obs = Observation(np.asarray(p, np.float64), float(v))
+            self.prior_observations.append(obs)
+            self.on_observation(obs)
+
     def find_with_priors(
         self, n: int, priors: Sequence[Tuple[np.ndarray, float]]
     ) -> SearchResult:
         """Seed the search with observations from earlier runs
         (findWithPriors, RandomSearch.scala:61-90)."""
-        for p, v in priors:
-            obs = Observation(np.asarray(p, np.float64), float(v))
-            self.prior_observations.append(obs)
-            self.on_observation(obs)
+        self.seed_priors(priors)
         return self.find(n)
 
     def _result(self) -> SearchResult:
@@ -161,22 +214,54 @@ class GaussianProcessSearch(RandomSearch):
         self.kernel = kernel
         self._rng = np.random.default_rng(seed)
 
-    def propose(self) -> np.ndarray:
+    def _fit(self):
         all_obs = self.prior_observations + self.observations
         if len(all_obs) < self.min_observations:
-            return super().propose()
+            return None
         x = np.stack([forward_scale(o.point, self.configs) for o in all_obs])
         y = np.asarray([o.value for o in all_obs])
-        model = fit_gp(
+        return fit_gp(
             x,
             y,
             kernel=self.kernel,
             maximize=self.maximize,
             seed=int(self._rng.integers(1 << 31)),
         )
+
+    def propose(self) -> np.ndarray:
+        model = self._fit()
+        if model is None:
+            return super().propose()
         pool = self._sobol.random(self.candidate_pool_size)
         ei = model.expected_improvement(pool)
         return backward_scale(pool[int(np.argmax(ei))], self.configs)
+
+    def propose_batch(self, k: int) -> np.ndarray:
+        """qEI via the constant-liar heuristic: fit once, then pick argmax EI
+        k times, each time conditioning the SAME sampled kernels on a fantasy
+        observation at the picked point with the current best ("CL-min")
+        value. The fantasy collapses predictive variance around prior picks,
+        so EI moves elsewhere — a diverse batch without re-running the slice
+        sampler per pick (kernel hyperparameters are reused; only the
+        Cholesky grows).
+        """
+        model = self._fit()
+        if model is None:
+            return super().propose_batch(k)
+        pool = self._sobol.random(self.candidate_pool_size)
+        x_aug = model.x
+        y_aug = model.y
+        liar = float(np.min(model.y))  # best value in the internal
+        # (standardized, minimization) space
+        picks = []
+        for _ in range(k):
+            m = dataclasses.replace(model, x=x_aug, y=y_aug)
+            ei = m.expected_improvement(pool)
+            j = int(np.argmax(ei))
+            picks.append(pool[j])
+            x_aug = np.vstack([x_aug, pool[j : j + 1]])
+            y_aug = np.append(y_aug, liar)
+        return backward_scale(np.stack(picks), self.configs)
 
 
 # ---------------------------------------------------------------------------

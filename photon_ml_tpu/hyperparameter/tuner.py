@@ -16,6 +16,7 @@ from typing import Callable, Optional, Sequence, Tuple
 import numpy as np
 
 from photon_ml_tpu.hyperparameter.search import (
+    BatchEvaluationFunction,
     GaussianProcessSearch,
     HyperparameterConfig,
     RandomSearch,
@@ -49,7 +50,13 @@ class HyperparameterTuner:
         maximize: bool = False,
         priors: Optional[Sequence[Tuple[np.ndarray, float]]] = None,
         seed: int = 1,
+        batch_size: int = 1,
+        batch_evaluation_function: Optional[BatchEvaluationFunction] = None,
     ) -> Optional[SearchResult]:
+        """`batch_size` > 1 runs trials in parallel rounds (constant-liar qEI
+        for BAYESIAN, plain Sobol batches for RANDOM) — the TPU-side upgrade
+        over the reference's inherently serial search loop. See
+        RandomSearch.find_batched."""
         if mode == HyperparameterTuningMode.NONE or n <= 0:
             return None
         cls = (
@@ -59,7 +66,9 @@ class HyperparameterTuner:
         )
         searcher = cls(configs, evaluation_function, maximize=maximize, seed=seed)
         if priors:
-            return searcher.find_with_priors(n, priors)
+            searcher.seed_priors(priors)
+        if batch_size > 1 or batch_evaluation_function is not None:
+            return searcher.find_batched(n, batch_size, batch_evaluation_function)
         return searcher.find(n)
 
 
