@@ -81,46 +81,49 @@ def read_libsvm(
     INTERCEPT pseudo-feature added per feature shard
     (AvroDataReader.readFeaturesFromRecord).
     """
-    labels = []
-    indptr = [0]
-    indices: list = []
-    values: list = []
-    max_idx = -1
-    with open(path) as f:
-        for line in f:
-            parsed = parse_libsvm_line(line, zero_based=zero_based)
-            if parsed is None:
-                continue
-            label, pairs, _ = parsed
-            labels.append(label)
-            for idx, v in pairs:
-                indices.append(idx)
-                values.append(v)
-                max_idx = max(max_idx, idx)
-            indptr.append(len(indices))
+    # Tokenize: native mmap parser when built (multi-GB ingest hot path),
+    # else the pure-Python tokenizer (semantic reference + fallback).
+    from photon_ml_tpu.native import libsvm_parser as native_parser
+
+    parsed_native = native_parser.parse_file(path, zero_based=zero_based)
+    if parsed_native is not None:
+        labels_a, indptr_a, indices_a, values_a, max_idx = parsed_native
+        values_a = values_a.astype(dtype, copy=False)
+    else:
+        labels = []
+        indptr = [0]
+        indices: list = []
+        values: list = []
+        max_idx = -1
+        with open(path) as f:
+            for line in f:
+                parsed = parse_libsvm_line(line, zero_based=zero_based)
+                if parsed is None:
+                    continue
+                label, pairs, _ = parsed
+                labels.append(label)
+                for idx, v in pairs:
+                    indices.append(idx)
+                    values.append(v)
+                    max_idx = max(max_idx, idx)
+                indptr.append(len(indices))
+        labels_a = np.asarray(labels, np.float64)
+        indptr_a = np.asarray(indptr, np.int64)
+        indices_a = np.asarray(indices, np.int32)
+        values_a = np.asarray(values, dtype)
 
     base_dim = (max_idx + 1) if num_features is None else num_features
     dim = base_dim + (1 if add_intercept else 0)
-    y = np.asarray(labels, dtype)
+    y = labels_a.astype(dtype)
     if binary_labels_to_01 and set(np.unique(y)) <= {-1.0, 1.0}:
         y = (y > 0).astype(dtype)
 
-    indptr_a = np.asarray(indptr, np.int64)
-    indices_a = np.asarray(indices, np.int32)
-    values_a = np.asarray(values, dtype)
     if add_intercept:
         n = len(y)
-        new_indptr = indptr_a + np.arange(n + 1, dtype=np.int64)
-        new_indices = np.empty(len(indices_a) + n, np.int32)
-        new_values = np.empty(len(values_a) + n, dtype)
-        for r in range(n):
-            lo, hi = indptr_a[r], indptr_a[r + 1]
-            nlo = new_indptr[r]
-            new_indices[nlo : nlo + (hi - lo)] = indices_a[lo:hi]
-            new_values[nlo : nlo + (hi - lo)] = values_a[lo:hi]
-            new_indices[nlo + (hi - lo)] = dim - 1
-            new_values[nlo + (hi - lo)] = 1.0
-        indptr_a, indices_a, values_a = new_indptr, new_indices, new_values
+        # Insert the intercept entry at every row end in one vectorized shot.
+        indices_a = np.insert(indices_a, indptr_a[1:], np.int32(dim - 1))
+        values_a = np.insert(values_a, indptr_a[1:], dtype(1.0))
+        indptr_a = indptr_a + np.arange(n + 1, dtype=np.int64)
 
     return CSRDataset(indptr_a, indices_a, values_a, y, dim)
 

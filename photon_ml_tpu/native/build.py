@@ -1,14 +1,20 @@
-"""On-demand compilation of the native library.
+"""On-demand compilation + loading of the native library.
 
 Builds `libphoton_native.so` from the C++ sources in this directory with the
 system `g++` the first time it is needed and caches the result next to the
 sources (keyed by a content hash, so edits trigger a rebuild). Returns None
 when no compiler is available — callers fall back to the pure-Python
 implementations of the same on-disk formats.
+
+Setting PHOTON_DISABLE_NATIVE=1 disables the native library for EVERY
+component (index store, LibSVM parser, ...) — one global kill switch, not
+per-component surprises. `load_native()` is the one shared ctypes loader;
+each binding module declares its own symbol signatures on the returned CDLL.
 """
 
 from __future__ import annotations
 
+import ctypes
 import hashlib
 import os
 import subprocess
@@ -16,10 +22,16 @@ import threading
 from typing import Optional
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SOURCES = ["index_store.cc"]
-_LOCK = threading.Lock()
+_SOURCES = ["index_store.cc", "libsvm_parser.cc"]
+_LOCK = threading.RLock()  # reentrant: load_native holds it across
+# native_library_path so concurrent first calls cannot race past a
+# half-initialized handle
 _CACHED: Optional[str] = None
 _ATTEMPTED = False
+_CDLL: Optional[ctypes.CDLL] = None
+_CDLL_TRIED = False
+
+_DISABLE_ENV = "PHOTON_DISABLE_NATIVE"
 
 
 def _source_hash() -> str:
@@ -31,8 +43,10 @@ def _source_hash() -> str:
 
 
 def native_library_path() -> Optional[str]:
-    """Path to the compiled shared library, or None if unbuildable."""
+    """Path to the compiled shared library, or None if unbuildable/disabled."""
     global _CACHED, _ATTEMPTED
+    if os.environ.get(_DISABLE_ENV, ""):
+        return None
     with _LOCK:
         if _ATTEMPTED:
             return _CACHED
@@ -63,3 +77,25 @@ def native_library_path() -> Optional[str]:
         except (OSError, subprocess.SubprocessError):
             _CACHED = None
         return _CACHED
+
+
+def load_native() -> Optional[ctypes.CDLL]:
+    """The process-wide CDLL handle (built on demand), or None.
+
+    Binding modules call this and declare their own restype/argtypes on the
+    returned object — declaring signatures is idempotent and per-symbol, so
+    sharing one handle is safe.
+    """
+    global _CDLL, _CDLL_TRIED
+    with _LOCK:
+        if _CDLL_TRIED:
+            return _CDLL
+        _CDLL_TRIED = True
+        path = native_library_path()
+        if path is None:
+            return None
+        try:
+            _CDLL = ctypes.CDLL(path)
+        except OSError:
+            _CDLL = None
+        return _CDLL

@@ -27,7 +27,7 @@ import os
 import struct
 from typing import Iterator, List, Optional, Sequence
 
-from photon_ml_tpu.native.build import native_library_path
+from photon_ml_tpu.native.build import load_native
 
 _MAGIC = b"PHIDX001"
 _HEADER = 32
@@ -76,11 +76,10 @@ def _lib():
     if _LIB_TRIED:
         return _LIB
     _LIB_TRIED = True
-    path = native_library_path()
-    if path is None:
+    lib = load_native()
+    if lib is None:
         return None
     try:
-        lib = ctypes.CDLL(path)
         lib.phidx_build.restype = ctypes.c_int64
         lib.phidx_build.argtypes = [
             ctypes.c_char_p,

@@ -1,0 +1,83 @@
+"""ctypes bindings for the native LibSVM parser (libsvm_parser.cc).
+
+`parse_file` returns raw CSR arrays or None when the native library is
+unavailable or the file is malformed — callers (data/libsvm.py read_libsvm)
+fall back to the pure-Python tokenizer, which is the semantic reference.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Optional, Tuple
+
+import numpy as np
+
+from photon_ml_tpu.native.build import load_native
+
+_LIB = None
+_LIB_TRIED = False
+
+
+def _lib():
+    global _LIB, _LIB_TRIED
+    if _LIB_TRIED:
+        return _LIB
+    _LIB_TRIED = True
+    lib = load_native()
+    if lib is None:
+        return None
+    lib.phsvm_parse.restype = ctypes.c_void_p
+    lib.phsvm_parse.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    for fn in (lib.phsvm_rows, lib.phsvm_nnz, lib.phsvm_max_index):
+        fn.restype = ctypes.c_int64
+        fn.argtypes = [ctypes.c_void_p]
+    lib.phsvm_copy.restype = None
+    lib.phsvm_copy.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_double),
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_double),
+    ]
+    lib.phsvm_free.restype = None
+    lib.phsvm_free.argtypes = [ctypes.c_void_p]
+    _LIB = lib
+    return _LIB
+
+
+def available() -> bool:
+    return _lib() is not None
+
+
+def parse_file(
+    path: str, *, zero_based: bool = False
+) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, int]]:
+    """Parse into (labels f64, indptr i64, indices i32, values f64, max_index).
+
+    Returns None when the native path is unavailable or declines (malformed
+    input is left to the Python tokenizer so error messages come from one
+    place).
+    """
+    lib = _lib()
+    if lib is None:
+        return None
+    handle = lib.phsvm_parse(path.encode("utf-8"), 1 if zero_based else 0)
+    if not handle:
+        return None
+    try:
+        rows = lib.phsvm_rows(handle)
+        nnz = lib.phsvm_nnz(handle)
+        labels = np.empty(rows, np.float64)
+        indptr = np.empty(rows + 1, np.int64)
+        indices = np.empty(nnz, np.int32)
+        values = np.empty(nnz, np.float64)
+        lib.phsvm_copy(
+            handle,
+            labels.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            indptr.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            values.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        )
+        return labels, indptr, indices, values, int(lib.phsvm_max_index(handle))
+    finally:
+        lib.phsvm_free(handle)
