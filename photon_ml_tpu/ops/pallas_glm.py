@@ -113,6 +113,72 @@ def _interpret_default() -> bool:
     return jax.default_backend() != "tpu"
 
 
+_HEALTHY: Optional[bool] = None
+
+
+def kernels_healthy() -> bool:
+    """One-time compiled smoke test of both kernels against the XLA path.
+
+    The kernels are exercised in interpreter mode by CI; a Mosaic
+    compile/runtime regression on real TPU hardware would otherwise surface
+    as a crashed training job. Probing a tiny problem once per process (and
+    checking numerics, not just absence of exceptions) lets `should_use`
+    fall back to the XLA objective instead.
+    """
+    global _HEALTHY
+    if _HEALTHY is not None:
+        return _HEALTHY
+    try:
+        import numpy as np
+
+        from photon_ml_tpu.ops.losses import LOGISTIC
+
+        rng = np.random.default_rng(0)
+        n, d = 2 * _TILE_N, 128
+        X = jnp.asarray(rng.normal(size=(n, d)).astype(np.float32))
+        y = jnp.asarray((rng.uniform(size=n) > 0.5).astype(np.float32))
+        off = jnp.zeros((n,))
+        wt = jnp.ones((n,))
+        w = jnp.asarray((rng.normal(size=d) * 0.1).astype(np.float32))
+        zero = jnp.zeros(())
+
+        val, g, _ = value_gradient_sums(
+            LOGISTIC, w, zero, X, y, off, wt, interpret=FORCE_INTERPRET
+        )
+        hv, _ = hessian_vector_sums(
+            LOGISTIC, w, zero, w, zero, X, y, off, wt, interpret=FORCE_INTERPRET
+        )
+        z = X @ w
+        u = wt * LOGISTIC.d1(z, y)
+        val_ref = jnp.sum(wt * LOGISTIC.loss(z, y))
+        g_ref = u @ X
+        hv_ref = (wt * LOGISTIC.d2(z, y) * (X @ w)) @ X
+        ok = (
+            bool(jnp.allclose(val, val_ref, rtol=1e-4))
+            and bool(jnp.allclose(g, g_ref, rtol=1e-3, atol=1e-3))
+            and bool(jnp.allclose(hv, hv_ref, rtol=1e-3, atol=1e-3))
+        )
+        if not ok:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "pallas_glm kernels produced wrong numerics in the smoke "
+                "test; falling back to the XLA objective path"
+            )
+        _HEALTHY = ok
+    except Exception as exc:  # compile or runtime failure
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "pallas_glm kernels unavailable (%s: %s); falling back to the "
+            "XLA objective path",
+            type(exc).__name__,
+            exc,
+        )
+        _HEALTHY = False
+    return _HEALTHY
+
+
 def should_use(features, w: Array) -> bool:
     """True when the fused kernels should replace the XLA objective path.
 
@@ -154,7 +220,9 @@ def should_use(features, w: Array) -> bool:
         # Sharding unknown inside a trace; be conservative on multi-device
         # hosts — the XLA path is the one GSPMD partitions correctly.
         return False
-    return True
+    # Last (it compiles a probe once per process): the kernels must actually
+    # work on this backend.
+    return kernels_healthy()
 
 
 def _row_mask(n: int) -> Array:
