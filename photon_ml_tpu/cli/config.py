@@ -163,7 +163,7 @@ def parse_coordinate_config(arg: str) -> CoordinateConfiguration:
     re_type = pop(COORDINATE_DATA_CONFIG_RANDOM_EFFECT_TYPE)
     lower = pop(COORDINATE_DATA_CONFIG_ACTIVE_DATA_LOWER_BOUND)
     upper = pop(COORDINATE_DATA_CONFIG_ACTIVE_DATA_UPPER_BOUND)
-    pop(COORDINATE_DATA_CONFIG_FEATURES_TO_SAMPLES_RATIO)  # accepted, unused
+    ratio = pop(COORDINATE_DATA_CONFIG_FEATURES_TO_SAMPLES_RATIO)
     min_bucket = pop(COORDINATE_DATA_CONFIG_MIN_BUCKET)
     projector = pop(COORDINATE_DATA_CONFIG_PROJECTOR)
     projected_dim = pop(COORDINATE_DATA_CONFIG_PROJECTED_DIM)
@@ -174,6 +174,9 @@ def parse_coordinate_config(arg: str) -> CoordinateConfiguration:
             feature_shard=shard,
             active_upper_bound=None if upper is None else int(upper),
             active_lower_bound=None if lower is None else int(lower),
+            num_features_to_samples_ratio_upper_bound=(
+                None if ratio is None else float(ratio)
+            ),
             min_bucket=8 if min_bucket is None else int(min_bucket),
             projector_type=(
                 ProjectorType.INDEX_MAP
@@ -254,6 +257,11 @@ def coordinate_config_to_string(cfg: CoordinateConfiguration) -> str:
         if dc.active_upper_bound is not None:
             parts.append(
                 f"{COORDINATE_DATA_CONFIG_ACTIVE_DATA_UPPER_BOUND}{KV_DELIMITER}{dc.active_upper_bound}"
+            )
+        if dc.num_features_to_samples_ratio_upper_bound is not None:
+            parts.append(
+                f"{COORDINATE_DATA_CONFIG_FEATURES_TO_SAMPLES_RATIO}{KV_DELIMITER}"
+                f"{dc.num_features_to_samples_ratio_upper_bound}"
             )
         parts.append(f"{COORDINATE_DATA_CONFIG_MIN_BUCKET}{KV_DELIMITER}{dc.min_bucket}")
         parts.append(

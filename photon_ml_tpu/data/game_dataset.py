@@ -69,6 +69,11 @@ class RandomEffectDataConfig:
     feature_shard: str
     active_upper_bound: Optional[int] = None
     active_lower_bound: Optional[int] = None
+    # Per-entity Pearson feature selection: keep at most
+    # ceil(ratio * n_entity_rows) features ranked by |corr(feature, label)|
+    # (RandomEffectDataset.featureSelectionOnActiveData:447-465,
+    # LocalDataset.stableComputePearsonCorrelationScore:187+). None = off.
+    num_features_to_samples_ratio_upper_bound: Optional[float] = None
     min_bucket: int = 8
     # Feature-space projection for the per-entity models; default INDEX_MAP
     # as in the reference (CoordinateDataConfiguration.scala:59-66).
@@ -166,6 +171,12 @@ class RandomEffectDataset:
     sample_entity_rows: Array  # (N,) int32
     num_active_samples: int
     num_passive_samples: int
+    # (num_entities + 1, D) 0/1 multipliers when Pearson feature selection is
+    # on; None otherwise. The +1 row (unseen entities) is all-ones. Training
+    # multiplies gathered blocks by the owning entity's row, so deselected
+    # features contribute no data signal and their (zero-init) coefficients
+    # stay exactly zero under L2 — scoring with full features is then safe.
+    feature_mask: Optional[Array] = None
 
     @property
     def num_entities(self) -> int:
@@ -251,6 +262,16 @@ def build_random_effect_dataset(
             ent_rows[bi] = kept_entities[j]
         buckets.append(EntityBlocks(gather, mask, ent_rows))
 
+    feature_mask = None
+    if config.num_features_to_samples_ratio_upper_bound is not None:
+        feature_mask = _pearson_feature_masks(
+            dataset,
+            config,
+            active_lists,
+            kept_entities,
+            num_entities,
+        )
+
     return RandomEffectDataset(
         config=config,
         entity_index=entity_index,
@@ -258,7 +279,68 @@ def build_random_effect_dataset(
         sample_entity_rows=jnp.asarray(entity_rows_of_sample, jnp.int32),
         num_active_samples=num_active,
         num_passive_samples=n - num_active,
+        feature_mask=feature_mask,
     )
+
+
+def _pearson_feature_masks(
+    dataset: GameDataset,
+    config: RandomEffectDataConfig,
+    active_lists: List[np.ndarray],
+    kept_entities: List[int],
+    num_entities: int,
+) -> Array:
+    """Per-entity 0/1 feature masks by |Pearson corr(feature, label)|.
+
+    Mirrors featureSelectionOnActiveData (RandomEffectDataset.scala:447-465):
+    keep ceil(ratio * n_rows) features per entity, ranked by |Pearson|;
+    constant-one columns (the intercept pseudo-feature) score 1.0 so they are
+    always retained, as in stableComputePearsonCorrelationScore's intercept
+    handling.
+    """
+    ratio = config.num_features_to_samples_ratio_upper_bound
+    features = dataset.shards[config.feature_shard]
+    labels_np = np.asarray(dataset.labels)
+    if isinstance(features, SparseFeatures):
+        dim = features.dim
+        ell_idx = np.asarray(features.indices)
+        ell_val = np.asarray(features.values)
+
+        def entity_dense(rows: np.ndarray) -> np.ndarray:
+            X = np.zeros((len(rows), dim), np.float64)
+            for r_i, r in enumerate(rows):
+                X[r_i, ell_idx[r]] += ell_val[r]
+            return X
+
+    else:
+        feats_np = np.asarray(features)
+        dim = feats_np.shape[-1]
+
+        def entity_dense(rows: np.ndarray) -> np.ndarray:
+            return feats_np[rows].astype(np.float64)
+
+    masks = np.ones((num_entities + 1, dim), np.float32)
+    for rows, row_id in zip(active_lists, kept_entities):
+        n_rows = len(rows)
+        keep = int(np.ceil(ratio * n_rows))
+        if keep >= dim:
+            continue
+        X = entity_dense(rows)
+        y = labels_np[rows].astype(np.float64)
+        Xc = X - X.mean(axis=0)
+        yc = y - y.mean()
+        x_std = np.sqrt((Xc * Xc).sum(axis=0))
+        y_std = np.sqrt((yc * yc).sum())
+        denom = x_std * y_std
+        with np.errstate(invalid="ignore", divide="ignore"):
+            corr = np.where(denom > 0, np.abs(Xc.T @ yc) / np.where(denom > 0, denom, 1.0), 0.0)
+        # Intercept: constant-one column scores 1.0 (always kept).
+        corr = np.where((x_std == 0) & (X[0] == 1.0) & (np.ptp(X, axis=0) == 0), 1.0, corr)
+        keep_idx = np.argpartition(corr, -keep)[-keep:]
+        row_mask = np.zeros(dim, np.float32)
+        row_mask[keep_idx] = 1.0
+        masks[row_id] = row_mask
+    return jnp.asarray(masks)
 
 
 def gather_block_features(features: Features, gather: Array) -> Features:
@@ -277,14 +359,27 @@ def gather_block_data(
     shard: str,
     blocks: EntityBlocks,
     offsets: Optional[Array] = None,
+    feature_mask: Optional[Array] = None,
 ) -> LabeledData:
     """Build the (E, S, ...) LabeledData blocks for one bucket. Offsets default
     to the dataset's; pass per-sample residual-adjusted offsets during
     coordinate descent. Padding slots get weight 0 (mask folded into weights).
+
+    `feature_mask` is the RandomEffectDataset's per-entity (E_total+1, D)
+    Pearson-selection matrix; the bucket's rows are gathered and multiplied
+    into the features so deselected columns carry no data signal.
     """
     offs = dataset.offsets if offsets is None else offsets
+    features = gather_block_features(dataset.shards[shard], blocks.gather)
+    if feature_mask is not None:
+        block_mask = jnp.take(feature_mask, blocks.entity_rows, axis=0)  # (E, D)
+        if isinstance(features, SparseFeatures):
+            mult = jax.vmap(lambda m, idx: m[idx])(block_mask, features.indices)
+            features = SparseFeatures(features.indices, features.values * mult, features.dim)
+        else:
+            features = features * block_mask[:, None, :]
     return LabeledData(
-        features=gather_block_features(dataset.shards[shard], blocks.gather),
+        features=features,
         labels=jnp.take(dataset.labels, blocks.gather, axis=0),
         offsets=jnp.take(offs, blocks.gather, axis=0),
         weights=jnp.take(dataset.weights, blocks.gather, axis=0) * blocks.mask,

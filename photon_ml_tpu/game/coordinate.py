@@ -276,7 +276,9 @@ class RandomEffectCoordinate:
         # and stats materialize once at the end.
         bucket_iters = []
         for blocks in red.buckets:
-            block_data = gather_block_data(ds, red.feature_shard, blocks, offsets)
+            block_data = gather_block_data(
+                ds, red.feature_shard, blocks, offsets, feature_mask=red.feature_mask
+            )
             w0 = matrix[blocks.entity_rows]
             res: OptResult = self._train_bucket(block_data, w0, rw)
             matrix = matrix.at[blocks.entity_rows].set(res.coefficients)

@@ -11,6 +11,7 @@ from photon_ml_tpu.hyperparameter.search import (
     config_from_json,
     forward_scale,
     priors_from_json,
+    shrink_search_range,
 )
 from photon_ml_tpu.hyperparameter.tuner import (
     HyperparameterTuner,

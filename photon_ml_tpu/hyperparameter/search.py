@@ -264,6 +264,51 @@ class GaussianProcessSearch(RandomSearch):
         return backward_scale(np.stack(picks), self.configs)
 
 
+def shrink_search_range(
+    configs: Sequence[HyperparameterConfig],
+    priors: Sequence[Tuple[np.ndarray, float]],
+    *,
+    radius: float = 0.25,
+    candidate_pool_size: int = 1024,
+    maximize: bool = False,
+    seed: int = 1,
+    kernel: str = "matern52",
+) -> List[HyperparameterConfig]:
+    """Narrow each parameter's range around the GP-predicted best point
+    (photon-client hyperparameter/ShrinkSearchRange.scala:28-101).
+
+    Fits a GP to the prior observations (unit-cube rescaled), predicts over a
+    Sobol candidate pool, takes the best predicted candidate, and returns new
+    configs whose [min, max] is the candidate +/- `radius` in unit space,
+    clipped to the original range and back-scaled (log-space parameters are
+    narrowed in log space, matching VectorRescaling).
+    """
+    if not priors:
+        raise ValueError("shrink_search_range needs prior observations")
+    x = np.stack([forward_scale(np.asarray(p, np.float64), configs) for p, _ in priors])
+    y = np.asarray([v for _, v in priors], np.float64)
+    model = fit_gp(x, y, kernel=kernel, maximize=maximize, seed=seed)
+    pool = qmc.Sobol(d=len(configs), scramble=True, seed=seed).random(
+        candidate_pool_size
+    )
+    mean, _ = model.predict(pool)
+    best = pool[int(np.argmin(mean))]  # internal space is always minimized
+    lo_unit = np.clip(best - radius, 0.0, 1.0)
+    hi_unit = np.clip(best + radius, 0.0, 1.0)
+    lo = backward_scale(lo_unit[None, :], configs)[0]
+    hi = backward_scale(hi_unit[None, :], configs)[0]
+    out = []
+    for i, c in enumerate(configs):
+        out.append(
+            dataclasses.replace(
+                c,
+                min_value=max(float(lo[i]), c.min_value),
+                max_value=min(float(hi[i]), c.max_value),
+            )
+        )
+    return out
+
+
 # ---------------------------------------------------------------------------
 # Config serialization (HyperparameterSerialization.scala:27-120)
 

@@ -240,3 +240,22 @@ class TestValidators:
         assert any("finite features" in n for n in names)
         # Disabled mode never raises.
         validate_game_dataset(ds, TaskType.LOGISTIC_REGRESSION, DataValidationType.VALIDATE_DISABLED)
+
+
+def test_features_to_samples_ratio_dsl_roundtrip():
+    from photon_ml_tpu.cli.config import (
+        coordinate_config_to_string,
+        parse_coordinate_config,
+    )
+
+    cfg = parse_coordinate_config(
+        "name=per-user,random.effect.type=userId,feature.shard=s,"
+        "features.to.samples.ratio=0.5,optimizer=LBFGS,reg.weights=1"
+    )
+    assert cfg.data_config.num_features_to_samples_ratio_upper_bound == 0.5
+    rendered = coordinate_config_to_string(cfg)
+    assert "features.to.samples.ratio=0.5" in rendered
+    assert (
+        parse_coordinate_config(rendered).data_config.num_features_to_samples_ratio_upper_bound
+        == 0.5
+    )

@@ -302,3 +302,79 @@ def test_tron_random_effect(rng):
     np.testing.assert_allclose(
         model.coefficients_matrix, model_l.coefficients_matrix, rtol=5e-2, atol=5e-3
     )
+
+
+class TestPearsonFeatureSelection:
+    def _dataset(self, rng, n=240, d=10, entities=4):
+        import numpy as np
+        import jax.numpy as jnp
+        from photon_ml_tpu.data.game_dataset import GameDataset
+
+        X = rng.normal(size=(n, d)).astype(np.float32)
+        X[:, d - 1] = 1.0  # intercept pseudo-feature
+        ent = rng.integers(0, entities, size=n)
+        # Label driven by features 0 and 1 only.
+        y = (X[:, 0] + 2 * X[:, 1] + 0.1 * rng.normal(size=n) > 0).astype(np.float32)
+        ds = GameDataset.build({"e": jnp.asarray(X)}, y, id_tags={"m": ent})
+        return ds
+
+    def test_masks_keep_correlated_and_intercept(self, rng):
+        import numpy as np
+        from photon_ml_tpu.data.game_dataset import (
+            RandomEffectDataConfig,
+            build_random_effect_dataset,
+        )
+
+        ds = self._dataset(rng)
+        red = build_random_effect_dataset(
+            ds,
+            RandomEffectDataConfig(
+                "m", "e", num_features_to_samples_ratio_upper_bound=0.08
+            ),
+        )
+        mask = np.asarray(red.feature_mask)
+        assert mask.shape == (red.num_entities + 1, 10)
+        # Unseen-entity row keeps everything.
+        np.testing.assert_array_equal(mask[-1], 1.0)
+        for e in range(red.num_entities):
+            # ceil(0.08 * ~60 rows) = 5 of 10 features kept.
+            assert 0 < mask[e].sum() < 10
+            # The informative features and the intercept survive selection.
+            assert mask[e, 0] == 1.0 and mask[e, 1] == 1.0
+            assert mask[e, 9] == 1.0  # constant-one intercept column
+
+    def test_deselected_features_train_to_zero(self, rng):
+        import numpy as np
+        import jax.numpy as jnp
+        from photon_ml_tpu.data.game_dataset import (
+            RandomEffectDataConfig,
+            build_random_effect_dataset,
+        )
+        from photon_ml_tpu.game.coordinate import RandomEffectCoordinate
+        from photon_ml_tpu.optimize.config import (
+            L2,
+            CoordinateOptimizationConfig,
+            OptimizerConfig,
+        )
+        from photon_ml_tpu.types import TaskType
+
+        ds = self._dataset(rng)
+        red = build_random_effect_dataset(
+            ds,
+            RandomEffectDataConfig(
+                "m", "e", num_features_to_samples_ratio_upper_bound=0.08
+            ),
+        )
+        cfg = CoordinateOptimizationConfig(
+            optimizer=OptimizerConfig(max_iterations=30),
+            regularization=L2,
+            reg_weight=0.1,
+        )
+        rc = RandomEffectCoordinate(ds, red, cfg, TaskType.LOGISTIC_REGRESSION)
+        model, _ = rc.train(jnp.zeros(ds.num_samples))
+        coeffs = np.asarray(model.coefficients_matrix)
+        mask = np.asarray(red.feature_mask)
+        # Coefficients of deselected features stay exactly zero.
+        np.testing.assert_array_equal(coeffs[:-1] * (1.0 - mask[:-1]), 0.0)
+        # And the kept informative features are actually used.
+        assert np.abs(coeffs[:-1, :2]).max() > 0.1

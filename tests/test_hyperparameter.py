@@ -221,3 +221,28 @@ def test_batch_evaluation_function_not_dropped_at_batch_size_one():
     result = rs.find_batched(3, batch_size=1, batch_evaluation_function=batch_eval)
     assert calls == [1, 1, 1]
     assert len(result.observations) == 3
+
+
+def test_shrink_search_range():
+    from photon_ml_tpu.hyperparameter.search import shrink_search_range
+
+    configs = [
+        HyperparameterConfig("a", 0.0, 1.0),
+        HyperparameterConfig("reg", 1e-3, 1e3, transform="LOG"),
+    ]
+    rng = np.random.default_rng(0)
+    priors = []
+    for _ in range(12):
+        a = rng.uniform(0, 1)
+        r = 10 ** rng.uniform(-3, 3)
+        # Optimum near a=0.3, reg=10.
+        val = (a - 0.3) ** 2 + (np.log10(r) - 1.0) ** 2
+        priors.append((np.array([a, r]), val))
+    narrowed = shrink_search_range(configs, priors, radius=0.2, seed=5)
+    for orig, new in zip(configs, narrowed):
+        assert new.min_value >= orig.min_value
+        assert new.max_value <= orig.max_value
+        assert new.min_value < new.max_value
+    # The narrowed window should contain the optimum region.
+    assert narrowed[0].min_value <= 0.45 and narrowed[0].max_value >= 0.15
+    assert narrowed[1].min_value <= 100 and narrowed[1].max_value >= 1.0
