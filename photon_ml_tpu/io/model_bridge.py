@@ -60,11 +60,10 @@ def artifact_from_game_model(
         if isinstance(m, FixedEffectModel):
             means = m.coefficients.means
             variances = m.coefficients.variances
-            if norm is not None and not norm.is_identity:
-                means = norm.model_to_original_space(means)
-                if variances is not None and norm.factors is not None:
-                    # var scales quadratically under w -> w * factor.
-                    variances = variances * jnp.square(norm.factors)
+            if norm is not None:
+                means, variances = norm.coefficients_to_original_space(
+                    means, variances
+                )
             coords[cid] = FixedEffectArtifact(
                 spec.shard,
                 np.asarray(means),

@@ -31,7 +31,7 @@ from typing import Dict, List, Mapping, Optional, Sequence, Tuple
 
 import numpy as np
 
-from photon_ml_tpu.data.index_map import DELIMITER, IndexMap
+from photon_ml_tpu.data.index_map import DELIMITER, IndexMap, feature_key
 from photon_ml_tpu.io import avro as avro_io
 from photon_ml_tpu.io import schemas
 from photon_ml_tpu.types import TaskType
@@ -75,10 +75,14 @@ def _coeffs_to_ntv(
     return out
 
 
-def _ntv_to_coeffs(records: Sequence[dict], index_map: IndexMap) -> np.ndarray:
-    vec = np.zeros(index_map.size, np.float64)
-    from photon_ml_tpu.data.index_map import feature_key
-
+def _ntv_to_coeffs(
+    records: Sequence[dict],
+    index_map: IndexMap,
+    out: Optional[np.ndarray] = None,
+) -> np.ndarray:
+    """(name, term, value) records -> coefficient vector (writes into `out`
+    when given — the random-effect loader fills matrix rows in place)."""
+    vec = np.zeros(index_map.size, np.float64) if out is None else out
     for r in records:
         idx = index_map.get_index(feature_key(r["name"], r["term"]))
         if idx >= 0:
@@ -202,8 +206,15 @@ def load_game_model(
     index_maps: Mapping[str, IndexMap],
     *,
     coordinates_to_load: Optional[Sequence[str]] = None,
+    dtype=np.float32,
 ) -> GameModelArtifact:
-    """loadGameModelFromHDFS (ModelProcessingUtils.scala:143-265)."""
+    """loadGameModelFromHDFS (ModelProcessingUtils.scala:143-265).
+
+    Random-effect coefficient matrices are materialized dense (E, D) in
+    `dtype` (float32 by default — the device-side precision) with rows filled
+    in place, so loading the reference's thousands-of-entities artifacts
+    stays at one matrix allocation rather than E temporary float64 rows.
+    """
     task = _load_metadata_task(models_dir)
     wanted = set(coordinates_to_load) if coordinates_to_load else None
     coords: Dict[str, object] = {}
@@ -239,23 +250,34 @@ def load_game_model(
                 lines = f.read().split()
             re_type, shard = lines[0], lines[1]
             imap = index_maps[shard]
-            entity_ids: List[str] = []
-            rows: List[np.ndarray] = []
-            var_rows: List[Optional[np.ndarray]] = []
+            # Stream part files: decode one part's records, fill its dense
+            # block, release — only one part's dicts are live at a time.
+            entity_ids = []
+            mean_blocks: List[np.ndarray] = []
+            var_blocks: List[Optional[np.ndarray]] = []
             for part in sorted(glob.glob(os.path.join(cdir, COEFFICIENTS, "*.avro"))):
                 _, recs = avro_io.read_container(part)
-                for rec in recs:
+                block = np.zeros((len(recs), imap.size), dtype)
+                vblock = (
+                    np.zeros_like(block)
+                    if recs and all(r.get("variances") for r in recs)
+                    else None
+                )
+                for i, rec in enumerate(recs):
                     entity_ids.append(rec["modelId"])
-                    rows.append(_ntv_to_coeffs(rec["means"], imap))
-                    var_rows.append(
-                        _ntv_to_coeffs(rec["variances"], imap)
-                        if rec.get("variances")
-                        else None
-                    )
-            means = np.stack(rows) if rows else np.zeros((0, imap.size))
+                    _ntv_to_coeffs(rec["means"], imap, out=block[i])
+                    if vblock is not None:
+                        _ntv_to_coeffs(rec["variances"], imap, out=vblock[i])
+                mean_blocks.append(block)
+                var_blocks.append(vblock)
+            means = (
+                np.concatenate(mean_blocks)
+                if mean_blocks
+                else np.zeros((0, imap.size), dtype)
+            )
             variances = (
-                np.stack([v for v in var_rows])
-                if var_rows and all(v is not None for v in var_rows)
+                np.concatenate(var_blocks)
+                if var_blocks and all(v is not None for v in var_blocks)
                 else None
             )
             coords[cid] = RandomEffectArtifact(re_type, shard, entity_ids, means, variances)

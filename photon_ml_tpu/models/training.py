@@ -85,7 +85,15 @@ def train_glm_sweep(
             variances = compute_variances(
                 loss, data, config.with_reg_weight(float(rw)), res.coefficients, norm
             )
-        models[rw] = create_model(task, Coefficients(res.coefficients, variances))
+        # The optimizer works in transformed space (normalization folded into
+        # effective coefficients, ValueAndGradientAggregator.scala:36-49); the
+        # returned models live in ORIGINAL space so scoring/persistence sees
+        # raw features — the legacy driver's modelToOriginalSpace step
+        # (Driver.scala train + NormalizationContext.scala:73-90).
+        means = res.coefficients
+        if norm is not None:
+            means, variances = norm.coefficients_to_original_space(means, variances)
+        models[rw] = create_model(task, Coefficients(means, variances))
         if warm_start:
             w = res.coefficients
     return SweepResult(models=models, results=results)

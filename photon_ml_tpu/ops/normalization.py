@@ -71,6 +71,20 @@ class NormalizationContext(NamedTuple):
             w_orig = w_orig.at[self.intercept_index].add(-jnp.dot(self.shifts, w_orig))
         return w_orig
 
+    def coefficients_to_original_space(self, means, variances=None):
+        """(means, variances) trained in normalized space -> original space.
+
+        Shared by the legacy sweep and the GAME model bridge so the variance
+        convention (var scales by factor^2 under w -> w * factor) lives in
+        exactly one place.
+        """
+        if self.is_identity:
+            return means, variances
+        means = self.model_to_original_space(means)
+        if variances is not None and self.factors is not None:
+            variances = variances * jnp.square(self.factors)
+        return means, variances
+
     def model_to_transformed_space(self, w: Array) -> Array:
         """Inverse of `model_to_original_space` (reference :91-107)."""
         if self.is_identity:

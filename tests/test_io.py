@@ -176,7 +176,12 @@ def test_model_store_roundtrip(tmp_path, rng):
     assert lre.random_effect_type == "userId"
     assert sorted(lre.entity_ids) == sorted(ents)
     order = [lre.entity_ids.index(e) for e in ents]
-    np.testing.assert_allclose(lre.means[order], re.means, rtol=1e-12)
+    # RE matrices load in device precision (float32) by default.
+    np.testing.assert_allclose(lre.means[order], re.means, rtol=1e-6)
+    loaded64 = load_game_model(out, {"globalShard": imap}, dtype=np.float64)
+    np.testing.assert_allclose(
+        loaded64.coordinates["per-user"].means[order], re.means, rtol=1e-12
+    )
 
 
 def test_model_store_sparsity_threshold(tmp_path):
