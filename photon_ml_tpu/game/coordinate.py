@@ -89,12 +89,17 @@ class FixedEffectCoordinate:
         # array — its dtype/shape/sharding are all visible, unlike inside the
         # jit trace where should_use would have to guess. The decision is
         # closed over by the jitted train_fn (ragged tails are masked inside
-        # the kernel, so no alignment precondition).
+        # the kernel, so no alignment precondition). Batch-sharded data gets
+        # a ShardedDispatch: per-device fused kernel + psum under shard_map.
         from photon_ml_tpu.ops import pallas_glm
 
         feats = dataset.shards[config_data_shard]
-        self._use_pallas = not isinstance(feats, SparseFeatures) and pallas_glm.should_use(
-            feats, jnp.zeros((feats.shape[-1],), feats.dtype)
+        self._use_pallas = (
+            False
+            if isinstance(feats, SparseFeatures)
+            else pallas_glm.dispatch(
+                feats, jnp.zeros((feats.shape[-1],), feats.dtype)
+            )
         )
         self._build_jits()
 

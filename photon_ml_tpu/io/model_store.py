@@ -257,6 +257,8 @@ def load_game_model(
             var_blocks: List[Optional[np.ndarray]] = []
             for part in sorted(glob.glob(os.path.join(cdir, COEFFICIENTS, "*.avro"))):
                 _, recs = avro_io.read_container(part)
+                if not recs:
+                    continue  # empty part files (partitions > entities) are inert
                 block = np.zeros((len(recs), imap.size), dtype)
                 vblock = (
                     np.zeros_like(block)

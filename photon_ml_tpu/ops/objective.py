@@ -89,7 +89,7 @@ def value_and_gradient(
     data: LabeledData,
     norm: Optional[NormalizationContext] = None,
     l2: float | Array = 0.0,
-    use_pallas: Optional[bool] = None,
+    use_pallas: Optional[pallas_glm.DispatchMode] = None,
 ) -> Tuple[Array, Array]:
     """One fused pass: margins computed once, shared by value and gradient.
 
@@ -108,7 +108,13 @@ def value_and_gradient(
     w_eff, shift = _eff(w, norm)
     if use_pallas is None:
         use_pallas = pallas_glm.should_use(data.features, w_eff)
-    if use_pallas:
+    if isinstance(use_pallas, pallas_glm.ShardedDispatch):
+        val, g, sum_u = pallas_glm.sharded_value_gradient_sums(
+            loss, w_eff, shift, data.features, data.labels, data.offsets,
+            data.weights, mesh=use_pallas.mesh, axis=use_pallas.axis,
+            interpret=pallas_glm.FORCE_INTERPRET,
+        )
+    elif use_pallas:
         val, g, sum_u = pallas_glm.value_gradient_sums(
             loss, w_eff, shift, data.features, data.labels, data.offsets,
             data.weights, interpret=pallas_glm.FORCE_INTERPRET,
@@ -146,7 +152,7 @@ def hessian_vector(
     data: LabeledData,
     norm: Optional[NormalizationContext] = None,
     l2: float | Array = 0.0,
-    use_pallas: Optional[bool] = None,
+    use_pallas: Optional[pallas_glm.DispatchMode] = None,
 ) -> Array:
     """Gauss-Newton/Hessian product H(w) v (HessianVectorAggregator.scala:23-142).
 
@@ -161,7 +167,13 @@ def hessian_vector(
     v_eff, v_shift = _eff(v, norm)
     if use_pallas is None:
         use_pallas = pallas_glm.should_use(data.features, w_eff)
-    if use_pallas:
+    if isinstance(use_pallas, pallas_glm.ShardedDispatch):
+        hv, sum_r = pallas_glm.sharded_hessian_vector_sums(
+            loss, w_eff, shift, v_eff, v_shift, data.features, data.labels,
+            data.offsets, data.weights, mesh=use_pallas.mesh,
+            axis=use_pallas.axis, interpret=pallas_glm.FORCE_INTERPRET,
+        )
+    elif use_pallas:
         hv, sum_r = pallas_glm.hessian_vector_sums(
             loss, w_eff, shift, v_eff, v_shift, data.features, data.labels,
             data.offsets, data.weights, interpret=pallas_glm.FORCE_INTERPRET,

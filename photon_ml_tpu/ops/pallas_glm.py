@@ -49,13 +49,15 @@ used otherwise.
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 import os
-from typing import Tuple
+from typing import Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 try:  # TPU memory spaces; absent on some CPU-only installs.
     from jax.experimental.pallas import tpu as pltpu
@@ -179,18 +181,52 @@ def kernels_healthy() -> bool:
     return _HEALTHY
 
 
-def should_use(features, w: Array) -> bool:
-    """True when the fused kernels should replace the XLA objective path.
+@dataclasses.dataclass(frozen=True)
+class ShardedDispatch:
+    """Fused-kernel dispatch decision for batch-sharded data: run the
+    single-device kernel per shard under shard_map and psum the raw sums
+    over `axis` — the fused equivalent of the reference's treeAggregate
+    combiner tree (ValueAndGradientAggregator.scala:248-252), with the
+    per-partition hot loop on the MXU and the combine on ICI."""
 
-    Beyond size/dtype gating, the kernels are single-device programs: under
-    GSPMD a pallas_call is an opaque custom call, so a sharded X would be
-    all-gathered onto every device — the opposite of the intended win.
-    Concrete arrays are accepted only when resident on one device; inside a
-    jit trace (tracers carry no committed sharding) the path is taken only
-    when a single device is visible, so single-chip runs fuse and multi-chip
-    meshes keep the XLA objective whose collectives GSPMD lays out properly.
-    Multi-chip fusion would mean invoking the kernel per-shard under
-    shard_map with a psum of the raw sums — future work.
+    mesh: Mesh
+    axis: str
+
+
+DispatchMode = Union[bool, ShardedDispatch]
+
+
+def _static_checks(features, w, n_rows: int) -> bool:
+    """Shape/dtype/VMEM gating shared by all dispatch modes. `n_rows` is the
+    PER-DEVICE row count the kernel will actually see."""
+    if not isinstance(features, jax.Array) and not hasattr(features, "shape"):
+        return False
+    if getattr(features, "ndim", 0) != 2 or w.ndim != 1:
+        return False
+    d = features.shape[1]
+    if n_rows < _MIN_ROWS or d < _MIN_COLS:
+        return False
+    if features.dtype not in (jnp.float32, jnp.bfloat16):
+        return False
+    if _TILE_N * d * features.dtype.itemsize > _TILE_BYTES_LIMIT:
+        return False
+    return True
+
+
+def dispatch(features, w: Array) -> DispatchMode:
+    """Decide how (whether) the fused kernels replace the XLA objective path.
+
+    Returns False (XLA), True (single-device fused kernel) or a
+    `ShardedDispatch` (per-shard fused kernel + psum under shard_map).
+
+    A pallas_call is an opaque custom call to GSPMD: invoked directly on a
+    sharded X it would all-gather the batch onto every device — the opposite
+    of the intended win. So multi-device engagement requires a *concrete*
+    array whose committed sharding this function can read: a NamedSharding
+    over a 1-D mesh, batch axis sharded, feature axis replicated. Inside a
+    jit trace (tracers carry no committed sharding) only a single visible
+    device engages the kernel; multi-chip callers decide at coordinate
+    construction time on the concrete array (FixedEffectCoordinate).
     """
     if not _ENABLED:
         return False
@@ -198,31 +234,52 @@ def should_use(features, w: Array) -> bool:
         # Interpret mode is for tests; never auto-engage it in production
         # CPU runs (it is slower than XLA).
         return False
-    if not isinstance(features, jax.Array) and not hasattr(features, "shape"):
+    if getattr(features, "ndim", 0) != 2:
         return False
-    if getattr(features, "ndim", 0) != 2 or w.ndim != 1:
-        return False
-    n, d = features.shape
-    if n < _MIN_ROWS or d < _MIN_COLS:
-        return False
-    if features.dtype not in (jnp.float32, jnp.bfloat16):
-        return False
-    if _TILE_N * d * features.dtype.itemsize > _TILE_BYTES_LIMIT:
-        return False
-    try:
-        n_devices = len(features.sharding.device_set)
-    except Exception:
-        n_devices = None  # tracer or abstract sharding: unknown placement
-    if n_devices is not None:
-        if n_devices > 1:
+    n = features.shape[0]
+
+    sharding = getattr(features, "sharding", None)
+    n_devices: Optional[int] = None
+    if isinstance(features, jax.Array):
+        try:
+            n_devices = len(sharding.device_set)
+        except Exception:
+            n_devices = None  # tracer or abstract sharding: unknown placement
+
+    if n_devices is not None and n_devices > 1:
+        # Multi-device: engage only for the canonical batch-sharded layout.
+        if not isinstance(sharding, NamedSharding):
             return False
-    elif jax.device_count() > 1:
+        mesh, spec = sharding.mesh, sharding.spec
+        if len(mesh.axis_names) != 1:
+            return False
+        axis = mesh.axis_names[0]
+        if not spec or spec[0] != axis:
+            return False
+        if len(spec) > 1 and spec[1] is not None:
+            return False
+        per_device_rows = n // mesh.devices.size
+        if not _static_checks(features, w, per_device_rows):
+            return False
+        if not kernels_healthy():
+            return False
+        return ShardedDispatch(mesh, axis)
+
+    if n_devices is None and jax.device_count() > 1:
         # Sharding unknown inside a trace; be conservative on multi-device
         # hosts — the XLA path is the one GSPMD partitions correctly.
+        return False
+    if not _static_checks(features, w, n):
         return False
     # Last (it compiles a probe once per process): the kernels must actually
     # work on this backend.
     return kernels_healthy()
+
+
+def should_use(features, w: Array) -> bool:
+    """Boolean view of `dispatch` for callers that cannot carry a mesh
+    (trace-time auto dispatch in ops/objective.py)."""
+    return dispatch(features, w) is True
 
 
 def _row_mask(n: int) -> Array:
@@ -428,3 +485,81 @@ def hessian_vector_sums(
         jnp.asarray(v_shift, jnp.float32).reshape(1, 1),
     )
     return hv[:, 0], stats[0, 0]
+
+
+# ---------------------------------------------------------------- distributed
+
+
+def sharded_value_gradient_sums(
+    loss: PointwiseLoss,
+    w_eff: Array,
+    shift: Array,
+    features: Array,
+    labels: Array,
+    offsets: Array,
+    weights: Array,
+    *,
+    mesh: Mesh,
+    axis: str,
+    interpret: bool = False,
+) -> Tuple[Array, Array, Array]:
+    """Distributed fused objective: per-device fused kernel + psum of the
+    raw sums (value, grad_raw, sum_u) over `axis`.
+
+    This is the TPU shape of ValueAndGradientAggregator's treeAggregate
+    (:248-252): seqOp = the Pallas row-tile loop on each device's shard,
+    combOp = one ICI all-reduce. Raw-sum semantics are identical to the
+    single-device kernel, so normalization/L2 post-processing in
+    ops/objective.py is unchanged.
+    """
+
+    def per_device(w, s, X, y, off, wt):
+        val, g, sum_u = value_gradient_sums(
+            loss, w, s, X, y, off, wt, interpret=interpret
+        )
+        stats = jax.lax.psum(jnp.stack([val, sum_u]), axis)
+        return stats[0], jax.lax.psum(g, axis), stats[1]
+
+    fn = jax.shard_map(
+        per_device,
+        mesh=mesh,
+        in_specs=(P(), P(), P(axis, None), P(axis), P(axis), P(axis)),
+        out_specs=(P(), P(), P()),
+        check_vma=False,
+    )
+    return fn(w_eff, shift, features, labels, offsets, weights)
+
+
+def sharded_hessian_vector_sums(
+    loss: PointwiseLoss,
+    w_eff: Array,
+    shift: Array,
+    v_eff: Array,
+    v_shift: Array,
+    features: Array,
+    labels: Array,
+    offsets: Array,
+    weights: Array,
+    *,
+    mesh: Mesh,
+    axis: str,
+    interpret: bool = False,
+) -> Tuple[Array, Array]:
+    """Distributed fused Hessian-vector product: per-device fused kernel +
+    psum of (hv_raw, sum_r) — HessianVectorAggregator.scala:136-142's
+    treeAggregate as one ICI all-reduce."""
+
+    def per_device(w, s, v, vs, X, y, off, wt):
+        hv, sum_r = hessian_vector_sums(
+            loss, w, s, v, vs, X, y, off, wt, interpret=interpret
+        )
+        return jax.lax.psum(hv, axis), jax.lax.psum(sum_r, axis)
+
+    fn = jax.shard_map(
+        per_device,
+        mesh=mesh,
+        in_specs=(P(), P(), P(), P(), P(axis, None), P(axis), P(axis), P(axis)),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    return fn(w_eff, shift, v_eff, v_shift, features, labels, offsets, weights)

@@ -22,6 +22,7 @@ import jax.numpy as jnp
 from photon_ml_tpu.data.containers import LabeledData
 from photon_ml_tpu.data.sampling import down_sample
 from photon_ml_tpu.ops import objective
+from photon_ml_tpu.ops.pallas_glm import DispatchMode
 from photon_ml_tpu.ops.losses import PointwiseLoss
 from photon_ml_tpu.ops.normalization import NormalizationContext
 from photon_ml_tpu.optimize.common import OptResult
@@ -39,7 +40,7 @@ def solve(
     config: CoordinateOptimizationConfig,
     w0: Array,
     norm: Optional[NormalizationContext] = None,
-    use_pallas: Optional[bool] = None,
+    use_pallas: Optional[DispatchMode] = None,
 ) -> OptResult:
     """Run the configured optimizer on one GLM problem.
 
@@ -98,7 +99,7 @@ def solve_with_sampling(
     *,
     task: TaskType,
     key: Optional[jax.Array] = None,
-    use_pallas: Optional[bool] = None,
+    use_pallas: Optional[DispatchMode] = None,
 ) -> OptResult:
     """DistributedOptimizationProblem.runWithSampling (:144-170): apply the
     coordinate's DownSampler before optimizing when rate < 1."""

@@ -322,3 +322,34 @@ def test_reader_rejects_intercept_shard_with_interceptless_index_map(tmp_path):
             index_maps={"g": imap},
             response_field="label",
         )
+
+
+def test_model_store_empty_part_file_keeps_variances(tmp_path, rng):
+    """Spark writes zero-record part files when partitions > entities; they
+    must not drop the coordinate's variances (ModelProcessingUtils layout)."""
+    from photon_ml_tpu.io import avro as avro_io
+    from photon_ml_tpu.io import schemas
+
+    d = 4
+    imap = _index_map(d)
+    re = RandomEffectArtifact(
+        "userId",
+        "globalShard",
+        ["u0", "u1"],
+        rng.normal(size=(2, d)),
+        np.abs(rng.normal(size=(2, d))),
+    )
+    art = GameModelArtifact(
+        task=TaskType.LOGISTIC_REGRESSION, coordinates={"per-user": re}
+    )
+    out = str(tmp_path / "model")
+    save_game_model(out, art, {"globalShard": imap})
+    avro_io.write_container(
+        os.path.join(out, "random-effect", "per-user", "coefficients", "part-00001.avro"),
+        schemas.BAYESIAN_LINEAR_MODEL,
+        [],
+    )
+    loaded = load_game_model(out, {"globalShard": imap})
+    lre = loaded.coordinates["per-user"]
+    assert len(lre.entity_ids) == 2
+    assert lre.variances is not None
