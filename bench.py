@@ -1,21 +1,32 @@
-"""Benchmark: GLMix (fixed + per-entity random effects) training throughput.
+"""Benchmark: GLMix training + the framework's main code paths, honestly.
 
 The reference publishes no benchmark numbers (BASELINE.md: no benchmarks/
-dir; the README's claim is qualitative scale). The measurable protocol from
-BASELINE.json is self-measured GLMix training wall-clock. This bench trains
-one full coordinate-descent pass of a synthetic GLMix logistic problem sized
-for a single chip:
+dir). The protocol here is therefore measured, not estimated:
 
-    1,048,576 samples x 512 dense fixed-effect features (MXU-heavy DP solve,
-    40 L-BFGS iterations) + 8,192 entities x up-to-128 rows x 16 features of
-    random effects (vmapped entity solves), one CD pass.
+Primary metric (stable across rounds): samples/s through ONE full
+coordinate-descent pass of a synthetic GLMix logistic problem —
+1,048,576 samples x 512 dense fixed-effect features + 8,192 entities x 16
+random-effect features (vmapped entity solves).
 
-Metric: samples-solved-per-second through the full pass
-(samples * optimizer-iterations / wall-clock would flatter; we report plain
-samples/s of the pass). `vs_baseline` is wall-clock speedup vs the pinned
-reference point BASELINE_WALL_S — an estimated Spark local[*] wall-clock for
-the same problem (the reference's own integ-test execution mode), recorded
-once here so rounds are comparable.
+`vs_baseline` is MEASURED on this host: the reference's hot loop is the
+per-datum ValueAndGradientAggregator accumulation reduced by treeAggregate
+(ValueAndGradientAggregator.scala:137-161, 248-252), whose single-process
+equivalent is a float64 BLAS value+gradient pass (Breeze delegates to
+netlib). The surrogate runs that pass in numpy float64 on a measured slice
+of the same problem, scales linearly in rows (the pass is O(n*d)), and
+multiplies by the same number of objective evaluations the accelerator run
+executed. `baseline_basis` documents this; no constant is invented.
+
+Per-variant diagnostics (the keys the r01 bench could not show):
+  * iterations / fn_evals actually executed (from the optimizer carry),
+  * kernel_engaged: whether the fused Pallas objective ran (and in which
+    dispatch mode),
+  * bytes_streamed + achieved GB/s: fn_evals x bytes-per-pass, where a pass
+    is one X read for the fused kernel and two (matvec + rmatvec) for the
+    XLA path.
+
+Variants: dense LBFGS, dense TRON (Hessian-vector path), sparse-ELL LBFGS,
+and scoring throughput — the four main compute paths.
 
 Prints exactly one JSON line. Runs the measurement in a subprocess with a
 watchdog so a wedged accelerator tunnel degrades to the CPU backend instead
@@ -30,13 +41,50 @@ import subprocess
 import sys
 import time
 
-# Estimated wall-clock for the same GLMix pass on the reference's Spark
-# local[*] path (its integ-test mode, SparkTestUtils.scala): O(10 min) for
-# 1M x 512 dense logistic + 8k entity subproblems based on the reference's
-# per-iteration treeAggregate structure. Fixed constant across rounds.
-BASELINE_WALL_S = 600.0
-
 _CHILD = "--run-child"
+
+
+def _measure_baseline_surrogate(n: int, d: int, fn_evals: int) -> dict:
+    """Measured single-process float64 BLAS value+gradient passes — the
+    reference's per-partition hot loop without Spark overhead (a strict
+    lower bound on the reference's wall-clock for the same work)."""
+    import numpy as np
+
+    slice_n = min(n, 131072)
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(slice_n, d))  # float64, as Breeze
+    y = (rng.uniform(size=slice_n) > 0.5).astype(np.float64)
+    w = rng.normal(size=d) * 0.1
+
+    def vg_pass():
+        z = X @ w
+        val = np.sum(np.log1p(np.exp(-np.abs(z))) + np.maximum(z, 0) - y * z)
+        u = 1.0 / (1.0 + np.exp(-z)) - y
+        g = u @ X
+        return val, g
+
+    vg_pass()  # warm BLAS
+    reps = 3
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        vg_pass()
+    per_pass = (time.perf_counter() - t0) / reps
+    est_wall = per_pass * (n / slice_n) * fn_evals
+    return {
+        "surrogate_slice_rows": slice_n,
+        "surrogate_pass_s": round(per_pass, 4),
+        "estimated_wall_s": round(est_wall, 3),
+    }
+
+
+def _solve_stats(res) -> dict:
+    import numpy as np
+
+    return {
+        "iterations": int(np.asarray(res.iterations)),
+        "fn_evals": int(np.asarray(res.fn_evals)),
+        "converged_reason": int(np.asarray(res.reason)),
+    }
 
 
 def _child() -> None:
@@ -44,6 +92,7 @@ def _child() -> None:
     import jax
     import jax.numpy as jnp
 
+    from photon_ml_tpu.data.containers import LabeledData, SparseFeatures
     from photon_ml_tpu.data.game_dataset import (
         GameDataset,
         RandomEffectDataConfig,
@@ -54,29 +103,30 @@ def _child() -> None:
         RandomEffectCoordinate,
     )
     from photon_ml_tpu.game.coordinate_descent import run_coordinate_descent
+    from photon_ml_tpu.ops import pallas_glm
     from photon_ml_tpu.optimize.config import (
         L2,
         CoordinateOptimizationConfig,
         OptimizerConfig,
     )
-    from photon_ml_tpu.types import TaskType
+    from photon_ml_tpu.types import OptimizerType, TaskType
 
     platform = jax.devices()[0].platform
     scale = float(os.environ.get("BENCH_SCALE", "1.0"))
-    n = int(1 << 20 * 1)
-    n = int(n * scale)
+    n = int((1 << 20) * scale)
     d_fixed, d_re = 512, 16
     n_entities = max(64, int(8192 * scale))
+    f32 = jnp.float32
 
     key = jax.random.PRNGKey(0)
     kx, ke, kw, ku, kl = jax.random.split(key, 5)
-    Xf = jax.random.normal(kx, (n, d_fixed), jnp.float32)
-    Xe = jax.random.normal(ke, (n, d_re), jnp.float32)
+    Xf = jax.random.normal(kx, (n, d_fixed), f32)
+    Xe = jax.random.normal(ke, (n, d_re), f32)
     entity = np.asarray(jax.random.randint(kl, (n,), 0, n_entities))
     w = jax.random.normal(kw, (d_fixed,)) * 0.1
     u = jax.random.normal(ku, (n_entities, d_re)) * 0.5
     margin = Xf @ w + jnp.einsum("nd,nd->n", Xe, u[jnp.asarray(entity)])
-    y = (jax.random.uniform(key, (n,)) < jax.nn.sigmoid(margin)).astype(jnp.float32)
+    y = (jax.random.uniform(key, (n,)) < jax.nn.sigmoid(margin)).astype(f32)
 
     ds = GameDataset.build(
         {"global": Xf, "per_entity": Xe}, y, id_tags={"entityId": entity}
@@ -100,29 +150,123 @@ def _child() -> None:
     fixed = FixedEffectCoordinate(ds, "global", cfg_f, TaskType.LOGISTIC_REGRESSION)
     rand = RandomEffectCoordinate(ds, red, cfg_r, TaskType.LOGISTIC_REGRESSION)
     coords = {"fixed": fixed, "per-entity": rand}
+    variants = {}
 
-    # Warm-up: compile everything once (compile time excluded, as the
-    # reference's JIT-warm JVM would be).
-    run_coordinate_descent(coords, 1)
+    def timed(fn):
+        out = fn()  # warm-up/compile
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        out = fn()
+        jax.block_until_ready(out)
+        return time.perf_counter() - t0, out
 
-    t0 = time.perf_counter()
-    result = run_coordinate_descent(coords, 1)
-    jax.block_until_ready(result.model["fixed"].coefficients.means)
-    jax.block_until_ready(result.model["per-entity"].coefficients_matrix)
-    wall = time.perf_counter() - t0
+    # ---- primary: full GLMix coordinate-descent pass ----------------------
+    glmix_wall, _ = timed(lambda: run_coordinate_descent(coords, 1).model[
+        "fixed"
+    ].coefficients.means)
+
+    # ---- dense fixed-effect LBFGS (the aggregator hot loop) ---------------
+    kernel_mode = fixed._use_pallas
+    dense_wall, res_lbfgs = timed(lambda: fixed.train(ds.offsets)[1])
+    stats = _solve_stats(res_lbfgs)
+    passes_per_eval = 1 if kernel_mode is not False else 2
+    dense_bytes = stats["fn_evals"] * n * d_fixed * 4 * passes_per_eval
+    variants["dense_lbfgs"] = dict(
+        stats,
+        wall_s=round(dense_wall, 3),
+        kernel_engaged=kernel_mode is not False,
+        dispatch=repr(kernel_mode),
+        bytes_streamed=dense_bytes,
+        achieved_gb_per_s=round(dense_bytes / dense_wall / 1e9, 1),
+    )
+
+    # ---- dense TRON (Hessian-vector path) ---------------------------------
+    cfg_t = CoordinateOptimizationConfig(
+        optimizer=OptimizerConfig(OptimizerType.TRON, 15, 1e-6),
+        regularization=L2,
+        reg_weight=1.0,
+    )
+    tron_coord = FixedEffectCoordinate(ds, "global", cfg_t, TaskType.LOGISTIC_REGRESSION)
+    tron_wall, res_tron = timed(lambda: tron_coord.train(ds.offsets)[1])
+    tstats = _solve_stats(res_tron)
+    tron_bytes = tstats["fn_evals"] * n * d_fixed * 4 * passes_per_eval
+    variants["dense_tron"] = dict(
+        tstats,
+        wall_s=round(tron_wall, 3),
+        kernel_engaged=tron_coord._use_pallas is not False,
+        bytes_streamed=tron_bytes,
+        achieved_gb_per_s=round(tron_bytes / tron_wall / 1e9, 1),
+    )
+
+    # ---- sparse-ELL LBFGS (the wide-sparse ingest shape) ------------------
+    k_nnz, d_sparse = 64, 16384
+    ks1, ks2 = jax.random.split(kx)
+    sp_idx = jax.random.randint(ks1, (n, k_nnz), 0, d_sparse, jnp.int32)
+    sp_val = jax.random.normal(ks2, (n, k_nnz), f32)
+    sp = SparseFeatures(sp_idx, sp_val, d_sparse)
+    ds_sp = GameDataset.build({"s": sp}, y)
+    sp_coord = FixedEffectCoordinate(
+        ds_sp,
+        "s",
+        CoordinateOptimizationConfig(
+            optimizer=OptimizerConfig(max_iterations=20, tolerance=1e-7),
+            regularization=L2,
+            reg_weight=1.0,
+        ),
+        TaskType.LOGISTIC_REGRESSION,
+    )
+    sp_wall, res_sp = timed(lambda: sp_coord.train(ds_sp.offsets)[1])
+    sstats = _solve_stats(res_sp)
+    # ELL pass streams indices (4B) + values (4B); XLA path reads twice
+    # (gather-matvec + scatter-rmatvec).
+    sp_bytes = sstats["fn_evals"] * n * k_nnz * 8 * 2
+    variants["sparse_ell_lbfgs"] = dict(
+        sstats,
+        nnz_per_row=k_nnz,
+        dim=d_sparse,
+        wall_s=round(sp_wall, 3),
+        kernel_engaged=False,
+        bytes_streamed=sp_bytes,
+        achieved_gb_per_s=round(sp_bytes / sp_wall / 1e9, 1),
+    )
+
+    # ---- scoring throughput (GameTransformer margins + link) --------------
+    @jax.jit
+    def score(wv):
+        return jax.nn.sigmoid(Xf @ wv + ds.offsets)
+
+    score_wall, _ = timed(lambda: score(res_lbfgs.coefficients))
+    score_bytes = n * d_fixed * 4
+    variants["scoring"] = dict(
+        wall_s=round(score_wall, 4),
+        samples_per_s=round(n / score_wall, 1),
+        achieved_gb_per_s=round(score_bytes / score_wall / 1e9, 1),
+    )
+
+    # ---- measured baseline surrogate --------------------------------------
+    surrogate = _measure_baseline_surrogate(n, d_fixed, stats["fn_evals"])
+    vs_baseline = round(surrogate["estimated_wall_s"] / dense_wall, 2)
 
     print(
         json.dumps(
             dict(
                 metric="glmix_train_samples_per_s",
-                value=round(n / wall, 1),
+                value=round(n / glmix_wall, 1),
                 unit="samples/s",
-                vs_baseline=round(BASELINE_WALL_S * scale / wall, 2),
-                wall_s=round(wall, 3),
+                vs_baseline=vs_baseline,
+                baseline_basis=(
+                    "measured f64 numpy-BLAS value+gradient passes (the "
+                    "reference aggregator hot loop without Spark overhead) "
+                    "on this host, scaled linearly in rows x same fn_evals; "
+                    "ratio is for the dense_lbfgs variant"
+                ),
+                baseline=surrogate,
+                wall_s=round(glmix_wall, 3),
                 platform=platform,
                 n_samples=n,
                 d_fixed=d_fixed,
                 n_entities=n_entities,
+                variants=variants,
             )
         )
     )

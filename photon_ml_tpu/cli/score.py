@@ -40,6 +40,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--root-output-directory", required=True)
     p.add_argument("--feature-shard-configurations", required=True, nargs="+",
                    metavar="DSL")
+    p.add_argument("--input-data-date-range", default=None,
+                   help="Inclusive 'yyyyMMdd-yyyyMMdd' range of daily input "
+                        "subdirectories (inputDataDateRange, GameDriver.scala:64)")
+    p.add_argument("--input-data-days-range", default=None,
+                   help="Relative '<start>-<end>' days-ago range "
+                        "(inputDataDaysRange, GameDriver.scala:69)")
     p.add_argument("--evaluators", nargs="*", default=[],
                    help="optional validation metrics computed on the scored data")
     p.add_argument("--model-id", default=None,
@@ -78,10 +84,14 @@ def run(args) -> dict:
         if et.is_grouped and et.id_tag not in id_tags:
             id_tags.append(et.id_tag)
 
-    if len(args.input_data_directories) > 1:
-        raise NotImplementedError("multiple input directories")
+    from photon_ml_tpu.utils.date_range import paths_for_date_range, resolve_range
+
+    in_range = resolve_range(
+        getattr(args, "input_data_date_range", None),
+        getattr(args, "input_data_days_range", None),
+    )
     dataset, _ = avro_data.read_game_dataset(
-        args.input_data_directories[0],
+        paths_for_date_range(args.input_data_directories, in_range),
         shard_configs,
         index_maps=index_maps,
         id_tag_fields=id_tags,

@@ -88,6 +88,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "SMOOTHED_HINGE_LOSS_LINEAR_SVM")
     p.add_argument("--input-data-directories", required=True, nargs="+",
                    help="training data dirs/files (Avro TrainingExample records)")
+    p.add_argument("--input-data-date-range", default=None,
+                   help="Inclusive 'yyyyMMdd-yyyyMMdd' range of daily input "
+                        "subdirectories <dir>/yyyy/MM/dd (inputDataDateRange, "
+                        "GameDriver.scala:64)")
+    p.add_argument("--input-data-days-range", default=None,
+                   help="Relative '<start days ago>-<end days ago>' range "
+                        "(inputDataDaysRange, GameDriver.scala:69)")
+    p.add_argument("--validation-data-date-range", default=None,
+                   help="Date range for validation dirs "
+                        "(validationDataDateRange, GameTrainingDriver.scala:91)")
+    p.add_argument("--validation-data-days-range", default=None,
+                   help="Days range for validation dirs "
+                        "(validationDataDaysRange, GameTrainingDriver.scala:96)")
     p.add_argument("--validation-data-directories", nargs="*", default=[],
                    help="validation data dirs/files")
     p.add_argument("--root-output-directory", required=True)
@@ -120,6 +133,10 @@ def build_parser() -> argparse.ArgumentParser:
                    default=VarianceComputationType.NONE)
     p.add_argument("--data-validation", type=lambda s: DataValidationType[s.strip().upper()],
                    default=DataValidationType.VALIDATE_FULL)
+    p.add_argument("--data-summary-directory", default=None,
+                   help="Write per-feature-shard summary statistics as "
+                        "FeatureSummarizationResultAvro under this directory "
+                        "(dataSummaryDirectory, GameTrainingDriver.scala:582)")
     p.add_argument("--output-mode", type=ModelOutputMode.parse, default=ModelOutputMode.BEST)
     p.add_argument("--model-sparsity-threshold", type=float, default=0.0)
     p.add_argument("--hyper-parameter-tuning", type=HyperparameterTuningMode.parse,
@@ -161,10 +178,17 @@ def _read_data(args, coordinate_configs: Dict[str, CoordinateConfiguration]):
             for shard in shard_configs
         }
 
-    if len(args.input_data_directories) > 1:
-        raise NotImplementedError("multiple input directories: concatenate upstream")
+    # Date-range resolution (IOUtils.resolveRange + pathsForDateRange,
+    # GameTrainingDriver.scala:508-509): expand base dirs to daily subdirs.
+    from photon_ml_tpu.utils.date_range import paths_for_date_range, resolve_range
+
+    train_range = resolve_range(
+        getattr(args, "input_data_date_range", None),
+        getattr(args, "input_data_days_range", None),
+    )
+    train_paths = paths_for_date_range(args.input_data_directories, train_range)
     train, index_maps = avro_data.read_game_dataset(
-        args.input_data_directories[0],
+        train_paths,
         shard_configs,
         index_maps=prebuilt,
         id_tag_fields=id_tags,
@@ -172,10 +196,15 @@ def _read_data(args, coordinate_configs: Dict[str, CoordinateConfiguration]):
 
     validation = None
     if args.validation_data_directories:
-        if len(args.validation_data_directories) > 1:
-            raise NotImplementedError("multiple validation directories")
+        val_range = resolve_range(
+            getattr(args, "validation_data_date_range", None),
+            getattr(args, "validation_data_days_range", None),
+        )
+        val_paths = paths_for_date_range(
+            args.validation_data_directories, val_range
+        )
         validation, _ = avro_data.read_game_dataset(
-            args.validation_data_directories[0],
+            val_paths,
             shard_configs,
             index_maps=index_maps,
             id_tag_fields=id_tags,
@@ -309,6 +338,24 @@ def _run_job(
     if event_emitter is not None:
         event_emitter.send(TrainingStartEvent(num_samples=train.num_samples))
 
+    # Feature-shard summarization output (calculateAndSaveFeatureShardStats,
+    # GameTrainingDriver.scala:575-593 -> writeBasicStatistics).
+    if args.data_summary_directory:
+        from photon_ml_tpu.data.stats import summarize
+        from photon_ml_tpu.io.model_store import write_basic_statistics
+
+        with Timed("feature summarization", registry=timings):
+            for shard, imap in index_maps.items():
+                stats = summarize(
+                    train.shards[shard], intercept_index=imap.intercept_index
+                )
+                n_written = write_basic_statistics(
+                    os.path.join(args.data_summary_directory, shard), stats, imap
+                )
+                logger.info(
+                    "feature summary: shard %s -> %d records", shard, n_written
+                )
+
     # Per-coordinate variance type (driver-level param applied to every
     # coordinate, GameTrainingDriver varianceComputationType).
     if args.variance_computation_type != VarianceComputationType.NONE:
@@ -400,6 +447,7 @@ def _run_job(
     best_i, best = select_best_result(all_results)
     specs = estimator.scoring_specs()
     summary: Dict[str, object] = {
+        "num_samples": int(train.num_samples),
         "num_explicit": len(explicit_results),
         "num_tuned": len(tuned_results),
         "best_index": best_i,

@@ -302,22 +302,68 @@ def _pearson_feature_masks(
     features = dataset.shards[config.feature_shard]
     labels_np = np.asarray(dataset.labels)
     if isinstance(features, SparseFeatures):
+        # Moments straight from the ELL (indices, values) entries — absent
+        # entries are zeros, so column sums over nnz entries give the full
+        # statistics without materializing an (n_rows, dim) matrix (the
+        # reference's stableComputePearsonCorrelationScore likewise streams
+        # over sparse entries; densifying at dim ~ 1e5-1e6 would allocate
+        # gigabytes per entity).
         dim = features.dim
         ell_idx = np.asarray(features.indices)
-        ell_val = np.asarray(features.values)
+        ell_val = np.asarray(features.values, np.float64)
 
-        def entity_dense(rows: np.ndarray) -> np.ndarray:
-            X = np.zeros((len(rows), dim), np.float64)
-            for r_i, r in enumerate(rows):
-                X[r_i, ell_idx[r]] += ell_val[r]
-            return X
+        def entity_corr(rows: np.ndarray, y: np.ndarray) -> np.ndarray:
+            n_rows = len(rows)
+            idx = ell_idx[rows].ravel()
+            val = ell_val[rows]
+            # Padding entries are (index 0, value 0): inert in the value sums;
+            # the nnz count masks them out of presence-based terms.
+            present = (val != 0).ravel().astype(np.float64)
+            sum_x = np.bincount(idx, weights=val.ravel(), minlength=dim)
+            cnt = np.bincount(idx, weights=present, minlength=dim)
+            mean_x = sum_x / n_rows
+            # Centered (two-pass) moments, matching the dense branch's
+            # numerics (the reference's stableComputePearsonCorrelationScore
+            # exists precisely to avoid raw-moment cancellation):
+            #   x_ss = sum_nz (x - mx)^2 + (n - nnz) * mx^2
+            #   cov  = sum_nz (x - mx) yc + mx * sum_nz yc
+            # (absent entries contribute (0 - mx) yc, and sum_all yc = 0
+            # folds their total into + mx * sum_nz yc analytically).
+            yc = y - y.mean()
+            y_ss = float(yc @ yc)
+            dev = (val.ravel() - mean_x[idx]) * present
+            x_ss = np.bincount(idx, weights=dev * dev, minlength=dim)
+            x_ss = x_ss + (n_rows - cnt) * mean_x * mean_x
+            ycb = np.broadcast_to(yc[:, None], val.shape).ravel()
+            cov = np.bincount(
+                idx, weights=dev * ycb, minlength=dim
+            ) + mean_x * np.bincount(idx, weights=ycb * present, minlength=dim)
+            denom = np.sqrt(x_ss * y_ss)
+            with np.errstate(invalid="ignore", divide="ignore"):
+                corr = np.where(denom > 0, np.abs(cov) / np.where(denom > 0, denom, 1.0), 0.0)
+            # Intercept: constant-one column (value 1 in every row) scores 1.0.
+            is_ones = (cnt == n_rows) & (sum_x == n_rows)
+            return np.where(is_ones & (x_ss <= 1e-9 * n_rows), 1.0, corr)
 
     else:
         feats_np = np.asarray(features)
         dim = feats_np.shape[-1]
 
-        def entity_dense(rows: np.ndarray) -> np.ndarray:
-            return feats_np[rows].astype(np.float64)
+        def entity_corr(rows: np.ndarray, y: np.ndarray) -> np.ndarray:
+            X = feats_np[rows].astype(np.float64)
+            Xc = X - X.mean(axis=0)
+            yc = y - y.mean()
+            x_std = np.sqrt((Xc * Xc).sum(axis=0))
+            y_std = np.sqrt((yc * yc).sum())
+            denom = x_std * y_std
+            with np.errstate(invalid="ignore", divide="ignore"):
+                corr = np.where(
+                    denom > 0, np.abs(Xc.T @ yc) / np.where(denom > 0, denom, 1.0), 0.0
+                )
+            # Intercept: constant-one column scores 1.0 (always kept).
+            return np.where(
+                (x_std == 0) & (X[0] == 1.0) & (np.ptp(X, axis=0) == 0), 1.0, corr
+            )
 
     masks = np.ones((num_entities + 1, dim), np.float32)
     for rows, row_id in zip(active_lists, kept_entities):
@@ -325,17 +371,7 @@ def _pearson_feature_masks(
         keep = int(np.ceil(ratio * n_rows))
         if keep >= dim:
             continue
-        X = entity_dense(rows)
-        y = labels_np[rows].astype(np.float64)
-        Xc = X - X.mean(axis=0)
-        yc = y - y.mean()
-        x_std = np.sqrt((Xc * Xc).sum(axis=0))
-        y_std = np.sqrt((yc * yc).sum())
-        denom = x_std * y_std
-        with np.errstate(invalid="ignore", divide="ignore"):
-            corr = np.where(denom > 0, np.abs(Xc.T @ yc) / np.where(denom > 0, denom, 1.0), 0.0)
-        # Intercept: constant-one column scores 1.0 (always kept).
-        corr = np.where((x_std == 0) & (X[0] == 1.0) & (np.ptp(X, axis=0) == 0), 1.0, corr)
+        corr = entity_corr(rows, labels_np[rows].astype(np.float64))
         keep_idx = np.argpartition(corr, -keep)[-keep:]
         row_mask = np.zeros(dim, np.float32)
         row_mask[keep_idx] = 1.0

@@ -1,0 +1,96 @@
+"""Legacy single-GLM metric computation (the deprecated driver's validation).
+
+Counterpart of photon-client evaluation/Evaluation.scala:31-196: one scoring
+pass through the model's mean function, then every metric applicable to the
+task — regression facet (MAE / MSE / RMSE / R^2), binary-classifier facet
+(AUC / AUPR / peak F1), per-datum log likelihood for logistic and Poisson,
+and the small-sample-corrected Akaike information criterion. Returned as the
+same name -> value map the reference logs (metric names verbatim).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.data.containers import LabeledData
+from photon_ml_tpu.evaluation import metrics
+from photon_ml_tpu.models.glm import (
+    BinaryClassifier,
+    GeneralizedLinearModel,
+    LogisticRegressionModel,
+    PoissonRegressionModel,
+)
+
+# Metric names, verbatim from Evaluation.scala:34-42.
+MEAN_ABSOLUTE_ERROR = "Mean absolute error"
+MEAN_SQUARE_ERROR = "Mean square error"
+ROOT_MEAN_SQUARE_ERROR = "Root mean square error"
+R_SQUARED = "R-squared"
+AREA_UNDER_PRECISION_RECALL = "Area under precision/recall"
+AREA_UNDER_ROC = "Area under ROC"
+PEAK_F1_SCORE = "Peak F1 score"
+DATA_LOG_LIKELIHOOD = "Per-datum log likelihood"
+AKAIKE_INFORMATION_CRITERION = "Akaike information criterion"
+
+_COEFF_EPS = 1e-9  # effective-parameter threshold (Evaluation.scala:109)
+
+
+def evaluate_glm(model: GeneralizedLinearModel, data: LabeledData) -> Dict[str, float]:
+    """Evaluation.evaluate: score once with the mean function, fan out to
+    every applicable metric."""
+    means = model.compute_mean(data.features, data.offsets)
+    labels = data.labels
+    weights = data.weights
+    out: Dict[str, float] = {}
+
+    is_classifier = isinstance(model, BinaryClassifier)
+    if not is_classifier:
+        # Regression facet (spark RegressionMetrics; Evaluation.scala:67-76).
+        w = weights
+        wsum = jnp.sum(w)
+        err = labels - means
+        out[MEAN_ABSOLUTE_ERROR] = float(jnp.sum(w * jnp.abs(err)) / wsum)
+        mse = float(jnp.sum(w * jnp.square(err)) / wsum)
+        out[MEAN_SQUARE_ERROR] = mse
+        out[ROOT_MEAN_SQUARE_ERROR] = float(np.sqrt(mse))
+        out[R_SQUARED] = float(metrics.r_squared(means, labels, weights))
+    else:
+        # Binary facet (spark BinaryClassificationMetrics; :79-90).
+        out[AREA_UNDER_PRECISION_RECALL] = float(
+            metrics.area_under_pr_curve(means, labels, weights)
+        )
+        out[AREA_UNDER_ROC] = float(
+            metrics.area_under_roc_curve(means, labels, weights)
+        )
+        out[PEAK_F1_SCORE] = float(metrics.peak_f1(means, labels, weights))
+
+    # Per-datum log likelihood (:93-101, 140-180).
+    log_lik = None
+    if isinstance(model, LogisticRegressionModel):
+        p = jnp.clip(means, 1e-12, 1.0 - 1e-12)
+        log_lik = float(
+            jnp.mean(labels * jnp.log(p) + (1.0 - labels) * jnp.log(1.0 - p))
+        )
+    elif isinstance(model, PoissonRegressionModel):
+        from scipy.special import gammaln
+
+        z = jnp.log(jnp.clip(means, 1e-30))  # margin = log(mean) for exp link
+        ll = labels * z - means - jnp.asarray(gammaln(np.asarray(labels) + 1.0))
+        log_lik = float(jnp.mean(ll))
+    if log_lik is not None:
+        out[DATA_LOG_LIKELIHOOD] = log_lik
+        # AICc (Evaluation.scala:104-118).
+        n = int(data.num_rows)
+        k = int(np.sum(np.abs(np.asarray(model.coefficients.means)) > _COEFF_EPS))
+        base = 2.0 * (k - n * log_lik)
+        denom = n - k - 1.0
+        # The reference's JVM double division yields +/-Infinity at n <= k+1;
+        # Python float / 0.0 raises, so guard: the correction is undefined
+        # there and AICc degenerates to infinity.
+        correction = 2.0 * k * (k + 1) / denom if denom > 0 else float("inf")
+        out[AKAIKE_INFORMATION_CRITERION] = base + correction
+
+    return out

@@ -131,3 +131,45 @@ def precision_at_k(
     valid = w[topk] > 0.0
     hits = jnp.sum(jnp.where(valid & (labels[topk] > 0.5), 1.0, 0.0))
     return hits / k
+
+
+def r_squared(scores: Array, labels: Array, weights: Array | None = None) -> Array:
+    """Coefficient of determination R^2 = 1 - SS_res / SS_tot.
+
+    The legacy metric set's regression facet (photon-client
+    evaluation/Evaluation.scala:31; spark RegressionMetrics r2). Weighted
+    form with the weighted label mean; weight 0 masks padding rows.
+    """
+    w = _masked_weights(weights, scores)
+    wsum = jnp.sum(w)
+    y_bar = jnp.sum(w * labels) / wsum
+    ss_res = jnp.sum(w * jnp.square(labels - scores))
+    ss_tot = jnp.sum(w * jnp.square(labels - y_bar))
+    return jnp.where(ss_tot > 0.0, 1.0 - ss_res / ss_tot, 0.0)
+
+
+def peak_f1(scores: Array, labels: Array, weights: Array | None = None) -> Array:
+    """max over score thresholds of the F1 measure
+    (Evaluation.scala PEAK_F1_SCORE: binaryMetrics.fMeasureByThreshold.max).
+
+    Sort by score descending and sweep: at each DISTINCT threshold t the
+    positive set is {score >= t}; F1 = 2PR/(P+R). Tied scores collapse to
+    one threshold (positions inside a tie group are not realizable cuts,
+    mirroring spark's distinct-threshold curve). Weight 0 masks padding.
+    """
+    w = _masked_weights(weights, scores)
+    masked_scores = jnp.where(w > 0.0, scores, -jnp.inf)
+    order = jnp.argsort(-masked_scores)
+    y = labels[order]
+    ww = w[order]
+    s = masked_scores[order]
+    tp = jnp.cumsum(ww * y)
+    fp = jnp.cumsum(ww * (1.0 - y))
+    pos = tp[-1]
+    precision = tp / jnp.maximum(tp + fp, 1e-12)
+    recall = tp / jnp.maximum(pos, 1e-12)
+    f1 = 2.0 * precision * recall / jnp.maximum(precision + recall, 1e-12)
+    # Valid cut points: last index of each tied-score group, real rows only.
+    nxt = jnp.concatenate([s[1:], jnp.full((1,), -jnp.inf, s.dtype)])
+    valid = (s != nxt) & (ww > 0.0)
+    return jnp.max(jnp.where(valid, f1, 0.0))

@@ -242,25 +242,48 @@ class GaussianProcessSearch(RandomSearch):
         observation at the picked point with the current best ("CL-min")
         value. The fantasy collapses predictive variance around prior picks,
         so EI moves elsewhere — a diverse batch without re-running the slice
-        sampler per pick (kernel hyperparameters are reused; only the
-        Cholesky grows).
+        sampler per pick (kernel hyperparameters are reused).
+
+        Pick 0 comes from the UNPADDED model, so it equals the plain EI
+        argmax `propose()` would return. Later picks condition on a fantasy
+        matrix pre-padded to its final (n + k - 1) shape — unused slots hold
+        copies of the latest fantasy pick, which over-collapses variance at
+        an already-picked point (pushing EI further away, i.e. extra batch
+        diversity) instead of distorting the incumbent's basin. The jitted
+        posterior (static-shape cache keyed on (n, d)) therefore compiles
+        once per shape, twice per batch, instead of k times per kernel
+        sample. Picked pool points are masked out so a degenerate EI (~0
+        everywhere) cannot return the same candidate twice.
         """
         model = self._fit()
         if model is None:
             return super().propose_batch(k)
         pool = self._sobol.random(self.candidate_pool_size)
-        x_aug = model.x
-        y_aug = model.y
+        n = model.x.shape[0]
         liar = float(np.min(model.y))  # best value in the internal
         # (standardized, minimization) space
         picks = []
-        for _ in range(k):
-            m = dataclasses.replace(model, x=x_aug, y=y_aug)
-            ei = m.expected_improvement(pool)
+        taken = np.zeros(len(pool), bool)
+        x_aug = y_aug = None
+        for i in range(k):
+            if i == 0:
+                m = model
+            else:
+                m = dataclasses.replace(model, x=x_aug, y=y_aug)
+            ei = np.where(taken, -np.inf, m.expected_improvement(pool))
             j = int(np.argmax(ei))
+            taken[j] = True
             picks.append(pool[j])
-            x_aug = np.vstack([x_aug, pool[j : j + 1]])
-            y_aug = np.append(y_aug, liar)
+            if i == 0 and k > 1:
+                x_aug = np.vstack([model.x, np.repeat(pool[j : j + 1], k - 1, axis=0)])
+                y_aug = np.append(model.y, np.full(k - 1, liar))
+            elif k > 1 and i < k - 1:
+                # Fantasy slot layout: slot n+t is pick t's permanent home
+                # (pick 0 claimed every slot at i == 0); after pick i only
+                # the tail from its own home onward refills, preserving all
+                # earlier picks' fantasies.
+                x_aug = x_aug.copy()
+                x_aug[n + i :] = pool[j]
         return backward_scale(np.stack(picks), self.configs)
 
 

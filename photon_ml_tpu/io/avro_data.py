@@ -18,7 +18,7 @@ readFeaturesFromRecord:274-352); index maps are built per shard on first read
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
@@ -56,25 +56,32 @@ def _record_features(record: dict, bags: Sequence[str]) -> List[Tuple[str, float
 
 
 def read_game_dataset(
-    path: str,
+    path: Union[str, Sequence[str]],
     shard_configs: Mapping[str, FeatureShardConfig],
     *,
     index_maps: Optional[Mapping[str, IndexMap]] = None,
     id_tag_fields: Sequence[str] = (),
     response_field: str = RESPONSE,
 ) -> Tuple[GameDataset, Dict[str, IndexMap]]:
-    """AvroDataReader.readMerged (:85-220) + GameConverters: Avro file/dir ->
-    (GameDataset, per-shard IndexMaps).
+    """AvroDataReader.readMerged (:85-220) + GameConverters: Avro file(s)/
+    dir(s) -> (GameDataset, per-shard IndexMaps).
 
-    `id_tag_fields` names record fields (or metadataMap keys) to capture as
-    id tags (entity/grouping keys). When `index_maps` is given, unseen
-    features are dropped (the scoring path); otherwise maps are built from
-    the data (the training path).
+    `path` may be one path or a sequence of paths (the reference's drivers
+    take N input directories and union them, readMerged's `paths` argument);
+    records concatenate in the given order. `id_tag_fields` names record
+    fields (or metadataMap keys) to capture as id tags (entity/grouping
+    keys). When `index_maps` is given, unseen features are dropped (the
+    scoring path); otherwise maps are built from the data (the training
+    path).
     """
-    _, records = avro_io.read_directory(path)
+    paths = [path] if isinstance(path, str) else list(path)
+    records: List[dict] = []
+    for p in paths:
+        _, recs = avro_io.read_directory(p)
+        records.extend(recs)
     n = len(records)
     if n == 0:
-        raise ValueError(f"no records found under {path}")
+        raise ValueError(f"no records found under {paths}")
 
     # Parse feature bags once per shard; index maps built from the parsed
     # lists when not supplied (feature parsing dominates host ETL cost).

@@ -291,6 +291,47 @@ def load_game_model(
     )
 
 
+def write_basic_statistics(
+    output_dir: str,
+    stats,
+    index_map: IndexMap,
+) -> int:
+    """Feature-shard summary as FeatureSummarizationResultAvro records
+    (ModelProcessingUtils.writeBasicStatistics:516-606): one record per
+    feature (intercept excluded) with the metrics map
+    {max, min, mean, normL1, normL2, numNonzeros, variance}, written to
+    `<output_dir>/part-00000.avro` in feature-id order. `stats` is a
+    data.stats.FeatureDataStatistics. Returns the record count."""
+    cols = {
+        "max": np.asarray(stats.max, np.float64),
+        "min": np.asarray(stats.min, np.float64),
+        "mean": np.asarray(stats.mean, np.float64),
+        "normL1": np.asarray(stats.norm_l1, np.float64),
+        "normL2": np.asarray(stats.norm_l2, np.float64),
+        "numNonzeros": np.asarray(stats.num_nonzeros, np.float64),
+        "variance": np.asarray(stats.variance, np.float64),
+    }
+    skip = stats.intercept_index if stats.intercept_index is not None else -1
+
+    def records():
+        for key, idx in sorted(index_map.items(), key=lambda kv: kv[1]):
+            if idx == skip:
+                continue
+            name, term = _split_key(key)
+            yield {
+                "featureName": name,
+                "featureTerm": term,
+                "metrics": {m: float(col[idx]) for m, col in cols.items()},
+            }
+
+    os.makedirs(output_dir, exist_ok=True)
+    return avro_io.write_container(
+        os.path.join(output_dir, DEFAULT_AVRO_FILE),
+        schemas.FEATURE_SUMMARIZATION,
+        records(),
+    )
+
+
 def _save_metadata(output_dir: str, artifact: GameModelArtifact) -> None:
     """saveGameModelMetadataToHDFS (:489-514) + gameOptConfigToJson (:408-487)."""
     doc = {

@@ -87,6 +87,11 @@ def load_native() -> Optional[ctypes.CDLL]:
     sharing one handle is safe.
     """
     global _CDLL, _CDLL_TRIED
+    # The kill switch is honored per call, not just at first load: flipping
+    # PHOTON_DISABLE_NATIVE at runtime disables an already-loaded handle, and
+    # setting it for the first call does not permanently poison the cache.
+    if os.environ.get(_DISABLE_ENV, ""):
+        return None
     with _LOCK:
         if _CDLL_TRIED:
             return _CDLL

@@ -4,7 +4,8 @@
 // photon-ml leans on the JVM (GLMSuite / LibSVMInputDataFormat parse rows on
 // Spark executors), the TPU build's host ETL is single-process Python, and
 // CPython-level tokenization of `label idx:val ...` lines dominates load
-// time on multi-GB training sets. This parser mmaps the file and tokenizes
+// time on multi-GB training sets. This parser reads the whole file into one
+// heap buffer (simple + NUL-terminable; see parse_body) and tokenizes
 // with raw pointer scans (strtod/strtol); the Python reader
 // (photon_ml_tpu/data/libsvm.py read_libsvm) copies the results straight
 // into numpy buffers and applies the same post-processing (label mapping,

@@ -258,6 +258,11 @@ def dispatch(features, w: Array) -> DispatchMode:
             return False
         if len(spec) > 1 and spec[1] is not None:
             return False
+        if n % mesh.devices.size != 0:
+            # shard_map requires even shards; fall back rather than pass the
+            # gate and crash at call time (shard_game_dataset pads, but a
+            # caller-built array might not).
+            return False
         per_device_rows = n // mesh.devices.size
         if not _static_checks(features, w, per_device_rows):
             return False

@@ -49,6 +49,14 @@ class OptResult(NamedTuple):
     iterations: Array
     reason: Array  # int32 ConvergenceReason code
     loss_history: Array
+    # Full state tracking (reference OptimizationStatesTracker keeps
+    # (coefficients, loss, gradient) per iteration; here the per-iteration
+    # scalars ride along as fixed-size arrays, NaN beyond `iterations`).
+    gradient_norm_history: Optional[Array] = None
+    # Total objective-data passes: value/gradient evaluations plus (TRON)
+    # Hessian-vector products — each streams the design matrix once on the
+    # fused path, so wall-clock / fn_evals is the per-pass cost.
+    fn_evals: Optional[Array] = None
 
     @property
     def converged(self) -> Array:

@@ -79,6 +79,8 @@ class _Carry(NamedTuple):
     init_f: Array
     init_gnorm: Array
     loss_history: Array
+    gnorm_history: Array
+    evals: Array  # cumulative objective evaluations (incl. line search)
 
 
 def _two_loop(pg: Array, S: Array, Y: Array, rho: Array, k: Array) -> Array:
@@ -162,6 +164,8 @@ def _minimize(
 
     history = empty_history(max_iterations, tracking, dtype)
     history = record_loss(history, jnp.zeros((), jnp.int32), f0)
+    gnorm_history = empty_history(max_iterations, tracking, dtype)
+    gnorm_history = record_loss(gnorm_history, jnp.zeros((), jnp.int32), init_gnorm)
 
     init = _Carry(
         x=w0,
@@ -180,6 +184,8 @@ def _minimize(
         init_f=f0,
         init_gnorm=init_gnorm,
         loss_history=history,
+        gnorm_history=gnorm_history,
+        evals=jnp.ones((), jnp.int32),
     )
 
     def cond(c: _Carry) -> Array:
@@ -216,7 +222,7 @@ def _minimize(
             ok = ok & jnp.isfinite(f_new)
             return (jnp.where(ok, t, t * 0.5), f_new, x_new, tries + 1, ok)
 
-        t, f_new, x_new, _, ls_ok = lax.while_loop(
+        t, f_new, x_new, ls_tries, ls_ok = lax.while_loop(
             ls_cond, ls_body, (t0, c.f, c.x, jnp.zeros((), jnp.int32), jnp.zeros((), bool))
         )
 
@@ -269,6 +275,10 @@ def _minimize(
             init_f=c.init_f,
             init_gnorm=c.init_gnorm,
             loss_history=record_loss(c.loss_history, iteration, f_out),
+            gnorm_history=record_loss(
+                c.gnorm_history, iteration, jnp.linalg.norm(pg_out)
+            ),
+            evals=c.evals + ls_tries + 1,
         )
 
     final = lax.while_loop(cond, body, init)
@@ -279,6 +289,8 @@ def _minimize(
         iterations=final.iteration,
         reason=final.reason,
         loss_history=final.loss_history,
+        gradient_norm_history=final.gnorm_history,
+        fn_evals=final.evals,
     )
 
 

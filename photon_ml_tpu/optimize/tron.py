@@ -59,6 +59,7 @@ class _CGCarry(NamedTuple):
     direction: Array
     rtr: Array
     iteration: Array
+    hvps: Array  # exact Hessian-vector products executed
     done: Array
 
 
@@ -81,6 +82,7 @@ def _truncated_cg(
         direction=-gradient,
         rtr=jnp.dot(gradient, gradient),
         iteration=jnp.zeros((), jnp.int32),
+        hvps=jnp.zeros((), jnp.int32),
         done=jnp.zeros((), bool),
     )
 
@@ -123,11 +125,12 @@ def _truncated_cg(
             direction=jnp.where(sel | converged, c.direction, dir_in),
             rtr=jnp.where(sel | converged, c.rtr, rtr_new),
             iteration=jnp.where(converged, c.iteration, c.iteration + 1),
+            hvps=c.hvps + 1,
             done=new_done,
         )
 
     out = lax.while_loop(cond, body, init)
-    return out.iteration, out.step, out.residual
+    return out.hvps, out.step, out.residual
 
 
 class _Carry(NamedTuple):
@@ -141,6 +144,8 @@ class _Carry(NamedTuple):
     init_f: Array
     init_gnorm: Array
     loss_history: Array
+    gnorm_history: Array
+    evals: Array  # value/gradient evaluations + CG Hessian-vector products
 
 
 @partial(
@@ -170,6 +175,8 @@ def minimize_tron(
 
     history = empty_history(max_iterations, tracking, dtype)
     history = record_loss(history, jnp.zeros((), jnp.int32), f0)
+    gnorm_history = empty_history(max_iterations, tracking, dtype)
+    gnorm_history = record_loss(gnorm_history, jnp.zeros((), jnp.int32), init_gnorm)
 
     init = _Carry(
         x=w0,
@@ -185,13 +192,15 @@ def minimize_tron(
         init_f=f0,
         init_gnorm=init_gnorm,
         loss_history=history,
+        gnorm_history=gnorm_history,
+        evals=jnp.ones((), jnp.int32),
     )
 
     def cond(c: _Carry) -> Array:
         return c.reason == ConvergenceReason.NOT_CONVERGED
 
     def body(c: _Carry) -> _Carry:
-        _, step, residual = _truncated_cg(
+        hvp_calls, step, residual = _truncated_cg(
             lambda v: hessian_vector_fn(c.x, v), c.g, c.delta
         )
         gs = jnp.dot(c.g, step)
@@ -263,6 +272,10 @@ def minimize_tron(
             init_f=c.init_f,
             init_gnorm=c.init_gnorm,
             loss_history=record_loss(c.loss_history, iteration, f_new),
+            gnorm_history=record_loss(
+                c.gnorm_history, iteration, jnp.linalg.norm(g_new)
+            ),
+            evals=c.evals + hvp_calls + 1,
         )
 
     final = lax.while_loop(cond, body, init)
@@ -273,4 +286,6 @@ def minimize_tron(
         iterations=final.iteration,
         reason=final.reason,
         loss_history=final.loss_history,
+        gradient_norm_history=final.gnorm_history,
+        fn_evals=final.evals,
     )

@@ -147,7 +147,19 @@ class TestDriversEndToEnd:
             "optimizer=LBFGS,max.iter=30,regularization=L2,reg.weights=1,min.bucket=4",
             "--validation-evaluators", "AUC",
             "--output-mode", "ALL",
+            "--data-summary-directory", str(tmp_path / "summary"),
         ])
+
+        # Feature-shard summary Avro (writeBasicStatistics hook,
+        # GameTrainingDriver.scala:582).
+        from photon_ml_tpu.io import avro as avro_io
+        _, srecs = avro_io.read_container(
+            str(tmp_path / "summary" / "globalShard" / "part-00000.avro")
+        )
+        assert {r["featureName"] for r in srecs} == {"f0", "f1", "f2", "f3"}
+        assert set(srecs[0]["metrics"]) == {
+            "max", "min", "mean", "normL1", "normL2", "numNonzeros", "variance"
+        }
 
         # Model layout (ModelProcessingUtils.scala:77-141).
         best = os.path.join(out, "models", "best")
@@ -259,3 +271,64 @@ def test_features_to_samples_ratio_dsl_roundtrip():
         parse_coordinate_config(rendered).data_config.num_features_to_samples_ratio_upper_bound
         == 0.5
     )
+
+
+class TestDateRangeAndMultiDirInput:
+    def test_train_on_daily_dirs_and_multiple_inputs(self, tmp_path):
+        """N input directories + date-range expansion feed one training run
+        (GameDriver.pathsForDateRange:248; AvroDataReader.readMerged paths)."""
+        # Daily layout: base/2016/01/{01,02}/part.avro + a second plain dir.
+        base = tmp_path / "daily"
+        d1 = base / "2016" / "01" / "01"
+        d2 = base / "2016" / "01" / "02"
+        d1.mkdir(parents=True)
+        d2.mkdir(parents=True)
+        extra = tmp_path / "extra"
+        extra.mkdir()
+        _write_glmix_avro(str(d1 / "part-00000.avro"), 0, 150)
+        _write_glmix_avro(str(d2 / "part-00000.avro"), 1, 150)
+        _write_glmix_avro(str(extra / "part-00000.avro"), 2, 100)
+        out = str(tmp_path / "out")
+
+        # Date-ranged read of the daily tree only.
+        train_cli.main([
+            "--training-task", "LOGISTIC_REGRESSION",
+            "--input-data-directories", str(base),
+            "--input-data-date-range", "20160101-20160131",
+            "--root-output-directory", out,
+            "--feature-shard-configurations",
+            "name=globalShard,feature.bags=features,intercept=true",
+            "--coordinate-configurations",
+            "name=global,feature.shard=globalShard,optimizer=LBFGS,"
+            "tolerance=1e-7,max.iter=20,regularization=L2,reg.weights=1",
+        ])
+        summary = json.load(open(os.path.join(out, "training-summary.json")))
+        assert summary["num_samples"] == 300  # both daily dirs, not extra
+
+        # Multiple plain input directories concatenate.
+        out2 = str(tmp_path / "out2")
+        train_cli.main([
+            "--training-task", "LOGISTIC_REGRESSION",
+            "--input-data-directories", str(d1), str(extra),
+            "--root-output-directory", out2,
+            "--feature-shard-configurations",
+            "name=globalShard,feature.bags=features,intercept=true",
+            "--coordinate-configurations",
+            "name=global,feature.shard=globalShard,optimizer=LBFGS,"
+            "tolerance=1e-7,max.iter=20,regularization=L2,reg.weights=1",
+        ])
+        summary2 = json.load(open(os.path.join(out2, "training-summary.json")))
+        assert summary2["num_samples"] == 250
+
+        # Scoring accepts multiple dirs + ranges too (cli/score.py).
+        score_out = str(tmp_path / "scores")
+        score_cli.main([
+            "--input-data-directories", str(base),
+            "--input-data-date-range", "20160101-20160102",
+            "--model-input-directory", os.path.join(out, "models", "best"),
+            "--root-output-directory", score_out,
+            "--feature-shard-configurations",
+            "name=globalShard,feature.bags=features,intercept=true",
+        ])
+        ssum = json.load(open(os.path.join(score_out, "scoring-summary.json")))
+        assert ssum["num_scored"] == 300

@@ -1,0 +1,91 @@
+"""Date-range input resolution (util/DateRange.scala, DaysRange.scala,
+IOUtils.scala:30-155, GameDriver.pathsForDateRange:248)."""
+
+import datetime
+import os
+
+import pytest
+
+from photon_ml_tpu.utils.date_range import (
+    DateRange,
+    DaysRange,
+    paths_for_date_range,
+    resolve_range,
+)
+
+
+class TestDateRange:
+    def test_parse_and_days(self):
+        r = DateRange.parse("20160228-20160302")  # leap year crossing
+        assert r.start == datetime.date(2016, 2, 28)
+        assert r.end == datetime.date(2016, 3, 2)
+        assert [d.day for d in r.days()] == [28, 29, 1, 2]
+        assert str(r) == "20160228-20160302"
+
+    def test_invalid_ranges(self):
+        with pytest.raises(ValueError):
+            DateRange.parse("20160301-20160201")  # reversed
+        with pytest.raises(ValueError):
+            DateRange.parse("20160301")  # no delimiter
+
+
+class TestDaysRange:
+    def test_to_date_range(self):
+        today = datetime.date(2026, 7, 30)
+        r = DaysRange.parse("90-1").to_date_range(today)
+        assert r.end == today - datetime.timedelta(days=1)
+        assert r.start == today - datetime.timedelta(days=90)
+
+    def test_invalid(self):
+        with pytest.raises(ValueError):
+            DaysRange.parse("1-90")  # start more recent than end
+
+
+class TestResolveRange:
+    def test_exclusive(self):
+        with pytest.raises(ValueError):
+            resolve_range("20160101-20160201", "90-1")
+        assert resolve_range(None, None) is None
+        assert resolve_range("20160101-20160102", None).start == datetime.date(2016, 1, 1)
+
+
+class TestPathsForDateRange:
+    def test_daily_expansion(self, tmp_path):
+        base = tmp_path / "daily"
+        for d in ("2016/01/01", "2016/01/03", "2016/02/01"):
+            (base / d).mkdir(parents=True)
+        got = paths_for_date_range([str(base)], DateRange.parse("20160101-20160131"))
+        assert got == [
+            str(base / "2016/01/01"),
+            str(base / "2016/01/03"),
+        ]
+
+    def test_no_range_passes_through(self, tmp_path):
+        assert paths_for_date_range(["a", "b"], None) == ["a", "b"]
+
+    def test_empty_range_raises(self, tmp_path):
+        base = tmp_path / "daily"
+        (base / "2016/01/01").mkdir(parents=True)
+        with pytest.raises(FileNotFoundError):
+            paths_for_date_range([str(base)], DateRange.parse("20170101-20170102"))
+
+    def test_error_on_missing(self, tmp_path):
+        base = tmp_path / "daily"
+        (base / "2016/01/01").mkdir(parents=True)
+        with pytest.raises(FileNotFoundError):
+            paths_for_date_range(
+                [str(base)],
+                DateRange.parse("20160101-20160102"),
+                error_on_missing=True,
+            )
+
+    def test_reference_ioutils_fixture_layout(self):
+        """The reference's own IOUtilsTest daily fixture tree resolves."""
+        base = (
+            "/root/reference/photon-client/src/integTest/resources/"
+            "IOUtilsTest/input/daily"
+        )
+        if not os.path.isdir(base):
+            pytest.skip("reference fixtures not mounted")
+        got = paths_for_date_range([base], DateRange.parse("20160101-20160401"))
+        assert [p[-10:] for p in got] == ["2016/01/01", "2016/02/01", "2016/03/01"]

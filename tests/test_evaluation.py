@@ -148,3 +148,84 @@ def test_build_grouped_index_shapes(rng):
     idx = build_grouped_index(gids)
     assert idx.gather.shape == (3, 3)
     assert float(idx.mask.sum()) == 6.0
+
+
+class TestLegacyMetrics:
+    """R^2 / peak-F1 and the legacy Evaluation.evaluate metric map
+    (photon-client evaluation/Evaluation.scala:31), cross-checked vs sklearn."""
+
+    def test_r_squared_vs_sklearn(self, rng):
+        from sklearn.metrics import r2_score
+
+        from photon_ml_tpu.evaluation.metrics import r_squared
+
+        y = rng.normal(size=200).astype(np.float32)
+        pred = (y + rng.normal(size=200) * 0.5).astype(np.float32)
+        ours = float(r_squared(jnp.asarray(pred), jnp.asarray(y)))
+        assert ours == pytest.approx(r2_score(y, pred), abs=1e-5)
+        # Weighted form vs sklearn sample_weight.
+        w = rng.uniform(0.5, 2.0, size=200).astype(np.float32)
+        ours_w = float(r_squared(jnp.asarray(pred), jnp.asarray(y), jnp.asarray(w)))
+        assert ours_w == pytest.approx(r2_score(y, pred, sample_weight=w), abs=1e-5)
+
+    def test_peak_f1_vs_sklearn(self, rng):
+        from sklearn.metrics import precision_recall_curve
+
+        from photon_ml_tpu.evaluation.metrics import peak_f1
+
+        y = (rng.uniform(size=300) > 0.6).astype(np.float32)
+        s = (y + rng.normal(size=300)).astype(np.float32)
+        p, r, _ = precision_recall_curve(y, s)
+        f1 = 2 * p * r / np.maximum(p + r, 1e-12)
+        expected = float(np.max(f1))
+        ours = float(peak_f1(jnp.asarray(s), jnp.asarray(y)))
+        assert ours == pytest.approx(expected, abs=1e-5)
+
+    def test_peak_f1_tied_scores_and_padding(self):
+        from photon_ml_tpu.evaluation.metrics import peak_f1
+
+        # Ties: scores [1, 1, 0]; labels [1, 0, 1]. Realizable cuts are
+        # {>=1} (P=0.5, R=0.5, F1=0.5) and {>=0} (P=2/3, R=1, F1=0.8).
+        s = jnp.asarray([1.0, 1.0, 0.0])
+        y = jnp.asarray([1.0, 0.0, 1.0])
+        assert float(peak_f1(s, y)) == pytest.approx(0.8, abs=1e-6)
+        # Padding rows (weight 0) must not contribute.
+        s2 = jnp.asarray([1.0, 1.0, 0.0, 9.0])
+        y2 = jnp.asarray([1.0, 0.0, 1.0, 0.0])
+        w2 = jnp.asarray([1.0, 1.0, 1.0, 0.0])
+        assert float(peak_f1(s2, y2, w2)) == pytest.approx(0.8, abs=1e-6)
+
+    def test_evaluate_glm_map(self, rng):
+        from photon_ml_tpu.data.containers import dense_data
+        from photon_ml_tpu.evaluation import legacy
+        from photon_ml_tpu.models.glm import create_model
+        from photon_ml_tpu.types import TaskType
+
+        n, d = 150, 5
+        X = rng.normal(size=(n, d)).astype(np.float32)
+        w = rng.normal(size=d).astype(np.float32)
+        ybin = (X @ w + rng.normal(size=n) * 0.3 > 0).astype(np.float32)
+        ylin = (X @ w + rng.normal(size=n) * 0.3).astype(np.float32)
+
+        logit = create_model(TaskType.LOGISTIC_REGRESSION, jnp.asarray(w))
+        m = legacy.evaluate_glm(logit, dense_data(X, ybin))
+        assert {
+            legacy.AREA_UNDER_ROC,
+            legacy.AREA_UNDER_PRECISION_RECALL,
+            legacy.PEAK_F1_SCORE,
+            legacy.DATA_LOG_LIKELIHOOD,
+            legacy.AKAIKE_INFORMATION_CRITERION,
+        } <= set(m)
+        assert 0.8 < m[legacy.AREA_UNDER_ROC] <= 1.0
+        assert m[legacy.DATA_LOG_LIKELIHOOD] < 0.0
+
+        lin = create_model(TaskType.LINEAR_REGRESSION, jnp.asarray(w))
+        m2 = legacy.evaluate_glm(lin, dense_data(X, ylin))
+        from sklearn.metrics import mean_squared_error
+
+        pred = np.asarray(X @ w)
+        assert m2[legacy.MEAN_SQUARE_ERROR] == pytest.approx(
+            mean_squared_error(ylin, pred), rel=1e-5
+        )
+        assert m2[legacy.R_SQUARED] > 0.8
+        assert legacy.PEAK_F1_SCORE not in m2

@@ -378,3 +378,83 @@ class TestPearsonFeatureSelection:
         np.testing.assert_array_equal(coeffs[:-1] * (1.0 - mask[:-1]), 0.0)
         # And the kept informative features are actually used.
         assert np.abs(coeffs[:-1, :2]).max() > 0.1
+
+
+    def test_sparse_masks_match_dense(self, rng):
+        """The ELL-moment Pearson path (no densification) must select the
+        same features as the dense path on identical data."""
+        import numpy as np
+        import jax.numpy as jnp
+        from photon_ml_tpu.data.containers import SparseFeatures
+        from photon_ml_tpu.data.game_dataset import (
+            GameDataset,
+            RandomEffectDataConfig,
+            build_random_effect_dataset,
+        )
+
+        n, d, entities = 240, 12, 4
+        X = rng.normal(size=(n, d)).astype(np.float32)
+        X[rng.uniform(size=(n, d)) < 0.6] = 0.0  # sparsify
+        X[:, d - 1] = 1.0  # intercept pseudo-feature
+        ent = rng.integers(0, entities, size=n)
+        y = (X[:, 0] + 2 * X[:, 1] + 0.1 * rng.normal(size=n) > 0).astype(np.float32)
+
+        # ELL encoding of the same matrix (k = max nnz per row).
+        k = int((X != 0).sum(axis=1).max())
+        idx = np.zeros((n, k), np.int32)
+        val = np.zeros((n, k), np.float32)
+        for r in range(n):
+            nz = np.flatnonzero(X[r])
+            idx[r, : len(nz)] = nz
+            val[r, : len(nz)] = X[r, nz]
+        sf = SparseFeatures(jnp.asarray(idx), jnp.asarray(val), d)
+
+        cfg = RandomEffectDataConfig(
+            "m", "e", num_features_to_samples_ratio_upper_bound=0.1
+        )
+        dense_red = build_random_effect_dataset(
+            GameDataset.build({"e": jnp.asarray(X)}, y, id_tags={"m": ent}), cfg
+        )
+        sparse_red = build_random_effect_dataset(
+            GameDataset.build({"e": sf}, y, id_tags={"m": ent}), cfg
+        )
+        np.testing.assert_array_equal(
+            np.asarray(dense_red.feature_mask), np.asarray(sparse_red.feature_mask)
+        )
+
+
+    def test_sparse_pearson_stable_under_large_offsets(self, rng):
+        """Large-magnitude, small-spread columns (1e4 +/- 1, the largest
+        offset float32 storage can carry without quantizing the signal away)
+        must keep their correlation signal — the reason the reference ships
+        stableComputePearsonCorrelationScore (raw-moment formulas cancel)."""
+        import numpy as np
+        import jax.numpy as jnp
+        from photon_ml_tpu.data.containers import SparseFeatures
+        from photon_ml_tpu.data.game_dataset import (
+            GameDataset,
+            RandomEffectDataConfig,
+            build_random_effect_dataset,
+        )
+
+        n, d = 60, 4
+        y = rng.normal(size=n).astype(np.float32)
+        X = np.zeros((n, d), np.float32)
+        X[:, 0] = 1e4 + y  # informative but offset-dominated
+        X[:, 1] = rng.normal(size=n)  # uninformative
+        X[:, 3] = 1.0  # intercept
+        idx = np.broadcast_to(np.arange(d, dtype=np.int32), (n, d)).copy()
+        sf = SparseFeatures(jnp.asarray(idx), jnp.asarray(X), d)
+        ds = GameDataset.build(
+            {"e": sf}, (y > 0).astype(np.float32), id_tags={"m": np.zeros(n, np.int64)}
+        )
+        red = build_random_effect_dataset(
+            ds,
+            RandomEffectDataConfig(
+                "m", "e", num_features_to_samples_ratio_upper_bound=2 / n
+            ),
+        )
+        mask = np.asarray(red.feature_mask)[0]
+        assert mask[0] == 1.0  # offset-dominated informative column survives
+        assert mask[3] == 1.0  # intercept survives
+        assert mask[1] == 0.0

@@ -423,3 +423,69 @@ class TestArtifactRoundTrip:
         # records_per_file=32 over 100 entities -> 4 part files like the
         # reference's saveModelsRDDToHDFS partitioned output.
         assert len(glob.glob(os.path.join(out, "random-effect/per-artist/coefficients/*.avro"))) == 4
+
+
+class TestFeatureSummaryParity:
+    """summarize() vs the reference's own expected heart summary fixture
+    (photon-api DriverIntegTest/input/heart_summary.txt: rows = mean,
+    variance, numNonzeros, max, min, normL1, normL2, meanAbs over the 13
+    heart features + intercept)."""
+
+    def test_heart_summary_matches_reference_fixture(self):
+        import numpy as np
+        from photon_ml_tpu.data.stats import summarize
+
+        ref_file = os.path.join(
+            "/root/reference/photon-api/src/integTest/resources",
+            "DriverIntegTest/input/heart_summary.txt",
+        )
+        rows = [
+            [float(v) for v in line.strip().split(",")]
+            for line in open(ref_file)
+            if line.strip()
+        ]
+        mean_r, var_r, nnz_r, max_r, min_r, l1_r, l2_r, meanabs_r = rows
+
+        shards = {"global": FeatureShardConfig(("features",), True)}
+        ds, imaps = read_game_dataset(os.path.join(DRIVER_IN, "heart.avro"), shards)
+        imap = imaps["global"]
+        stats = summarize(ds.shards["global"], intercept_index=imap.intercept_index)
+
+        # Fixture columns are features "1".."13" then the intercept.
+        order = [imap.get_index(str(i)) for i in range(1, 14)] + [imap.intercept_index]
+        assert all(i >= 0 for i in order)
+        for ours, ref in (
+            (stats.mean, mean_r),
+            (stats.variance, var_r),
+            (stats.num_nonzeros, nnz_r),
+            (stats.max, max_r),
+            (stats.min, min_r),
+            (stats.norm_l1, l1_r),
+            (stats.norm_l2, l2_r),
+            (stats.mean_abs, meanabs_r),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(ours)[order], np.asarray(ref), rtol=2e-4
+            )
+
+    def test_write_basic_statistics_roundtrip(self, tmp_path):
+        import numpy as np
+        from photon_ml_tpu.data.stats import summarize
+        from photon_ml_tpu.io import avro as avro_io
+        from photon_ml_tpu.io.model_store import write_basic_statistics
+
+        shards = {"global": FeatureShardConfig(("features",), True)}
+        ds, imaps = read_game_dataset(os.path.join(DRIVER_IN, "heart.avro"), shards)
+        imap = imaps["global"]
+        stats = summarize(ds.shards["global"], intercept_index=imap.intercept_index)
+        out = str(tmp_path / "summary" / "global")
+        n = write_basic_statistics(out, stats, imap)
+        assert n == imap.size - 1  # intercept excluded
+        _, recs = avro_io.read_container(os.path.join(out, "part-00000.avro"))
+        assert len(recs) == n
+        by_name = {r["featureName"]: r["metrics"] for r in recs}
+        i3 = imap.get_index("3")
+        m = by_name["3"]
+        assert set(m) == {"max", "min", "mean", "normL1", "normL2", "numNonzeros", "variance"}
+        assert m["mean"] == pytest.approx(float(np.asarray(stats.mean)[i3]), rel=1e-6)
+        assert m["variance"] == pytest.approx(float(np.asarray(stats.variance)[i3]), rel=1e-6)
