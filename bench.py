@@ -152,22 +152,28 @@ def _child() -> None:
     coords = {"fixed": fixed, "per-entity": rand}
     variants = {}
 
-    def timed(fn):
+    def timed(fn, label=""):
+        t_c = time.perf_counter()
         out = fn()  # warm-up/compile
         jax.block_until_ready(out)
+        sys.stderr.write(f"bench: {label} warm-up {time.perf_counter() - t_c:.1f}s\n")
+        sys.stderr.flush()
         t0 = time.perf_counter()
         out = fn()
         jax.block_until_ready(out)
         return time.perf_counter() - t0, out
 
+    sys.stderr.write(f"bench: data built n={n}\n")
+    sys.stderr.flush()
+
     # ---- primary: full GLMix coordinate-descent pass ----------------------
     glmix_wall, _ = timed(lambda: run_coordinate_descent(coords, 1).model[
         "fixed"
-    ].coefficients.means)
+    ].coefficients.means, "glmix")
 
     # ---- dense fixed-effect LBFGS (the aggregator hot loop) ---------------
     kernel_mode = fixed._use_pallas
-    dense_wall, res_lbfgs = timed(lambda: fixed.train(ds.offsets)[1])
+    dense_wall, res_lbfgs = timed(lambda: fixed.train(ds.offsets)[1], "dense_lbfgs")
     stats = _solve_stats(res_lbfgs)
     passes_per_eval = 1 if kernel_mode is not False else 2
     dense_bytes = stats["fn_evals"] * n * d_fixed * 4 * passes_per_eval
@@ -187,7 +193,7 @@ def _child() -> None:
         reg_weight=1.0,
     )
     tron_coord = FixedEffectCoordinate(ds, "global", cfg_t, TaskType.LOGISTIC_REGRESSION)
-    tron_wall, res_tron = timed(lambda: tron_coord.train(ds.offsets)[1])
+    tron_wall, res_tron = timed(lambda: tron_coord.train(ds.offsets)[1], "dense_tron")
     tstats = _solve_stats(res_tron)
     tron_bytes = tstats["fn_evals"] * n * d_fixed * 4 * passes_per_eval
     variants["dense_tron"] = dict(
@@ -215,7 +221,7 @@ def _child() -> None:
         ),
         TaskType.LOGISTIC_REGRESSION,
     )
-    sp_wall, res_sp = timed(lambda: sp_coord.train(ds_sp.offsets)[1])
+    sp_wall, res_sp = timed(lambda: sp_coord.train(ds_sp.offsets)[1], "sparse_ell")
     sstats = _solve_stats(res_sp)
     # ELL pass streams indices (4B) + values (4B); XLA path reads twice
     # (gather-matvec + scatter-rmatvec).
@@ -235,7 +241,7 @@ def _child() -> None:
     def score(wv):
         return jax.nn.sigmoid(Xf @ wv + ds.offsets)
 
-    score_wall, _ = timed(lambda: score(res_lbfgs.coefficients))
+    score_wall, _ = timed(lambda: score(res_lbfgs.coefficients), "scoring")
     score_bytes = n * d_fixed * 4
     variants["scoring"] = dict(
         wall_s=round(score_wall, 4),

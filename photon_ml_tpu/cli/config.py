@@ -55,6 +55,10 @@ COORDINATE_OPT_CONFIG_REGULARIZATION = "regularization"
 COORDINATE_OPT_CONFIG_REG_ALPHA = "reg.alpha"
 COORDINATE_OPT_CONFIG_REG_WEIGHTS = "reg.weights"
 COORDINATE_OPT_CONFIG_DOWN_SAMPLING_RATE = "down.sampling.rate"
+# Box-constraint map: path to a JSON file in the legacy constraint-string
+# format (GLMSuite.scala:190-265; resolved against the shard's index map by
+# the training driver).
+COORDINATE_OPT_CONFIG_CONSTRAINTS_FILE = "constraints.file"
 # TPU-build extensions (no reference equivalent; entity blocking replaces
 # Spark partitioning, and projection is configured per coordinate).
 COORDINATE_DATA_CONFIG_MIN_BUCKET = "min.bucket"
@@ -134,6 +138,7 @@ class CoordinateConfiguration:
     data_config: object  # FixedEffectDataConfig | RandomEffectDataConfig
     opt_config: CoordinateOptimizationConfig
     reg_weights: Tuple[float, ...] = (0.0,)
+    constraint_file: Optional[str] = None  # JSON constraint map (GLMSuite.scala:46)
 
     def expand(self) -> List[CoordinateOptimizationConfig]:
         return [
@@ -206,6 +211,7 @@ def parse_coordinate_config(arg: str) -> CoordinateConfiguration:
     alpha = pop(COORDINATE_OPT_CONFIG_REG_ALPHA)
     weights_str = pop(COORDINATE_OPT_CONFIG_REG_WEIGHTS)
     down_sampling = float(pop(COORDINATE_OPT_CONFIG_DOWN_SAMPLING_RATE, "1.0"))
+    constraint_file = pop(COORDINATE_OPT_CONFIG_CONSTRAINTS_FILE)
     if kv:
         raise ValueError(f"unknown coordinate config keys {sorted(kv)} in {arg!r}")
 
@@ -237,7 +243,9 @@ def parse_coordinate_config(arg: str) -> CoordinateConfiguration:
         reg_weight=max(reg_weights),
         down_sampling_rate=down_sampling,
     )
-    return CoordinateConfiguration(name, data_config, opt, reg_weights)
+    return CoordinateConfiguration(
+        name, data_config, opt, reg_weights, constraint_file=constraint_file
+    )
 
 
 def coordinate_config_to_string(cfg: CoordinateConfiguration) -> str:
@@ -292,6 +300,10 @@ def coordinate_config_to_string(cfg: CoordinateConfiguration) -> str:
     if oc.down_sampling_rate < 1.0:
         parts.append(
             f"{COORDINATE_OPT_CONFIG_DOWN_SAMPLING_RATE}{KV_DELIMITER}{oc.down_sampling_rate}"
+        )
+    if cfg.constraint_file:
+        parts.append(
+            f"{COORDINATE_OPT_CONFIG_CONSTRAINTS_FILE}{KV_DELIMITER}{cfg.constraint_file}"
         )
     return LIST_DELIMITER.join(parts)
 

@@ -52,6 +52,7 @@ from photon_ml_tpu.io import avro_data, model_bridge, model_store
 from photon_ml_tpu.types import (
     DataValidationType,
     NormalizationType,
+    ProjectorType,
     RegularizationType,
     TaskType,
     VarianceComputationType,
@@ -133,6 +134,11 @@ def build_parser() -> argparse.ArgumentParser:
                    default=VarianceComputationType.NONE)
     p.add_argument("--data-validation", type=lambda s: DataValidationType[s.strip().upper()],
                    default=DataValidationType.VALIDATE_FULL)
+    p.add_argument("--checkpoint-directory", default=None,
+                   help="Checkpoint-restart root for the coordinate-descent "
+                        "outer loop (SURVEY §5.3): a rerun with identical "
+                        "arguments resumes from the last completed "
+                        "coordinate update")
     p.add_argument("--data-summary-directory", default=None,
                    help="Write per-feature-shard summary statistics as "
                         "FeatureSummarizationResultAvro under this directory "
@@ -366,6 +372,43 @@ def _run_job(
                 cfg.opt_config, variance_computation=args.variance_computation_type
             )
 
+    # Box-constraint maps (constraints.file in the coordinate DSL): resolve
+    # the legacy JSON constraint string against the shard's index map
+    # (GLMSuite.createConstraintFeatureMap:190-265) into (lower, upper)
+    # vectors for the projected-L-BFGS optimizer.
+    for cfg in coordinate_configs.values():
+        if not cfg.constraint_file:
+            continue
+        import dataclasses as _dc
+
+        from photon_ml_tpu.optimize.constraints import (
+            bounds_arrays,
+            create_constraint_feature_map,
+        )
+
+        dc_cfg = cfg.data_config
+        if isinstance(dc_cfg, RandomEffectDataConfig) and dc_cfg.projector_type not in (
+            ProjectorType.IDENTITY,
+        ):
+            raise ValueError(
+                f"coordinate {cfg.name!r}: box constraints require the "
+                "IDENTITY projector (bounds are per global feature index)"
+            )
+        imap = index_maps[dc_cfg.feature_shard]
+        with open(cfg.constraint_file) as f:
+            cmap = create_constraint_feature_map(f.read(), imap)
+        box = bounds_arrays(cmap, imap.size)
+        if box is not None:
+            cfg.opt_config = _dc.replace(
+                cfg.opt_config,
+                optimizer=_dc.replace(cfg.opt_config.optimizer, box_constraints=box),
+            )
+            logger.info(
+                "coordinate %s: box constraints on %d feature(s)",
+                cfg.name,
+                len(cmap),
+            )
+
     estimator = GameEstimator(
         args.training_task,
         {cid: c.data_config for cid, c in coordinate_configs.items()},
@@ -380,6 +423,7 @@ def _run_job(
             if index_maps[shard].intercept_index is not None
         },
         seed=args.random_seed,
+        checkpoint_dir=getattr(args, "checkpoint_directory", None),
     )
 
     # Warm start / partial retrain (GameTrainingDriver.scala:370-409).

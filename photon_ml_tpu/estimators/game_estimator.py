@@ -66,6 +66,9 @@ logger = logging.getLogger(__name__)
 GameOptimizationConfiguration = Mapping[str, CoordinateOptimizationConfig]
 
 
+from photon_ml_tpu.optimize.config import static_config_key as _static_config_key
+
+
 @dataclasses.dataclass
 class GameResult:
     """One (GameModel, configuration, evaluation) triple
@@ -110,6 +113,7 @@ class GameEstimator:
         locked_coordinates: Optional[Set[str]] = None,
         intercept_indices: Optional[Mapping[str, int]] = None,
         seed: int = 0,
+        checkpoint_dir: Optional[str] = None,
     ):
         self.task = task
         self.data_configs = dict(coordinate_data_configs)
@@ -126,6 +130,9 @@ class GameEstimator:
         self.locked = set(locked_coordinates or ())
         self.intercept_indices = dict(intercept_indices or {})
         self.seed = seed
+        # Outer-loop checkpoint root (SURVEY §5.3); each optimization
+        # configuration in the sweep checkpoints under config-<i>/.
+        self.checkpoint_dir = checkpoint_dir
         self._prepared: Optional[Dict[str, _PreparedCoordinate]] = None
         self._prepared_dataset: Optional[GameDataset] = None
         self._coordinate_cache: Dict[Tuple, object] = {}
@@ -141,10 +148,10 @@ class GameEstimator:
         projected: bool = False,
     ) -> Optional[NormalizationContext]:
         """`intercept_shard` is the ORIGINAL shard name users configure
-        intercepts under; `shard` may be its projected view. In a projected
-        space the intercept lands in a different slot per entity, so
-        shift-based normalization is not expressible there — factor-only
-        types are safe (a constant column gets factor 1 via the zero-variance
+        intercepts under; `shard` may be a projected view (the RANDOM
+        projector's dense space, where the global intercept column is mixed
+        away, so shift-based normalization is not expressible — factor-only
+        types are safe: a constant column gets factor 1 via the zero-variance
         guard)."""
         if self.normalization == NormalizationType.NONE:
             return None
@@ -152,9 +159,10 @@ class GameEstimator:
         if projected:
             if self.normalization == NormalizationType.STANDARDIZATION:
                 raise ValueError(
-                    "STANDARDIZATION is not supported on projected random-effect "
-                    "shards (per-entity intercept slots); use a factor-only "
-                    "normalization type or IDENTITY projection"
+                    "STANDARDIZATION is not supported on randomly-projected "
+                    "shards (the intercept column is mixed into every "
+                    "projected dimension); use a factor-only normalization "
+                    "type, INDEX_MAP or IDENTITY projection"
                 )
             intercept = None
         stats = summarize(dataset.shards[shard], intercept_index=intercept)
@@ -164,6 +172,29 @@ class GameEstimator:
             variance=stats.variance,
             max_abs=stats.max_abs,
             intercept_index=intercept,
+        )
+
+    def _norm_for_projected_re(self, dataset: GameDataset, original_shard: str, ps):
+        """Normalization for a projected random-effect coordinate.
+
+        INDEX_MAP compaction projects the GLOBAL context (computed on the
+        original shard) into every entity's local slots — the reference's
+        per-entity projected NormalizationContexts
+        (IndexMapProjectorRDD.scala:133), so STANDARDIZATION works on
+        projected shards. RANDOM projection cannot carry an affine
+        per-feature transform through the Gaussian mix; factor-only types
+        fall back to statistics of the projected (dense) space.
+        """
+        from photon_ml_tpu.game.projector import IndexMapProjector
+        from photon_ml_tpu.ops.normalization import project_normalization
+
+        if self.normalization == NormalizationType.NONE:
+            return None
+        if isinstance(ps.projector, IndexMapProjector):
+            global_norm = self._norm_for_shard(dataset, original_shard)
+            return project_normalization(global_norm, ps.projector.slot_tables)
+        return self._norm_for_shard(
+            dataset, ps.shard_name, intercept_shard=original_shard, projected=True
         )
 
     def prepare(self, dataset: GameDataset) -> Dict[str, _PreparedCoordinate]:
@@ -193,12 +224,10 @@ class GameEstimator:
                     projected_dim=cfg.projected_dim,
                     seed=self.seed,
                 )
-                norm = self._norm_for_shard(
-                    dataset,
-                    ps.shard_name,
-                    intercept_shard=original_shard,
-                    projected=ps.shard_name != original_shard,
-                )
+                if ps.shard_name != original_shard:
+                    norm = self._norm_for_projected_re(dataset, original_shard, ps)
+                else:
+                    norm = self._norm_for_shard(dataset, original_shard)
                 prepared[cid] = _PreparedCoordinate(
                     cfg, original_shard, ps.shard_name, norm, red, ps.projector
                 )
@@ -234,7 +263,7 @@ class GameEstimator:
         keyed by the static parts of the config — the reg weight is traced, so
         sweep steps share compiled programs."""
         static_cfg = dataclasses.replace(opt_config, reg_weight=0.0)
-        key = (cid, repr(static_cfg))
+        key = (cid, _static_config_key(static_cfg))
         coord = self._coordinate_cache.get(key)
         if coord is None:
             if prep.re_dataset is not None:
@@ -350,6 +379,11 @@ class GameEstimator:
                 ),
                 reg_weights=reg_weights,
                 seed=self.seed + ci,
+                checkpoint_dir=(
+                    None
+                    if self.checkpoint_dir is None
+                    else f"{self.checkpoint_dir}/config-{ci}"
+                ),
             )
             evaluation = None
             if validation_data is not None and suite is not None:

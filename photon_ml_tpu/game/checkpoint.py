@@ -1,0 +1,230 @@
+"""Checkpoint-restart of the coordinate-descent outer loop.
+
+The reference leans on Spark lineage re-computation for mid-job failure
+recovery, with determinism guaranteed by byteswap64-keyed sampling
+(RandomEffectDataset.scala:375-384) and DISK_ONLY persists bounding recompute
+(CoordinateDescent.scala:325-341). SURVEY §5.3 names the TPU replacement:
+checkpoint-restart of the outer-loop state plus a deterministic input
+pipeline. This module is that checkpoint.
+
+Durable state after each coordinate update:
+  * every coordinate's current model, in the TRAINING representation
+    (projected + normalized spaces) — scores/residuals are recomputed from
+    the models on resume, so they are never persisted;
+  * the step cursor, a structural fingerprint of the run configuration
+    (coordinate ids + static optimizer configs + reg weights — resume with a
+    DIFFERENT configuration is refused, not silently fast-forwarded), the
+    PRNG seed (down-sampling keys derive from (seed, step), so a resumed run
+    draws the SAME subsamples), the best-pass snapshot and the validation
+    history.
+
+Write protocol — crash-exact by construction:
+  * each step writes ONE model file, `steps/<step>/<cid>.npz` (only the
+    coordinate trained that step; other coordinates keep their existing
+    files);
+  * `state.json` maps every coordinate to its current file and is replaced
+    atomically LAST — it is the commit point. A crash before the replace
+    leaves the previous state.json pointing only at fully-written files, so
+    resume re-runs the interrupted step exactly as the uninterrupted run
+    would have;
+  * the best-pass snapshot stores file REFERENCES (the pass-end models are
+    by definition the current models), so it costs no extra writes;
+  * step directories no longer referenced by state.json are pruned after
+    the commit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import shutil
+import tempfile
+from typing import Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.game.model import (
+    Coefficients,
+    FixedEffectModel,
+    GameModel,
+    RandomEffectModel,
+)
+
+STATE_FILE = "state.json"
+STEPS_DIR = "steps"
+
+
+def _atomic_write(path: str, data: bytes) -> None:
+    d = os.path.dirname(path)
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d)
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+def _save_model_npz(path: str, model) -> None:
+    import io as _io
+
+    buf = _io.BytesIO()
+    if isinstance(model, FixedEffectModel):
+        arrays = {"kind": np.asarray("fixed"), "means": np.asarray(model.coefficients.means)}
+        if model.coefficients.variances is not None:
+            arrays["variances"] = np.asarray(model.coefficients.variances)
+    elif isinstance(model, RandomEffectModel):
+        arrays = {"kind": np.asarray("random"), "matrix": np.asarray(model.coefficients_matrix)}
+        if model.variances_matrix is not None:
+            arrays["variances"] = np.asarray(model.variances_matrix)
+    else:
+        raise TypeError(f"unknown model type {type(model)}")
+    np.savez(buf, **arrays)
+    _atomic_write(path, buf.getvalue())
+
+
+def _load_model_npz(path: str, task):
+    with np.load(path, allow_pickle=False) as z:
+        kind = str(z["kind"])
+        var = jnp.asarray(z["variances"]) if "variances" in z else None
+        if kind == "fixed":
+            return FixedEffectModel(Coefficients(jnp.asarray(z["means"]), var), task)
+        return RandomEffectModel(jnp.asarray(z["matrix"]), var, task)
+
+
+def _results_to_json(res) -> dict:
+    return {"primary": str(res.primary), "results": dict(res.results)}
+
+
+def _results_from_json(doc: Optional[dict]):
+    if doc is None:
+        return None
+    from photon_ml_tpu.evaluation.suite import EvaluationResults, EvaluatorType
+
+    return EvaluationResults(
+        primary=EvaluatorType.parse(doc["primary"]), results=dict(doc["results"])
+    )
+
+
+@dataclasses.dataclass
+class CheckpointState:
+    """Host-side mirror of state.json."""
+
+    completed_steps: int  # coordinate updates finished
+    seed: int
+    models: Dict[str, object]
+    best_models: Dict[str, object]
+    best_results: Optional[object]  # EvaluationResults
+    validation_history: List[Tuple[int, str, object]]
+
+
+class CoordinateDescentCheckpoint:
+    """Reader/writer for one run's checkpoint directory."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        # cid -> relative npz path currently representing the coordinate.
+        self._model_files: Dict[str, str] = {}
+        self._best_files: Dict[str, str] = {}
+
+    def exists(self) -> bool:
+        return os.path.isfile(os.path.join(self.directory, STATE_FILE))
+
+    def save(
+        self,
+        *,
+        completed_steps: int,
+        seed: int,
+        config_key: str,
+        models: Dict[str, object],
+        trained_cid: Optional[str],
+        best_is_current: bool,
+        best_results,
+        validation_history,
+    ) -> None:
+        """Commit one coordinate update.
+
+        `trained_cid` is the coordinate updated this step (None at a forced
+        full write); any coordinate without an existing file (initial
+        warm-start models on the first save) is also written. When
+        `best_is_current`, the best snapshot re-references the current model
+        files instead of copying them.
+        """
+        step_rel = os.path.join(STEPS_DIR, str(completed_steps))
+        for cid, model in models.items():
+            if cid == trained_cid or cid not in self._model_files:
+                rel = os.path.join(step_rel, f"{cid}.npz")
+                _save_model_npz(os.path.join(self.directory, rel), model)
+                self._model_files[cid] = rel
+        if best_is_current and best_results is not None:
+            self._best_files = dict(self._model_files)
+        state = {
+            "completed_steps": completed_steps,
+            "seed": seed,
+            "config_key": config_key,
+            "model_files": dict(self._model_files),
+            "best_files": dict(self._best_files) if best_results is not None else {},
+            "best_results": (
+                None if best_results is None else _results_to_json(best_results)
+            ),
+            "validation_history": [
+                [it, cid, _results_to_json(res)] for it, cid, res in validation_history
+            ],
+        }
+        # state.json LAST: it is the commit point for the whole step.
+        _atomic_write(
+            os.path.join(self.directory, STATE_FILE),
+            json.dumps(state, indent=2).encode(),
+        )
+        self._prune(state)
+
+    def _prune(self, state: dict) -> None:
+        """Remove step directories no longer referenced (best-effort)."""
+        live = {
+            os.path.dirname(rel)
+            for rel in list(state["model_files"].values())
+            + list(state["best_files"].values())
+        }
+        root = os.path.join(self.directory, STEPS_DIR)
+        if not os.path.isdir(root):
+            return
+        for name in os.listdir(root):
+            rel = os.path.join(STEPS_DIR, name)
+            if rel not in live:
+                shutil.rmtree(os.path.join(root, name), ignore_errors=True)
+
+    def load(self, task, *, config_key: Optional[str] = None) -> CheckpointState:
+        with open(os.path.join(self.directory, STATE_FILE)) as f:
+            state = json.load(f)
+        if config_key is not None and state.get("config_key") != config_key:
+            raise ValueError(
+                f"checkpoint at {self.directory} was written for a different "
+                "run configuration — refusing to resume (delete the "
+                "checkpoint directory to start fresh)"
+            )
+        self._model_files = dict(state["model_files"])
+        self._best_files = dict(state.get("best_files", {}))
+        models = {
+            cid: _load_model_npz(os.path.join(self.directory, rel), task)
+            for cid, rel in self._model_files.items()
+        }
+        best = {
+            cid: _load_model_npz(os.path.join(self.directory, rel), task)
+            for cid, rel in self._best_files.items()
+        }
+        return CheckpointState(
+            completed_steps=int(state["completed_steps"]),
+            seed=int(state["seed"]),
+            models=models,
+            best_models=best,
+            best_results=_results_from_json(state.get("best_results")),
+            validation_history=[
+                (int(it), cid, _results_from_json(res))
+                for it, cid, res in state["validation_history"]
+            ],
+        )

@@ -211,32 +211,78 @@ class RandomEffectCoordinate:
         cfg = self.config
         loss = self.loss
         norm = self.norm
+        from photon_ml_tpu.ops.normalization import PerEntityNormalization
 
-        @jax.jit
-        def train_bucket(block_data: LabeledData, w0_block, reg_weight):
-            # use_pallas=False: the per-entity solves are vmapped; the fused
-            # kernels are single-problem programs and the vmapped XLA path is
-            # the one that batches these small solves efficiently.
-            def one(data_e, w0_e):
-                return problem.solve(
-                    loss,
-                    data_e,
-                    _config_with_traced_weight(cfg, reg_weight),
-                    w0_e,
-                    norm,
-                    use_pallas=False,
-                )
+        per_entity_norm = isinstance(norm, PerEntityNormalization)
 
-            return jax.vmap(one)(block_data, w0_block)
+        if per_entity_norm:
+            # Projected-space normalization: each entity's solve gets its own
+            # (factors, shifts) row, vmapped alongside its data block
+            # (IndexMapProjectorRDD.scala:133).
+            @jax.jit
+            def train_bucket(block_data, w0_block, f_block, s_block, reg_weight):
+                def one(data_e, w0_e, f_e, s_e):
+                    return problem.solve(
+                        loss,
+                        data_e,
+                        _config_with_traced_weight(cfg, reg_weight),
+                        w0_e,
+                        norm.row_context(f_e, s_e),
+                        use_pallas=False,
+                    )
 
-        @jax.jit
-        def variance_bucket(block_data: LabeledData, w_block, reg_weight):
-            def one(data_e, w_e):
-                return problem.compute_variances(
-                    loss, data_e, _config_with_traced_weight(cfg, reg_weight), w_e, norm
-                )
+                return jax.vmap(one)(block_data, w0_block, f_block, s_block)
 
-            return jax.vmap(one)(block_data, w_block)
+            @jax.jit
+            def variance_bucket(block_data, w_block, f_block, s_block, reg_weight):
+                def one(data_e, w_e, f_e, s_e):
+                    return problem.compute_variances(
+                        loss,
+                        data_e,
+                        _config_with_traced_weight(cfg, reg_weight),
+                        w_e,
+                        norm.row_context(f_e, s_e),
+                    )
+
+                return jax.vmap(one)(block_data, w_block, f_block, s_block)
+
+            def norm_blocks(entity_rows):
+                f = None if norm.factors is None else norm.factors[entity_rows]
+                s = None if norm.shifts is None else norm.shifts[entity_rows]
+                return f, s
+
+            self._norm_blocks = norm_blocks
+        else:
+
+            @jax.jit
+            def train_bucket(block_data: LabeledData, w0_block, reg_weight):
+                # use_pallas=False: the per-entity solves are vmapped; the
+                # fused kernels are single-problem programs and the vmapped
+                # XLA path is the one that batches these small solves
+                # efficiently.
+                def one(data_e, w0_e):
+                    return problem.solve(
+                        loss,
+                        data_e,
+                        _config_with_traced_weight(cfg, reg_weight),
+                        w0_e,
+                        norm,
+                        use_pallas=False,
+                    )
+
+                return jax.vmap(one)(block_data, w0_block)
+
+            @jax.jit
+            def variance_bucket(block_data: LabeledData, w_block, reg_weight):
+                def one(data_e, w_e):
+                    return problem.compute_variances(
+                        loss, data_e, _config_with_traced_weight(cfg, reg_weight), w_e, norm
+                    )
+
+                return jax.vmap(one)(block_data, w_block)
+
+            self._norm_blocks = None
+        self._per_entity_norm = per_entity_norm
 
         @jax.jit
         def score_fn(features, entity_rows, matrix):
@@ -285,10 +331,19 @@ class RandomEffectCoordinate:
                 ds, red.feature_shard, blocks, offsets, feature_mask=red.feature_mask
             )
             w0 = matrix[blocks.entity_rows]
-            res: OptResult = self._train_bucket(block_data, w0, rw)
+            if self._per_entity_norm:
+                f_blk, s_blk = self._norm_blocks(blocks.entity_rows)
+                res: OptResult = self._train_bucket(block_data, w0, f_blk, s_blk, rw)
+            else:
+                res = self._train_bucket(block_data, w0, rw)
             matrix = matrix.at[blocks.entity_rows].set(res.coefficients)
             if var_matrix is not None:
-                v = self._variance_bucket(block_data, res.coefficients, rw)
+                if self._per_entity_norm:
+                    v = self._variance_bucket(
+                        block_data, res.coefficients, f_blk, s_blk, rw
+                    )
+                else:
+                    v = self._variance_bucket(block_data, res.coefficients, rw)
                 var_matrix = var_matrix.at[blocks.entity_rows].set(v)
             bucket_iters.append(res.iterations)
         stats = {

@@ -53,6 +53,7 @@ def run_coordinate_descent(
     validation_offsets=None,
     reg_weights: Optional[Mapping[str, float]] = None,
     seed: int = 0,
+    checkpoint_dir: Optional[str] = None,
 ) -> CoordinateDescentResult:
     """Run cyclic coordinate descent (CoordinateDescent.run, :132-134).
 
@@ -60,6 +61,14 @@ def run_coordinate_descent(
     `validation_scorer(cid, model) -> scores` produces validation-set scores
     for one coordinate's model; the suite evaluates the summed scores.
     `reg_weights`: optional per-coordinate override (the sweep path).
+
+    `checkpoint_dir` enables checkpoint-restart of the outer loop (SURVEY
+    §5.3's replacement for Spark lineage recovery): after every coordinate
+    update the models + step cursor persist atomically; a rerun with the
+    same arguments fast-forwards past completed updates, recomputing scores
+    from the checkpointed models, and reproduces the uninterrupted result
+    (down-sampling keys derive from (seed, step), so resumed subsamples are
+    identical).
     """
     locked = locked_coordinates or set()
     ids = list(coordinates.keys())
@@ -76,36 +85,80 @@ def run_coordinate_descent(
     dtype = base_offsets.dtype
 
     models: Dict[str, object] = dict(initial_models.models) if initial_models else {}
+    timing: Dict[str, float] = {}
+    validation_history: List[Tuple[int, str, EvaluationResults]] = []
+    best_results: Optional[EvaluationResults] = None
+    best_models: Dict[str, object] = dict(models)
+    completed_steps = 0
+
+    ckpt = None
+    ckpt_config_key = None
+    if checkpoint_dir is not None:
+        import hashlib
+
+        from photon_ml_tpu.game.checkpoint import CoordinateDescentCheckpoint
+        from photon_ml_tpu.optimize.config import static_config_key
+
+        # Fingerprint the run configuration: resume with changed
+        # coordinates/optimizer settings/reg weights must refuse, not
+        # silently fast-forward past training with stale models.
+        fp = (
+            tuple(ids),
+            tuple(sorted(locked)),
+            tuple(static_config_key(coordinates[c].config) for c in ids),
+            tuple(sorted((reg_weights or {}).items())),
+        )
+        ckpt_config_key = hashlib.sha256(repr(fp).encode()).hexdigest()
+
+        ckpt = CoordinateDescentCheckpoint(checkpoint_dir)
+        if ckpt.exists():
+            task = next(iter(coordinates.values())).task
+            state = ckpt.load(task, config_key=ckpt_config_key)
+            if state.seed != seed:
+                raise ValueError(
+                    f"checkpoint at {checkpoint_dir} was written with seed "
+                    f"{state.seed}, not {seed} — refusing to resume"
+                )
+            models = state.models
+            best_models = state.best_models or dict(models)
+            best_results = state.best_results
+            validation_history = list(state.validation_history)
+            completed_steps = state.completed_steps
+            logger.info(
+                "resuming coordinate descent from %s at step %d",
+                checkpoint_dir,
+                completed_steps,
+            )
+
     scores: Dict[str, jnp.ndarray] = {}
     summed = jnp.zeros((n,), dtype)
-    timing: Dict[str, float] = {}
-
-    # Locked coordinates and warm-start models contribute scores immediately
-    # (reference seeds summedScores from initial models, :168-220).
+    # Locked coordinates, warm-start and checkpointed models contribute
+    # scores immediately (reference seeds summedScores from initial models,
+    # :168-220; on resume the residual state is a pure function of models).
     for cid in ids:
         if cid in models:
             s = coordinates[cid].score(models[cid])
             scores[cid] = s
             summed = summed + s
 
-    validation_history: List[Tuple[int, str, EvaluationResults]] = []
     val_scores: Dict[str, jnp.ndarray] = {}
     if validation_scorer is not None:
         for cid in ids:
             if cid in models:
                 val_scores[cid] = validation_scorer(cid, models[cid])
 
-    best_results: Optional[EvaluationResults] = None
-    best_models: Dict[str, object] = dict(models)
-
     import jax
 
     root_key = jax.random.PRNGKey(seed)
     pass_results: Optional[EvaluationResults] = None
+    last_unlocked = unlocked[-1]
     for it in range(num_iterations):
         for ci, cid in enumerate(ids):
             if cid in locked:
                 continue
+            step = it * len(ids) + ci
+            if step < completed_steps:
+                continue  # fast-forward past checkpointed updates
             coord = coordinates[cid]
             t0 = time.perf_counter()
             residual = summed - scores.get(cid, jnp.zeros((n,), dtype))
@@ -116,7 +169,7 @@ def run_coordinate_descent(
             if getattr(coord.config, "down_sampling_rate", 1.0) < 1.0:
                 # Fresh subsample per optimize call, as in the reference's
                 # runWithSampling (DistributedOptimizationProblem.scala:144).
-                kwargs["key"] = jax.random.fold_in(root_key, it * len(ids) + ci)
+                kwargs["key"] = jax.random.fold_in(root_key, step)
             model, _stats = coord.train(offsets, models.get(cid), **kwargs)
             new_scores = coord.score(model)
             summed = residual + new_scores
@@ -137,12 +190,27 @@ def run_coordinate_descent(
                 logger.info("validation after %s: %s", cid, results.results)
                 pass_results = results
 
-        # Best-model selection happens on full passes only, when every
-        # coordinate's model exists (CoordinateDescent.scala:499-652) —
-        # a mid-pass snapshot could capture a partial GameModel.
-        if pass_results is not None and pass_results.better_than(best_results):
-            best_results = pass_results
-            best_models = dict(models)
+            # Best-model selection happens on full passes only, when every
+            # coordinate's model exists (CoordinateDescent.scala:499-652) —
+            # applied at the pass's last trained coordinate so the update is
+            # covered by this step's checkpoint.
+            best_updated = False
+            if cid == last_unlocked and pass_results is not None and pass_results.better_than(best_results):
+                best_results = pass_results
+                best_models = dict(models)
+                best_updated = True
+
+            if ckpt is not None:
+                ckpt.save(
+                    completed_steps=step + 1,
+                    seed=seed,
+                    config_key=ckpt_config_key,
+                    models=models,
+                    trained_cid=cid,
+                    best_is_current=best_updated,
+                    best_results=best_results,
+                    validation_history=validation_history,
+                )
 
     final = GameModel(dict(models))
     best = GameModel(dict(best_models)) if best_models else final

@@ -91,9 +91,16 @@ def random_effect_margins(features, entity_rows: Array, matrix: Array, norm) -> 
     Shared by RandomEffectCoordinate scoring and GameTransformer. jit-safe.
     """
     from photon_ml_tpu.data.containers import SparseFeatures as _SF
+    from photon_ml_tpu.ops.normalization import PerEntityNormalization
 
     shift = None
-    if norm is not None and not norm.is_identity:
+    if isinstance(norm, PerEntityNormalization) and not norm.is_identity:
+        # Projected-space normalization: each entity row has its own
+        # factors/shifts (IndexMapProjectorRDD.scala:133).
+        matrix = norm.effective_matrix(matrix)
+        if norm.shifts is not None:
+            shift = -jnp.sum(norm.shifts * matrix, axis=1)  # (E+1,)
+    elif norm is not None and not norm.is_identity:
         matrix = jax.vmap(norm.effective_coefficients)(matrix)
         if norm.shifts is not None:
             shift = -(matrix @ norm.shifts)  # (E+1,) margin shifts

@@ -70,9 +70,18 @@ def artifact_from_game_model(
                 None if variances is None else np.asarray(variances),
             )
         elif isinstance(m, RandomEffectModel):
+            from photon_ml_tpu.ops.normalization import PerEntityNormalization
+
             matrix = m.coefficients_matrix
             variances = m.variances_matrix
-            if norm is not None and not norm.is_identity:
+            if isinstance(norm, PerEntityNormalization):
+                # Projected-space contexts: per-entity factor/shift rows
+                # (IndexMapProjectorRDD.scala:133), still in projected space;
+                # the projector scatter below maps to global indices.
+                matrix, variances = norm.matrix_to_original_space(
+                    jnp.asarray(matrix), variances
+                )
+            elif norm is not None and not norm.is_identity:
                 # Row-wise modelToOriginalSpace: factors plus, for identity-
                 # projected shards with shifts, the intercept fold-in.
                 import jax
@@ -174,7 +183,11 @@ def warm_start_model_for_estimator(
             matrix = jnp.asarray(aligned)
             if spec.projector is not None:
                 matrix = spec.projector.project_matrix(matrix)
-            if norm is not None and not norm.is_identity:
+            from photon_ml_tpu.ops.normalization import PerEntityNormalization
+
+            if isinstance(norm, PerEntityNormalization):
+                matrix = norm.matrix_to_transformed_space(matrix)
+            elif norm is not None and not norm.is_identity:
                 import jax
 
                 matrix = jax.vmap(norm.model_to_transformed_space)(matrix)

@@ -146,3 +146,101 @@ def from_feature_stats(
 
 def _safe_inv(x: Array) -> Array:
     return jnp.where(x > 0.0, 1.0 / jnp.where(x > 0.0, x, 1.0), 1.0)
+
+
+class PerEntityNormalization(NamedTuple):
+    """Per-entity projected normalization contexts
+    (IndexMapProjectorRDD.projectNormalizationContexts:133).
+
+    When a random-effect coordinate trains in a per-entity compacted feature
+    space (IndexMapProjector), the GLOBAL normalization context — computed on
+    the original shard over all data — maps into each entity's local slots:
+    factors[e, j] = global_factors[slot_tables[e, j]] (and likewise shifts).
+    Padding slots get (factor 1, shift 0) so they stay inert. Stored as
+    (E+1, D_proj) matrices, one row per entity, vmapped alongside the entity
+    solves. `intercept_slots[e]` is the entity's local slot of the global
+    intercept (-1 when absent), used by the space-conversion maps.
+    """
+
+    factors: Optional[Array] = None
+    shifts: Optional[Array] = None
+    intercept_slots: Optional[Array] = None  # (E+1,) int32, -1 = none
+
+    @property
+    def is_identity(self) -> bool:
+        return self.factors is None and self.shifts is None
+
+    def row_context(self, factors_row, shifts_row) -> NormalizationContext:
+        """Per-entity NormalizationContext inside a vmapped solve. The
+        intercept index is irrelevant to the effective-coefficient algebra,
+        so it is not threaded through."""
+        return NormalizationContext(factors_row, shifts_row, None)
+
+    def effective_matrix(self, matrix: Array) -> Array:
+        """(E+1, D_proj) coefficient matrix -> effective (factor-folded)."""
+        return matrix if self.factors is None else matrix * self.factors
+
+    def matrix_to_original_space(self, matrix: Array, variances: Optional[Array] = None):
+        """Row-wise model_to_original_space over the entity axis."""
+        if self.is_identity:
+            return matrix, variances
+        m = self.effective_matrix(matrix)
+        if self.shifts is not None:
+            if self.intercept_slots is None:
+                raise ValueError("Per-entity shifts require intercept slots")
+            fold = -jnp.sum(self.shifts * m, axis=1)  # (E+1,)
+            rows = jnp.arange(m.shape[0])
+            slots = jnp.clip(self.intercept_slots, 0)
+            m = m.at[rows, slots].add(
+                jnp.where(self.intercept_slots >= 0, fold, 0.0)
+            )
+        if variances is not None and self.factors is not None:
+            variances = variances * jnp.square(self.factors)
+        return m, variances
+
+    def matrix_to_transformed_space(self, matrix: Array) -> Array:
+        """Row-wise model_to_transformed_space (warm-start direction)."""
+        if self.is_identity:
+            return matrix
+        m = matrix
+        if self.shifts is not None:
+            if self.intercept_slots is None:
+                raise ValueError("Per-entity shifts require intercept slots")
+            fold = jnp.sum(self.shifts * matrix, axis=1)
+            rows = jnp.arange(m.shape[0])
+            slots = jnp.clip(self.intercept_slots, 0)
+            m = m.at[rows, slots].add(
+                jnp.where(self.intercept_slots >= 0, fold, 0.0)
+            )
+        return m / self.factors if self.factors is not None else m
+
+
+def project_normalization(
+    norm: NormalizationContext, slot_tables
+) -> PerEntityNormalization:
+    """Project a global context through per-entity index compaction tables
+    ((E+1, D_proj) of global indices, -1 = padding) —
+    IndexMapProjectorRDD.scala:133's projected NormalizationContexts."""
+    import numpy as np
+
+    tables = np.asarray(slot_tables)
+    cols = np.where(tables >= 0, tables, 0)
+    pad = tables < 0
+    factors = None
+    if norm.factors is not None:
+        f = np.asarray(norm.factors)[cols]
+        f[pad] = 1.0
+        factors = jnp.asarray(f)
+    shifts = None
+    intercept_slots = None
+    if norm.shifts is not None:
+        s = np.asarray(norm.shifts)[cols]
+        s[pad] = 0.0
+        shifts = jnp.asarray(s)
+        if norm.intercept_index is None:
+            raise ValueError("Normalization with shifts requires an intercept")
+        hits = tables == norm.intercept_index
+        intercept_slots = jnp.asarray(
+            np.where(hits.any(axis=1), hits.argmax(axis=1), -1), jnp.int32
+        )
+    return PerEntityNormalization(factors, shifts, intercept_slots)

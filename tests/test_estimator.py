@@ -163,3 +163,110 @@ class TestGameEstimator:
         # One compiled coordinate object per (cid, static config): the sweep
         # must not grow the cache beyond 2.
         assert len(est._coordinate_cache) == 2
+
+
+class TestProjectedNormalization:
+    """STANDARDIZATION on INDEX_MAP-projected random-effect shards via
+    per-entity projected NormalizationContexts
+    (IndexMapProjectorRDD.scala:133)."""
+
+    def _sparse_glmix(self, seed, n=400, n_entities=8, d=6, full_support=True):
+        """Sparse RE shard with an intercept column (last). When
+        `full_support`, every entity sees every feature, making INDEX_MAP
+        projection a pure re-indexing — mathematically identical to
+        IDENTITY."""
+        from photon_ml_tpu.data.containers import SparseFeatures
+
+        rng = np.random.default_rng(seed)
+        X = rng.normal(size=(n, d)).astype(np.float32) + 2.0  # shifted data
+        X[:, d - 1] = 1.0  # intercept
+        entity = rng.integers(0, n_entities, size=n)
+        if not full_support:
+            # Each entity only uses a subset of the non-intercept features.
+            for e in range(n_entities):
+                drop = rng.choice(d - 1, size=2, replace=False)
+                X[np.ix_(entity == e, drop)] = 0.0
+        b = rng.normal(size=(n_entities, d))
+        margins = np.einsum("nd,nd->n", X, b[entity]) * 0.5
+        y = (rng.uniform(size=n) < 1 / (1 + np.exp(-margins))).astype(np.float32)
+        idx = np.broadcast_to(np.arange(d, dtype=np.int32), (n, d)).copy()
+        sf = SparseFeatures(jnp.asarray(idx), jnp.asarray(X), d)
+        return GameDataset.build({"e": sf}, y, id_tags={"m": entity}), d
+
+    def _fit(self, ds, d, projector):
+        from photon_ml_tpu.types import ProjectorType
+
+        est = GameEstimator(
+            TaskType.LOGISTIC_REGRESSION,
+            {
+                "per-m": RandomEffectDataConfig(
+                    "m", "e", min_bucket=4, projector_type=projector
+                )
+            },
+            normalization=NormalizationType.STANDARDIZATION,
+            intercept_indices={"e": d - 1},
+        )
+        cfg = {
+            "per-m": CoordinateOptimizationConfig(
+                optimizer=OptimizerConfig(max_iterations=60, tolerance=1e-9),
+                regularization=L2,
+                reg_weight=1.0,
+            )
+        }
+        results = est.fit(ds, None, [cfg])
+        return est, results[0].model
+
+    def test_index_map_matches_identity_on_full_support(self):
+        from photon_ml_tpu.io.model_bridge import artifact_from_game_model
+        from photon_ml_tpu.types import ProjectorType
+
+        ds, d = self._sparse_glmix(0, full_support=True)
+        est_id, model_id = self._fit(ds, d, ProjectorType.IDENTITY)
+        ds2, _ = self._sparse_glmix(0, full_support=True)
+        est_ix, model_ix = self._fit(ds2, d, ProjectorType.INDEX_MAP)
+
+        # Original-space artifacts must agree: the projected solve is the
+        # same optimization in permuted coordinates.
+        art_id = artifact_from_game_model(
+            model_id, est_id.scoring_specs(), TaskType.LOGISTIC_REGRESSION
+        )
+        art_ix = artifact_from_game_model(
+            model_ix, est_ix.scoring_specs(), TaskType.LOGISTIC_REGRESSION
+        )
+        a, b = art_id.coordinates["per-m"], art_ix.coordinates["per-m"]
+        assert a.entity_ids == b.entity_ids
+        np.testing.assert_allclose(a.means, b.means, rtol=5e-3, atol=2e-3)
+
+        # And the transformers score identically.
+        from photon_ml_tpu.transformers.game_transformer import GameTransformer
+
+        t_id = GameTransformer(model_id, est_id.scoring_specs(), TaskType.LOGISTIC_REGRESSION)
+        t_ix = GameTransformer(model_ix, est_ix.scoring_specs(), TaskType.LOGISTIC_REGRESSION)
+        s_id = np.asarray(t_id.transform(ds).scores)
+        s_ix = np.asarray(t_ix.transform(ds2).scores)
+        np.testing.assert_allclose(s_id, s_ix, rtol=5e-3, atol=2e-3)
+
+    def test_standardization_trains_on_sparse_support(self):
+        """Partial per-entity support: the projected solve must converge and
+        round-trip through the model store in original space."""
+        from photon_ml_tpu.io import model_store
+        from photon_ml_tpu.io.model_bridge import artifact_from_game_model
+        from photon_ml_tpu.types import ProjectorType
+        from photon_ml_tpu.evaluation.metrics import area_under_roc_curve
+
+        ds, d = self._sparse_glmix(1, full_support=False)
+        est, model = self._fit(ds, d, ProjectorType.INDEX_MAP)
+        specs = est.scoring_specs()
+
+        from photon_ml_tpu.transformers.game_transformer import GameTransformer
+
+        t = GameTransformer(model, specs, TaskType.LOGISTIC_REGRESSION)
+        scores = t.transform(ds).scores
+        assert bool(jnp.all(jnp.isfinite(scores)))
+        auc = float(area_under_roc_curve(scores, ds.labels))
+        assert auc > 0.75
+
+        art = artifact_from_game_model(model, specs, TaskType.LOGISTIC_REGRESSION)
+        re_art = art.coordinates["per-m"]
+        assert np.all(np.isfinite(re_art.means))
+        assert re_art.means.shape[1] == d  # original space
