@@ -111,7 +111,14 @@ def _child() -> None:
     )
     from photon_ml_tpu.types import OptimizerType, TaskType
 
+    t_start = time.perf_counter()
+
+    def _mark(msg):
+        sys.stderr.write(f"bench: +{time.perf_counter() - t_start:.1f}s {msg}\n")
+        sys.stderr.flush()
+
     platform = jax.devices()[0].platform
+    _mark(f"backend up ({platform})")
     scale = float(os.environ.get("BENCH_SCALE", "1.0"))
     n = int((1 << 20) * scale)
     d_fixed, d_re = 512, 16
@@ -127,16 +134,20 @@ def _child() -> None:
     u = jax.random.normal(ku, (n_entities, d_re)) * 0.5
     margin = Xf @ w + jnp.einsum("nd,nd->n", Xe, u[jnp.asarray(entity)])
     y = (jax.random.uniform(key, (n,)) < jax.nn.sigmoid(margin)).astype(f32)
+    jax.block_until_ready(y)
+    _mark("synthetic arrays materialized")
 
     ds = GameDataset.build(
         {"global": Xf, "per_entity": Xe}, y, id_tags={"entityId": entity}
     )
+    _mark("GameDataset built")
     red = build_random_effect_dataset(
         ds,
         RandomEffectDataConfig(
             "entityId", "per_entity", active_upper_bound=128, min_bucket=32
         ),
     )
+    _mark("RandomEffectDataset built")
     cfg_f = CoordinateOptimizationConfig(
         optimizer=OptimizerConfig(max_iterations=40, tolerance=1e-8),
         regularization=L2,
@@ -148,7 +159,9 @@ def _child() -> None:
         reg_weight=10.0,
     )
     fixed = FixedEffectCoordinate(ds, "global", cfg_f, TaskType.LOGISTIC_REGRESSION)
+    _mark(f"FixedEffectCoordinate built (dispatch={fixed._use_pallas!r})")
     rand = RandomEffectCoordinate(ds, red, cfg_r, TaskType.LOGISTIC_REGRESSION)
+    _mark("RandomEffectCoordinate built")
     coords = {"fixed": fixed, "per-entity": rand}
     variants = {}
 
@@ -237,11 +250,15 @@ def _child() -> None:
     )
 
     # ---- scoring throughput (GameTransformer margins + link) --------------
+    # X passed as an ARGUMENT: a closure capture would lower the 2 GB
+    # design matrix as a program constant and ship it with the executable.
     @jax.jit
-    def score(wv):
-        return jax.nn.sigmoid(Xf @ wv + ds.offsets)
+    def score(features, offsets, wv):
+        return jax.nn.sigmoid(features @ wv + offsets)
 
-    score_wall, _ = timed(lambda: score(res_lbfgs.coefficients), "scoring")
+    score_wall, _ = timed(
+        lambda: score(Xf, ds.offsets, res_lbfgs.coefficients), "scoring"
+    )
     score_bytes = n * d_fixed * 4
     variants["scoring"] = dict(
         wall_s=round(score_wall, 4),

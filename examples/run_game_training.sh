@@ -17,8 +17,18 @@ export PYTHONPATH="$REPO_DIR${PYTHONPATH:+:$PYTHONPATH}"
 DATA="$ROOT/data"
 mkdir -p "$DATA"
 
-echo "== 1/4 generate a1a-like dataset =="
-python "$REPO_DIR/examples/generate_dataset.py" "$DATA" --train 1600 --test 800
+# Prefer the REAL adult-income dataset when the reference's fixtures are
+# mounted (DriverIntegTest ships a9a/a9a.t — the same family as the
+# tutorial's a1a); fall back to the deterministic synthetic stand-in.
+REF_A9A="${REF_A9A:-/root/reference/photon-client/src/integTest/resources/DriverIntegTest/input}"
+if [[ -f "$REF_A9A/a9a" && -f "$REF_A9A/a9a.t" ]]; then
+    echo "== 1/4 use the reference's a9a LibSVM fixtures =="
+    cp "$REF_A9A/a9a" "$DATA/train.libsvm"
+    cp "$REF_A9A/a9a.t" "$DATA/test.libsvm"
+else
+    echo "== 1/4 generate a1a-like dataset (reference fixtures not mounted) =="
+    python "$REPO_DIR/examples/generate_dataset.py" "$DATA" --train 1600 --test 800
+fi
 
 echo "== 2/4 convert LibSVM -> TrainingExample Avro =="
 python -m photon_ml_tpu.cli.libsvm_to_avro "$DATA/train.libsvm" "$DATA/train.avro"

@@ -386,6 +386,16 @@ def _run_job(
             create_constraint_feature_map,
         )
 
+        if args.normalization != NormalizationType.NONE:
+            # The bounds are original-space per-feature boxes; the optimizer
+            # clips TRANSFORMED-space coefficients, so with normalization a
+            # clipped model could still violate the user's bounds after the
+            # original-space fold-out. Refuse rather than silently violate.
+            raise ValueError(
+                f"coordinate {cfg.name!r}: box constraints cannot combine "
+                "with --normalization (bounds apply in original feature "
+                "space; the optimizer works in normalized space)"
+            )
         dc_cfg = cfg.data_config
         if isinstance(dc_cfg, RandomEffectDataConfig) and dc_cfg.projector_type not in (
             ProjectorType.IDENTITY,

@@ -262,10 +262,13 @@ class GameEstimator:
         """CoordinateFactory.build (CoordinateFactory.scala:51) with a cache
         keyed by the static parts of the config — the reg weight is traced, so
         sweep steps share compiled programs."""
-        static_cfg = dataclasses.replace(opt_config, reg_weight=0.0)
-        key = (cid, _static_config_key(static_cfg))
+        key = (cid, _static_config_key(opt_config))
         coord = self._coordinate_cache.get(key)
         if coord is None:
+            # Coordinates are constructed with the weight zeroed so the
+            # baked-in config carries no sweep-step value (the real weight is
+            # a traced argument to every train call).
+            static_cfg = dataclasses.replace(opt_config, reg_weight=0.0)
             if prep.re_dataset is not None:
                 coord = RandomEffectCoordinate(
                     dataset, prep.re_dataset, static_cfg, self.task, prep.norm

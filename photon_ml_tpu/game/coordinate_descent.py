@@ -106,7 +106,13 @@ def run_coordinate_descent(
             tuple(ids),
             tuple(sorted(locked)),
             tuple(static_config_key(coordinates[c].config) for c in ids),
-            tuple(sorted((reg_weights or {}).items())),
+            # Effective per-coordinate reg weight: the override when given,
+            # else the coordinate's own configured weight (static_config_key
+            # deliberately excludes it, so it must enter here).
+            tuple(
+                (c, float((reg_weights or {}).get(c, coordinates[c].config.reg_weight)))
+                for c in ids
+            ),
         )
         ckpt_config_key = hashlib.sha256(repr(fp).encode()).hexdigest()
 
