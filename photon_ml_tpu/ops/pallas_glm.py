@@ -76,7 +76,12 @@ Array = jax.Array
 # Row-tile height. 512 rows x 512 features x 4 B = 1 MB per X tile; with
 # double buffering and the [D, 1]/[D, 2] operands this stays well inside the
 # ~16 MB/core VMEM envelope up to D ~ 4096.
-_TILE_N = 512
+_TILE_N = int(os.environ.get("PHOTON_PALLAS_TILE", "512"))
+if _TILE_N < 8 or _TILE_N % 8 != 0:
+    raise ValueError(
+        f"PHOTON_PALLAS_TILE={_TILE_N}: must be a positive multiple of 8 "
+        "(TPU sublane alignment)"
+    )
 # VMEM budget for one X tile (bytes). Above this, fall back to XLA rather
 # than blocking the feature dimension (a D-blocked variant would need a
 # second pass for margins; XLA is already fine for very wide problems).
@@ -85,6 +90,24 @@ _MIN_ROWS = 4 * _TILE_N
 _MIN_COLS = 128
 
 _DISABLE_ENV = "PHOTON_DISABLE_PALLAS"
+
+# MXU precision for the kernels' thin matmuls. HIGHEST (6-pass bf16 = full
+# f32) matches a float64 host reference to ~2e-5 and is the default; the
+# kernels are HBM-bound at these shapes, so the extra MXU passes are cheap.
+# Override with PHOTON_PALLAS_PRECISION=high|default to trade accuracy for
+# MXU throughput on wider problems.
+_PRECISION_NAMES = {
+    "highest": jax.lax.Precision.HIGHEST,
+    "high": jax.lax.Precision.HIGH,
+    "default": jax.lax.Precision.DEFAULT,
+}
+_prec_name = os.environ.get("PHOTON_PALLAS_PRECISION", "highest").strip().lower()
+if _prec_name not in _PRECISION_NAMES:
+    raise ValueError(
+        f"PHOTON_PALLAS_PRECISION={_prec_name!r}: expected one of "
+        f"{sorted(_PRECISION_NAMES)}"
+    )
+_PRECISION = _PRECISION_NAMES[_prec_name]
 
 # Kill switch. Initialized from PHOTON_DISABLE_PALLAS at import; flip at
 # runtime with `set_enabled`. NOTE: `should_use` runs at *trace* time, so a
@@ -316,7 +339,7 @@ def _value_grad_kernel(loss: PointwiseLoss, n: int, x_ref, y_ref, off_ref,
     z = jax.lax.dot_general(
         x, w_ref[:], dimension_numbers=(((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
-        precision=jax.lax.Precision.HIGHEST,
+        precision=_PRECISION,
     ) + jnp.where(valid, off_ref[:], 0.0)
     y = jnp.where(valid, y_ref[:], 0.0)
     wt = jnp.where(valid, wt_ref[:], 0.0)
@@ -325,7 +348,7 @@ def _value_grad_kernel(loss: PointwiseLoss, n: int, x_ref, y_ref, off_ref,
     g = jax.lax.dot_general(
         x, u, dimension_numbers=(((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
-        precision=jax.lax.Precision.HIGHEST,
+        precision=_PRECISION,
     )
     sum_u = jnp.sum(u)
 
@@ -350,7 +373,7 @@ def _hvp_kernel(loss: PointwiseLoss, n: int, x_ref, y_ref, off_ref, wt_ref,
     zq = jax.lax.dot_general(
         x, wv_ref[:], dimension_numbers=(((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
-        precision=jax.lax.Precision.HIGHEST,
+        precision=_PRECISION,
     )
     z = zq[:, 0:1] + jnp.where(valid, off_ref[:], 0.0)
     q = zq[:, 1:2] + vshift_ref[0, 0]
@@ -358,7 +381,7 @@ def _hvp_kernel(loss: PointwiseLoss, n: int, x_ref, y_ref, off_ref, wt_ref,
     hv = jax.lax.dot_general(
         x, r, dimension_numbers=(((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
-        precision=jax.lax.Precision.HIGHEST,
+        precision=_PRECISION,
     )
     sum_r = jnp.sum(r)
 
