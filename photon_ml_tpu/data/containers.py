@@ -56,6 +56,14 @@ class SparseFeatures:
         2-D only: batched blocks go through vmap (which rewrites the scatter
         per-lane); an unbatched call on (..., N, K) data would silently sum
         across batch members, so it is rejected.
+
+        Scatter-add is the measured-best TPU primitive for this (v5e,
+        1M x 64 nnz into dim 16384: scatter 565 ms vs sorted segment-sum
+        1581 ms vs static-permutation cumsum-diff 1013 ms) — sort-based
+        reformulations pay more for the 67M-element random gather than the
+        scatter costs. The op remains far from HBM roofline; a Pallas
+        VMEM-accumulator kernel is the remaining headroom if Mosaic grows a
+        fast vector scatter.
         """
         if self.indices.ndim != 2:
             raise ValueError("rmatvec is per-problem; vmap over leading axes")

@@ -57,6 +57,10 @@ class OptResult(NamedTuple):
     # Hessian-vector products — each streams the design matrix once on the
     # fused path, so wall-clock / fn_evals is the per-pass cost.
     fn_evals: Optional[Array] = None
+    # (max_iterations + 1, D) per-iteration coefficient snapshots when
+    # track_coefficients is requested (the reference OptimizationStatesTracker
+    # keeps full OptimizerStates; here it is an opt-in fixed-size array).
+    coefficients_history: Optional[Array] = None
 
     @property
     def converged(self) -> Array:
@@ -111,6 +115,21 @@ def record_loss(history: Array, iteration: Array, loss: Array) -> Array:
 def empty_history(max_iterations: int, tracking: bool, dtype) -> Array:
     n = max_iterations + 1 if tracking else 0
     return jnp.full((n,), jnp.nan, dtype=dtype)
+
+
+def empty_coef_history(max_iterations: int, tracking: bool, w0: Array) -> Array:
+    """(max_iterations + 1, D) NaN-filled snapshot buffer with w0 at row 0
+    (zero rows when tracking is off)."""
+    rows = max_iterations + 1 if tracking else 0
+    hist = jnp.full((rows, w0.shape[0]), jnp.nan, w0.dtype)
+    return hist.at[0].set(w0) if rows else hist
+
+
+def record_coefficients(history: Array, iteration: Array, w: Array) -> Array:
+    """Append a coefficient snapshot if tracking is enabled."""
+    if history.shape[0] == 0:
+        return history
+    return history.at[iteration].set(w)
 
 
 def safe_div(a: Array, b: Array, eps: float = 0.0) -> Array:

@@ -37,7 +37,9 @@ from photon_ml_tpu.optimize.common import (
     ConvergenceReason,
     OptResult,
     check_convergence,
+    empty_coef_history,
     empty_history,
+    record_coefficients,
     record_loss,
     safe_div,
 )
@@ -80,6 +82,7 @@ class _Carry(NamedTuple):
     init_gnorm: Array
     loss_history: Array
     gnorm_history: Array
+    coef_history: Array
     evals: Array  # cumulative objective evaluations (incl. line search)
 
 
@@ -124,6 +127,7 @@ def _two_loop(pg: Array, S: Array, Y: Array, rho: Array, k: Array) -> Array:
         "use_box",
         "max_line_search",
         "tracking",
+        "track_coefficients",
     ),
 )
 def _minimize(
@@ -141,6 +145,7 @@ def _minimize(
     use_box: bool,
     max_line_search: int,
     tracking: bool,
+    track_coefficients: bool,
 ) -> OptResult:
     dtype = w0.dtype
     dim = w0.shape[0]
@@ -166,6 +171,7 @@ def _minimize(
     history = record_loss(history, jnp.zeros((), jnp.int32), f0)
     gnorm_history = empty_history(max_iterations, tracking, dtype)
     gnorm_history = record_loss(gnorm_history, jnp.zeros((), jnp.int32), init_gnorm)
+    coef_history = empty_coef_history(max_iterations, track_coefficients, w0)
 
     init = _Carry(
         x=w0,
@@ -185,6 +191,7 @@ def _minimize(
         init_gnorm=init_gnorm,
         loss_history=history,
         gnorm_history=gnorm_history,
+        coef_history=coef_history,
         evals=jnp.ones((), jnp.int32),
     )
 
@@ -278,6 +285,7 @@ def _minimize(
             gnorm_history=record_loss(
                 c.gnorm_history, iteration, jnp.linalg.norm(pg_out)
             ),
+            coef_history=record_coefficients(c.coef_history, iteration, x_out),
             evals=c.evals + ls_tries + 1,
         )
 
@@ -291,6 +299,7 @@ def _minimize(
         loss_history=final.loss_history,
         gradient_norm_history=final.gnorm_history,
         fn_evals=final.evals,
+        coefficients_history=final.coef_history if final.coef_history.shape[0] else None,
     )
 
 
@@ -307,6 +316,7 @@ def minimize_lbfgs(
     upper_bounds: Optional[Array] = None,
     max_line_search: int = _MAX_LINE_SEARCH,
     tracking: bool = False,
+    track_coefficients: bool = False,
 ) -> OptResult:
     """Minimize `value_and_grad_fn` (smooth part) from `w0`.
 
@@ -338,5 +348,7 @@ def minimize_lbfgs(
         use_l1=use_l1,
         use_box=use_box,
         max_line_search=max_line_search,
-        tracking=tracking,
+        # Requesting snapshots implies state tracking (no silent None).
+        tracking=tracking or track_coefficients,
+        track_coefficients=track_coefficients,
     )

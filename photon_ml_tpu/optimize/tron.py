@@ -35,7 +35,9 @@ from photon_ml_tpu.optimize.common import (
     ConvergenceReason,
     OptResult,
     check_convergence,
+    empty_coef_history,
     empty_history,
+    record_coefficients,
     record_loss,
     safe_div,
 )
@@ -145,6 +147,7 @@ class _Carry(NamedTuple):
     init_gnorm: Array
     loss_history: Array
     gnorm_history: Array
+    coef_history: Array
     evals: Array  # value/gradient evaluations + CG Hessian-vector products
 
 
@@ -156,6 +159,7 @@ class _Carry(NamedTuple):
         "max_iterations",
         "max_failures",
         "tracking",
+        "track_coefficients",
     ),
 )
 def minimize_tron(
@@ -167,8 +171,11 @@ def minimize_tron(
     tolerance: float = DEFAULT_TOLERANCE,
     max_failures: int = DEFAULT_MAX_FAILURES,
     tracking: bool = False,
+    track_coefficients: bool = False,
 ) -> OptResult:
     """Minimize with trust-region Newton; `hessian_vector_fn(w, v) -> H(w) v`."""
+    # Requesting snapshots implies state tracking (no silent None).
+    tracking = tracking or track_coefficients
     dtype = w0.dtype
     f0, g0 = value_and_grad_fn(w0)
     init_gnorm = jnp.linalg.norm(g0)
@@ -177,6 +184,7 @@ def minimize_tron(
     history = record_loss(history, jnp.zeros((), jnp.int32), f0)
     gnorm_history = empty_history(max_iterations, tracking, dtype)
     gnorm_history = record_loss(gnorm_history, jnp.zeros((), jnp.int32), init_gnorm)
+    coef_history = empty_coef_history(max_iterations, track_coefficients, w0)
 
     init = _Carry(
         x=w0,
@@ -193,6 +201,7 @@ def minimize_tron(
         init_gnorm=init_gnorm,
         loss_history=history,
         gnorm_history=gnorm_history,
+        coef_history=coef_history,
         evals=jnp.ones((), jnp.int32),
     )
 
@@ -275,6 +284,7 @@ def minimize_tron(
             gnorm_history=record_loss(
                 c.gnorm_history, iteration, jnp.linalg.norm(g_new)
             ),
+            coef_history=record_coefficients(c.coef_history, iteration, x_new),
             evals=c.evals + hvp_calls + 1,
         )
 
@@ -288,4 +298,5 @@ def minimize_tron(
         loss_history=final.loss_history,
         gradient_norm_history=final.gnorm_history,
         fn_evals=final.evals,
+        coefficients_history=final.coef_history if final.coef_history.shape[0] else None,
     )

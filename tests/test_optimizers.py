@@ -181,3 +181,38 @@ def test_tracking_records_monotone_losses(rng):
     valid = hist[~np.isnan(hist)]
     assert len(valid) == int(res.iterations) + 1
     assert np.all(np.diff(valid) <= 1e-5)  # non-increasing losses
+
+
+def test_coefficient_history_tracking():
+    """Opt-in per-iteration coefficient snapshots (the reference
+    OptimizationStatesTracker keeps full OptimizerStates)."""
+    A = jnp.asarray(np.diag([1.0, 4.0, 9.0]), jnp.float32)
+    b = jnp.asarray([1.0, -2.0, 3.0], jnp.float32)
+
+    def vg(w):
+        r = A @ w - b
+        return 0.5 * jnp.dot(r, A @ w - b), A.T @ r
+
+    res = minimize_lbfgs(vg, jnp.zeros(3, jnp.float32), tracking=True,
+                         track_coefficients=True, max_iterations=20)
+    hist = np.asarray(res.coefficients_history)
+    its = int(res.iterations)
+    assert hist.shape == (21, 3)
+    np.testing.assert_array_equal(hist[0], 0.0)  # w0 snapshot
+    np.testing.assert_allclose(hist[its], np.asarray(res.coefficients), rtol=1e-6)
+    assert np.all(np.isnan(hist[its + 1:]))  # untouched rows stay NaN
+
+    res_t = minimize_tron(vg, lambda w, v: A.T @ (A @ v),
+                          jnp.zeros(3, jnp.float32), tracking=True,
+                          track_coefficients=True, max_iterations=10)
+    hist_t = np.asarray(res_t.coefficients_history)
+    np.testing.assert_allclose(
+        hist_t[int(res_t.iterations)], np.asarray(res_t.coefficients), rtol=1e-6
+    )
+    # Off by default: no history allocated.
+    res_off = minimize_lbfgs(vg, jnp.zeros(3, jnp.float32), tracking=True)
+    assert res_off.coefficients_history is None
+    # track_coefficients alone implies tracking (no silent None).
+    res_imp = minimize_lbfgs(vg, jnp.zeros(3, jnp.float32), track_coefficients=True)
+    assert res_imp.coefficients_history is not None
+    assert res_imp.loss_history.shape[0] > 0
