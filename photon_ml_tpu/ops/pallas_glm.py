@@ -39,12 +39,22 @@ per core, so accumulating into an output block whose index_map is constant
 is the standard safe reduction pattern.
 
 Dispatch policy (`should_use`): the kernels engage only for problems where
-the fusion pays — dense f32 X, N >= _MIN_ROWS, D >= _MIN_COLS, and a row
-tile that fits the VMEM budget. The vmapped random-effect entity solves
+the fusion pays — dense f32/bf16 X, N >= _MIN_ROWS, D >= _MIN_COLS, and a
+row tile that fits the VMEM budget. The vmapped random-effect entity solves
 (small N, small D per entity) and the sparse path fall through to XLA
 automatically; no flags thread through the optimizer stack. On non-TPU
 backends the kernels run only in interpret mode (tests); the XLA path is
 used otherwise.
+
+Measured roofline (v5e, 1M x 512 f32, full LBFGS solve / fn_evals):
+  ~17 ms per fused pass = ~125 GB/s sustained at HIGHEST precision. The
+  kernel is MXU-bound, not HBM-bound, at these shapes: the width-1/2 RHS
+  pads to the 128-lane MXU tile and HIGHEST multiplies the passes, so
+  bf16 X (half the HBM bytes) measures the SAME wall per pass, and a
+  VPU-only formulation (multiply + cross-sublane reduce) is ~6x slower.
+  DEFAULT precision reaches ~10 ms/pass (~200 GB/s) but its bf16-rounded
+  gradients cost ~1.5x more line-search evaluations — net wash, worse
+  quality, hence the HIGHEST default (see PHOTON_PALLAS_PRECISION).
 """
 
 from __future__ import annotations
@@ -173,6 +183,12 @@ def kernels_healthy() -> bool:
         hv, _ = hessian_vector_sums(
             LOGISTIC, w, zero, w, zero, X, y, off, wt, interpret=FORCE_INTERPRET
         )
+        # dispatch admits bf16 X too; probe that lowering path as well (a
+        # bf16-specific Mosaic failure must not bypass the gate).
+        val_bf, g_bf, _ = value_gradient_sums(
+            LOGISTIC, w, zero, X.astype(jnp.bfloat16), y, off, wt,
+            interpret=FORCE_INTERPRET,
+        )
         z = X @ w
         u = wt * LOGISTIC.d1(z, y)
         val_ref = jnp.sum(wt * LOGISTIC.loss(z, y))
@@ -189,6 +205,9 @@ def kernels_healthy() -> bool:
             bool(jnp.allclose(val, val_ref, rtol=1e-2))
             and bool(jnp.max(jnp.abs(g - g_ref)) < 2e-2 * g_scale + 1e-3)
             and bool(jnp.max(jnp.abs(hv - hv_ref)) < 2e-2 * hv_scale + 1e-3)
+            # bf16 inputs round at ~0.4%; same broken-vs-rounding bar.
+            and bool(jnp.allclose(val_bf, val_ref, rtol=3e-2))
+            and bool(jnp.max(jnp.abs(g_bf - g_ref)) < 5e-2 * g_scale + 1e-2)
         )
         if not ok:
             import logging
@@ -238,7 +257,9 @@ def _static_checks(features, w, n_rows: int) -> bool:
         return False
     if features.dtype not in (jnp.float32, jnp.bfloat16):
         return False
-    if _TILE_N * d * features.dtype.itemsize > _TILE_BYTES_LIMIT:
+    # Budget the tile at its f32 WORKING size: bf16 inputs upcast to f32 in
+    # VMEM, so the input itemsize would under-count by 2x.
+    if _TILE_N * d * 4 > _TILE_BYTES_LIMIT:
         return False
     return True
 
@@ -335,7 +356,9 @@ def _value_grad_kernel(loss: PointwiseLoss, n: int, x_ref, y_ref, off_ref,
                        wt_ref, w_ref, stats_ref, grad_ref):
     i = pl.program_id(0)
     valid = _row_mask(n)
-    x = jnp.where(valid, x_ref[:], 0.0)
+    # bf16 X streams at half the HBM traffic; compute stays f32 in VMEM
+    # (Mosaic rejects mixed-dtype matmul operands).
+    x = jnp.where(valid, x_ref[:], 0.0).astype(jnp.float32)
     z = jax.lax.dot_general(
         x, w_ref[:], dimension_numbers=(((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
@@ -369,7 +392,7 @@ def _hvp_kernel(loss: PointwiseLoss, n: int, x_ref, y_ref, off_ref, wt_ref,
                 wv_ref, vshift_ref, stats_ref, hv_ref):
     i = pl.program_id(0)
     valid = _row_mask(n)
-    x = jnp.where(valid, x_ref[:], 0.0)
+    x = jnp.where(valid, x_ref[:], 0.0).astype(jnp.float32)
     zq = jax.lax.dot_general(
         x, wv_ref[:], dimension_numbers=(((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,

@@ -125,11 +125,10 @@ def empty_coef_history(max_iterations: int, tracking: bool, w0: Array) -> Array:
     return hist.at[0].set(w0) if rows else hist
 
 
-def record_coefficients(history: Array, iteration: Array, w: Array) -> Array:
-    """Append a coefficient snapshot if tracking is enabled."""
-    if history.shape[0] == 0:
-        return history
-    return history.at[iteration].set(w)
+# Coefficient snapshots use the same guard/record semantics as the scalar
+# histories; `record_loss` is rank-agnostic (`.at[iteration].set` works for
+# the (rows, D) buffer too).
+record_coefficients = record_loss
 
 
 def safe_div(a: Array, b: Array, eps: float = 0.0) -> Array:
