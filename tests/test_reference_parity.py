@@ -489,3 +489,106 @@ class TestFeatureSummaryParity:
         assert set(m) == {"max", "min", "mean", "normL1", "normL2", "numNonzeros", "variance"}
         assert m["mean"] == pytest.approx(float(np.asarray(stats.mean)[i3]), rel=1e-6)
         assert m["variance"] == pytest.approx(float(np.asarray(stats.variance)[i3]), rel=1e-6)
+
+
+class TestYahooMusicGameFlow:
+    """GAME-level flows on the reference's yahoo-music records with its own
+    integ-test feature-shard configurations
+    (GameTrainingDriverIntegTest.scala:763-765: shard1 = features ∪
+    userFeatures ∪ songFeatures, shard2 = features ∪ userFeatures,
+    shard3 = songFeatures)."""
+
+    SHARDS = {
+        "shard1": FeatureShardConfig(("features", "userFeatures", "songFeatures"), True),
+        "shard2": FeatureShardConfig(("features", "userFeatures"), True),
+        "shard3": FeatureShardConfig(("songFeatures",), True),
+    }
+    DATA = os.path.join(GAME, "input/duplicateFeatures/yahoo-music-train.avro")
+
+    def test_multi_bag_shards_read(self):
+        ds, imaps = read_game_dataset(
+            self.DATA, self.SHARDS, id_tag_fields=("userId", "songId", "artistId")
+        )
+        assert ds.num_samples == 6
+        assert set(ds.shards) == {"shard1", "shard2", "shard3"}
+        # shard1 unions every bag; shard3 sees only song features + intercept.
+        assert imaps["shard1"].size > imaps["shard3"].size
+        for tag in ("userId", "songId", "artistId"):
+            assert tag in ds.id_tags
+
+    def test_game_training_on_reference_records(self):
+        """Fixed + per-song random effect trains end to end on the actual
+        reference records (LINEAR_REGRESSION, as the fixture's model-spec)."""
+        from photon_ml_tpu.data.game_dataset import (
+            FixedEffectDataConfig,
+            RandomEffectDataConfig,
+        )
+        from photon_ml_tpu.estimators.game_estimator import GameEstimator
+
+        ds, imaps = read_game_dataset(
+            self.DATA, self.SHARDS, id_tag_fields=("userId", "songId")
+        )
+        est = GameEstimator(
+            TaskType.LINEAR_REGRESSION,
+            {
+                "global": FixedEffectDataConfig("shard1"),
+                "per-song": RandomEffectDataConfig("songId", "shard3", min_bucket=2),
+            },
+            intercept_indices={
+                s: imaps[s].intercept_index for s in imaps
+            },
+        )
+        cfg = CoordinateOptimizationConfig(
+            optimizer=OptimizerConfig(OptimizerType.TRON, 10, 1e-5),
+            regularization=L2,
+            reg_weight=10.0,  # the fixture model-spec's global config
+        )
+        results = est.fit(ds, None, [{"global": cfg, "per-song": cfg}])
+        from photon_ml_tpu.transformers.game_transformer import GameTransformer
+
+        t = GameTransformer(results[0].model, est.scoring_specs(), TaskType.LINEAR_REGRESSION)
+        out = t.transform(ds)
+        assert bool(np.all(np.isfinite(np.asarray(out.scores))))
+        # Training reduced the residual against the (rating) responses.
+        base_err = float(rmse(np.zeros(6, np.float32), ds.labels))
+        fit_err = float(rmse(out.scores, ds.labels))
+        assert fit_err < base_err
+
+    def test_score_with_reference_random_effect_model(self):
+        """Load the reference's pre-trained per-song entity models and score
+        records whose songIds the model knows: the RE contribution must match
+        a manual dot product over the raw Avro coefficients."""
+        mdir = os.path.join(GAME, "retrainModels", "mixedEffects")
+        imaps = _index_map_from_model_dir(mdir)
+        art = model_store.load_game_model(mdir, imaps, coordinates_to_load=["per-song"])
+        model, specs = game_model_from_artifact(art)
+
+        ds, _ = read_game_dataset(
+            self.DATA,
+            {"shard2": FeatureShardConfig(("features", "userFeatures"), True)},
+            index_maps=imaps,
+            id_tag_fields=("songId",),
+        )
+        transformer = GameTransformer(model, specs, art.task)
+        scores = np.asarray(transformer.transform(ds).scores)
+
+        song_art = art.coordinates["per-song"]
+        row_of = {eid: i for i, eid in enumerate(song_art.entity_ids)}
+        imap = imaps["shard2"]
+        _, recs = avro_io.read_container(self.DATA)
+        known = 0
+        for i, rec in enumerate(recs):
+            sid = str(rec["songId"])
+            row = row_of.get(sid)
+            if row is None:
+                assert scores[i] == pytest.approx(0.0, abs=1e-5)
+                continue
+            known += 1
+            s = song_art.means[row, imap.get_index(INTERCEPT_KEY)]
+            for bag in ("features", "userFeatures"):
+                for f in rec.get(bag) or ():
+                    j = imap.get_index(feature_key(f["name"], f.get("term", "")))
+                    if j >= 0:
+                        s += song_art.means[row, j] * f["value"]
+            assert scores[i] == pytest.approx(float(s), rel=1e-4, abs=1e-5)
+        assert known >= 1  # the fixture's songs overlap the model
