@@ -332,3 +332,46 @@ class TestDateRangeAndMultiDirInput:
         ])
         ssum = json.load(open(os.path.join(score_out, "scoring-summary.json")))
         assert ssum["num_scored"] == 300
+
+
+class TestHyperparameterTuningCLI:
+    def test_bayesian_tuning_end_to_end(self, tmp_path):
+        """--hyper-parameter-tuning BAYESIAN runs GP trials after the
+        explicit sweep, writes tuned-<i> model dirs, and the selected best
+        model comes from the union (GameTrainingDriver.runHyperparameterTuning
+        -> AtlasTuner -> GaussianProcessSearch)."""
+        train_avro = str(tmp_path / "train.avro")
+        val_avro = str(tmp_path / "val.avro")
+        _write_glmix_avro(train_avro, 0, 300)
+        _write_glmix_avro(val_avro, 1, 150)
+        out = str(tmp_path / "out")
+
+        train_cli.main([
+            "--training-task", "LOGISTIC_REGRESSION",
+            "--input-data-directories", train_avro,
+            "--validation-data-directories", val_avro,
+            "--root-output-directory", out,
+            "--feature-shard-configurations",
+            "name=globalShard,feature.bags=features,intercept=true",
+            "--coordinate-configurations",
+            "name=global,feature.shard=globalShard,optimizer=LBFGS,"
+            "tolerance=1e-7,max.iter=25,regularization=L2,reg.weights=1",
+            "--validation-evaluators", "AUC",
+            "--hyper-parameter-tuning", "BAYESIAN",
+            "--hyper-parameter-tuning-iter", "4",
+            "--output-mode", "ALL",
+        ])
+        summary = json.load(open(os.path.join(out, "training-summary.json")))
+        assert summary["num_tuned"] == 4
+        # Tuned model dirs persisted alongside explicit ones.
+        for i in range(4):
+            assert os.path.isfile(
+                os.path.join(out, "models", f"tuned-{i}", "model-metadata.json")
+            )
+        assert summary["best_evaluation"]["AUC"] > 0.6
+        # Each trial carries its own sampled reg weight in the metadata.
+        weights = set()
+        for i in range(4):
+            meta = json.load(open(os.path.join(out, "models", f"tuned-{i}", "model-metadata.json")))
+            weights.add(json.dumps(meta.get("optimizationConfigurations", {}), sort_keys=True))
+        assert len(weights) > 1  # the search explored, not repeated, configs
