@@ -1,0 +1,325 @@
+"""Legacy single-GLM training driver: the staged pipeline.
+
+Counterpart of photon-client Driver.scala:60-524 (stages
+INIT → PREPROCESSED → TRAINED → VALIDATED, DriverStage.scala:38-49),
+PhotonMLCmdLineParser.scala / Params.scala (argument surface),
+ModelSelection.scala:26-92 (best reg weight), io/deprecated/GLMSuite.scala
+(Avro/LibSVM input formats, constraint maps, text + Avro model output) and
+IOUtils.writeModelsInText:242-280.
+
+The deprecated driver predates GAME: one fixed-effect GLM, a regularization
+sweep trained with warm start (ModelTraining.scala:175-213), per-weight
+validation metrics (evaluation/Evaluation.scala) and model selection. The
+modern GAME driver (`cli/train.py`) covers the same math; this CLI preserves
+the legacy surface — staged execution with stage assertions, LibSVM or
+TrainingExample-Avro input, inline JSON constraint strings, text model
+output (one `name\tterm\tvalue\tregWeight` line per coefficient, sorted by
+value descending) — so reference jobs port directly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import enum
+import json
+import logging
+import os
+import shutil
+import sys
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from photon_ml_tpu.types import (
+    NormalizationType,
+    OptimizerType,
+    RegularizationType,
+    TaskType,
+)
+
+logger = logging.getLogger("photon_ml_tpu.cli.glm_driver")
+
+
+class DriverStage(enum.IntEnum):
+    """DriverStage.scala:45-49."""
+
+    INIT = 0
+    PREPROCESSED = 1
+    TRAINED = 2
+    VALIDATED = 3
+
+
+class InputFormat(enum.Enum):
+    """io/deprecated/InputFormatFactory: TRAINING_EXAMPLE (Avro) | LIBSVM."""
+
+    TRAINING_EXAMPLE = "TRAINING_EXAMPLE"
+    LIBSVM = "LIBSVM"
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="photon-ml-tpu-glm-driver",
+        description="Legacy single-GLM staged training driver (Driver.scala)",
+    )
+    p.add_argument("--training-data-directory", required=True)
+    p.add_argument("--validate-data-directory", default=None)
+    p.add_argument("--output-directory", required=True)
+    p.add_argument("--delete-output-dirs-if-exist", action="store_true")
+    p.add_argument("--format", type=lambda s: InputFormat[s.strip().upper()],
+                   default=InputFormat.TRAINING_EXAMPLE,
+                   help="TRAINING_EXAMPLE (Avro) or LIBSVM")
+    p.add_argument("--task", type=TaskType.parse, default=TaskType.LOGISTIC_REGRESSION)
+    p.add_argument("--regularization-weights", default="0.1,1,10,100",
+                   help="comma-separated sweep (trained descending, warm start)")
+    p.add_argument("--regularization-type", type=RegularizationType.parse,
+                   default=RegularizationType.L2)
+    p.add_argument("--elastic-net-alpha", type=float, default=None)
+    p.add_argument("--optimizer", type=OptimizerType.parse, default=OptimizerType.LBFGS)
+    p.add_argument("--max-iterations", type=int, default=100)
+    p.add_argument("--tolerance", type=float, default=1e-7)
+    p.add_argument("--normalization-type", type=NormalizationType.parse,
+                   default=NormalizationType.NONE)
+    p.add_argument("--intercept", default="true",
+                   help="append the intercept pseudo-feature (true/false)")
+    p.add_argument("--coefficient-constraints", default=None,
+                   help="inline JSON constraint string (GLMSuite.scala:46 "
+                        "format, wildcards supported)")
+    p.add_argument("--summarization-output-dir", default=None,
+                   help="write per-feature statistics as "
+                        "FeatureSummarizationResultAvro")
+    p.add_argument("--logging-level", default="INFO")
+    return p
+
+
+@dataclasses.dataclass
+class _State:
+    stage: DriverStage = DriverStage.INIT
+    stage_history: List[DriverStage] = dataclasses.field(default_factory=list)
+
+    def assert_stage(self, expected: DriverStage) -> None:
+        """Driver.assertDriverStage: refuse to run stages out of order."""
+        if self.stage != expected:
+            raise RuntimeError(
+                f"Expected driver stage {expected.name} but found {self.stage.name}"
+            )
+
+    def update(self, new: DriverStage) -> None:
+        self.stage_history.append(self.stage)
+        self.stage = new
+
+
+def _read(args, path: str, index_map=None):
+    """preprocess(): LibSVM or TrainingExample Avro -> LabeledData (+ map)."""
+    from photon_ml_tpu.data.containers import LabeledData, pack_csr_to_ell
+    import jax.numpy as jnp
+
+    flag = args.intercept.strip().lower()
+    if flag not in ("true", "false"):
+        raise ValueError(f"--intercept must be true or false, got {args.intercept!r}")
+    with_intercept = flag == "true"
+    if args.format == InputFormat.LIBSVM:
+        from photon_ml_tpu.data.libsvm import read_libsvm
+
+        num_features = None
+        if index_map is not None:
+            num_features = index_map.size - (1 if with_intercept else 0)
+        csr = read_libsvm(path, add_intercept=with_intercept, num_features=num_features)
+        feats = pack_csr_to_ell(csr.indptr, csr.indices, csr.values, csr.dim)
+        n = csr.num_rows
+        data = LabeledData(
+            feats,
+            jnp.asarray(csr.labels, jnp.float32),
+            jnp.zeros(n, jnp.float32),
+            jnp.ones(n, jnp.float32),
+        )
+        # LibSVM features are positional; synthesize the name map (feature i
+        # is named str(i+1), as in the reference's LibSVM input format).
+        from photon_ml_tpu.data.index_map import IndexMap
+
+        if index_map is None:
+            names = [str(i + 1) for i in range(csr.dim - (1 if with_intercept else 0))]
+            index_map = IndexMap(
+                {**{n_: i for i, n_ in enumerate(names)},
+                 **({"(INTERCEPT)": csr.dim - 1} if with_intercept else {})}
+            )
+        return data, index_map
+    from photon_ml_tpu.io.avro_data import FeatureShardConfig, read_game_dataset
+
+    shards = {"global": FeatureShardConfig(("features",), with_intercept)}
+    maps = None if index_map is None else {"global": index_map}
+    ds, built = read_game_dataset(path, shards, index_maps=maps)
+    data = LabeledData(ds.shards["global"], ds.labels, ds.offsets, ds.weights)
+    return data, built["global"]
+
+
+def run(args) -> Dict[str, object]:
+    logging.basicConfig(
+        level=getattr(logging, args.logging_level.upper(), logging.INFO),
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.data.stats import summarize
+    from photon_ml_tpu.evaluation import legacy
+    from photon_ml_tpu.io.model_store import write_basic_statistics
+    from photon_ml_tpu.models.training import train_glm_sweep
+    from photon_ml_tpu.ops.normalization import from_feature_stats
+    from photon_ml_tpu.optimize.config import (
+        CoordinateOptimizationConfig,
+        OptimizerConfig,
+        RegularizationContext,
+    )
+    from photon_ml_tpu.utils.observability import (
+        EventEmitter,
+        TrainingFinishEvent,
+        TrainingStartEvent,
+    )
+
+    out_dir = args.output_directory
+    if os.path.exists(out_dir):
+        if not args.delete_output_dirs_if_exist:
+            raise FileExistsError(
+                f"{out_dir} exists; pass --delete-output-dirs-if-exist"
+            )
+        shutil.rmtree(out_dir)
+    os.makedirs(out_dir)
+
+    state = _State()
+    emitter = EventEmitter()
+    emitter.send(TrainingStartEvent(num_samples=-1))
+
+    # INIT -> PREPROCESSED (Driver.preprocess: read, summarize, normalize).
+    state.assert_stage(DriverStage.INIT)
+    train_data, index_map = _read(args, args.training_data_directory)
+    logger.info(
+        "training data: %d samples, %d features",
+        train_data.num_rows,
+        train_data.feature_dim,
+    )
+    stats = summarize(train_data.features, intercept_index=index_map.intercept_index)
+    if args.summarization_output_dir:
+        n_rec = write_basic_statistics(args.summarization_output_dir, stats, index_map)
+        logger.info("feature summary: %d records", n_rec)
+    norm = None
+    if args.normalization_type != NormalizationType.NONE:
+        norm = from_feature_stats(
+            args.normalization_type,
+            mean=stats.mean,
+            variance=stats.variance,
+            max_abs=stats.max_abs,
+            intercept_index=index_map.intercept_index,
+        )
+    state.update(DriverStage.PREPROCESSED)
+
+    # PREPROCESSED -> TRAINED (Driver.train -> ModelTraining sweep).
+    state.assert_stage(DriverStage.PREPROCESSED)
+    reg = RegularizationContext(
+        args.regularization_type,
+        elastic_net_alpha=(
+            args.elastic_net_alpha
+            if args.regularization_type == RegularizationType.ELASTIC_NET
+            else None
+        ),
+    )
+    box = None
+    if args.coefficient_constraints:
+        from photon_ml_tpu.optimize.constraints import (
+            bounds_arrays,
+            create_constraint_feature_map,
+        )
+
+        if args.normalization_type != NormalizationType.NONE:
+            raise ValueError(
+                "constraints cannot combine with normalization (bounds are "
+                "original-space; the optimizer clips normalized coefficients)"
+            )
+        cmap = create_constraint_feature_map(args.coefficient_constraints, index_map)
+        box = bounds_arrays(cmap, index_map.size)
+    cfg = CoordinateOptimizationConfig(
+        optimizer=OptimizerConfig(
+            args.optimizer, args.max_iterations, args.tolerance, box_constraints=box
+        ),
+        regularization=reg,
+    )
+    weights = [float(w) for w in args.regularization_weights.split(",") if w.strip()]
+    if not weights:
+        raise ValueError("--regularization-weights parsed to an empty list")
+    sweep = train_glm_sweep(train_data, args.task, cfg, weights, norm=norm)
+    state.update(DriverStage.TRAINED)
+
+    # TRAINED -> VALIDATED (Driver.validate: metrics per weight + selection).
+    summary: Dict[str, object] = {
+        "num_features": int(train_data.feature_dim),
+        "num_training_samples": int(train_data.num_rows),
+        "regularization_weights": weights,
+    }
+    if args.validate_data_directory:
+        state.assert_stage(DriverStage.TRAINED)
+        val_data, _ = _read(args, args.validate_data_directory, index_map=index_map)
+        metrics_per_weight = {}
+        for rw, model in sweep.models.items():
+            metrics_per_weight[str(rw)] = legacy.evaluate_glm(model, val_data)
+        # Model selection from the metrics just computed (ModelSelection.scala
+        # :26-92: AUC maximized for classifiers, RMSE minimized otherwise) —
+        # no second scoring pass.
+        if args.task in (TaskType.LOGISTIC_REGRESSION, TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM):
+            key, better = legacy.AREA_UNDER_ROC, max
+        else:
+            key, better = legacy.ROOT_MEAN_SQUARE_ERROR, min
+        best_weight = better(sweep.models, key=lambda rw: metrics_per_weight[str(rw)][key])
+        best_value = metrics_per_weight[str(best_weight)][key]
+        summary["validation_metrics"] = metrics_per_weight
+        summary["best_regularization_weight"] = best_weight
+        summary["best_metric_value"] = best_value
+        state.update(DriverStage.VALIDATED)
+        logger.info("best reg weight %s (%s %.5f)", best_weight, key, best_value)
+
+    # Output: learned-models-text (IOUtils.writeModelsInText:242-280 format:
+    # name\tterm\tvalue\tregWeight, sorted by value descending) + Avro.
+    from photon_ml_tpu.io.model_store import (
+        FixedEffectArtifact,
+        GameModelArtifact,
+        save_game_model,
+    )
+
+    text_dir = os.path.join(out_dir, "learned-models-text")
+    os.makedirs(text_dir)
+    from photon_ml_tpu.data.index_map import DELIMITER
+
+    for rw, model in sweep.models.items():
+        means = np.asarray(model.coefficients.means)
+        order = np.argsort(-means)
+        lines = []
+        for idx in order:
+            key = index_map.get_feature_name(int(idx))
+            if key is None:
+                continue
+            name, _, term = key.partition(DELIMITER)
+            lines.append(f"{name}\t{term}\t{means[idx]}\t{rw}")
+        with open(os.path.join(text_dir, f"model-{rw}.txt"), "w") as f:
+            f.write("\n".join(lines) + "\n")
+        save_game_model(
+            os.path.join(out_dir, "models", str(rw)),
+            GameModelArtifact(
+                task=args.task,
+                coordinates={"global": FixedEffectArtifact("global", means)},
+            ),
+            {"global": index_map},
+        )
+    index_map.save(os.path.join(out_dir, "feature-index.json"))
+    summary["stages"] = [s.name for s in state.stage_history + [state.stage]]
+    summary_path = os.path.join(out_dir, "driver-summary.json")
+    with open(summary_path, "w") as f:
+        json.dump(summary, f, indent=2, default=str)
+    emitter.send(TrainingFinishEvent(num_configs=len(sweep.models)))
+    logger.info("final models written to %s", text_dir)
+    return summary
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    run(build_parser().parse_args(argv))
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -113,6 +113,14 @@ def read_libsvm(
         values_a = np.asarray(values, dtype)
 
     base_dim = (max_idx + 1) if num_features is None else num_features
+    if num_features is not None:
+        # Features beyond the training-time space are DROPPED, matching the
+        # Avro reader's unseen-feature behavior (io/avro_data.py) — a kept
+        # out-of-range index would alias another column downstream.
+        oob = indices_a >= base_dim
+        if oob.any():
+            indices_a = np.where(oob, 0, indices_a)
+            values_a = np.where(oob, 0, values_a)
     dim = base_dim + (1 if add_intercept else 0)
     y = labels_a.astype(dtype)
     if binary_labels_to_01 and set(np.unique(y)) <= {-1.0, 1.0}:

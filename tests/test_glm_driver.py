@@ -1,0 +1,90 @@
+"""Legacy staged GLM driver (Driver.scala stages, GLMSuite I/O surface)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.cli import glm_driver
+
+REF_IN = "/root/reference/photon-client/src/integTest/resources/DriverIntegTest/input"
+needs_ref = pytest.mark.skipif(
+    not os.path.isdir(REF_IN), reason="reference fixtures not mounted"
+)
+
+
+@needs_ref
+class TestLegacyDriver:
+    def test_avro_staged_run_with_validation(self, tmp_path):
+        """heart.avro through all four stages: metrics per weight, model
+        selection, text + Avro model output, feature summarization."""
+        out = str(tmp_path / "out")
+        summary = glm_driver.run(glm_driver.build_parser().parse_args([
+            "--training-data-directory", os.path.join(REF_IN, "heart.avro"),
+            "--validate-data-directory", os.path.join(REF_IN, "heart_validation.avro"),
+            "--output-directory", out,
+            "--task", "LOGISTIC_REGRESSION",
+            "--optimizer", "TRON",
+            "--regularization-weights", "0.1,1,10",
+            "--summarization-output-dir", str(tmp_path / "summary"),
+        ]))
+        assert summary["stages"] == ["INIT", "PREPROCESSED", "TRAINED", "VALIDATED"]
+        assert set(summary["validation_metrics"]) == {"0.1", "1.0", "10.0"}
+        m = summary["validation_metrics"][str(summary["best_regularization_weight"])]
+        assert m["Area under ROC"] > 0.7
+        assert "Peak F1 score" in m and "Per-datum log likelihood" in m
+        # Text model format: name\tterm\tvalue\tregWeight, value-descending.
+        lines = open(os.path.join(out, "learned-models-text", "model-10.0.txt")).read().splitlines()
+        vals = [float(l.split("\t")[2]) for l in lines]
+        assert vals == sorted(vals, reverse=True)
+        assert all(l.split("\t")[3] == "10.0" for l in lines)
+        # Avro model per weight reloads through the model store.
+        from photon_ml_tpu.data.index_map import IndexMap
+        from photon_ml_tpu.io import model_store
+
+        imap = IndexMap.load(os.path.join(out, "feature-index.json"))
+        art = model_store.load_game_model(os.path.join(out, "models", "10.0"), {"global": imap})
+        assert np.all(np.isfinite(art.coordinates["global"].means))
+        # Summarization Avro written.
+        from photon_ml_tpu.io import avro as avro_io
+
+        _, recs = avro_io.read_container(str(tmp_path / "summary" / "part-00000.avro"))
+        assert len(recs) == imap.size - 1
+
+    def test_libsvm_format_with_constraints(self, tmp_path):
+        """heart.txt (the LibSVM twin of heart.avro) through the LIBSVM input
+        format with an inline JSON constraint string."""
+        out = str(tmp_path / "out")
+        summary = glm_driver.run(glm_driver.build_parser().parse_args([
+            "--training-data-directory", os.path.join(REF_IN, "heart.txt"),
+            "--validate-data-directory", os.path.join(REF_IN, "heart_validation.txt"),
+            "--output-directory", out,
+            "--format", "LIBSVM",
+            "--regularization-weights", "1",
+            "--coefficient-constraints",
+            json.dumps([{"name": "1", "term": "", "lowerBound": -0.01, "upperBound": 0.01}]),
+        ]))
+        assert summary["stages"][-1] == "VALIDATED"
+        from photon_ml_tpu.data.index_map import IndexMap
+        from photon_ml_tpu.io import model_store
+
+        imap = IndexMap.load(os.path.join(out, "feature-index.json"))
+        art = model_store.load_game_model(os.path.join(out, "models", "1.0"), {"global": imap})
+        w1 = art.coordinates["global"].means[imap.get_index("1")]
+        assert -0.01 - 1e-6 <= w1 <= 0.01 + 1e-6
+
+    def test_stage_assertions(self, tmp_path):
+        st = glm_driver._State()
+        st.update(glm_driver.DriverStage.PREPROCESSED)
+        with pytest.raises(RuntimeError, match="Expected driver stage INIT"):
+            st.assert_stage(glm_driver.DriverStage.INIT)
+
+    def test_output_dir_guard(self, tmp_path):
+        out = tmp_path / "out"
+        out.mkdir()
+        with pytest.raises(FileExistsError):
+            glm_driver.run(glm_driver.build_parser().parse_args([
+                "--training-data-directory", os.path.join(REF_IN, "heart.avro"),
+                "--output-directory", str(out),
+            ]))
