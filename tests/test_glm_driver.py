@@ -88,3 +88,39 @@ class TestLegacyDriver:
                 "--training-data-directory", os.path.join(REF_IN, "heart.avro"),
                 "--output-directory", str(out),
             ]))
+
+    def test_linear_regression_on_mg_fixtures(self, tmp_path):
+        """mg_train/mg_test (the reference's linear-regression LibSVM pair):
+        regression facet metrics + RMSE-minimizing selection, cross-checked
+        against sklearn Ridge on identical data."""
+        out = str(tmp_path / "out")
+        summary = glm_driver.run(glm_driver.build_parser().parse_args([
+            "--training-data-directory", os.path.join(REF_IN, "mg_train.txt"),
+            "--validate-data-directory", os.path.join(REF_IN, "mg_test.txt"),
+            "--output-directory", out,
+            "--format", "LIBSVM",
+            "--task", "LINEAR_REGRESSION",
+            "--optimizer", "TRON",
+            "--regularization-weights", "0.01,1,100",
+        ]))
+        best = str(summary["best_regularization_weight"])
+        metrics = summary["validation_metrics"][best]
+        assert {"Root mean square error", "Mean absolute error", "R-squared"} <= set(metrics)
+        # Selection minimizes RMSE across the sweep.
+        rmses = {w: m["Root mean square error"] for w, m in summary["validation_metrics"].items()}
+        assert rmses[best] == min(rmses.values())
+
+        from sklearn.linear_model import Ridge
+        from sklearn.metrics import mean_squared_error
+
+        from photon_ml_tpu.data.libsvm import read_libsvm
+
+        tr = read_libsvm(os.path.join(REF_IN, "mg_train.txt"))
+        te = read_libsvm(os.path.join(REF_IN, "mg_test.txt"), num_features=tr.dim - 1)
+        # Our objective: sum-loss 0.5(z-y)^2 + rw/2 ||w||^2 == Ridge(alpha=rw)
+        # up to Ridge's intercept handling; fit without intercept on the
+        # same appended-intercept design matrix.
+        clf = Ridge(alpha=float(best), fit_intercept=False)
+        clf.fit(tr.to_dense(), tr.labels)
+        sk_rmse = float(np.sqrt(mean_squared_error(te.labels, te.to_dense() @ clf.coef_)))
+        assert rmses[best] == pytest.approx(sk_rmse, rel=0.02)
