@@ -85,6 +85,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--coefficient-constraints", default=None,
                    help="inline JSON constraint string (GLMSuite.scala:46 "
                         "format, wildcards supported)")
+    p.add_argument("--selected-features-file", default=None,
+                   help="Avro of FeatureNameTermAvro records (or JSON lines "
+                        "of {name, term}); training restricts to these "
+                        "features + intercept (Driver.prepareTrainingData, "
+                        "GLMSuite selectedFeaturesFile)")
     p.add_argument("--summarization-output-dir", default=None,
                    help="write per-feature statistics as "
                         "FeatureSummarizationResultAvro")
@@ -119,6 +124,13 @@ def _read(args, path: str, index_map=None):
         raise ValueError(f"--intercept must be true or false, got {args.intercept!r}")
     with_intercept = flag == "true"
     if args.format == InputFormat.LIBSVM:
+        if args.selected_features_file:
+            # LibSVM features are positional — a (name, term) whitelist has
+            # no meaning there (the reference's selectedFeaturesFile rides
+            # the Avro input format); refuse rather than silently ignore.
+            raise ValueError(
+                "--selected-features-file requires --format TRAINING_EXAMPLE"
+            )
         from photon_ml_tpu.data.libsvm import read_libsvm
 
         num_features = None
@@ -147,10 +159,49 @@ def _read(args, path: str, index_map=None):
     from photon_ml_tpu.io.avro_data import FeatureShardConfig, read_game_dataset
 
     shards = {"global": FeatureShardConfig(("features",), with_intercept)}
+    if index_map is None and args.selected_features_file:
+        index_map = _selected_features_map(
+            args.selected_features_file, with_intercept
+        )
     maps = None if index_map is None else {"global": index_map}
     ds, built = read_game_dataset(path, shards, index_maps=maps)
     data = LabeledData(ds.shards["global"], ds.labels, ds.offsets, ds.weights)
     return data, built["global"]
+
+
+def _selected_features_map(path: str, with_intercept: bool):
+    """selectedFeaturesFile (Driver.prepareTrainingData:199-205; GLMSuite
+    whitelist): build the index map from the listed (name, term) tuples so
+    every other feature is dropped at read time. Accepts the reference's
+    FeatureNameTermAvro container or JSON-lines of {name, term}."""
+    from photon_ml_tpu.data.index_map import IndexMap, feature_key
+
+    if not os.path.exists(path):
+        raise IOError(f"Could not find [{path}]. Check that the file exists")
+    from photon_ml_tpu.io import avro as avro_io
+
+    # Sniff the container magic to pick the parser — a corrupt Avro file
+    # must surface its own error, not a misleading JSON one.
+    probe = path
+    if os.path.isdir(path):
+        parts = [
+            n for n in sorted(os.listdir(path))
+            if n.endswith(".avro") and not n.startswith((".", "_"))
+        ]
+        probe = os.path.join(path, parts[0]) if parts else path
+    is_avro = False
+    if os.path.isfile(probe):
+        with open(probe, "rb") as f:
+            is_avro = f.read(4) == b"Obj\x01"
+    if is_avro:
+        _, records = avro_io.read_directory(path)
+    else:
+        with open(path) as f:
+            records = [json.loads(line) for line in f if line.strip()]
+    keys = [feature_key(r["name"], r.get("term", "")) for r in records]
+    if not keys:
+        raise ValueError(f"selected-features file {path} lists no features")
+    return IndexMap.from_feature_names(keys, add_intercept=with_intercept)
 
 
 def run(args) -> Dict[str, object]:

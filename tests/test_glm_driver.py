@@ -124,3 +124,45 @@ class TestLegacyDriver:
         clf.fit(tr.to_dense(), tr.labels)
         sk_rmse = float(np.sqrt(mean_squared_error(te.labels, te.to_dense() @ clf.coef_)))
         assert rmses[best] == pytest.approx(sk_rmse, rel=0.02)
+
+    def test_selected_features_whitelist(self, tmp_path):
+        """--selected-features-file restricts training to the listed
+        (name, term) features + intercept (GLMSuite selectedFeaturesFile)."""
+        from photon_ml_tpu.io import avro as avro_io
+        from photon_ml_tpu.io.avro_data import write_training_examples
+
+        rng = np.random.default_rng(0)
+        n = 200
+        X = rng.normal(size=(n, 3))
+        y = (X[:, 0] + 0.1 * rng.normal(size=n) > 0).astype(float)
+        feats = [[("fa", float(X[i,0])), ("fb", float(X[i,1])), ("fc", float(X[i,2]))]
+                 for i in range(n)]
+        train = str(tmp_path / "train.avro")
+        write_training_examples(train, feats, y.tolist())
+        sel = str(tmp_path / "selected.avro")
+        avro_io.write_container(sel, {
+            "type": "record", "name": "FeatureNameTermAvro",
+            "namespace": "com.linkedin.photon.avro.generated",
+            "fields": [{"name": "name", "type": "string"},
+                       {"name": "term", "type": "string"}],
+        }, [{"name": "fa", "term": ""}])
+
+        out = str(tmp_path / "out")
+        glm_driver.run(glm_driver.build_parser().parse_args([
+            "--training-data-directory", train,
+            "--output-directory", out,
+            "--regularization-weights", "1",
+            "--selected-features-file", sel,
+        ]))
+        from photon_ml_tpu.data.index_map import IndexMap
+
+        imap = IndexMap.load(os.path.join(out, "feature-index.json"))
+        assert imap.size == 2  # fa + intercept only
+        assert imap.get_index("fa") >= 0 and imap.get_index("fb") < 0
+
+        with pytest.raises(IOError, match="Could not find"):
+            glm_driver.run(glm_driver.build_parser().parse_args([
+                "--training-data-directory", train,
+                "--output-directory", str(tmp_path / "out2"),
+                "--selected-features-file", str(tmp_path / "missing.avro"),
+            ]))
