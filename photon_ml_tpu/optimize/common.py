@@ -61,6 +61,10 @@ class OptResult(NamedTuple):
     # track_coefficients is requested (the reference OptimizationStatesTracker
     # keeps full OptimizerStates; here it is an opt-in fixed-size array).
     coefficients_history: Optional[Array] = None
+    # TRON-only per-iteration diagnostics under tracking (TRON.scala:217-218
+    # logs actual/predicted reduction, trust radius delta and CG count).
+    trust_radius_history: Optional[Array] = None
+    cg_iterations_history: Optional[Array] = None
 
     @property
     def converged(self) -> Array:

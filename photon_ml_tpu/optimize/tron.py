@@ -148,6 +148,8 @@ class _Carry(NamedTuple):
     loss_history: Array
     gnorm_history: Array
     coef_history: Array
+    delta_history: Array  # trust radius per iteration (tracking only)
+    cg_history: Array  # CG Hessian-vector products per iteration (tracking)
     evals: Array  # value/gradient evaluations + CG Hessian-vector products
 
 
@@ -185,6 +187,9 @@ def minimize_tron(
     gnorm_history = empty_history(max_iterations, tracking, dtype)
     gnorm_history = record_loss(gnorm_history, jnp.zeros((), jnp.int32), init_gnorm)
     coef_history = empty_coef_history(max_iterations, track_coefficients, w0)
+    delta_history = empty_history(max_iterations, tracking, dtype)
+    delta_history = record_loss(delta_history, jnp.zeros((), jnp.int32), init_gnorm)
+    cg_history = empty_history(max_iterations, tracking, dtype)
 
     init = _Carry(
         x=w0,
@@ -202,6 +207,8 @@ def minimize_tron(
         loss_history=history,
         gnorm_history=gnorm_history,
         coef_history=coef_history,
+        delta_history=delta_history,
+        cg_history=cg_history,
         evals=jnp.ones((), jnp.int32),
     )
 
@@ -285,6 +292,10 @@ def minimize_tron(
                 c.gnorm_history, iteration, jnp.linalg.norm(g_new)
             ),
             coef_history=record_coefficients(c.coef_history, iteration, x_new),
+            delta_history=record_loss(c.delta_history, iteration, delta),
+            cg_history=record_loss(
+                c.cg_history, iteration, hvp_calls.astype(dtype)
+            ),
             evals=c.evals + hvp_calls + 1,
         )
 
@@ -299,4 +310,6 @@ def minimize_tron(
         gradient_norm_history=final.gnorm_history,
         fn_evals=final.evals,
         coefficients_history=final.coef_history if final.coef_history.shape[0] else None,
+        trust_radius_history=final.delta_history if final.delta_history.shape[0] else None,
+        cg_iterations_history=final.cg_history if final.cg_history.shape[0] else None,
     )

@@ -216,3 +216,28 @@ def test_coefficient_history_tracking():
     res_imp = minimize_lbfgs(vg, jnp.zeros(3, jnp.float32), track_coefficients=True)
     assert res_imp.coefficients_history is not None
     assert res_imp.loss_history.shape[0] > 0
+
+
+def test_tron_diagnostic_histories():
+    """TRON per-iteration trust radius + CG counts under tracking
+    (TRON.scala:217-218's per-iteration log line, as returned arrays)."""
+    A = jnp.asarray(np.diag([1.0, 4.0, 9.0]), jnp.float32)
+    b = jnp.asarray([1.0, -2.0, 3.0], jnp.float32)
+
+    def vg(w):
+        r = A @ w - b
+        return 0.5 * jnp.dot(r, r), A.T @ r
+
+    res = minimize_tron(vg, lambda w, v: A.T @ (A @ v),
+                        jnp.zeros(3, jnp.float32), tracking=True,
+                        max_iterations=10)
+    its = int(res.iterations)
+    deltas = np.asarray(res.trust_radius_history)
+    cgs = np.asarray(res.cg_iterations_history)
+    assert deltas.shape == (11,) and cgs.shape == (11,)
+    assert np.all(deltas[: its + 1] > 0)  # radius stays positive
+    assert np.all(cgs[1 : its + 1] >= 1)  # every accepted step ran CG
+    assert np.all(np.isnan(deltas[its + 1:]))
+    # Off when not tracking.
+    res2 = minimize_tron(vg, lambda w, v: A.T @ (A @ v), jnp.zeros(3, jnp.float32))
+    assert res2.trust_radius_history is None
