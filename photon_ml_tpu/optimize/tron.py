@@ -292,9 +292,18 @@ def minimize_tron(
                 c.gnorm_history, iteration, jnp.linalg.norm(g_new)
             ),
             coef_history=record_coefficients(c.coef_history, iteration, x_new),
-            delta_history=record_loss(c.delta_history, iteration, delta),
-            cg_history=record_loss(
-                c.cg_history, iteration, hvp_calls.astype(dtype)
+            # Diagnostics record only on ACCEPTED steps: a rejected attempt
+            # must not clobber slot k's accepted radius/CG count (iteration
+            # does not advance on rejection).
+            delta_history=jnp.where(
+                improved,
+                record_loss(c.delta_history, iteration, delta),
+                c.delta_history,
+            ),
+            cg_history=jnp.where(
+                improved,
+                record_loss(c.cg_history, iteration, hvp_calls.astype(dtype)),
+                c.cg_history,
             ),
             evals=c.evals + hvp_calls + 1,
         )

@@ -241,3 +241,29 @@ def test_tron_diagnostic_histories():
     # Off when not tracking.
     res2 = minimize_tron(vg, lambda w, v: A.T @ (A @ v), jnp.zeros(3, jnp.float32))
     assert res2.trust_radius_history is None
+
+
+def test_tron_rejected_steps_preserve_diagnostics():
+    """A rejected trust-region attempt must not overwrite the accepted
+    history slots (iteration does not advance on rejection)."""
+    # Highly non-quadratic scalar-ish objective that forces rejections: the
+    # Newton model overshoots for exp-sum curvature far from the optimum.
+    def vg(w):
+        z = jnp.sum(jnp.exp(2.0 * w))
+        return z, 2.0 * jnp.exp(2.0 * w)
+
+    def hvp(w, v):
+        return 4.0 * jnp.exp(2.0 * w) * v
+
+    w0 = jnp.full((4,), 3.0, jnp.float32)
+    res = minimize_tron(vg, hvp, w0, max_iterations=30, tolerance=1e-10,
+                        tracking=True)
+    its = int(res.iterations)
+    deltas = np.asarray(res.trust_radius_history)
+    cgs = np.asarray(res.cg_iterations_history)
+    # Slot 0 keeps the INITIAL radius (||g0||) and the NaN cg sentinel even
+    # if the very first attempt was rejected.
+    g0 = float(np.linalg.norm(2.0 * np.exp(2.0 * np.full(4, 3.0))))
+    assert deltas[0] == pytest.approx(g0, rel=1e-5)
+    assert np.isnan(cgs[0])
+    assert np.all(cgs[1 : its + 1] >= 1)
