@@ -639,3 +639,23 @@ class TestPoissonParity:
         m = legacy.evaluate_glm(sweep.models[rw], data)
         assert legacy.DATA_LOG_LIKELIHOOD in m
         assert m[legacy.ROOT_MEAN_SQUARE_ERROR] < np.std(y)  # better than mean-only
+
+
+class TestBadWeightsRejection:
+    """The reference's bad-weights fixtures (heart data with zero/negative
+    weights injected; GameTrainingDriverIntegTest bad-weight rejection) must
+    fail row validation (DataValidators.sanityCheckDataFrameForTraining)."""
+
+    @pytest.mark.parametrize("fixture", ["zero-weights.avro", "negative-weights.avro"])
+    def test_validation_rejects(self, fixture):
+        from photon_ml_tpu.data.validators import validate_game_dataset
+        from photon_ml_tpu.types import DataValidationType
+
+        ds, _ = read_game_dataset(
+            os.path.join(DRIVER_IN, "bad-weights", fixture),
+            {"g": FeatureShardConfig(("features",), True)},
+        )
+        with pytest.raises(ValueError, match="weight"):
+            validate_game_dataset(
+                ds, TaskType.LOGISTIC_REGRESSION, DataValidationType.VALIDATE_FULL
+            )
