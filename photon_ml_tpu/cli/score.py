@@ -40,6 +40,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--root-output-directory", required=True)
     p.add_argument("--feature-shard-configurations", required=True, nargs="+",
                    metavar="DSL")
+    p.add_argument("--input-column-names", default=None,
+                   help="Rename record fields (see the training driver)")
     p.add_argument("--input-data-date-range", default=None,
                    help="Inclusive 'yyyyMMdd-yyyyMMdd' range of daily input "
                         "subdirectories (inputDataDateRange, GameDriver.scala:64)")
@@ -95,6 +97,11 @@ def run(args) -> dict:
         shard_configs,
         index_maps=index_maps,
         id_tag_fields=id_tags,
+        columns=(
+            avro_data.InputColumnNames.parse(args.input_column_names)
+            if getattr(args, "input_column_names", None)
+            else None
+        ),
     )
     logger.info("scoring %d samples", dataset.num_samples)
 

@@ -89,6 +89,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "SMOOTHED_HINGE_LOSS_LINEAR_SVM")
     p.add_argument("--input-data-directories", required=True, nargs="+",
                    help="training data dirs/files (Avro TrainingExample records)")
+    p.add_argument("--input-column-names", default=None,
+                   help="Rename record fields: 'response=the_label,weight=w,"
+                        "offset=o,uid=id,metadataMap=meta' (inputColumnsNames,"
+                        " InputColumnsNames.scala:65-73)")
     p.add_argument("--input-data-date-range", default=None,
                    help="Inclusive 'yyyyMMdd-yyyyMMdd' range of daily input "
                         "subdirectories <dir>/yyyy/MM/dd (inputDataDateRange, "
@@ -193,11 +197,17 @@ def _read_data(args, coordinate_configs: Dict[str, CoordinateConfiguration]):
         getattr(args, "input_data_days_range", None),
     )
     train_paths = paths_for_date_range(args.input_data_directories, train_range)
+    columns = (
+        avro_data.InputColumnNames.parse(args.input_column_names)
+        if getattr(args, "input_column_names", None)
+        else None
+    )
     train, index_maps = avro_data.read_game_dataset(
         train_paths,
         shard_configs,
         index_maps=prebuilt,
         id_tag_fields=id_tags,
+        columns=columns,
     )
 
     validation = None
@@ -214,6 +224,7 @@ def _read_data(args, coordinate_configs: Dict[str, CoordinateConfiguration]):
             shard_configs,
             index_maps=index_maps,
             id_tag_fields=id_tags,
+            columns=columns,
         )
     return train, validation, index_maps, shard_configs
 

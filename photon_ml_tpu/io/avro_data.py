@@ -39,6 +39,50 @@ _RESERVED = {RESPONSE, LABEL, OFFSET, WEIGHT, UID, META_DATA_MAP}
 
 
 @dataclasses.dataclass(frozen=True)
+class InputColumnNames:
+    """Configurable record-field names (InputColumnsNames.scala:65-73;
+    parsed from `default=actual` pairs by the drivers, mirroring
+    ScoptParserHelpers.parseInputColumnNames:136-150)."""
+
+    response: str = RESPONSE
+    offset: str = OFFSET
+    weight: str = WEIGHT
+    uid: str = UID
+    metadata_map: str = META_DATA_MAP
+
+    _KEYS = ("response", "offset", "weight", "uid", "metadataMap")
+
+    def __post_init__(self):
+        # "Each column must have a unique name" (InputColumnsNames.scala:28):
+        # a collision like response='weight' would silently read labels from
+        # the weight field.
+        names = [self.response, self.offset, self.weight, self.uid, self.metadata_map]
+        if len(set(names)) != len(names):
+            raise ValueError(f"input column names must be unique, got {names}")
+
+    @classmethod
+    def parse(cls, spec: str) -> "InputColumnNames":
+        """Parse "response=the_label,weight=w,..." (unknown keys rejected)."""
+        kwargs = {}
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            key, _, value = part.partition("=")
+            key = key.strip()
+            if key not in cls._KEYS or not value:
+                raise ValueError(
+                    f"input column spec {part!r}: expected default=actual with "
+                    f"default in {cls._KEYS}"
+                )
+            field = "metadata_map" if key == "metadataMap" else key
+            if field in kwargs:
+                raise ValueError(f"duplicate input column spec for {key!r}")
+            kwargs[field] = value.strip()
+        return cls(**kwargs)
+
+
+@dataclasses.dataclass(frozen=True)
 class FeatureShardConfig:
     """One feature shard = union of feature bags + optional intercept
     (FeatureShardConfiguration.scala:26)."""
@@ -62,6 +106,7 @@ def read_game_dataset(
     index_maps: Optional[Mapping[str, IndexMap]] = None,
     id_tag_fields: Sequence[str] = (),
     response_field: str = RESPONSE,
+    columns: Optional[InputColumnNames] = None,
 ) -> Tuple[GameDataset, Dict[str, IndexMap]]:
     """AvroDataReader.readMerged (:85-220) + GameConverters: Avro file(s)/
     dir(s) -> (GameDataset, per-shard IndexMaps).
@@ -104,16 +149,22 @@ def read_game_dataset(
         v = rec.get(field)
         return default if v is None else float(v)
 
+    if columns is not None and response_field != RESPONSE:
+        raise ValueError(
+            "pass the response name through `columns`, not both `columns` "
+            "and `response_field`"
+        )
+    cols = columns or InputColumnNames(response=response_field)
     labels = np.empty(n, np.float32)
     offsets = np.empty(n, np.float32)
     weights = np.empty(n, np.float32)
     for i, rec in enumerate(records):
-        if response_field in rec:
-            labels[i] = _get(rec, response_field, 0.0)
+        if cols.response in rec:
+            labels[i] = _get(rec, cols.response, 0.0)
         else:
             labels[i] = _get(rec, LABEL, 0.0)
-        offsets[i] = _get(rec, OFFSET, 0.0)
-        weights[i] = _get(rec, WEIGHT, 1.0)
+        offsets[i] = _get(rec, cols.offset, 0.0)
+        weights[i] = _get(rec, cols.weight, 1.0)
 
     id_tags: Dict[str, np.ndarray] = {}
     for tag in id_tag_fields:
@@ -121,10 +172,10 @@ def read_game_dataset(
         for rec in records:
             v = rec.get(tag)
             if v is None:
-                v = (rec.get(META_DATA_MAP) or {}).get(tag, "")
+                v = (rec.get(cols.metadata_map) or {}).get(tag, "")
             vals.append(str(v))
         id_tags[tag] = np.asarray(vals)
-    uids = [rec.get(UID) for rec in records]
+    uids = [rec.get(cols.uid) for rec in records]
     if any(u is not None for u in uids):
         id_tags[UID] = np.asarray([str(u) if u is not None else "" for u in uids])
 

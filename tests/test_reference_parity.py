@@ -659,3 +659,53 @@ class TestBadWeightsRejection:
             validate_game_dataset(
                 ds, TaskType.LOGISTIC_REGRESSION, DataValidationType.VALIDATE_FULL
             )
+
+
+class TestDifferentColumnNames:
+    """The reference's different-column-names fixture (AvroDataReader with a
+    customized InputColumnsNames: the_label/w/intercept/metadata)."""
+
+    def test_renamed_columns_read(self):
+        from photon_ml_tpu.io.avro_data import InputColumnNames
+
+        cols = InputColumnNames.parse(
+            "response=the_label,weight=w,offset=intercept,metadataMap=metadata"
+        )
+        ds, _ = read_game_dataset(
+            os.path.join(DRIVER_IN, "different-column-names", "diff-col-names.avro"),
+            {"g": FeatureShardConfig(("features",), True)},
+            columns=cols,
+        )
+        labels = np.asarray(ds.labels)
+        assert set(np.unique(labels)) <= {0.0, 1.0}
+        assert labels.sum() > 0  # the_label actually populated the response
+        np.testing.assert_array_equal(np.asarray(ds.weights), 1.0)
+        np.testing.assert_array_equal(np.asarray(ds.offsets), 0.0)
+        # Same file with DEFAULT columns: the response column is absent, so
+        # every label falls back to 0 — proving the renames were load-bearing.
+        ds_default, _ = read_game_dataset(
+            os.path.join(DRIVER_IN, "different-column-names", "diff-col-names.avro"),
+            {"g": FeatureShardConfig(("features",), True)},
+        )
+        assert np.asarray(ds_default.labels).sum() == 0.0
+
+    def test_parse_rejects_unknown_keys(self):
+        from photon_ml_tpu.io.avro_data import InputColumnNames
+
+        with pytest.raises(ValueError):
+            InputColumnNames.parse("nope=x")
+
+    def test_parse_rejects_collisions(self):
+        from photon_ml_tpu.io.avro_data import InputColumnNames
+
+        with pytest.raises(ValueError, match="unique"):
+            InputColumnNames.parse("response=weight")
+        with pytest.raises(ValueError, match="duplicate"):
+            InputColumnNames.parse("weight=a,weight=b")
+        with pytest.raises(ValueError, match="columns"):
+            read_game_dataset(
+                os.path.join(DRIVER_IN, "heart.avro"),
+                {"g": FeatureShardConfig(("features",), True)},
+                response_field="label",
+                columns=InputColumnNames(),
+            )
