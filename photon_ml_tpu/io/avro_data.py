@@ -168,9 +168,18 @@ def read_game_dataset(
 
     id_tags: Dict[str, np.ndarray] = {}
     for tag in id_tag_fields:
+        # Resolution order (GameConverters.getGameDatumFromRow id-tag
+        # lookup): direct record field; "map.key" dotted path into a
+        # map-typed column (the reference reads ids from map columns,
+        # AvroDataReader map-field handling); metadataMap fallback.
+        field, _, map_key = tag.partition(".")
         vals = []
         for rec in records:
             v = rec.get(tag)
+            if v is None and map_key:
+                inner = rec.get(field)
+                if isinstance(inner, dict):
+                    v = inner.get(map_key)
             if v is None:
                 v = (rec.get(cols.metadata_map) or {}).get(tag, "")
             vals.append(str(v))

@@ -709,3 +709,77 @@ class TestDifferentColumnNames:
                 response_field="label",
                 columns=InputColumnNames(),
             )
+
+
+class TestRemainingDriverFixtures:
+    def test_linear_regression_pair_vs_ridge(self):
+        """linear_regression_train/val.avro: TRON linear fit matches sklearn
+        Ridge on the identical design matrix."""
+        from sklearn.linear_model import Ridge
+        from sklearn.metrics import mean_squared_error
+
+        shards = {"g": FeatureShardConfig(("features",), True)}
+        tr, imaps = read_game_dataset(
+            os.path.join(DRIVER_IN, "linear_regression_train.avro"), shards
+        )
+        va, _ = read_game_dataset(
+            os.path.join(DRIVER_IN, "linear_regression_val.avro"),
+            shards,
+            index_maps=imaps,
+        )
+        rw = 1.0
+        cfg = CoordinateOptimizationConfig(
+            optimizer=OptimizerConfig(OptimizerType.TRON, 50, 1e-9),
+            regularization=L2,
+        )
+        sweep = train_glm_sweep(_labeled(tr, "g"), TaskType.LINEAR_REGRESSION, cfg, [rw])
+        w = np.asarray(sweep.models[rw].coefficients.means, np.float64)
+        Xtr = np.asarray(tr.shards["g"].to_dense(), np.float64)
+        Xv = np.asarray(va.shards["g"].to_dense(), np.float64)
+        clf = Ridge(alpha=rw, fit_intercept=False)
+        clf.fit(Xtr, np.asarray(tr.labels))
+        ours = float(np.sqrt(mean_squared_error(np.asarray(va.labels), Xv @ w)))
+        sk = float(np.sqrt(mean_squared_error(np.asarray(va.labels), Xv @ clf.coef_)))
+        assert ours == pytest.approx(sk, rel=1e-3)
+
+    def test_empty_feature_vectors_read(self):
+        """empty.avro (heart rows with EMPTY feature lists): rows reduce to
+        the intercept pseudo-feature; training still runs (intercept-only
+        fit = base-rate model)."""
+        shards = {"g": FeatureShardConfig(("features",), True)}
+        ds, imaps = read_game_dataset(os.path.join(DRIVER_IN, "empty.avro"), shards)
+        assert ds.num_samples == 250
+        assert imaps["g"].size == 1  # intercept only
+        dense = np.asarray(ds.shards["g"].to_dense())
+        np.testing.assert_array_equal(dense, 1.0)
+        cfg = CoordinateOptimizationConfig(
+            optimizer=OptimizerConfig(OptimizerType.LBFGS, 50, 1e-9),
+            regularization=L2,
+        )
+        sweep = train_glm_sweep(
+            _labeled(ds, "g"), TaskType.LOGISTIC_REGRESSION, cfg, [1.0]
+        )
+        w0 = float(np.asarray(sweep.models[1.0].coefficients.means)[0])
+        base_rate = float(np.asarray(ds.labels).mean())
+        # Intercept-only logistic optimum ~= logit of the base rate.
+        assert 1 / (1 + np.exp(-w0)) == pytest.approx(base_rate, abs=0.02)
+
+    def test_feed_avro_map_columns(self):
+        """GameIntegTest avroMap/feed.avro: id tags resolved from MAP-typed
+        columns via dotted paths, responses from renamed numeric columns."""
+        from photon_ml_tpu.io.avro_data import InputColumnNames
+
+        ds, _ = read_game_dataset(
+            os.path.join(GAME, "input", "avroMap", "feed.avro"),
+            {"g": FeatureShardConfig(("features",), True)},
+            columns=InputColumnNames.parse("response=click"),
+            id_tag_fields=("ids.activityId", "updateInfo.actorType", "ids.viewerId"),
+        )
+        assert ds.num_samples == 2
+        # Record 0 carries activityId + actorType; record 1's maps hold
+        # different keys (viewerId) -> empty-string tag, not a crash.
+        assert ds.id_tags["ids.activityId"][0] == "urn:li:activity:6489565768462716928"
+        assert ds.id_tags["ids.activityId"][1] == ""
+        assert ds.id_tags["updateInfo.actorType"][0] == "linkedin:company"
+        assert ds.id_tags["ids.viewerId"][1] == "355852286"
+        assert set(np.asarray(ds.labels)) <= {0.0, 1.0}
