@@ -1,0 +1,175 @@
+"""Pure-Python reader for PalDB v1 stores — the reference's off-heap
+feature-index format.
+
+The reference distributes feature index maps as PalDB partitions
+(`paldb-partition-<shard>-<n>.dat`, written by PalDBIndexMapBuilder.scala:27
+via `com.linkedin.paldb:paldb:1.1.0` and read back memory-mapped by
+PalDBIndexMap.scala:43-118). Each store holds BOTH directions — feature name
+→ integer id AND id → name (PalDBIndexMapBuilder.put:59-62) — and a
+multi-partition map offsets each partition's internal ids by the cumulative
+`size/2` of its predecessors (PalDBIndexMap.load:88-96).
+
+This module decodes the on-disk format (reverse-engineered from the
+reference's own fixture stores and validated against their known contents;
+see tests/test_paldb.py):
+
+    writeUTF("PALDB_V1") | long timestamp | int keyCount
+    int keyLengthCount | int maxKeyLength
+    per serialized-key-length: int keyLength, int keyCount, int slotCount,
+        int slotSize, int indexOffset, long dataOffset
+    long indexGlobalOffset | long dataGlobalOffset
+    ... index section: per length, slotCount slots of
+        [serialized key (keyLength bytes)][LSB base-128 varint data offset,
+         zero-padded to slotSize]  (all-zero key bytes = empty slot)
+    ... data section: per length group, a reserved 0x00 at offset 0, then
+        entries [varint length][serialized value]
+
+Value/key serialization (the subset PalDB's index maps use):
+    int:    codes 0x05..0x0D encode 0..8 directly; 0x0E + raw byte encodes
+            9..254; 0x10 + LSB base-128 varint encodes larger values
+    string: 'g' + varint(byteCount) + utf-8 bytes (feature keys carry the
+            reference's embedded name/term delimiter \x01, trailing for
+            empty terms)
+
+Only whole-store loading is implemented (the framework keeps index maps
+in-memory / in its own mmap store); random access hashing is unnecessary.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import struct
+from typing import Dict, List, Tuple, Union
+
+MAGIC = "PALDB_V1"
+
+Key = Union[int, str]
+
+
+def _read_varint(b: bytes, pos: int) -> Tuple[int, int]:
+    """LSB base-128 varint (high bit = continuation)."""
+    shift = 0
+    out = 0
+    while True:
+        c = b[pos]
+        pos += 1
+        out |= (c & 0x7F) << shift
+        if not c & 0x80:
+            return out, pos
+        shift += 7
+
+
+def _decode(b: bytes, pos: int) -> Tuple[Key, int]:
+    """Decode one serialized key/value at `pos`."""
+    c = b[pos]
+    if 0x05 <= c <= 0x0D:
+        return c - 5, pos + 1
+    if c == 0x0E:
+        return b[pos + 1], pos + 2
+    if c == 0x10:
+        return _read_varint(b, pos + 1)
+    if c == ord("g"):
+        n, pos = _read_varint(b, pos + 1)
+        s = b[pos : pos + n].decode("utf-8")
+        return s, pos + n
+    raise ValueError(f"unsupported PalDB serialization code 0x{c:02x} at {pos}")
+
+
+def read_store(path: str) -> Dict[Key, Key]:
+    """Load every (key, value) pair of one PalDB partition file."""
+    with open(path, "rb") as f:
+        b = f.read()
+    ulen = struct.unpack(">H", b[:2])[0]
+    if b[2 : 2 + ulen].decode() != MAGIC:
+        raise ValueError(f"{path}: not a {MAGIC} store")
+    off = 2 + ulen + 8  # skip timestamp
+    key_count, klc, _max_kl = struct.unpack(">iii", b[off : off + 12])
+    off += 12
+    entries = []
+    for _ in range(klc):
+        kl, kc, slots, slot_size, idx_off = struct.unpack(">iiiii", b[off : off + 20])
+        off += 20
+        data_off = struct.unpack(">q", b[off : off + 8])[0]
+        off += 8
+        entries.append((kl, kc, slots, slot_size, idx_off, data_off))
+    idx_abs, data_abs = struct.unpack(">qq", b[off : off + 16])
+
+    out: Dict[Key, Key] = {}
+    for kl, kc, slots, slot_size, idx_off, data_off in entries:
+        base = idx_abs + idx_off
+        group = data_abs + data_off
+        found = 0
+        for s in range(slots):
+            slot = b[base + s * slot_size : base + (s + 1) * slot_size]
+            if not any(slot[:kl]):
+                continue  # empty slot
+            key, _ = _decode(slot, 0)
+            rel, _ = _read_varint(slot, kl)
+            vlen, vpos = _read_varint(b, group + rel)
+            value, _ = _decode(b, vpos)
+            out[key] = value
+            found += 1
+        if found != kc:
+            raise ValueError(
+                f"{path}: key-length {kl} group decoded {found} of {kc} keys"
+            )
+    if len(out) != key_count:
+        raise ValueError(f"{path}: decoded {len(out)} of {key_count} keys")
+    return out
+
+
+def partition_files(store_dir: str, shard: str) -> List[str]:
+    """The shard's partition files in partition order
+    (PalDBIndexMapLoader's `paldb-partition-<shard>-<n>.dat`).
+
+    Matching is exact on the shard name with a strictly numeric partition
+    suffix — a glob would let shard 'global' swallow 'global-v2' partitions
+    (corrupting the id space via wrong offsets) or trip over stray
+    non-numeric .dat files."""
+    pat = re.compile(rf"paldb-partition-{re.escape(shard)}-(\d+)\.dat$")
+    if not os.path.isdir(store_dir):
+        return []
+    matches = []
+    for name in os.listdir(store_dir):
+        m = pat.fullmatch(name)
+        if m:
+            matches.append((int(m.group(1)), os.path.join(store_dir, name)))
+    return [p for _, p in sorted(matches)]
+
+
+def load_index_map(store_dir: str, shard: str):
+    """Load a shard's PalDB partitions into an in-memory IndexMap.
+
+    Mirrors PalDBIndexMap.load:88-96: partition i's internal ids are
+    offset by the cumulative size/2 of partitions 0..i-1, making global ids
+    unique. Both stored directions are cross-checked.
+    """
+    from photon_ml_tpu.data.index_map import IndexMap
+
+    paths = partition_files(store_dir, shard)
+    if not paths:
+        raise FileNotFoundError(
+            f"no paldb-partition-{shard}-*.dat files under {store_dir}"
+        )
+    name_to_id: Dict[str, int] = {}
+    offset = 0
+    for p in paths:
+        store = read_store(p)
+        id_to_name = {k: v for k, v in store.items() if isinstance(k, int)}
+        names = {k: v for k, v in store.items() if isinstance(k, str)}
+        if len(id_to_name) != len(names):
+            raise ValueError(f"{p}: asymmetric id/name entries")
+        from photon_ml_tpu.data.index_map import DELIMITER, feature_key
+
+        for name, internal in names.items():
+            # Cross-check the reverse direction the builder wrote.
+            if id_to_name.get(internal) != name:
+                raise ValueError(f"{p}: id->name mismatch for {name!r}")
+            # Reference keys are always name+DELIMITER+term (trailing
+            # delimiter for empty terms); normalize to this framework's
+            # feature_key convention (bare name when the term is empty).
+            n_, _, t_ = name.partition(DELIMITER)
+            name_to_id[feature_key(n_, t_)] = internal + offset
+        offset += len(names)
+    return IndexMap(name_to_id)

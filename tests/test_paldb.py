@@ -1,0 +1,131 @@
+"""PalDB v1 store reader vs the reference's OWN prebuilt index partitions
+(PalDBIndexMap.scala / PalDBIndexMapBuilder.scala fixtures)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.data.index_map import DELIMITER, INTERCEPT_KEY, feature_key
+from photon_ml_tpu.io import paldb
+
+REF = "/root/reference/photon-client/src/integTest/resources"
+PALDB_HEART = os.path.join(REF, "PalDBIndexMapTest")
+GAME_IN = os.path.join(REF, "GameIntegTest", "input")
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(REF), reason="reference fixtures not mounted"
+)
+
+
+class TestPalDBReader:
+    def test_heart_two_partition_store(self):
+        """paldb_offheapmap_for_heart: 13 heart features hash-split over two
+        partitions, global ids offset per partition (PalDBIndexMap.load)."""
+        m = paldb.load_index_map(
+            os.path.join(PALDB_HEART, "paldb_offheapmap_for_heart"), "global"
+        )
+        assert set(m) == {str(i) for i in range(1, 14)}
+        assert sorted(m[k] for k in m) == list(range(13))
+
+    def test_heart_store_with_intercept(self):
+        m = paldb.load_index_map(
+            os.path.join(PALDB_HEART, "paldb_offheapmap_for_heart_with_intercept"),
+            "global",
+        )
+        assert set(m) == {str(i) for i in range(1, 14)} | {INTERCEPT_KEY}
+        assert m.intercept_index is not None
+        assert sorted(m[k] for k in m) == list(range(14))
+
+    @pytest.mark.parametrize(
+        "store,shard,size",
+        [
+            ("feature-indexes", "shard1", 15045),
+            ("feature-indexes", "shard2", 15015),
+            ("feature-indexes", "shard3", 31),
+            ("test-with-uid-feature-indexes", "globalShard", 7234),
+            ("test-with-uid-feature-indexes", "userShard", 7204),
+            ("test-with-uid-feature-indexes", "songShard", 7204),
+        ],
+    )
+    def test_game_integ_stores_decode_fully(self, store, shard, size):
+        """Every GameIntegTest store decodes completely (the reader refuses
+        partial decodes), ids are dense 0..size-1, intercepts present —
+        covering every int width (single-byte, raw-byte, varint) and
+        thousands of name/term strings."""
+        m = paldb.load_index_map(os.path.join(GAME_IN, store), shard)
+        assert m.size == size
+        assert sorted(m[k] for k in m) == list(range(size))
+        assert m.intercept_index is not None
+
+    def test_shard3_covers_song_feature_list(self):
+        """shard3's keys include every (name, term) the reference's
+        songFeatures list names."""
+        m = paldb.load_index_map(os.path.join(GAME_IN, "feature-indexes"), "shard3")
+        lists = open(os.path.join(GAME_IN, "feature-lists", "songFeatures")).read()
+        for line in lists.splitlines():
+            if line.strip():
+                name, term = (line.split("\t") + [""])[:2]
+                assert m.get_index(feature_key(name, term)) >= 0
+
+    def test_rejects_non_paldb(self, tmp_path):
+        p = tmp_path / "paldb-partition-x-0.dat"
+        p.write_bytes(b"\x00\x08NOTPALDB" + b"\x00" * 50)
+        with pytest.raises(ValueError, match="PALDB_V1"):
+            paldb.read_store(str(p))
+
+
+class TestTrainWithReferenceIndexStore:
+    def test_cli_trains_against_reference_paldb_index(self, tmp_path):
+        """End to end: the training driver consumes the reference's OWN
+        PalDB index partitions via --offheap-indexmap-dir and trains on the
+        yahoo-music records with those feature ids."""
+        from photon_ml_tpu.cli import train as train_cli
+        import json
+
+        out = str(tmp_path / "out")
+        train_cli.main([
+            "--training-task", "LINEAR_REGRESSION",
+            "--input-data-directories",
+            os.path.join(GAME_IN, "duplicateFeatures", "yahoo-music-train.avro"),
+            "--root-output-directory", out,
+            "--offheap-indexmap-dir",
+            os.path.join(GAME_IN, "test-with-uid-feature-indexes"),
+            "--feature-shard-configurations",
+            "name=globalShard,feature.bags=features|userFeatures|songFeatures,intercept=true",
+            "--coordinate-configurations",
+            "name=global,feature.shard=globalShard,optimizer=TRON,"
+            "max.iter=10,regularization=L2,reg.weights=10",
+        ])
+        summary = json.load(open(os.path.join(out, "training-summary.json")))
+        assert summary["num_samples"] == 6
+        # The saved model's coefficient ids live in the REFERENCE's index
+        # space (size 7234), not a data-derived map.
+        from photon_ml_tpu.data.index_map import IndexMap
+        from photon_ml_tpu.io import model_store
+
+        imap = IndexMap.load(
+            os.path.join(out, "models", "best", "feature-indexes", "globalShard.json")
+        )
+        assert imap.size == 7234
+        art = model_store.load_game_model(
+            os.path.join(out, "models", "best"), {"globalShard": imap}
+        )
+        assert np.isfinite(art.coordinates["global"].means).all()
+
+
+def test_partition_files_exact_shard_match(tmp_path):
+    """Shard 'global' must not swallow 'global-v2' partitions or stray
+    non-numeric .dat files."""
+    for name in (
+        "paldb-partition-global-0.dat",
+        "paldb-partition-global-1.dat",
+        "paldb-partition-global-v2-0.dat",
+        "paldb-partition-global-meta.dat",
+    ):
+        (tmp_path / name).write_bytes(b"x")
+    got = [os.path.basename(p) for p in paldb.partition_files(str(tmp_path), "global")]
+    assert got == ["paldb-partition-global-0.dat", "paldb-partition-global-1.dat"]
+    got2 = [os.path.basename(p) for p in paldb.partition_files(str(tmp_path), "global-v2")]
+    assert got2 == ["paldb-partition-global-v2-0.dat"]
+    assert paldb.partition_files(str(tmp_path / "missing"), "x") == []
