@@ -40,6 +40,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--root-output-directory", required=True)
     p.add_argument("--feature-shard-configurations", required=True, nargs="+",
                    metavar="DSL")
+    p.add_argument("--offheap-indexmap-dir", default=None,
+                   help="prebuilt feature-index partitions (PalDB or PHIDX); "
+                        "default: the JSON maps saved beside the model")
     p.add_argument("--input-column-names", default=None,
                    help="Rename record fields (see the training driver)")
     p.add_argument("--input-data-date-range", default=None,
@@ -65,16 +68,24 @@ def run(args) -> dict:
         parse_feature_shard_config(s) for s in args.feature_shard_configurations
     )
 
-    # Feature index maps saved next to the models by the training driver
-    # (the reference resolves these via the off-heap PalDB dir or rebuilds
-    # them; here they ride with the model artifact).
+    # Feature index maps: an explicit off-heap store (the reference's PalDB
+    # partitions or this framework's PHIDX, prepareFeatureMaps
+    # GameDriver.scala:231-236) or, by default, the JSON maps the training
+    # driver saved beside the model artifact.
     from photon_ml_tpu.data.index_map import IndexMap
 
-    index_dir = os.path.join(args.model_input_directory, "feature-indexes")
-    index_maps = {
-        shard: IndexMap.load(os.path.join(index_dir, f"{shard}.json"))
-        for shard in shard_configs
-    }
+    if getattr(args, "offheap_indexmap_dir", None):
+        from photon_ml_tpu.io.paldb import resolve_offheap_index_maps
+
+        index_maps = resolve_offheap_index_maps(
+            args.offheap_indexmap_dir, shard_configs
+        )
+    else:
+        index_dir = os.path.join(args.model_input_directory, "feature-indexes")
+        index_maps = {
+            shard: IndexMap.load(os.path.join(index_dir, f"{shard}.json"))
+            for shard in shard_configs
+        }
     artifact = model_store.load_game_model(args.model_input_directory, index_maps)
     model, specs = model_bridge.game_model_from_artifact(artifact)
 

@@ -181,23 +181,13 @@ def _read_data(args, coordinate_configs: Dict[str, CoordinateConfiguration]):
     # partitions when given, else index maps derived from the data itself.
     prebuilt = None
     if getattr(args, "offheap_indexmap_dir", None):
-        # prepareFeatureMaps (GameDriver.scala:231-236). Two store formats:
-        # the reference's PalDB partitions (paldb-partition-<shard>-<n>.dat,
-        # read directly via io/paldb.py) and this framework's PHIDX
-        # partitions (cli.build_index output) — auto-detected per shard.
-        from photon_ml_tpu.io import paldb
-        from photon_ml_tpu.native.index_store import PartitionedIndexStore
+        # prepareFeatureMaps (GameDriver.scala:231-236): PalDB or PHIDX
+        # partitions, auto-detected per shard.
+        from photon_ml_tpu.io.paldb import resolve_offheap_index_maps
 
-        prebuilt = {}
-        for shard in shard_configs:
-            if paldb.partition_files(args.offheap_indexmap_dir, shard):
-                prebuilt[shard] = paldb.load_index_map(
-                    args.offheap_indexmap_dir, shard
-                )
-            else:
-                prebuilt[shard] = PartitionedIndexStore(
-                    args.offheap_indexmap_dir, shard
-                )
+        prebuilt = resolve_offheap_index_maps(
+            args.offheap_indexmap_dir, shard_configs
+        )
 
     # Date-range resolution (IOUtils.resolveRange + pathsForDateRange,
     # GameTrainingDriver.scala:508-509): expand base dirs to daily subdirs.

@@ -138,6 +138,22 @@ def partition_files(store_dir: str, shard: str) -> List[str]:
     return [p for _, p in sorted(matches)]
 
 
+def resolve_offheap_index_maps(store_dir: str, shards):
+    """Per-shard index maps from an off-heap store directory, auto-detecting
+    the reference's PalDB partitions vs this framework's PHIDX partitions
+    (prepareFeatureMaps, GameDriver.scala:231-236). Shared by the training
+    and scoring drivers so format detection cannot drift between them."""
+    from photon_ml_tpu.native.index_store import PartitionedIndexStore
+
+    out = {}
+    for shard in shards:
+        if partition_files(store_dir, shard):
+            out[shard] = load_index_map(store_dir, shard)
+        else:
+            out[shard] = PartitionedIndexStore(store_dir, shard)
+    return out
+
+
 def load_index_map(store_dir: str, shard: str):
     """Load a shard's PalDB partitions into an in-memory IndexMap.
 

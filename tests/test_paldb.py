@@ -129,3 +129,51 @@ def test_partition_files_exact_shard_match(tmp_path):
     got2 = [os.path.basename(p) for p in paldb.partition_files(str(tmp_path), "global-v2")]
     assert got2 == ["paldb-partition-global-v2-0.dat"]
     assert paldb.partition_files(str(tmp_path / "missing"), "x") == []
+
+
+class TestScoreWithReferencePalDBIndex:
+    def test_cli_scores_reference_model_with_paldb_index(self, tmp_path):
+        """GameScoringDriverIntegTest flow: the scoring driver loads the
+        reference's pre-trained model THROUGH the reference's PalDB index
+        store and scores yahoo-music records; CLI scores must equal the
+        library path's scores under the same maps."""
+        import json
+
+        from photon_ml_tpu.cli import score as score_cli
+        from photon_ml_tpu.io import model_store
+        from photon_ml_tpu.io.avro_data import FeatureShardConfig, read_game_dataset
+        from photon_ml_tpu.io.model_bridge import game_model_from_artifact
+        from photon_ml_tpu.io.score_store import load_scores
+        from photon_ml_tpu.transformers.game_transformer import GameTransformer
+
+        mdir = os.path.join(REF, "GameIntegTest", "fixedEffectOnlyGAMEModel")
+        store = os.path.join(GAME_IN, "test-with-uid-feature-indexes")
+        data = os.path.join(GAME_IN, "duplicateFeatures", "yahoo-music-train.avro")
+        out = str(tmp_path / "scores")
+        score_cli.main([
+            "--input-data-directories", data,
+            "--model-input-directory", mdir,
+            "--offheap-indexmap-dir", store,
+            "--root-output-directory", out,
+            "--feature-shard-configurations",
+            "name=globalShard,feature.bags=features|userFeatures|songFeatures,intercept=true",
+        ])
+        ssum = json.load(open(os.path.join(out, "scoring-summary.json")))
+        assert ssum["num_scored"] == 6
+        items = load_scores(os.path.join(out, "scores"))
+        cli_scores = np.asarray([it.prediction_score for it in items])
+
+        imap = paldb.load_index_map(store, "globalShard")
+        art = model_store.load_game_model(mdir, {"globalShard": imap})
+        model, specs = game_model_from_artifact(art)
+        ds, _ = read_game_dataset(
+            data,
+            {"globalShard": FeatureShardConfig(
+                ("features", "userFeatures", "songFeatures"), True)},
+            index_maps={"globalShard": imap},
+        )
+        lib_scores = np.asarray(
+            GameTransformer(model, specs, art.task).transform(ds).scores
+        )
+        np.testing.assert_allclose(cli_scores, lib_scores, rtol=1e-5)
+        assert np.isfinite(cli_scores).all()
