@@ -36,6 +36,13 @@ class SparseFeatures:
     `dim` (the feature-space width) is static metadata so shapes stay known to
     XLA. Padding slots must have value 0.0 (index value is then irrelevant;
     0 by convention).
+
+    Invariant: non-padding indices are unique within a row. matvec/rmatvec are
+    linear so duplicates would still sum correctly there, but moment-based
+    consumers (the sparse Pearson feature-selection path in
+    data/game_dataset.py) count per-column presence and would diverge from the
+    dense branch on duplicated entries. `pack_csr_to_ell` accumulates
+    duplicates; hand-built arrays must honor the invariant themselves.
     """
 
     indices: Array  # (..., N, K) int32
@@ -159,6 +166,15 @@ def pack_csr_to_ell(
     for r in range(n):
         lo, hi = indptr[r], indptr[r + 1]
         ri, rv = indices[lo:hi], values[lo:hi]
+        if len(ri) > 1:
+            # Accumulate duplicate column indices (possible in hand-built
+            # CSR or malformed LibSVM) so the per-row uniqueness invariant
+            # holds — see the SparseFeatures docstring.
+            uniq, inv = np.unique(ri, return_inverse=True)
+            if len(uniq) < len(ri):
+                acc = np.zeros(len(uniq), dtype=np.float64)
+                np.add.at(acc, inv, rv)
+                ri, rv = uniq, acc.astype(rv.dtype)
         if len(ri) > k:
             keep = np.argsort(-np.abs(rv))[:k]
             ri, rv = ri[keep], rv[keep]

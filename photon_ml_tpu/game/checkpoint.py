@@ -103,7 +103,12 @@ def _load_model_npz(path: str, task):
         var = jnp.asarray(z["variances"]) if "variances" in z else None
         if kind == "fixed":
             return FixedEffectModel(Coefficients(jnp.asarray(z["means"]), var), task)
-        return RandomEffectModel(jnp.asarray(z["matrix"]), var, task)
+        if kind == "random":
+            return RandomEffectModel(jnp.asarray(z["matrix"]), var, task)
+        raise ValueError(
+            f"{path}: unknown model kind {kind!r} (corrupted or foreign "
+            "checkpoint file)"
+        )
 
 
 def _results_to_json(res) -> dict:

@@ -102,6 +102,13 @@ def run_coordinate_descent(
         # Fingerprint the run configuration: resume with changed
         # coordinates/optimizer settings/reg weights must refuse, not
         # silently fast-forward past training with stale models.
+        def _shard_identity(feats) -> tuple:
+            from photon_ml_tpu.data.containers import SparseFeatures
+
+            if isinstance(feats, SparseFeatures):
+                return ("sparse", tuple(feats.indices.shape), feats.dim)
+            return ("dense", tuple(feats.shape))
+
         fp = (
             tuple(ids),
             tuple(sorted(locked)),
@@ -111,6 +118,23 @@ def run_coordinate_descent(
             # deliberately excludes it, so it must enter here).
             tuple(
                 (c, float((reg_weights or {}).get(c, coordinates[c].config.reg_weight)))
+                for c in ids
+            ),
+            # Cheap dataset identity: resuming after the input data changed
+            # must refuse rather than fast-forward past steps trained on the
+            # old data (full content hashes would cost a pass over the data;
+            # shape + sample-count changes catch the realistic swaps).
+            tuple(
+                (
+                    c,
+                    coordinates[c].dataset.num_samples,
+                    tuple(
+                        sorted(
+                            (name, _shard_identity(f))
+                            for name, f in coordinates[c].dataset.shards.items()
+                        )
+                    ),
+                )
                 for c in ids
             ),
         )

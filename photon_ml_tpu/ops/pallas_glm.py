@@ -86,12 +86,29 @@ Array = jax.Array
 # Row-tile height. 512 rows x 512 features x 4 B = 1 MB per X tile; with
 # double buffering and the [D, 1]/[D, 2] operands this stays well inside the
 # ~16 MB/core VMEM envelope up to D ~ 4096.
-_TILE_N = int(os.environ.get("PHOTON_PALLAS_TILE", "512"))
-if _TILE_N < 8 or _TILE_N % 8 != 0:
-    raise ValueError(
-        f"PHOTON_PALLAS_TILE={_TILE_N}: must be a positive multiple of 8 "
-        "(TPU sublane alignment)"
-    )
+#
+# Env overrides are validated leniently: a bad value falls back to the
+# default with a warning instead of making the whole package unimportable
+# for code paths that never touch the kernels.
+def _env_tile() -> int:
+    raw = os.environ.get("PHOTON_PALLAS_TILE", "512")
+    try:
+        tile = int(raw)
+        if tile < 8 or tile % 8 != 0:
+            raise ValueError
+        return tile
+    except ValueError:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "PHOTON_PALLAS_TILE=%r: must be a positive multiple of 8 (TPU "
+            "sublane alignment); using the default 512",
+            raw,
+        )
+        return 512
+
+
+_TILE_N = _env_tile()
 # VMEM budget for one X tile (bytes). Above this, fall back to XLA rather
 # than blocking the feature dimension (a D-blocked variant would need a
 # second pass for margins; XLA is already fine for very wide problems).
@@ -113,10 +130,14 @@ _PRECISION_NAMES = {
 }
 _prec_name = os.environ.get("PHOTON_PALLAS_PRECISION", "highest").strip().lower()
 if _prec_name not in _PRECISION_NAMES:
-    raise ValueError(
-        f"PHOTON_PALLAS_PRECISION={_prec_name!r}: expected one of "
-        f"{sorted(_PRECISION_NAMES)}"
+    import logging
+
+    logging.getLogger(__name__).warning(
+        "PHOTON_PALLAS_PRECISION=%r: expected one of %s; using 'highest'",
+        _prec_name,
+        sorted(_PRECISION_NAMES),
     )
+    _prec_name = "highest"
 _PRECISION = _PRECISION_NAMES[_prec_name]
 
 # Kill switch. Initialized from PHOTON_DISABLE_PALLAS at import; flip at

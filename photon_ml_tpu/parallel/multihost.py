@@ -23,6 +23,7 @@ import os
 import socket
 import subprocess
 import sys
+import time
 from typing import Optional
 
 _WORKER_FLAG = "--multihost-worker"
@@ -146,40 +147,78 @@ def dryrun_multihost(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     )
     env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
-    procs = []
-    for pid in range(n_processes):
-        procs.append(
-            subprocess.Popen(
-                [
-                    sys.executable,
-                    os.path.abspath(__file__),
-                    _WORKER_FLAG,
-                    coordinator,
-                    str(n_processes),
-                    str(pid),
-                    str(devices_per_proc),
-                ],
-                env=env,
-                stdout=subprocess.PIPE,
-                stderr=subprocess.PIPE,
-                text=True,
-                cwd=os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    # Workers write stdout/stderr to temp files rather than pipes: the parent
+    # polls returncodes without draining anything, so a chatty worker (XLA
+    # dump flags, distributed-runtime logging) can never block on a full pipe
+    # buffer, and crash diagnostics survive kills.
+    import tempfile
+
+    with tempfile.TemporaryDirectory(prefix="photon_multihost_") as logdir:
+        procs = []
+        for pid in range(n_processes):
+            out_f = open(os.path.join(logdir, f"w{pid}.out"), "w+")
+            err_f = open(os.path.join(logdir, f"w{pid}.err"), "w+")
+            procs.append(
+                (
+                    subprocess.Popen(
+                        [
+                            sys.executable,
+                            os.path.abspath(__file__),
+                            _WORKER_FLAG,
+                            coordinator,
+                            str(n_processes),
+                            str(pid),
+                            str(devices_per_proc),
+                        ],
+                        env=env,
+                        stdout=out_f,
+                        stderr=err_f,
+                        cwd=repo_root,
+                    ),
+                    out_f,
+                    err_f,
+                )
             )
-        )
-    outs = []
-    failed = []
-    for p in procs:
+
+        def _read(f) -> str:
+            f.flush()
+            f.seek(0)
+            return f.read()
+
+        def _reap_all() -> None:
+            for q, _, _ in procs:
+                if q.poll() is None:
+                    q.kill()
+            for q, of, ef in procs:
+                q.wait()
+                of.close()
+                ef.close()
+
+        # Poll all workers rather than wait() in order: if a later process
+        # crashes, the earlier ones hang in the collective, and a sequential
+        # wait would time out with a generic message while the crashed
+        # worker's stderr (the actual explanation) is discarded.
+        deadline = time.monotonic() + timeout_s
         try:
-            out, err = p.communicate(timeout=timeout_s)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            raise RuntimeError("dryrun_multihost timed out")
-        outs.append(out)
-        if p.returncode != 0:
-            failed.append(err[-2000:])
-    if failed:
-        raise RuntimeError("dryrun_multihost worker failed:\n" + "\n---\n".join(failed))
+            while True:
+                states = [q.poll() for q, _, _ in procs]
+                crashed = [i for i, s in enumerate(states) if s not in (None, 0)]
+                if crashed:
+                    errs = [
+                        f"worker {i} (exit {states[i]}):\n{_read(procs[i][2])[-2000:]}"
+                        for i in crashed
+                    ]
+                    raise RuntimeError(
+                        "dryrun_multihost worker failed:\n" + "\n---\n".join(errs)
+                    )
+                if all(s == 0 for s in states):
+                    break
+                if time.monotonic() > deadline:
+                    raise RuntimeError("dryrun_multihost timed out")
+                time.sleep(0.2)
+            outs = [_read(of) for _, of, _ in procs]
+        finally:
+            _reap_all()
     ok_lines = [line for out in outs for line in out.splitlines() if "dryrun_multihost OK" in line]
     if not ok_lines:
         raise RuntimeError(f"no OK line from workers: {outs}")
