@@ -1,0 +1,282 @@
+"""Bucketed sparse layout: the TPU-native sparse design matrix.
+
+Why this exists: the padded-ELL layout (`containers.SparseFeatures`) expresses
+`X @ w` as an XLA gather and `X^T u` as an XLA scatter-add, and both serialize
+on TPU (measured ~0.5-0.8 s per pass at 1M rows x 64 nnz into dim 16k on
+v5e). The reference's hot loop streams the same entries once per pass inside
+Spark executors (photon-lib function/glm/ValueAndGradientAggregator.scala:
+137-161); matching it on TPU needs a layout the hardware can gather/scatter
+natively.
+
+The only fast data-dependent addressing primitive Mosaic exposes is the
+within-vreg `dynamic_gather`: a 128-lane table gathered per sublane row. So
+the layout makes every gather a 128-wide one:
+
+* rows are grouped into **tiles** (2048 rows at level 1);
+* the feature space is cut into **buckets** of 128 consecutive ids;
+* within a tile, entries are sorted by bucket and each (tile, bucket)
+  **segment** is padded to one fixed width `SP` (a multiple of 1024 so the
+  kernels' (SP/128, 128) blocks satisfy the 8-sublane rule).
+
+Inside a segment every entry hits the same 128-wide slice of `w` (forward:
+one dynamic_gather per vreg) and the same 128-wide slice of the gradient
+(backward: one-hot contraction on the MXU). Row indices are tile-local, so
+the z-scatter / u-gather side stays within a VMEM-resident (16, 128) tile
+accumulator. Per entry the layout stores one packed int32
+(`row_local << 7 | lane`) and one f32 value.
+
+**Two levels + COO spill.** A fixed SP wastes padding: segment sizes vary
+(and skew hard on power-law features). Level 1 sizes SP near the *mean*
+segment size and spills the excess; spilled entries are re-bucketed at level
+2 with 8x coarser row tiles (16384 rows), whose segments pool 8 tiles' spill
+and so stay well-filled; anything past level 2's cap lands in a plain COO
+list evaluated by XLA scatter/gather. Uniform data: level 1 carries ~99%,
+blowup ~1.0-1.2x. Skewed data trades kernel speed for correctness
+gracefully. The pack runs once per dataset (the sparsity pattern is static
+across every optimizer iteration, reg-weight sweep and coordinate-descent
+pass) as a vectorized counting sort — O(nnz) numpy, no argsort.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.data.containers import SparseFeatures
+
+Array = jax.Array
+
+BUCKET = 128  # feature ids per bucket == the dynamic_gather table width
+_ROW_SHIFT = 7  # packed = row_local << 7 | lane
+
+L1_TILE_ROWS = 2048  # level-1 tile: row_local fits 11 bits, z-acc (16, 128)
+L2_TILE_ROWS = 16384  # level-2 tile: pools 8 L1 tiles' spill, z-acc (128, 128)
+# Hard cap on segment width (entries): the kernels statically unroll SP/128
+# iterations per segment, so wider segments would explode compile time.
+# Anything past the cap lands in the COO overflow.
+MAX_SP = 8192
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class BucketedLevel:
+    """One fixed-SP level. Arrays are (T * B * spv, 128); see module doc."""
+
+    packed: Array  # int32
+    values: Array  # f32
+    tile_rows: int = dataclasses.field(metadata=dict(static=True))
+    spv: int = dataclasses.field(metadata=dict(static=True))  # SP // 128
+
+    def num_tiles(self, n_rows: int) -> int:
+        return -(-n_rows // self.tile_rows)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class BucketedSparseFeatures:
+    """Device-resident bucketed sparse matrix (two levels + COO spill)."""
+
+    level1: BucketedLevel
+    level2: Optional[BucketedLevel]
+    overflow_rows: Array
+    overflow_cols: Array
+    overflow_vals: Array
+    n_rows: int = dataclasses.field(metadata=dict(static=True))
+    dim: int = dataclasses.field(metadata=dict(static=True))
+
+    @property
+    def num_buckets(self) -> int:
+        return -(-self.dim // BUCKET)
+
+    @property
+    def shape(self) -> Tuple[int, int]:
+        return (self.n_rows, self.dim)
+
+    def density_report(self) -> dict:
+        nnz1 = float(np.asarray((self.level1.values != 0).sum()))
+        nnz2 = (
+            float(np.asarray((self.level2.values != 0).sum()))
+            if self.level2 is not None
+            else 0.0
+        )
+        onnz = float(self.overflow_vals.shape[0])
+        total = max(nnz1 + nnz2 + onnz, 1.0)
+        cap1 = float(self.level1.packed.size)
+        cap2 = float(self.level2.packed.size) if self.level2 is not None else 0.0
+        return {
+            "sp1": self.level1.spv * 128,
+            "sp2": self.level2.spv * 128 if self.level2 is not None else 0,
+            "level1_fraction": nnz1 / total,
+            "level2_fraction": nnz2 / total,
+            "overflow_fraction": onnz / total,
+            "pad_blowup": (cap1 + cap2) / total,
+        }
+
+
+def _sort_by_segment(seg: np.ndarray, n_seg: int):
+    """Stable sort by segment id.
+
+    Returns (order, pos, counts): `order` lists entry indices
+    segment-by-segment and `pos[j]` is the rank of entry `order[j]` within
+    its segment. numpy's stable argsort on int32 keys is a radix sort —
+    effectively O(nnz); `pos` comes from a sequential repeat rather than a
+    random gather (2-3x faster at ~1e8 entries).
+    """
+    counts = np.bincount(seg, minlength=n_seg)
+    starts = np.zeros(n_seg + 1, np.int64)
+    np.cumsum(counts, out=starts[1:])
+    order = np.argsort(seg, kind="stable")
+    pos = np.arange(len(seg), dtype=np.int64) - np.repeat(starts[:-1], counts)
+    return order, pos, counts
+
+
+def _pack_level(
+    rows: np.ndarray,
+    cols: np.ndarray,
+    vals: np.ndarray,
+    n_rows: int,
+    dim: int,
+    tile_rows: int,
+    sp: int,
+    dtype,
+) -> Tuple[BucketedLevel, np.ndarray]:
+    """Pack entries that fit segment width `sp`; return (level, spill mask)."""
+    B = max(1, -(-dim // BUCKET))
+    T = max(1, -(-n_rows // tile_rows))
+    # tile_rows and BUCKET are powers of two: shifts keep the hot O(nnz)
+    # passes in cheap int32 ops.
+    tile_shift = tile_rows.bit_length() - 1
+    rows32 = rows.astype(np.int32, copy=False)
+    cols32 = cols.astype(np.int32, copy=False)
+    seg = (rows32 >> tile_shift) * np.int32(B) + (cols32 >> 7)
+    n_seg = T * B
+    # Pack the per-entry payload BEFORE sorting so only two arrays need the
+    # (random-access) reorder gather.
+    payload = ((rows32 & np.int32(tile_rows - 1)) << _ROW_SHIFT) | (
+        cols32 & np.int32(BUCKET - 1)
+    )
+    order, pos, _ = _sort_by_segment(seg, n_seg)
+    spv = sp // 128
+    fits = pos < sp
+    sel = order[fits]  # entry indices that fit, in segment order
+    # Destinations are monotone in the sorted order -> sequential flat writes.
+    dst = seg[sel].astype(np.int64) * sp + pos[fits]
+    packed = np.zeros(n_seg * sp, np.int32)
+    values = np.zeros(n_seg * sp, dtype)
+    packed[dst] = payload[sel]
+    values[dst] = vals[sel]
+    level = BucketedLevel(
+        packed=jnp.asarray(packed.reshape(n_seg * spv, 128)),
+        values=jnp.asarray(values.reshape(n_seg * spv, 128)),
+        tile_rows=tile_rows,
+        spv=spv,
+    )
+    spill_mask = np.zeros(len(seg), dtype=bool)
+    spill_mask[order[~fits]] = True
+    return level, spill_mask
+
+
+def _round_up(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+def pack_bucketed(
+    rows: np.ndarray,
+    cols: np.ndarray,
+    vals: np.ndarray,
+    n_rows: int,
+    dim: int,
+    *,
+    dtype=np.float32,
+) -> BucketedSparseFeatures:
+    """Pack COO triplets into the two-level bucketed layout."""
+    rows = np.asarray(rows, np.int64)
+    cols = np.asarray(cols, np.int64)
+    vals = np.asarray(vals, dtype)
+    keep = vals != 0
+    rows, cols, vals = rows[keep], cols[keep], vals[keep]
+    nnz = len(vals)
+
+    B = max(1, -(-dim // BUCKET))
+    T1 = max(1, -(-n_rows // L1_TILE_ROWS))
+    # Level-1 SP near the mean segment size (1024-granular): padding stays
+    # ~1x and the spill tail (mean-crossing segments) goes to level 2.
+    mean1 = nnz / max(T1 * B, 1)
+    sp1 = min(max(1024, _round_up(int(mean1), 1024)), MAX_SP)
+    level1, spill = _pack_level(rows, cols, vals, n_rows, dim, L1_TILE_ROWS, sp1, dtype)
+
+    level2 = None
+    o_rows = rows[spill]
+    o_cols = cols[spill]
+    o_vals = vals[spill]
+    if len(o_vals):
+        T2 = max(1, -(-n_rows // L2_TILE_ROWS))
+        mean2 = len(o_vals) / max(T2 * B, 1)
+        # Generous width (4x mean) — level-2 feeds from the variance tail, so
+        # its own segment sizes are lumpy; what still spills goes to COO.
+        sp2 = min(max(1024, _round_up(int(4 * mean2), 1024)), MAX_SP)
+        level2, spill2 = _pack_level(
+            o_rows, o_cols, o_vals, n_rows, dim, L2_TILE_ROWS, sp2, dtype
+        )
+        o_rows, o_cols, o_vals = o_rows[spill2], o_cols[spill2], o_vals[spill2]
+
+    return BucketedSparseFeatures(
+        level1=level1,
+        level2=level2,
+        overflow_rows=jnp.asarray(o_rows.astype(np.int32)),
+        overflow_cols=jnp.asarray(o_cols.astype(np.int32)),
+        overflow_vals=jnp.asarray(o_vals),
+        n_rows=int(n_rows),
+        dim=int(dim),
+    )
+
+
+def pack_from_ell(sp: SparseFeatures, **kwargs) -> BucketedSparseFeatures:
+    """Convert a padded-ELL matrix (2-D) to the bucketed layout."""
+    if sp.indices.ndim != 2:
+        raise ValueError("pack_from_ell takes per-problem (N, K) ELL data")
+    n, k = sp.indices.shape
+    idx = np.asarray(sp.indices)
+    val = np.asarray(sp.values)
+    rows = np.repeat(np.arange(n, dtype=np.int64), k)
+    return pack_bucketed(
+        rows, idx.reshape(-1).astype(np.int64), val.reshape(-1), n, sp.dim, **kwargs
+    )
+
+
+def level_entries(level: BucketedLevel, n_rows: int, dim: int):
+    """Decode one level back to COO triplets (host side, tests)."""
+    B = max(1, -(-dim // BUCKET))
+    sp = level.spv * 128
+    pk = np.asarray(level.packed).reshape(-1, sp)
+    vv = np.asarray(level.values).reshape(-1, sp)
+    seg = np.arange(pk.shape[0])
+    t, b = seg // B, seg % B
+    nz = vv != 0
+    ent_seg, ent_pos = np.nonzero(nz)
+    pkx = pk[ent_seg, ent_pos]
+    rows = t[ent_seg] * level.tile_rows + (pkx >> _ROW_SHIFT)
+    cols = b[ent_seg] * BUCKET + (pkx & (BUCKET - 1))
+    return rows.astype(np.int64), cols.astype(np.int64), vv[ent_seg, ent_pos]
+
+
+def to_coo(bf: BucketedSparseFeatures):
+    """Full COO decode (host side, tests)."""
+    parts = [level_entries(bf.level1, bf.n_rows, bf.dim)]
+    if bf.level2 is not None:
+        parts.append(level_entries(bf.level2, bf.n_rows, bf.dim))
+    parts.append(
+        (
+            np.asarray(bf.overflow_rows, np.int64),
+            np.asarray(bf.overflow_cols, np.int64),
+            np.asarray(bf.overflow_vals),
+        )
+    )
+    rows = np.concatenate([p[0] for p in parts])
+    cols = np.concatenate([p[1] for p in parts])
+    vals = np.concatenate([p[2] for p in parts])
+    return rows, cols, vals

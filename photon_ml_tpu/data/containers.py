@@ -117,7 +117,7 @@ class LabeledData:
 
     @property
     def feature_dim(self) -> int:
-        if isinstance(self.features, SparseFeatures):
+        if hasattr(self.features, "dim"):  # SparseFeatures / bucketed layout
             return self.features.dim
         return self.features.shape[-1]
 

@@ -101,6 +101,19 @@ class FixedEffectCoordinate:
                 feats, jnp.zeros((feats.shape[-1],), feats.dtype)
             )
         )
+        # Sparse shards repack once into the bucketed layout so the
+        # objective's matvec/rmatvec run the Pallas sparse kernels
+        # (ops/pallas_sparse.py) instead of XLA gather/scatter — the sparse
+        # counterpart of the dense fused-kernel decision above. maybe_pack
+        # owns the whole decision (backend, dtype, sharding, size, padding
+        # economics) and returns None when the ELL/XLA path should stay.
+        self._features = feats
+        if isinstance(feats, SparseFeatures):
+            from photon_ml_tpu.ops import pallas_sparse
+
+            bf = pallas_sparse.maybe_pack(feats, dataset.num_samples)
+            if bf is not None:
+                self._features = bf
         self._build_jits()
 
     def _build_jits(self) -> None:
@@ -158,8 +171,8 @@ class FixedEffectCoordinate:
         key: Optional[jax.Array] = None,
     ) -> Tuple[FixedEffectModel, OptResult]:
         ds = self.dataset
-        feats = ds.shards[self.shard]
-        dim = feats.dim if isinstance(feats, SparseFeatures) else feats.shape[-1]
+        feats = self._features
+        dim = feats.dim if hasattr(feats, "dim") else feats.shape[-1]
         w0 = (
             initial_model.coefficients.means
             if initial_model is not None
@@ -183,7 +196,7 @@ class FixedEffectCoordinate:
     def score(self, model: FixedEffectModel) -> Array:
         """Raw per-sample margins x.w — residual bookkeeping happens in the
         coordinate-descent loop, so no offsets here."""
-        return self._score_fn(self.dataset.shards[self.shard], model.coefficients.means)
+        return self._score_fn(self._features, model.coefficients.means)
 
 
 class RandomEffectCoordinate:

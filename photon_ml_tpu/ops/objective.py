@@ -36,8 +36,9 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from photon_ml_tpu.data.bucketed import BucketedSparseFeatures
 from photon_ml_tpu.data.containers import LabeledData, SparseFeatures
-from photon_ml_tpu.ops import pallas_glm
+from photon_ml_tpu.ops import pallas_glm, pallas_sparse
 from photon_ml_tpu.ops.losses import PointwiseLoss
 from photon_ml_tpu.ops.normalization import NormalizationContext
 
@@ -52,15 +53,40 @@ def _eff(w: Array, norm: Optional[NormalizationContext]) -> Tuple[Array, Array]:
 
 
 def _matvec(features, w_eff: Array) -> Array:
+    if isinstance(features, BucketedSparseFeatures):
+        if pallas_sparse.should_use(features):
+            return pallas_sparse.matvec(
+                features, w_eff, interpret=pallas_glm.FORCE_INTERPRET
+            )
+        return pallas_sparse.matvec_xla(features, w_eff)
     if isinstance(features, SparseFeatures):
         return features.matvec(w_eff)
     return features @ w_eff
 
 
 def _rmatvec(features, u: Array) -> Array:
+    if isinstance(features, BucketedSparseFeatures):
+        if pallas_sparse.should_use(features):
+            return pallas_sparse.rmatvec(
+                features, u, interpret=pallas_glm.FORCE_INTERPRET
+            )
+        return pallas_sparse.rmatvec_xla(features, u)
     if isinstance(features, SparseFeatures):
         return features.rmatvec(u)
     return u @ features
+
+
+def _sq_rmatvec(features, u: Array) -> Array:
+    """sum_i u_i * x_i^2 per feature (Hessian diagonals)."""
+    if isinstance(features, BucketedSparseFeatures):
+        if pallas_sparse.should_use(features):
+            return pallas_sparse.rmatvec(
+                features, u, interpret=pallas_glm.FORCE_INTERPRET, square=True
+            )
+        return pallas_sparse.rmatvec_xla(features, u, square=True)
+    if isinstance(features, SparseFeatures):
+        return features.sq_rmatvec(u)
+    return u @ jnp.square(features)
 
 
 def compute_margins(
@@ -212,12 +238,8 @@ def hessian_diagonal(
     z = _matvec(data.features, w_eff) + shift + data.offsets
     c = data.weights * loss.d2(z, data.labels)
     feats = data.features
-    if isinstance(feats, SparseFeatures):
-        sq = feats.sq_rmatvec(c)
-        lin = feats.rmatvec(c)
-    else:
-        sq = c @ jnp.square(feats)
-        lin = c @ feats
+    sq = _sq_rmatvec(feats, c)
+    lin = _rmatvec(feats, c)
     diag = sq
     if norm is not None and norm.shifts is not None:
         s = norm.shifts
@@ -243,7 +265,12 @@ def hessian_matrix(
     z = _matvec(data.features, w_eff) + shift + data.offsets
     c = data.weights * loss.d2(z, data.labels)
     feats = data.features
-    X = feats.to_dense() if isinstance(feats, SparseFeatures) else feats
+    if isinstance(feats, BucketedSparseFeatures):
+        X = pallas_sparse.to_dense_xla(feats)
+    elif isinstance(feats, SparseFeatures):
+        X = feats.to_dense()
+    else:
+        X = feats
     if norm is not None and norm.shifts is not None:
         X = X - norm.shifts
     H = (X * c[:, None]).T @ X

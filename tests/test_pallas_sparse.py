@@ -1,0 +1,246 @@
+"""Bucketed sparse layout + Pallas kernel tests.
+
+Kernel bodies run in interpret mode on the CPU mesh (pallas_glm.FORCE_INTERPRET
+pattern, as in test_pallas_glm.py); numerics are checked against float64
+references built from the raw COO triplets.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from photon_ml_tpu.data import bucketed
+from photon_ml_tpu.data.bucketed import (
+    BucketedSparseFeatures,
+    pack_bucketed,
+    pack_from_ell,
+    to_coo,
+)
+from photon_ml_tpu.data.containers import LabeledData, SparseFeatures
+from photon_ml_tpu.ops import pallas_glm, pallas_sparse
+
+
+def _random_coo(rng, n_rows, dim, nnz, hot_fraction=0.0):
+    rows = rng.integers(0, n_rows, size=nnz).astype(np.int64)
+    cols = rng.integers(0, dim, size=nnz).astype(np.int64)
+    if hot_fraction:
+        n_hot = int(nnz * hot_fraction)
+        cols[:n_hot] = 3  # single hot feature -> hot bucket -> spill paths
+    vals = rng.normal(size=nnz).astype(np.float32)
+    return rows, cols, vals
+
+
+def _dense(rows, cols, vals, n_rows, dim):
+    M = np.zeros((n_rows, dim), np.float64)
+    np.add.at(M, (rows, cols), vals.astype(np.float64))
+    return M
+
+
+class TestPacking:
+    def test_roundtrip_preserves_every_entry(self):
+        rng = np.random.default_rng(0)
+        rows, cols, vals = _random_coo(rng, 5000, 300, 40000, hot_fraction=0.1)
+        bf = pack_bucketed(rows, cols, vals, 5000, 300)
+        r2, c2, v2 = to_coo(bf)
+        assert np.array_equal(
+            _dense(rows, cols, vals, 5000, 300), _dense(r2, c2, v2, 5000, 300)
+        )
+
+    def test_hot_feature_spills_not_drops(self):
+        rng = np.random.default_rng(1)
+        rows, cols, vals = _random_coo(rng, 4096, 256, 30000, hot_fraction=0.5)
+        bf = pack_bucketed(rows, cols, vals, 4096, 256)
+        rep = bf.density_report()
+        assert rep["level1_fraction"] < 1.0  # the hot bucket overflowed L1
+        r2, c2, v2 = to_coo(bf)
+        assert np.array_equal(
+            _dense(rows, cols, vals, 4096, 256), _dense(r2, c2, v2, 4096, 256)
+        )
+
+    def test_pack_from_ell_drops_padding(self):
+        sp = SparseFeatures(
+            indices=jnp.asarray([[1, 2, 0], [4, 0, 0]], jnp.int32),
+            values=jnp.asarray([[1.0, 2.0, 0.0], [3.0, 0.0, 0.0]], jnp.float32),
+            dim=6,
+        )
+        bf = pack_from_ell(sp)
+        r2, c2, v2 = to_coo(bf)
+        M = _dense(r2, c2, v2, 2, 6)
+        assert M[0, 1] == 1.0 and M[0, 2] == 2.0 and M[1, 4] == 3.0
+        assert M.sum() == 6.0  # nothing extra (padding zeros dropped)
+
+    def test_empty_matrix(self):
+        bf = pack_bucketed(
+            np.zeros(0, np.int64), np.zeros(0, np.int64), np.zeros(0, np.float32), 10, 7
+        )
+        z = pallas_sparse.matvec_xla(bf, jnp.ones(7))
+        assert z.shape == (10,) and float(jnp.abs(z).max()) == 0.0
+
+
+@pytest.fixture
+def interpret_kernels():
+    old = pallas_glm.FORCE_INTERPRET
+    pallas_glm.FORCE_INTERPRET = True
+    yield
+    pallas_glm.FORCE_INTERPRET = old
+
+
+class TestKernelParity:
+    @pytest.mark.parametrize("shape", [(5000, 300, 35000), (9000, 700, 60000)])
+    def test_matvec_rmatvec_match_f64(self, shape, interpret_kernels):
+        n, d, nnz = shape
+        rng = np.random.default_rng(2)
+        rows, cols, vals = _random_coo(rng, n, d, nnz, hot_fraction=0.05)
+        bf = pack_bucketed(rows, cols, vals, n, d)
+        M = _dense(rows, cols, vals, n, d)
+        w = rng.normal(size=d).astype(np.float32)
+        u = rng.normal(size=n).astype(np.float32)
+
+        z = np.asarray(pallas_sparse.matvec(bf, jnp.asarray(w), interpret=True))
+        g = np.asarray(pallas_sparse.rmatvec(bf, jnp.asarray(u), interpret=True))
+        gs = np.asarray(
+            pallas_sparse.rmatvec(bf, jnp.asarray(u), interpret=True, square=True)
+        )
+        np.testing.assert_allclose(z, M @ w, rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(g, M.T @ u, rtol=2e-5, atol=2e-5)
+        gs_ref = np.zeros(d)
+        np.add.at(gs_ref, cols, vals.astype(np.float64) ** 2 * u[rows])
+        np.testing.assert_allclose(gs, gs_ref, rtol=2e-5, atol=2e-5)
+
+    def test_xla_reference_matches_f64(self):
+        rng = np.random.default_rng(3)
+        rows, cols, vals = _random_coo(rng, 3000, 500, 20000)
+        bf = pack_bucketed(rows, cols, vals, 3000, 500)
+        M = _dense(rows, cols, vals, 3000, 500)
+        w = rng.normal(size=500).astype(np.float32)
+        u = rng.normal(size=3000).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(pallas_sparse.matvec_xla(bf, jnp.asarray(w))), M @ w, rtol=2e-5, atol=2e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(pallas_sparse.rmatvec_xla(bf, jnp.asarray(u))), M.T @ u, rtol=2e-5, atol=2e-5
+        )
+
+    def test_to_dense_xla(self):
+        rng = np.random.default_rng(4)
+        rows, cols, vals = _random_coo(rng, 600, 130, 4000, hot_fraction=0.3)
+        bf = pack_bucketed(rows, cols, vals, 600, 130)
+        np.testing.assert_allclose(
+            np.asarray(pallas_sparse.to_dense_xla(bf)),
+            _dense(rows, cols, vals, 600, 130),
+            rtol=1e-6,
+            atol=1e-6,
+        )
+
+
+class TestMaybePack:
+    def _ell(self, n, d, k, dtype=np.float32, seed=0):
+        rng = np.random.default_rng(seed)
+        idx = rng.integers(0, d, size=(n, k)).astype(np.int32)
+        val = rng.normal(size=(n, k)).astype(dtype)
+        return SparseFeatures(jnp.asarray(idx), jnp.asarray(val), d)
+
+    def test_engages_on_worthwhile_shard(self, interpret_kernels):
+        sp = self._ell(9000, 200, 8)
+        assert pallas_sparse.maybe_pack(sp, 9000) is not None
+
+    def test_declines_low_density(self, interpret_kernels):
+        # 1 nnz/row into a wide dim: segment floor of 1024 slots would blow
+        # padding up far past the ELL bytes.
+        sp = self._ell(100_000, 16384, 1)
+        assert pallas_sparse.maybe_pack(sp, 100_000) is None
+
+    # (the f64 decline branch is untestable here: without jax_enable_x64,
+    # jnp.asarray coerces f64 input to f32 before the gate ever sees it)
+
+    def test_declines_small_problem(self, interpret_kernels):
+        sp = self._ell(1000, 200, 8)
+        assert pallas_sparse.maybe_pack(sp, 1000) is None
+
+    def test_declines_when_disabled(self, interpret_kernels):
+        sp = self._ell(9000, 200, 8)
+        pallas_glm.set_enabled(False)
+        try:
+            assert pallas_sparse.maybe_pack(sp, 9000) is None
+        finally:
+            pallas_glm.set_enabled(True)
+
+
+class TestObjectiveIntegration:
+    def test_objective_with_bucketed_features(self, interpret_kernels):
+        """value_and_gradient / hessian paths agree between ELL and bucketed."""
+        from photon_ml_tpu.ops import objective
+        from photon_ml_tpu.ops.losses import LOGISTIC
+
+        rng = np.random.default_rng(5)
+        n, d, k = 4000, 260, 9
+        idx = rng.integers(0, d, size=(n, k)).astype(np.int32)
+        val = rng.normal(size=(n, k)).astype(np.float32)
+        sp = SparseFeatures(jnp.asarray(idx), jnp.asarray(val), d)
+        bf = pack_from_ell(sp)
+        y = (rng.uniform(size=n) > 0.5).astype(np.float32)
+        w = (rng.normal(size=d) * 0.1).astype(np.float32)
+        mk = lambda feats: LabeledData(
+            feats, jnp.asarray(y), jnp.zeros(n), jnp.ones(n)
+        )
+        v1, g1 = objective.value_and_gradient(LOGISTIC, jnp.asarray(w), mk(sp), l2=0.5)
+        v2, g2 = objective.value_and_gradient(LOGISTIC, jnp.asarray(w), mk(bf), l2=0.5)
+        np.testing.assert_allclose(float(v1), float(v2), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=1e-4, atol=1e-4)
+
+        hv1 = objective.hessian_vector(LOGISTIC, jnp.asarray(w), jnp.asarray(w), mk(sp), l2=0.5)
+        hv2 = objective.hessian_vector(LOGISTIC, jnp.asarray(w), jnp.asarray(w), mk(bf), l2=0.5)
+        np.testing.assert_allclose(np.asarray(hv1), np.asarray(hv2), rtol=1e-4, atol=1e-4)
+
+        d1 = objective.hessian_diagonal(LOGISTIC, jnp.asarray(w), mk(sp), l2=0.5)
+        d2 = objective.hessian_diagonal(LOGISTIC, jnp.asarray(w), mk(bf), l2=0.5)
+        np.testing.assert_allclose(np.asarray(d1), np.asarray(d2), rtol=1e-4, atol=1e-4)
+
+    def test_fixed_effect_coordinate_packs_and_trains(self, interpret_kernels):
+        """A big-enough sparse shard repacks to bucketed and converges to the
+        same optimum as the ELL/XLA path."""
+        from photon_ml_tpu.data.game_dataset import GameDataset
+        from photon_ml_tpu.game.coordinate import FixedEffectCoordinate
+        from photon_ml_tpu.optimize.config import (
+            L2,
+            CoordinateOptimizationConfig,
+            OptimizerConfig,
+        )
+        from photon_ml_tpu.types import TaskType
+
+        rng = np.random.default_rng(6)
+        n, d, k = 9000, 200, 6
+        idx = rng.integers(0, d, size=(n, k)).astype(np.int32)
+        val = rng.normal(size=(n, k)).astype(np.float32)
+        sp = SparseFeatures(jnp.asarray(idx), jnp.asarray(val), d)
+        w_true = rng.normal(size=d) * 0.3
+        M = _dense(np.repeat(np.arange(n), k), idx.reshape(-1), val.reshape(-1), n, d)
+        y = (rng.uniform(size=n) < 1 / (1 + np.exp(-M @ w_true))).astype(np.float32)
+        ds = GameDataset.build({"s": sp}, y)
+        cfg = CoordinateOptimizationConfig(
+            optimizer=OptimizerConfig(max_iterations=30, tolerance=1e-8),
+            regularization=L2,
+            reg_weight=1.0,
+        )
+        coord = FixedEffectCoordinate(ds, "s", cfg, TaskType.LOGISTIC_REGRESSION)
+        assert isinstance(coord._features, BucketedSparseFeatures)
+        model, res = coord.train(ds.offsets)
+
+        pallas_glm.set_enabled(False)
+        try:
+            coord_ell = FixedEffectCoordinate(ds, "s", cfg, TaskType.LOGISTIC_REGRESSION)
+            assert isinstance(coord_ell._features, SparseFeatures)
+            model_ell, _ = coord_ell.train(ds.offsets)
+        finally:
+            pallas_glm.set_enabled(True)
+        np.testing.assert_allclose(
+            np.asarray(model.coefficients.means),
+            np.asarray(model_ell.coefficients.means),
+            rtol=5e-3,
+            atol=5e-4,
+        )
+        # scoring path uses the bucketed features too
+        s1 = np.asarray(coord.score(model))
+        s2 = np.asarray(coord_ell.score(model))
+        np.testing.assert_allclose(s1, s2, rtol=1e-4, atol=1e-4)
