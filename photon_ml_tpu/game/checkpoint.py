@@ -91,6 +91,8 @@ def _save_model_npz(path: str, model) -> None:
         arrays = {"kind": np.asarray("random"), "matrix": np.asarray(model.coefficients_matrix)}
         if model.variances_matrix is not None:
             arrays["variances"] = np.asarray(model.variances_matrix)
+        if model.n_entities is not None:
+            arrays["n_entities"] = np.asarray(model.n_entities)
     else:
         raise TypeError(f"unknown model type {type(model)}")
     np.savez(buf, **arrays)
@@ -104,7 +106,10 @@ def _load_model_npz(path: str, task):
         if kind == "fixed":
             return FixedEffectModel(Coefficients(jnp.asarray(z["means"]), var), task)
         if kind == "random":
-            return RandomEffectModel(jnp.asarray(z["matrix"]), var, task)
+            n_ent = int(z["n_entities"]) if "n_entities" in z else None
+            return RandomEffectModel(
+                jnp.asarray(z["matrix"]), var, task, n_entities=n_ent
+            )
         raise ValueError(
             f"{path}: unknown model kind {kind!r} (corrupted or foreign "
             "checkpoint file)"

@@ -199,6 +199,27 @@ class FixedEffectCoordinate:
         return self._score_fn(self._features, model.coefficients.means)
 
 
+def _infer_entity_mesh(re_dataset):
+    """The 1-D mesh the RE dataset's entity blocks are sharded over, if any."""
+    from jax.sharding import NamedSharding
+
+    try:
+        if not re_dataset.buckets:
+            return None
+        sh = re_dataset.buckets[0].entity_rows.sharding
+        if (
+            isinstance(sh, NamedSharding)
+            and len(sh.mesh.axis_names) == 1
+            and len(sh.device_set) > 1
+            and sh.spec
+            and sh.spec[0] == sh.mesh.axis_names[0]
+        ):
+            return sh.mesh
+    except Exception:
+        return None
+    return None
+
+
 class RandomEffectCoordinate:
     """One random-effect coordinate (RandomEffectCoordinate.scala:37-221)."""
 
@@ -218,6 +239,20 @@ class RandomEffectCoordinate:
         self.norm = norm
         feats = dataset.shards[re_dataset.feature_shard]
         self.dim = feats.dim if isinstance(feats, SparseFeatures) else feats.shape[-1]
+        # Entity-sharded coefficient store: when the RE dataset's entity
+        # blocks are sharded over a mesh, the (E+1, D) matrix is row-sharded
+        # over the same axis and accessed through ring collectives
+        # (parallel/mesh.py) — per-device coefficient state is total/n_devices
+        # instead of a full replica, which is what lets the framework chase
+        # the reference's RDD-partitioned coefficient scale
+        # (RandomEffectModel.scala:36-239). PerEntityNormalization keeps the
+        # replicated path: its per-entity factor/shift arrays would need the
+        # same sharding treatment to be meaningful at that scale.
+        self._entity_mesh = None
+        from photon_ml_tpu.ops.normalization import PerEntityNormalization as _PEN
+
+        if not isinstance(norm, _PEN):
+            self._entity_mesh = _infer_entity_mesh(re_dataset)
         self._build_jits()
 
     def _build_jits(self) -> None:
@@ -323,15 +358,36 @@ class RandomEffectCoordinate:
         red = self.re_dataset
         dtype = ds.labels.dtype
         e_total = red.num_entities
+        mesh = self._entity_mesh
+        n_rows = e_total + 1
+        if mesh is not None:
+            from photon_ml_tpu.parallel.mesh import (
+                matrix_row_sharding,
+                pad_rows_for_mesh,
+                ring_gather_rows,
+                ring_scatter_rows,
+                sharded_zeros,
+            )
+
+            n_rows = pad_rows_for_mesh(n_rows, mesh)
+            row_sh = matrix_row_sharding(mesh)
         if initial_model is not None:
             matrix = initial_model.coefficients_matrix
+            if matrix.shape[0] < n_rows:
+                matrix = jnp.pad(matrix, ((0, n_rows - matrix.shape[0]), (0, 0)))
+            if mesh is not None:
+                matrix = jax.device_put(matrix, row_sh)
+        elif mesh is not None:
+            matrix = sharded_zeros((n_rows, self.dim), dtype, row_sh)
         else:
-            matrix = jnp.zeros((e_total + 1, self.dim), dtype)
-        var_matrix = (
-            jnp.zeros((e_total + 1, self.dim), dtype)
-            if self.config.variance_computation != VarianceComputationType.NONE
-            else None
-        )
+            matrix = jnp.zeros((n_rows, self.dim), dtype)
+        want_var = self.config.variance_computation != VarianceComputationType.NONE
+        if not want_var:
+            var_matrix = None
+        elif mesh is not None:
+            var_matrix = sharded_zeros((n_rows, self.dim), dtype, row_sh)
+        else:
+            var_matrix = jnp.zeros((n_rows, self.dim), dtype)
         rw = jnp.asarray(
             self.config.reg_weight if reg_weight is None else reg_weight, dtype
         )
@@ -343,13 +399,21 @@ class RandomEffectCoordinate:
             block_data = gather_block_data(
                 ds, red.feature_shard, blocks, offsets, feature_mask=red.feature_mask
             )
-            w0 = matrix[blocks.entity_rows]
+            if mesh is not None:
+                w0 = ring_gather_rows(matrix, blocks.entity_rows, mesh)
+            else:
+                w0 = matrix[blocks.entity_rows]
             if self._per_entity_norm:
                 f_blk, s_blk = self._norm_blocks(blocks.entity_rows)
                 res: OptResult = self._train_bucket(block_data, w0, f_blk, s_blk, rw)
             else:
                 res = self._train_bucket(block_data, w0, rw)
-            matrix = matrix.at[blocks.entity_rows].set(res.coefficients)
+            if mesh is not None:
+                matrix = ring_scatter_rows(
+                    matrix, blocks.entity_rows, res.coefficients, mesh
+                )
+            else:
+                matrix = matrix.at[blocks.entity_rows].set(res.coefficients)
             if var_matrix is not None:
                 if self._per_entity_norm:
                     v = self._variance_bucket(
@@ -357,7 +421,12 @@ class RandomEffectCoordinate:
                     )
                 else:
                     v = self._variance_bucket(block_data, res.coefficients, rw)
-                var_matrix = var_matrix.at[blocks.entity_rows].set(v)
+                if mesh is not None:
+                    var_matrix = ring_scatter_rows(
+                        var_matrix, blocks.entity_rows, v, mesh
+                    )
+                else:
+                    var_matrix = var_matrix.at[blocks.entity_rows].set(v)
             bucket_iters.append(res.iterations)
         stats = {
             "buckets": [
@@ -372,10 +441,27 @@ class RandomEffectCoordinate:
         }
         # Keep the unseen-entity row pinned to zero.
         matrix = matrix.at[e_total].set(0.0)
-        model = RandomEffectModel(matrix, var_matrix, self.task)
+        model = RandomEffectModel(
+            matrix,
+            var_matrix,
+            self.task,
+            n_entities=e_total if matrix.shape[0] != e_total + 1 else None,
+        )
         return model, stats
 
     def score(self, model: RandomEffectModel) -> Array:
+        if self._entity_mesh is not None and model.coefficients_matrix.shape[0] % (
+            self._entity_mesh.devices.size
+        ) == 0:
+            from photon_ml_tpu.game.model import random_effect_margins_sharded
+
+            return random_effect_margins_sharded(
+                self.dataset.shards[self.re_dataset.feature_shard],
+                self.re_dataset.sample_entity_rows,
+                model.coefficients_matrix,
+                self.norm,
+                self._entity_mesh,
+            )
         return self._score_fn(
             self.dataset.shards[self.re_dataset.feature_shard],
             self.re_dataset.sample_entity_rows,

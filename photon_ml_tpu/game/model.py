@@ -84,6 +84,54 @@ class FixedEffectModel:
         return mean_for_task(self.task, self.score(data) + data.offsets)
 
 
+def random_effect_margins_sharded(
+    features, entity_rows: Array, matrix: Array, norm, mesh
+) -> Array:
+    """Sharded-gather scoring: the row-sharded coefficient matrix is read via
+    the ring collective (parallel/mesh.ring_gather_rows) so no device ever
+    materializes the full (E+1, D) matrix — the sharded counterpart of
+    RandomEffectModel.score's re-key + join (RandomEffectModel.scala:239+).
+
+    Normalization is applied to the gathered per-sample rows (same row-wise
+    algebra as the replicated path). Per-entity normalization is not
+    supported here — its factor/shift tables are themselves entity-sized and
+    would need the same sharding; callers keep the replicated path for it.
+
+    NOTE: the norm algebra and sparse/dense dot below deliberately mirror
+    `random_effect_margins`; they cannot share code without materializing
+    (N, D) gathered rows on the replicated sparse path (a memory regression
+    there). tests/test_parallel.py asserts numerical parity between the two,
+    with and without normalization — keep both in sync.
+    """
+    from photon_ml_tpu.data.containers import SparseFeatures as _SF
+    from photon_ml_tpu.ops.normalization import PerEntityNormalization
+    from photon_ml_tpu.parallel.mesh import ring_gather_rows
+
+    if isinstance(norm, PerEntityNormalization) and not norm.is_identity:
+        raise NotImplementedError(
+            "sharded scoring with per-entity normalization: use the "
+            "replicated path"
+        )
+    n = entity_rows.shape[0]
+    ndev = mesh.devices.size
+    rem = (-n) % ndev  # ring collectives need evenly splittable requests
+    rows_q = jnp.pad(entity_rows, (0, rem)) if rem else entity_rows
+    w_rows = ring_gather_rows(matrix, rows_q, mesh)[:n]  # (N, D), sample-sharded
+    shift = None
+    if norm is not None and not norm.is_identity:
+        w_rows = jax.vmap(norm.effective_coefficients)(w_rows)
+        if norm.shifts is not None:
+            shift = -(w_rows @ norm.shifts)
+    if isinstance(features, _SF):
+        g = jnp.take_along_axis(w_rows, features.indices, axis=1)
+        out = jnp.sum(g * features.values, axis=-1)
+    else:
+        out = jnp.einsum("nd,nd->n", features, w_rows)
+    if shift is not None:
+        out = out + shift
+    return out
+
+
 def random_effect_margins(features, entity_rows: Array, matrix: Array, norm) -> Array:
     """Per-sample random-effect margins: gather each sample's coefficient row
     and dot, with normalization folded in once per entity row (the same
@@ -129,13 +177,27 @@ class RandomEffectModel:
     to zeros (the reference scores those with the prior/zero model).
     """
 
-    coefficients_matrix: Array  # (E + 1, D); last row all-zero for unseen
+    coefficients_matrix: Array  # (>= E + 1, D); row E (pinned zero) scores
+    # unseen entities; rows past E + 1 exist only when the matrix is padded
+    # to a device-mesh multiple (entity-sharded store) and are all-zero.
     variances_matrix: Optional[Array]
     task: TaskType = dataclasses.field(metadata=dict(static=True))
+    # Logical entity count E. None = unpadded matrix (E = rows - 1); set by
+    # mesh-trained coordinates whose matrices are row-padded.
+    n_entities: Optional[int] = dataclasses.field(
+        default=None, metadata=dict(static=True)
+    )
 
     @property
     def num_entities(self) -> int:
+        if self.n_entities is not None:
+            return self.n_entities
         return self.coefficients_matrix.shape[0] - 1
+
+    @property
+    def unseen_row(self) -> int:
+        """Row index scoring uses for entities unseen at training time."""
+        return self.num_entities
 
     @property
     def dim(self) -> int:

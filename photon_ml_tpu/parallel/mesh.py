@@ -123,6 +123,141 @@ def shard_game_dataset(dataset: GameDataset, mesh: Mesh) -> GameDataset:
     )
 
 
+import functools
+
+
+def matrix_row_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard matrix ROWS (entities) over the mesh; feature axis replicated."""
+    return NamedSharding(mesh, P(mesh.axis_names[0], None))
+
+
+@functools.lru_cache(maxsize=64)
+def _sharded_zeros_fn(shape, dtype, sharding):
+    return jax.jit(lambda: jnp.zeros(shape, dtype), out_shardings=sharding)
+
+
+def sharded_zeros(shape, dtype, sharding: NamedSharding):
+    """Allocate directly in sharded form (no replicated intermediate)."""
+    return _sharded_zeros_fn(tuple(shape), np.dtype(dtype), sharding)()
+
+
+def pad_rows_for_mesh(n_rows: int, mesh: Mesh) -> int:
+    ndev = mesh.devices.size
+    return -(-n_rows // ndev) * ndev
+
+
+@functools.lru_cache(maxsize=64)
+def _ring_gather_fn(mesh: Mesh, rows_ndim: int):
+    """Build (once per mesh/rank — jit caches by callable identity, so a
+    fresh closure per call would retrace and recompile every invocation)."""
+    axis = mesh.axis_names[0]
+    ndev = mesh.devices.size
+    perm = [(i, (i - 1) % ndev) for i in range(ndev)]
+
+    def per_device(m_loc, rows_loc):
+        my = jax.lax.axis_index(axis)
+        chunk_rows = m_loc.shape[0]
+
+        def step(s, carry):
+            out, chunk = carry
+            owner = jax.lax.rem(my + s, ndev)
+            base = owner * chunk_rows
+            mask = (rows_loc >= base) & (rows_loc < base + chunk_rows)
+            local = jnp.clip(rows_loc - base, 0, chunk_rows - 1)
+            out = out + jnp.where(mask[..., None], chunk[local], 0.0)
+            chunk = jax.lax.ppermute(chunk, axis, perm)
+            return out, chunk
+
+        out = jnp.zeros((*rows_loc.shape, m_loc.shape[1]), m_loc.dtype)
+        out, _ = jax.lax.fori_loop(0, ndev, step, (out, m_loc))
+        return out
+
+    spec_rows = P(axis, *([None] * (rows_ndim - 1)))
+    return jax.jit(
+        jax.shard_map(
+            per_device,
+            mesh=mesh,
+            in_specs=(P(axis, None), spec_rows),
+            out_specs=P(axis, *([None] * rows_ndim)),
+            check_vma=False,
+        )
+    )
+
+
+def ring_gather_rows(matrix: jax.Array, rows: jax.Array, mesh: Mesh) -> jax.Array:
+    """out[i] = matrix[rows[i]] where `matrix` is row-sharded and `rows` is
+    sharded along its own leading axis — without ever materializing the full
+    matrix on one device.
+
+    The row-sharded matrix chunk rotates around the ring (ppermute over ICI,
+    the ring-attention access pattern): at step s device d holds the chunk of
+    device (d+s) %% ndev and serves the requests that fall in that row range.
+    Peak per-device footprint is two chunks (resident + in flight) — this is
+    what lets the random-effect coefficient store exceed single-device HBM
+    (the reference's RDD[(REId, model)] partitioning,
+    photon-api model/RandomEffectModel.scala:36-239).
+    """
+    return _ring_gather_fn(mesh, rows.ndim)(matrix, rows)
+
+
+@functools.lru_cache(maxsize=64)
+def _ring_scatter_fn(mesh: Mesh, rows_ndim: int, vals_ndim: int):
+    axis = mesh.axis_names[0]
+    ndev = mesh.devices.size
+    perm = [(i, (i - 1) % ndev) for i in range(ndev)]
+
+    def per_device(m_loc, rows_loc, vals_loc):
+        my = jax.lax.axis_index(axis)
+        chunk_rows = m_loc.shape[0]
+        r_flat = rows_loc.reshape(-1)
+        v_flat = vals_loc.reshape(-1, vals_loc.shape[-1])
+
+        def step(s, carry):
+            m, r, v = carry
+            # After s ppermute hops the payload in hand originated s devices
+            # to the right; its origin does not matter — only the row range.
+            base = my * chunk_rows
+            mask = (r >= base) & (r < base + chunk_rows)
+            # Masked-out updates are routed to a dummy extra row so they
+            # cannot clobber in-range rows.
+            local = jnp.where(mask, r - base, chunk_rows)
+            m_ext = jnp.concatenate(
+                [m, jnp.zeros((1, m.shape[1]), m.dtype)], axis=0
+            )
+            m = m_ext.at[local].set(v)[:chunk_rows]
+            r = jax.lax.ppermute(r, axis, perm)
+            v = jax.lax.ppermute(v, axis, perm)
+            return m, r, v
+
+        m, _, _ = jax.lax.fori_loop(0, ndev, step, (m_loc, r_flat, v_flat))
+        return m
+
+    spec_rows = P(axis, *([None] * (rows_ndim - 1)))
+    spec_vals = P(axis, *([None] * (vals_ndim - 1)))
+    return jax.jit(
+        jax.shard_map(
+            per_device,
+            mesh=mesh,
+            in_specs=(P(axis, None), spec_rows, spec_vals),
+            out_specs=P(axis, None),
+            check_vma=False,
+        )
+    )
+
+
+def ring_scatter_rows(
+    matrix: jax.Array, rows: jax.Array, values: jax.Array, mesh: Mesh
+) -> jax.Array:
+    """matrix.at[rows].set(values) for a row-sharded matrix with sharded
+    (rows, values) — the inverse ring of `ring_gather_rows`: the update
+    payload rotates; each device applies the updates that land in its chunk.
+
+    Duplicate rows must carry equal values (the padded-entity contract:
+    padding entities all write the zero solution to the pinned row).
+    """
+    return _ring_scatter_fn(mesh, rows.ndim, values.ndim)(matrix, rows, values)
+
+
 def shard_random_effect_dataset(
     red: RandomEffectDataset, mesh: Mesh
 ) -> RandomEffectDataset:

@@ -119,6 +119,26 @@ def _re_margins(features: Features, entity_rows: Array, matrix: Array, norm) -> 
     return random_effect_margins(features, entity_rows, matrix, norm)
 
 
+def _entity_sharded_mesh(matrix) -> "object | None":
+    """The 1-D mesh a row-sharded coefficient matrix lives on, if any."""
+    from jax.sharding import NamedSharding
+
+    try:
+        sh = matrix.sharding
+        if (
+            isinstance(sh, NamedSharding)
+            and len(sh.mesh.axis_names) == 1
+            and len(sh.device_set) > 1
+            and sh.spec
+            and sh.spec[0] == sh.mesh.axis_names[0]
+            and matrix.shape[0] % sh.mesh.devices.size == 0
+        ):
+            return sh.mesh
+    except Exception:
+        return None
+    return None
+
+
 @jax.jit
 def _fe_margins(features: Features, w: Array, norm) -> Array:
     n = features.values.shape[0] if isinstance(features, SparseFeatures) else features.shape[0]
@@ -132,9 +152,20 @@ def coordinate_margins(
     """Score one coordinate's model over prepared data."""
     if spec.is_random_effect:
         assert isinstance(model, RandomEffectModel)
-        return _re_margins(
-            prepared.features, prepared.entity_rows, model.coefficients_matrix, spec.norm
-        )
+        matrix = model.coefficients_matrix
+        mesh = _entity_sharded_mesh(matrix)
+        from photon_ml_tpu.ops.normalization import PerEntityNormalization
+
+        if mesh is not None and not isinstance(spec.norm, PerEntityNormalization):
+            # Mesh-trained row-sharded matrix: score through the ring gather
+            # so the full (E+1, D) matrix is never replicated on one device
+            # (the whole point of the entity-sharded store).
+            from photon_ml_tpu.game.model import random_effect_margins_sharded
+
+            return random_effect_margins_sharded(
+                prepared.features, prepared.entity_rows, matrix, spec.norm, mesh
+            )
+        return _re_margins(prepared.features, prepared.entity_rows, matrix, spec.norm)
     assert isinstance(model, FixedEffectModel)
     return _fe_margins(prepared.features, model.coefficients.means, spec.norm)
 

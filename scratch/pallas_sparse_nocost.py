@@ -1,0 +1,383 @@
+"""Pallas TPU kernels for bucketed sparse matvec / rmatvec.
+
+The sparse GLM hot loop — margins `z = X @ w` and gradient `g = X^T u` over a
+bag-of-features design matrix — is the reference's native workload
+(photon-lib function/glm/ValueAndGradientAggregator.scala:137-161 streams
+sparse LabeledPoint entries; photon-lib data/LabeledPoint.scala:33). Expressed
+as XLA gather/scatter the two passes serialize (~0.59 s forward / ~0.47 s
+backward at 1M x 64nnz, dim 16k — measured on v5e); these kernels run the
+same passes out of VMEM with the only fast data-dependent primitive the
+hardware has — the within-vreg 128-lane `dynamic_gather` — plus small one-hot
+contractions on the MXU.
+
+Layout contract (see data/bucketed.py): entries grouped by (row-tile,
+feature-bucket of 128) into fixed-width segments; per entry one packed int32
+`row_local << 7 | lane` and one f32 value; two levels (fine tiles + a coarse
+spill level) and a COO tail handled by XLA.
+
+Forward, per (row-tile, bucket-group) grid step, per segment:
+    w_b       = 128-wide bucket slice of w, broadcast over sublanes
+    p         = dynamic_gather(w_b, lane) * value    # 1024 entries / vreg-op
+    z_tile   += sum_e p_e . onehot(row_local_e)      # MXU contraction
+The z-scatter runs on the MXU: per 128-entry sublane row, a one-hot
+(rhi x rlo) contraction accumulates into the tile's (tile_rows/128, 128)
+z block, VMEM-resident across the whole bucket loop.
+
+Backward mirrors it: per entry u[row_local] is a lane-gather of the u-tile
+followed by a sublane one-hot select, and the 128-wide bucket gradient is a
+one-hot contraction. Each kernel streams `packed`+`values` exactly once per
+pass — the sparse counterpart of the dense fused kernel's single-X-read
+property (ops/pallas_glm.py).
+
+Precision: the one-hot operand is exact in bf16; the value-carrying operand
+is split hi/lo into two bf16 MXU passes, which matches f32 accumulation to
+~3e-6 relative (measured) at a fraction of HIGHEST's six passes. Set
+PHOTON_SPARSE_PRECISION=default for single-pass bf16 (~1.7e-3 relative) when
+raw speed matters more than line-search quality.
+
+Measured on v5e at 1M x 64 nnz, dim 16384 (uniform): forward ~16 ms, backward
+~21 ms per pass at hi/lo precision vs 592 / 465 ms for the XLA path — see
+BENCH_r03.json for the bench-protocol numbers.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pragma: no cover - absent only on CPU-only installs
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+from photon_ml_tpu.data.bucketed import (
+    BUCKET,
+    BucketedLevel,
+    BucketedSparseFeatures,
+    _ROW_SHIFT,
+)
+from photon_ml_tpu.ops import pallas_glm
+
+Array = jax.Array
+
+# Value-carrying MXU operand precision: "hilo" (two bf16 passes ~= f32) or a
+# jax.lax.Precision name. Validated leniently like the dense kernel's knobs.
+_SPARSE_PREC = os.environ.get("PHOTON_SPARSE_PRECISION", "hilo").strip().lower()
+if _SPARSE_PREC not in ("hilo", "default", "highest"):
+    import logging
+
+    logging.getLogger(__name__).warning(
+        "PHOTON_SPARSE_PRECISION=%r: expected hilo|default|highest; using hilo",
+        _SPARSE_PREC,
+    )
+    _SPARSE_PREC = "hilo"
+
+# Static-unroll budget: segments wider than this fall back to XLA (the
+# kernels unroll spv iterations per segment).
+MAX_SPV = 64
+# Bucket-group size: segments fused per grid step to amortize per-step
+# overhead (measured ~2x at 1M x 64nnz). Chosen per call to divide B.
+_GROUP = 32
+
+
+def _bcast_row(row: Array, sublanes: int) -> Array:
+    return jax.lax.broadcast_in_dim(row[0, :], (sublanes, 128), (1,))
+
+
+def _onehot_contract(values_row: Array, onehot: Array) -> Array:
+    """dot(values, onehot^T) with the configured value-operand precision."""
+    dn = (((1,), (1,)), ((), ()))
+    if _SPARSE_PREC == "hilo":
+        hi = values_row.astype(jnp.bfloat16).astype(jnp.float32)
+        lo = values_row - hi
+        return jax.lax.dot_general(
+            hi, onehot, dimension_numbers=dn, preferred_element_type=jnp.float32
+        ) + jax.lax.dot_general(
+            lo, onehot, dimension_numbers=dn, preferred_element_type=jnp.float32
+        )
+    prec = (
+        jax.lax.Precision.HIGHEST
+        if _SPARSE_PREC == "highest"
+        else jax.lax.Precision.DEFAULT
+    )
+    return jax.lax.dot_general(
+        values_row,
+        onehot,
+        dimension_numbers=dn,
+        preferred_element_type=jnp.float32,
+        precision=prec,
+    )
+
+
+def _matvec_kernel(spv: int, rt: int, group: int, pk_ref, val_ref, w_ref, z_ref):
+    bg = pl.program_id(1)
+    zc = jnp.zeros((rt, 128), jnp.float32)
+    for gi in range(group):
+        pk = pk_ref[pl.ds(gi * spv, spv), :]
+        vv = val_ref[pl.ds(gi * spv, spv), :]
+        rl = jax.lax.shift_right_logical(pk, _ROW_SHIFT)
+        lane = jax.lax.bitwise_and(pk, BUCKET - 1)
+        wb = _bcast_row(w_ref[pl.ds(bg * group + gi, 1), :], spv)
+        p = jnp.take_along_axis(wb, lane, axis=1) * vv
+        for s in range(spv):
+            rl_row = rl[s : s + 1, :]
+            rhi = jax.lax.shift_right_logical(rl_row, 7)
+            rlo = jax.lax.bitwise_and(rl_row, 127)
+            orh = jax.lax.broadcasted_iota(jnp.int32, (rt, 128), 0) == _bcast_row(
+                rhi, rt
+            )
+            p1 = jnp.where(orh, _bcast_row(p[s : s + 1, :], rt), 0.0)
+            orlt = (
+                jax.lax.broadcasted_iota(jnp.int32, (128, 128), 0)
+                == _bcast_row(rlo, 128)
+            ).astype(jnp.float32)
+            zc = zc + _onehot_contract(p1, orlt)
+
+    @pl.when(bg == 0)
+    def _():
+        z_ref[:] = zc
+
+    @pl.when(bg > 0)
+    def _():
+        z_ref[:] += zc
+
+
+def _rmatvec_kernel(
+    spv: int, rt: int, group: int, square: bool, pk_ref, val_ref, u_ref, g_ref
+):
+    bg = pl.program_id(0)
+    t = pl.program_id(1)
+    u2 = u_ref[:]
+    for gi in range(group):
+        pk = pk_ref[pl.ds(gi * spv, spv), :]
+        vv = val_ref[pl.ds(gi * spv, spv), :]
+        if square:
+            vv = vv * vv
+        rl = jax.lax.shift_right_logical(pk, _ROW_SHIFT)
+        lane = jax.lax.bitwise_and(pk, BUCKET - 1)
+        gc = jnp.zeros((1, 128), jnp.float32)
+        for s in range(spv):
+            rl_row = rl[s : s + 1, :]
+            rhi = jax.lax.shift_right_logical(rl_row, 7)
+            rlo = jax.lax.bitwise_and(rl_row, 127)
+            tu = jnp.take_along_axis(u2, _bcast_row(rlo, rt), axis=1)
+            orh = jax.lax.broadcasted_iota(jnp.int32, (rt, 128), 0) == _bcast_row(
+                rhi, rt
+            )
+            u_sel = jnp.sum(jnp.where(orh, tu, 0.0), axis=0, keepdims=True)
+            a = u_sel * vv[s : s + 1, :]
+            olt = (
+                jax.lax.broadcasted_iota(jnp.int32, (128, 128), 0)
+                == _bcast_row(lane[s : s + 1, :], 128)
+            ).astype(jnp.float32)
+            gc = gc + _onehot_contract(a, olt)
+        bidx = bg * group + gi
+
+        @pl.when(t == 0)
+        def _():
+            g_ref[pl.ds(bidx, 1), :] = gc
+
+        @pl.when(t > 0)
+        def _():
+            g_ref[pl.ds(bidx, 1), :] += gc
+
+
+def _pick_group(B: int) -> int:
+    for g in (_GROUP, 16, 8, 4, 2, 1):
+        if B % g == 0:
+            return g
+    return 1
+
+
+def _level_matvec(
+    level: BucketedLevel, n_rows: int, dim: int, w_pad2: Array, interpret: bool
+) -> Array:
+    B = w_pad2.shape[0]
+    T = level.num_tiles(n_rows)
+    rt = level.tile_rows // 128
+    spv = level.spv
+    G = _pick_group(B)
+    z2 = pl.pallas_call(
+        functools.partial(_matvec_kernel, spv, rt, G),
+        grid=(T, B // G),
+        in_specs=[
+            pl.BlockSpec(
+                (G * spv, 128), lambda t, bg: (t * (B // G) + bg, 0), memory_space=_VMEM
+            ),
+            pl.BlockSpec(
+                (G * spv, 128), lambda t, bg: (t * (B // G) + bg, 0), memory_space=_VMEM
+            ),
+            pl.BlockSpec((B, 128), lambda t, bg: (0, 0), memory_space=_VMEM),
+        ],
+        out_specs=pl.BlockSpec((rt, 128), lambda t, bg: (t, 0), memory_space=_VMEM),
+        out_shape=jax.ShapeDtypeStruct((T * rt, 128), jnp.float32),
+                interpret=interpret,
+    )(level.packed, level.values, w_pad2)
+    return z2.reshape(-1)[: n_rows]
+
+
+def _level_rmatvec(
+    level: BucketedLevel,
+    n_rows: int,
+    B: int,
+    u_pad: Array,
+    square: bool,
+    interpret: bool,
+) -> Array:
+    T = level.num_tiles(n_rows)
+    rt = level.tile_rows // 128
+    spv = level.spv
+    G = _pick_group(B)
+    u2 = jnp.pad(u_pad, (0, T * level.tile_rows - u_pad.shape[0])).reshape(T * rt, 128)
+    g2 = pl.pallas_call(
+        functools.partial(_rmatvec_kernel, spv, rt, G, square),
+        grid=(B // G, T),
+        in_specs=[
+            pl.BlockSpec(
+                (G * spv, 128), lambda bg, t: (t * (B // G) + bg, 0), memory_space=_VMEM
+            ),
+            pl.BlockSpec(
+                (G * spv, 128), lambda bg, t: (t * (B // G) + bg, 0), memory_space=_VMEM
+            ),
+            pl.BlockSpec((rt, 128), lambda bg, t: (t, 0), memory_space=_VMEM),
+        ],
+        out_specs=pl.BlockSpec((B, 128), lambda bg, t: (0, 0), memory_space=_VMEM),
+        out_shape=jax.ShapeDtypeStruct((B, 128), jnp.float32),
+                interpret=interpret,
+    )(level.packed, level.values, u2)
+    return g2.reshape(-1)
+
+
+def should_use(bf: BucketedSparseFeatures) -> bool:
+    """Kernel dispatch gate: TPU backend (or forced interpret for tests),
+    sane segment widths, enough work to amortize."""
+    if not pallas_glm.is_enabled():
+        return False
+    if jax.default_backend() != "tpu" and not pallas_glm.FORCE_INTERPRET:
+        return False
+    if bf.level1.spv > MAX_SPV:
+        return False
+    if bf.level2 is not None and bf.level2.spv > MAX_SPV:
+        return False
+    return True
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def matvec(bf: BucketedSparseFeatures, w: Array, *, interpret: bool = False) -> Array:
+    """z = X @ w over the bucketed layout (kernels + XLA overflow)."""
+    B = bf.num_buckets
+    w_pad2 = jnp.pad(w.astype(jnp.float32), (0, B * BUCKET - bf.dim)).reshape(B, BUCKET)
+    z = _level_matvec(bf.level1, bf.n_rows, bf.dim, w_pad2, interpret)
+    if bf.level2 is not None:
+        z = z + _level_matvec(bf.level2, bf.n_rows, bf.dim, w_pad2, interpret)
+    if bf.overflow_vals.shape[0]:
+        z = z.at[bf.overflow_rows].add(
+            bf.overflow_vals * jnp.take(w_pad2.reshape(-1), bf.overflow_cols)
+        )
+    return z
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "square"))
+def rmatvec(
+    bf: BucketedSparseFeatures,
+    u: Array,
+    *,
+    interpret: bool = False,
+    square: bool = False,
+) -> Array:
+    """g = X^T u (or (X.^2)^T u with square=True, for Hessian diagonals)."""
+    B = bf.num_buckets
+    u_f = u.astype(jnp.float32)
+    g = _level_rmatvec(bf.level1, bf.n_rows, B, u_f, square, interpret)
+    if bf.level2 is not None:
+        g = g + _level_rmatvec(bf.level2, bf.n_rows, B, u_f, square, interpret)
+    g = g[: bf.dim]
+    if bf.overflow_vals.shape[0]:
+        ov = bf.overflow_vals
+        if square:
+            ov = ov * ov
+        g = g.at[bf.overflow_cols].add(ov * jnp.take(u_f, bf.overflow_rows))
+    return g
+
+
+# ------------------------------------------------------------- XLA reference
+
+
+def _level_coo(level: BucketedLevel, B: int):
+    rl = jax.lax.shift_right_logical(level.packed, _ROW_SHIFT)
+    lane = jax.lax.bitwise_and(level.packed, BUCKET - 1)
+    seg = jnp.arange(level.packed.shape[0]) // level.spv
+    bucket = (seg % B)[:, None]
+    tile = (seg // B)[:, None]
+    rows = tile * level.tile_rows + rl
+    cols = bucket * BUCKET + lane
+    return rows, cols
+
+
+def matvec_xla(bf: BucketedSparseFeatures, w: Array) -> Array:
+    """Same contraction via XLA gather/scatter (fallback + test oracle)."""
+    B = bf.num_buckets
+    w_pad = jnp.pad(w.astype(jnp.float32), (0, B * BUCKET - bf.dim))
+    z = jnp.zeros(bf.n_rows, jnp.float32)
+    for level in (bf.level1, bf.level2):
+        if level is None:
+            continue
+        rows, cols = _level_coo(level, B)
+        p = jnp.take(w_pad, cols) * level.values
+        pad_rows = level.num_tiles(bf.n_rows) * level.tile_rows
+        zl = jnp.zeros(pad_rows, jnp.float32).at[rows.reshape(-1)].add(p.reshape(-1))
+        z = z + zl[: bf.n_rows]
+    if bf.overflow_vals.shape[0]:
+        z = z.at[bf.overflow_rows].add(
+            bf.overflow_vals * jnp.take(w_pad, bf.overflow_cols)
+        )
+    return z
+
+
+def to_dense_xla(bf: BucketedSparseFeatures) -> Array:
+    """Densify inside jit (FULL-variance Hessian path; modest dims only)."""
+    B = bf.num_buckets
+    M = jnp.zeros((bf.n_rows, B * BUCKET), jnp.float32)
+    for level in (bf.level1, bf.level2):
+        if level is None:
+            continue
+        rows, cols = _level_coo(level, B)
+        valid = rows < bf.n_rows  # padding entries have value 0 anyway
+        M = M.at[
+            jnp.where(valid, rows, 0).reshape(-1), cols.reshape(-1)
+        ].add(jnp.where(valid, level.values, 0.0).reshape(-1))
+    if bf.overflow_vals.shape[0]:
+        M = M.at[bf.overflow_rows, bf.overflow_cols].add(bf.overflow_vals)
+    return M[:, : bf.dim]
+
+
+def rmatvec_xla(bf: BucketedSparseFeatures, u: Array, *, square: bool = False) -> Array:
+    B = bf.num_buckets
+    g = jnp.zeros(B * BUCKET, jnp.float32)
+    u_f = u.astype(jnp.float32)
+    for level in (bf.level1, bf.level2):
+        if level is None:
+            continue
+        rows, cols = _level_coo(level, B)
+        pad_rows = level.num_tiles(bf.n_rows) * level.tile_rows
+        u_pad = jnp.pad(u_f, (0, pad_rows - bf.n_rows))
+        val = level.values
+        if square:
+            val = val * val
+        a = jnp.take(u_pad, rows) * val
+        g = g.at[cols.reshape(-1)].add(a.reshape(-1))
+    g = g[: bf.dim]
+    if bf.overflow_vals.shape[0]:
+        ov = bf.overflow_vals
+        if square:
+            ov = ov * ov
+        g = g.at[bf.overflow_cols].add(ov * jnp.take(u_f, bf.overflow_rows))
+    return g

@@ -1,0 +1,45 @@
+import sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np, jax, jax.numpy as jnp
+from photon_ml_tpu.data.bucketed import pack_bucketed, BucketedSparseFeatures
+from photon_ml_tpu.ops import pallas_sparse as ps
+
+N, K, D = 1 << 20, 64, 16384
+REPS = 8
+rng = np.random.default_rng(0)
+idx = rng.integers(0, D, size=(N, K)).astype(np.int64)
+val = rng.normal(size=(N, K)).astype(np.float32)
+w_np = (rng.normal(size=D) * 0.1).astype(np.float32)
+rows = np.repeat(np.arange(N, dtype=np.int64), K)
+bf = pack_bucketed(rows, idx.reshape(-1), val.reshape(-1), N, D)
+print("packed", flush=True)
+w = jnp.asarray(w_np)
+empty = bf.overflow_vals[:0]
+bf1 = BucketedSparseFeatures(level1=bf.level1, level2=None,
+    overflow_rows=bf.overflow_rows[:0], overflow_cols=bf.overflow_cols[:0],
+    overflow_vals=empty, n_rows=N, dim=D)
+bf2 = BucketedSparseFeatures(level1=bf.level2, level2=None,
+    overflow_rows=bf.overflow_rows[:0], overflow_cols=bf.overflow_cols[:0],
+    overflow_vals=empty, n_rows=N, dim=D)
+
+def scan_probe(name, b):
+    @jax.jit
+    def f(x):
+        def one(c, i):
+            return c + jnp.sum(ps.matvec(b, x * (1.0 + i * 1e-4))), None
+        tot, _ = jax.lax.scan(one, 0.0, jnp.arange(REPS, dtype=jnp.float32))
+        return tot
+    t0 = time.perf_counter()
+    float(f(w))
+    print(f"{name} scan compile+run: {time.perf_counter()-t0:.1f}s", flush=True)
+    ent = np.random.default_rng()
+    ts = []
+    for r in range(3):
+        t0 = time.perf_counter()
+        float(f(w * (1.0 + float(ent.uniform(1e-4, 1e-2)))))
+        ts.append((time.perf_counter() - t0) / REPS)
+    print(f"{name} scan: {min(ts)*1e3:.1f} ms/eval", flush=True)
+
+scan_probe("L1-only", bf1)
+scan_probe("L2-only", bf2)
+print("done", flush=True)

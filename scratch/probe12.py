@@ -1,0 +1,49 @@
+import sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np, jax, jax.numpy as jnp
+from photon_ml_tpu.data.bucketed import pack_bucketed, BucketedSparseFeatures
+from photon_ml_tpu.ops import pallas_sparse as ps
+
+N, K, D = 1 << 20, 64, 16384
+REPS = 8
+rng = np.random.default_rng(0)
+idx = rng.integers(0, D, size=(N, K)).astype(np.int64)
+val = rng.normal(size=(N, K)).astype(np.float32)
+u_np = rng.normal(size=N).astype(np.float32)
+w_np = (rng.normal(size=D) * 0.1).astype(np.float32)
+rows = np.repeat(np.arange(N, dtype=np.int64), K)
+bf = pack_bucketed(rows, idx.reshape(-1), val.reshape(-1), N, D)
+print("packed", flush=True)
+w = jnp.asarray(w_np); u = jnp.asarray(u_np)
+
+mv_raw = ps.matvec.__wrapped__   # un-jitted
+rmv_raw = ps.rmatvec.__wrapped__
+
+def scan_probe(name, call, vec):
+    @jax.jit
+    def f(x):
+        def one(c, i):
+            return c + jnp.sum(call(x * (1.0 + i * 1e-4))), None
+        tot, _ = jax.lax.scan(one, 0.0, jnp.arange(REPS, dtype=jnp.float32))
+        return tot
+    t0 = time.perf_counter()
+    float(f(vec))
+    print(f"{name} compile+run: {time.perf_counter()-t0:.1f}s", flush=True)
+    ent = np.random.default_rng()
+    ts = []
+    for r in range(3):
+        t0 = time.perf_counter()
+        float(f(vec * (1.0 + float(ent.uniform(1e-4, 1e-2)))))
+        ts.append((time.perf_counter() - t0) / REPS)
+    print(f"{name}: {min(ts)*1e3:.1f} ms/eval  (all {[f'{x*1e3:.1f}' for x in ts]})", flush=True)
+
+scan_probe("matvec  nojit", lambda x: mv_raw(bf, x), w)
+scan_probe("rmatvec nojit", lambda x: rmv_raw(bf, u if False else x), u)
+m = 1.0 + float(np.random.default_rng().uniform(1e-4, 1e-2))
+z_k = np.asarray(ps.matvec(bf, w * m))
+g_k = np.asarray(ps.rmatvec(bf, u * m))
+z_ref = np.einsum("nk,nk->n", w_np[idx].astype(np.float64), val) * m
+g_ref = np.zeros(D); np.add.at(g_ref, idx.reshape(-1), (val.astype(np.float64) * u_np[:, None]).reshape(-1)); g_ref *= m
+print("z rel err:", np.abs(z_k - z_ref).max() / np.abs(z_ref).max(), flush=True)
+print("g rel err:", np.abs(g_k - g_ref).max() / np.abs(g_ref).max(), flush=True)
+print("done", flush=True)

@@ -1,0 +1,189 @@
+"""Round 2 of kernel probes: fixed timing (outer calls vary args), chunked
+MXU one-hot builds, plus a fused value+grad candidate."""
+import functools, time
+import numpy as np
+import jax, jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+N, K, D = 1 << 20, 64, 16384
+HI, LO = D // 128, 128
+TN = 128
+GRID = N // TN
+E = K * TN  # entries per tile = 8192
+CH = 1024   # one-hot chunk (rows of the E axis)
+
+rng = np.random.default_rng(0)
+idx_nk = rng.integers(0, D, size=(N, K)).astype(np.int32)
+val_nk = rng.normal(size=(N, K)).astype(np.float32)
+u_np = rng.normal(size=(N,)).astype(np.float32)
+w_np = (rng.normal(size=(D,)) * 0.1).astype(np.float32)
+
+idxT = jnp.asarray(idx_nk.T.copy())
+valT = jnp.asarray(val_nk.T.copy())
+u = jnp.asarray(u_np)
+w = jnp.asarray(w_np)
+
+z_ref_np = np.einsum("nk,nk->n", w_np[idx_nk].astype(np.float64), val_nk).astype(np.float64)
+g_ref_np = np.zeros(D, np.float64)
+np.add.at(g_ref_np, idx_nk.reshape(-1), (val_nk.astype(np.float64) * u_np[:, None]).reshape(-1))
+
+
+def timeit(name, fn, argmaker, check=None):
+    """argmaker(r) -> args; r=0 compiles, r=1.. timed with different args."""
+    try:
+        out = jax.block_until_ready(fn(*argmaker(0)))
+    except Exception as e:
+        print(f"{name}: FAIL {type(e).__name__}: {str(e)[:250]}")
+        return
+    ts = []
+    for r in (1, 2, 3):
+        a = argmaker(r)
+        t0 = time.perf_counter()
+        out = jax.block_until_ready(fn(*a))
+        ts.append(time.perf_counter() - t0)
+    msg = f"{name}: {min(ts)*1e3:.1f} ms/eval"
+    if check is not None:
+        msg += f"   [{check(out, 1 + ts.index(min(ts)))}]"  # scale of last... use r of min
+    print(msg)
+
+
+def wargs(r):
+    return idxT, valT, w * (1.0 + r * 1e-3)
+
+
+def uargs(r):
+    return idxT, valT, u * (1.0 + r * 1e-3)
+
+
+def chk_z(out, r):
+    got = np.asarray(out, np.float64)
+    want = z_ref_np[:7] * (1.0 + r * 1e-3)
+    return f"err {np.max(np.abs(got - want)):.2e}"
+
+
+def chk_g(out, r):
+    got = np.asarray(out, np.float64).reshape(-1)[:7]
+    want = g_ref_np[:7] * (1.0 + r * 1e-3)
+    return f"err {np.max(np.abs(got - want)):.2e}"
+
+
+# ---------------- F1: select-loop fwd ----------------
+def f1_kernel(idx_ref, val_ref, w2_ref, z_ref):
+    idx = idx_ref[:]
+    hi = jax.lax.shift_right_logical(idx, 7)
+    lo = jax.lax.bitwise_and(idx, 127)
+    acc = jnp.zeros((K, TN), jnp.float32)
+    w2 = w2_ref[:]
+    for j in range(HI):
+        wrow = jax.lax.broadcast_in_dim(w2[j, :], (K, TN), (1,))
+        g = jnp.take_along_axis(wrow, lo, axis=1)
+        acc = acc + jnp.where(hi == j, g, 0.0)
+    z_ref[:] = jnp.sum(acc * val_ref[:], axis=0, keepdims=True)
+
+
+@jax.jit
+def f1(idxT, valT, w):
+    return pl.pallas_call(
+        f1_kernel,
+        grid=(GRID,),
+        in_specs=[
+            pl.BlockSpec((K, TN), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((K, TN), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((HI, LO), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, TN), lambda i: (0, i), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((1, N), jnp.float32),
+    )(idxT, valT, w.reshape(HI, LO))[0, :7]
+
+
+# ---------------- F2c: chunked MXU one-hot fwd ----------------
+def f2_kernel(idx_ref, val_ref, w2_ref, z_ref):
+    idx = idx_ref[:].reshape(E // 128, 128)
+    hi = jax.lax.shift_right_logical(idx, 7)
+    lo = jax.lax.bitwise_and(idx, 127)
+    w2 = w2_ref[:]
+    gs = []
+    for c in range(E // CH):
+        hic = hi[c * (CH // 128):(c + 1) * (CH // 128)].reshape(CH, 1)
+        oh = (jax.lax.broadcasted_iota(jnp.int32, (CH, HI), 1) == hic).astype(jnp.float32)
+        t = jax.lax.dot_general(
+            oh, w2, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (CH, 128)
+        loc = lo[c * (CH // 128):(c + 1) * (CH // 128)].reshape(CH, 1)
+        lob = jax.lax.broadcast_in_dim(loc[:, 0], (CH, 128), (0,))
+        g = jnp.take_along_axis(t, lob, axis=1)[:, :1]  # (CH, 1)
+        gs.append(g)
+    g_all = jnp.concatenate(gs, axis=0).reshape(K, TN)
+    z_ref[:] = jnp.sum(g_all * val_ref[:], axis=0, keepdims=True)
+
+
+@jax.jit
+def f2(idxT, valT, w):
+    return pl.pallas_call(
+        f2_kernel,
+        grid=(GRID,),
+        in_specs=[
+            pl.BlockSpec((K, TN), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((K, TN), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((HI, LO), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, TN), lambda i: (0, i), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((1, N), jnp.float32),
+    )(idxT, valT, w.reshape(HI, LO))[0, :7]
+
+
+# ---------------- B1c: chunked MXU one-hot bwd ----------------
+def b1_kernel(idx_ref, val_ref, u_ref, g_ref):
+    i = pl.program_id(0)
+    idx = idx_ref[:]
+    a = val_ref[:] * jax.lax.broadcast_in_dim(u_ref[0, :], (K, TN), (1,))
+    hi = jax.lax.shift_right_logical(idx, 7).reshape(E // 128, 128)
+    lo = jax.lax.bitwise_and(idx, 127).reshape(E // 128, 128)
+    af = a.reshape(E // 128, 128)
+    contrib = jnp.zeros((HI, LO), jnp.float32)
+    for c in range(E // CH):
+        sl = slice(c * (CH // 128), (c + 1) * (CH // 128))
+        hic = hi[sl].reshape(CH, 1)
+        loc = lo[sl].reshape(CH, 1)
+        ac = af[sl].reshape(CH, 1)
+        A = jnp.where(jax.lax.broadcasted_iota(jnp.int32, (CH, HI), 1) == hic, ac, 0.0)
+        O = (jax.lax.broadcasted_iota(jnp.int32, (CH, LO), 1) == loc).astype(jnp.float32)
+        contrib += jax.lax.dot_general(
+            A, O, dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(i == 0)
+    def _():
+        g_ref[:] = contrib
+
+    @pl.when(i > 0)
+    def _():
+        g_ref[:] += contrib
+
+
+@jax.jit
+def b1(idxT, valT, u):
+    return pl.pallas_call(
+        b1_kernel,
+        grid=(GRID,),
+        in_specs=[
+            pl.BlockSpec((K, TN), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((K, TN), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, TN), lambda i: (0, i), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((HI, LO), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((HI, LO), jnp.float32),
+    )(idxT, valT, u.reshape(1, N))
+
+
+def b1_head(idxT, valT, u):
+    return b1(idxT, valT, u).reshape(-1)[:7]
+
+
+timeit("F1 fwd select-loop  ", f1, wargs, chk_z)
+timeit("F2c fwd MXU chunked ", f2, wargs, chk_z)
+timeit("B1c bwd MXU chunked ", jax.jit(b1_head), uargs, chk_g)
+print("done")

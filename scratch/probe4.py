@@ -1,0 +1,147 @@
+"""Bisect: (a) is F1 really sub-ms (full-output checksum + grid scaling)?
+(b) which construct crashes the Mosaic remote compiler?"""
+import time
+import numpy as np
+import jax, jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+N, K, D = 1 << 20, 64, 16384
+HI, LO = D // 128, 128
+TN = 128
+E = K * TN
+
+rng = np.random.default_rng(0)
+idx_nk = rng.integers(0, D, size=(N, K)).astype(np.int32)
+val_nk = rng.normal(size=(N, K)).astype(np.float32)
+w_np = (rng.normal(size=(D,)) * 0.1).astype(np.float32)
+idxT = jnp.asarray(idx_nk.T.copy())
+valT = jnp.asarray(val_nk.T.copy())
+w = jnp.asarray(w_np)
+z_ref = np.einsum("nk,nk->n", w_np[idx_nk].astype(np.float64), val_nk)
+
+
+def f1_kernel(idx_ref, val_ref, w2_ref, z_ref):
+    idx = idx_ref[:]
+    hi = jax.lax.shift_right_logical(idx, 7)
+    lo = jax.lax.bitwise_and(idx, 127)
+    acc = jnp.zeros((K, TN), jnp.float32)
+    w2 = w2_ref[:]
+    for j in range(HI):
+        wrow = jax.lax.broadcast_in_dim(w2[j, :], (K, TN), (1,))
+        g = jnp.take_along_axis(wrow, lo, axis=1)
+        acc = acc + jnp.where(hi == j, g, 0.0)
+    z_ref[:] = jnp.sum(acc * val_ref[:], axis=0, keepdims=True)
+
+
+def make_f1(n_rows):
+    @jax.jit
+    def f1(idxT, valT, w):
+        z = pl.pallas_call(
+            f1_kernel,
+            grid=(n_rows // TN,),
+            in_specs=[
+                pl.BlockSpec((K, TN), lambda i: (0, i), memory_space=pltpu.VMEM),
+                pl.BlockSpec((K, TN), lambda i: (0, i), memory_space=pltpu.VMEM),
+                pl.BlockSpec((HI, LO), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((1, TN), lambda i: (0, i), memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((1, n_rows), jnp.float32),
+        )(idxT[:, :n_rows], valT[:, :n_rows], w.reshape(HI, LO))
+        return jnp.sum(z), z[0, :5]
+
+    return f1
+
+
+for n_rows in (N // 8, N):
+    f1 = make_f1(n_rows)
+    jax.block_until_ready(f1(idxT, valT, w))
+    ts = []
+    for r in (1, 2, 3):
+        wr = w * (1.0 + r * 1e-3)
+        t0 = time.perf_counter()
+        s, head = jax.block_until_ready(f1(idxT, valT, wr))
+        ts.append(time.perf_counter() - t0)
+    want = z_ref[:n_rows].sum() * (1.0 + 3 * 1e-3)
+    print(
+        f"F1 rows={n_rows}: {min(ts)*1e3:.2f} ms  checksum rel err "
+        f"{abs(float(s) - want)/abs(want):.2e}  head err "
+        f"{np.max(np.abs(np.asarray(head) - z_ref[:5]*(1+3e-3))):.2e}"
+    )
+
+# ---------------- construct bisection ----------------
+def try_kernel(name, kernel, in_specs, out_spec, out_shape, args):
+    try:
+        out = pl.pallas_call(
+            kernel, grid=(4,), in_specs=in_specs, out_specs=out_spec,
+            out_shape=out_shape,
+        )(*args)
+        jax.block_until_ready(out)
+        print(f"{name}: ok")
+    except Exception as e:
+        print(f"{name}: FAIL {type(e).__name__}: {str(e)[:160]}")
+
+
+A8 = jnp.asarray(rng.normal(size=(32, 128)).astype(np.float32))
+I8 = jnp.asarray(rng.integers(0, 128, size=(32, 128)).astype(np.int32))
+
+spec = lambda s: pl.BlockSpec(s, lambda i: (0, 0), memory_space=pltpu.VMEM)
+
+# t1: reshape (8,128)->(1024,1)
+def t1(a_ref, o_ref):
+    r = a_ref[:].reshape(1024, 1)
+    o_ref[:] = jnp.sum(r) + jnp.zeros((1, 1))
+try_kernel("t1 reshape (8,128)->(1024,1)", t1, [spec((8, 128))], spec((1, 1)), jax.ShapeDtypeStruct((1, 1), jnp.float32), (A8[:8],))
+
+# t2: iota (1024,128) cmp col
+def t2(i_ref, o_ref):
+    col = i_ref[:].reshape(1024, 1)
+    oh = (jax.lax.broadcasted_iota(jnp.int32, (1024, 128), 1) == col).astype(jnp.float32)
+    o_ref[:] = jnp.sum(oh) + jnp.zeros((1, 1))
+try_kernel("t2 iota cmp colvec (1024,128)", t2, [spec((8, 128))], spec((1, 1)), jax.ShapeDtypeStruct((1, 1), jnp.float32), (I8[:8],))
+
+# t2b: iota cmp with (S,128)-shaped hi (no reshape to column)
+def t2b(i_ref, o_ref):
+    hi = i_ref[:]
+    oh = (jax.lax.broadcasted_iota(jnp.int32, (32, 128), 1) == hi).astype(jnp.float32)
+    o_ref[:] = jnp.sum(oh) + jnp.zeros((1, 1))
+try_kernel("t2b iota cmp same-shape (32,128)", t2b, [spec((32, 128))], spec((1, 1)), jax.ShapeDtypeStruct((1, 1), jnp.float32), (I8,))
+
+# t3: dot_general contracting dim 0
+def t3(a_ref, b_ref, o_ref):
+    o_ref[:] = jax.lax.dot_general(
+        a_ref[:], b_ref[:], dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+try_kernel("t3 dotT (32,128)x(32,128)", t3, [spec((32, 128)), spec((32, 128))], spec((128, 128)), jax.ShapeDtypeStruct((128, 128), jnp.float32), (A8, A8))
+
+# t4: plain dot (128,32)@(32,128)
+def t4(a_ref, b_ref, o_ref):
+    o_ref[:] = jnp.dot(a_ref[:].T, b_ref[:], preferred_element_type=jnp.float32)
+try_kernel("t4 a.T@b", t4, [spec((32, 128)), spec((32, 128))], spec((128, 128)), jax.ShapeDtypeStruct((128, 128), jnp.float32), (A8, A8))
+
+# t5: take_along_axis with broadcast_in_dim indices
+def t5(a_ref, i_ref, o_ref):
+    lob = jax.lax.broadcast_in_dim(i_ref[:][:, 0], (32, 128), (0,))
+    g = jnp.take_along_axis(a_ref[:], lob, axis=1)
+    o_ref[:] = jnp.sum(g) + jnp.zeros((1, 1))
+try_kernel("t5 take broadcast idx", t5, [spec((32, 128)), spec((32, 128))], spec((1, 1)), jax.ShapeDtypeStruct((1, 1), jnp.float32), (A8, I8))
+
+# t6: dot with one-hot f32 built from iota (the F2/B1 core)
+def t6(i_ref, a_ref, o_ref):
+    oh = (jax.lax.broadcasted_iota(jnp.int32, (32, 128), 1) == i_ref[:]).astype(jnp.float32)
+    o_ref[:] = jax.lax.dot_general(
+        oh, a_ref[:], dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+try_kernel("t6 onehot dotT", t6, [spec((32, 128)), spec((32, 128))], spec((128, 128)), jax.ShapeDtypeStruct((128, 128), jnp.float32), (I8, A8))
+
+# t7: accumulate output across grid with pl.when
+def t7(a_ref, o_ref):
+    i = pl.program_id(0)
+    @pl.when(i == 0)
+    def _():
+        o_ref[:] = a_ref[:]
+    @pl.when(i > 0)
+    def _():
+        o_ref[:] += a_ref[:]
+try_kernel("t7 grid accum", t7, [spec((32, 128))], spec((32, 128)), jax.ShapeDtypeStruct((32, 128), jnp.float32), (A8,))
+print("done")

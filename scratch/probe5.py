@@ -1,0 +1,168 @@
+"""Ablate the fwd kernel to find where the 120ms goes.
+V0 full | V1 constant ORLT (no one-hot build) | V2 no matmul | V3 loop empty
+V4 full but grid batched over 8 buckets per step | V5 only gather+mult, no loop
+"""
+import sys, time, functools
+sys.path.insert(0, "/root/repo")
+import numpy as np
+import jax, jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from photon_ml_tpu.data.bucketed import pack_bucketed
+
+N, K, D = 1 << 20, 64, 16384
+RT = 16
+rng = np.random.default_rng(0)
+idx = rng.integers(0, D, size=(N, K)).astype(np.int64)
+val = rng.normal(size=(N, K)).astype(np.float32)
+rows = np.repeat(np.arange(N, dtype=np.int64), K)
+bf = pack_bucketed(rows, idx.reshape(-1), val.reshape(-1), N, D)
+T, B, spv = bf.num_tiles, bf.num_buckets, bf.spv
+print("T,B,spv:", T, B, spv)
+w_np = (rng.normal(size=D) * 0.1).astype(np.float32)
+w = jnp.asarray(w_np)
+
+PREC = jax.lax.Precision.DEFAULT
+
+def bcast(row, s):
+    return jax.lax.broadcast_in_dim(row[0, :], (s, 128), (1,))
+
+def mk_kernel(variant):
+    def kern(pk_ref, val_ref, w_ref, z_ref):
+        b = pl.program_id(1)
+        pk = pk_ref[:]
+        rl = jax.lax.shift_right_logical(pk, 7)
+        lane = jax.lax.bitwise_and(pk, 127)
+        wb = bcast(w_ref[pl.ds(b, 1), :], spv)
+        p = jnp.take_along_axis(wb, lane, axis=1) * val_ref[:]
+        zc = jnp.zeros((RT, 128), jnp.float32)
+        if variant != "V5":
+            for s in range(spv):
+                rl_row = rl[s : s + 1, :]
+                rhi = jax.lax.shift_right_logical(rl_row, 7)
+                rlo = jax.lax.bitwise_and(rl_row, 127)
+                if variant == "V3":
+                    zc = zc + jnp.float32(1e-9) * bcast(rlo.astype(jnp.float32), RT)
+                    continue
+                orh = jax.lax.broadcasted_iota(jnp.int32, (RT, 128), 0) == bcast(rhi, RT)
+                p1 = jnp.where(orh, bcast(p[s : s + 1, :], RT), 0.0)
+                if variant == "V2":
+                    zc = zc + p1
+                    continue
+                if variant == "V1":
+                    orlt = jnp.broadcast_to(jnp.float32(1.0), (128, 128)) * 0.5
+                else:
+                    orlt = (
+                        jax.lax.broadcasted_iota(jnp.int32, (128, 128), 0) == bcast(rlo, 128)
+                    ).astype(jnp.float32)
+                zc = zc + jax.lax.dot_general(
+                    p1, orlt, dimension_numbers=(((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32, precision=PREC)
+        else:
+            zc = zc + jnp.sum(p) * jnp.ones((RT, 128), jnp.float32) * 1e-9
+        @pl.when(b == 0)
+        def _():
+            z_ref[:] = zc
+        @pl.when(b > 0)
+        def _():
+            z_ref[:] += zc
+    return kern
+
+def run(variant):
+    fn = pl.pallas_call(
+        mk_kernel(variant),
+        grid=(T, B),
+        in_specs=[
+            pl.BlockSpec((spv, 128), lambda t, b: (t * B + b, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((spv, 128), lambda t, b: (t * B + b, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((B, 128), lambda t, b: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((RT, 128), lambda t, b: (t, 0), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((T * RT, 128), jnp.float32),
+    )
+    f = jax.jit(lambda pk, v, w2: jnp.sum(fn(pk, v, w2)))
+    w2 = w.reshape(B, 128)
+    try:
+        float(f(bf.packed, bf.values, w2))
+    except Exception as e:
+        print(f"{variant}: FAIL {str(e)[:150]}")
+        return
+    ent = np.random.default_rng()  # OS entropy: unique args every run
+    ts = []
+    for r in range(3):
+        w2r = w2 * (1.0 + float(ent.uniform(1e-4, 1e-2)))
+        t0 = time.perf_counter()
+        float(f(bf.packed, bf.values, w2r))  # scalar fetch forces sync
+        ts.append(time.perf_counter() - t0)
+    print(f"{variant}: {min(ts)*1e3:.1f} ms  (all {[f'{x*1e3:.1f}' for x in ts]})")
+
+# V4: batch G buckets per grid step
+def run_v4(G):
+    def kern(pk_ref, val_ref, w_ref, z_ref):
+        bg = pl.program_id(1)
+        zc = jnp.zeros((RT, 128), jnp.float32)
+        for gi in range(G):
+            pk = pk_ref[pl.ds(gi * spv, spv), :]
+            vv = val_ref[pl.ds(gi * spv, spv), :]
+            rl = jax.lax.shift_right_logical(pk, 7)
+            lane = jax.lax.bitwise_and(pk, 127)
+            wb = bcast(w_ref[pl.ds(bg * G + gi, 1), :], spv)
+            p = jnp.take_along_axis(wb, lane, axis=1) * vv
+            for s in range(spv):
+                rl_row = rl[s : s + 1, :]
+                rhi = jax.lax.shift_right_logical(rl_row, 7)
+                rlo = jax.lax.bitwise_and(rl_row, 127)
+                orh = jax.lax.broadcasted_iota(jnp.int32, (RT, 128), 0) == bcast(rhi, RT)
+                p1 = jnp.where(orh, bcast(p[s : s + 1, :], RT), 0.0)
+                orlt = (
+                    jax.lax.broadcasted_iota(jnp.int32, (128, 128), 0) == bcast(rlo, 128)
+                ).astype(jnp.float32)
+                zc = zc + jax.lax.dot_general(
+                    p1, orlt, dimension_numbers=(((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32, precision=PREC)
+        @pl.when(bg == 0)
+        def _():
+            z_ref[:] = zc
+        @pl.when(bg > 0)
+        def _():
+            z_ref[:] += zc
+
+    fn = pl.pallas_call(
+        kern,
+        grid=(T, B // G),
+        in_specs=[
+            pl.BlockSpec((G * spv, 128), lambda t, bg: (t * (B // G) + bg, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((G * spv, 128), lambda t, bg: (t * (B // G) + bg, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((B, 128), lambda t, bg: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((RT, 128), lambda t, bg: (t, 0), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((T * RT, 128), jnp.float32),
+    )
+    f = jax.jit(lambda pk, v, w2: fn(pk, v, w2))
+    fsum = jax.jit(lambda pk, v, w2: jnp.sum(fn(pk, v, w2)))
+    w2 = w.reshape(B, 128)
+    try:
+        out = jax.block_until_ready(f(bf.packed, bf.values, w2))
+        float(fsum(bf.packed, bf.values, w2))
+    except Exception as e:
+        print(f"V4 G={G}: FAIL {str(e)[:200]}")
+        return
+    ent = np.random.default_rng()
+    ts = []
+    for r in range(3):
+        m = 1.0 + float(ent.uniform(1e-4, 1e-2))
+        w2r = w2 * m
+        t0 = time.perf_counter()
+        float(fsum(bf.packed, bf.values, w2r))
+        ts.append(time.perf_counter() - t0)
+    out = f(bf.packed, bf.values, w2 * m)
+    z_ref = np.einsum("nk,nk->n", w_np[idx].astype(np.float64), val) * m
+    got = np.asarray(out).reshape(-1)[: N]
+    print(f"V4 G={G}: {min(ts)*1e3:.1f} ms  (all {[f'{x*1e3:.1f}' for x in ts]})  err {np.abs(got - z_ref).max()/np.abs(z_ref).max():.1e}")
+
+for v in ("V5", "V3", "V2", "V1", "V0"):
+    run(v)
+run_v4(8)
+run_v4(16)
+print("done")

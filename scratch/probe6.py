@@ -1,0 +1,153 @@
+"""RTT-amortized timing: scan 8 perturbed kernel evals inside one jit.
+Variants: V0 full G=1, V5 no-scatter G=1, V4 G=8 full, V4 G=8 no-scatter,
+plus rmatvec G=8. Also measures bare RTT."""
+import sys, time, functools
+sys.path.insert(0, "/root/repo")
+import numpy as np
+import jax, jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from photon_ml_tpu.data.bucketed import pack_bucketed
+
+N, K, D = 1 << 20, 64, 16384
+RT = 16
+REPS = 8
+rng = np.random.default_rng(0)
+idx = rng.integers(0, D, size=(N, K)).astype(np.int64)
+val = rng.normal(size=(N, K)).astype(np.float32)
+rows = np.repeat(np.arange(N, dtype=np.int64), K)
+bf = pack_bucketed(rows, idx.reshape(-1), val.reshape(-1), N, D)
+T, B, spv = bf.num_tiles, bf.num_buckets, bf.spv
+w = jnp.asarray((rng.normal(size=D) * 0.1).astype(np.float32))
+u = jnp.asarray(rng.normal(size=N).astype(np.float32))
+PREC = jax.lax.Precision.DEFAULT
+
+# bare RTT
+fid = jax.jit(lambda x: x + 1.0)
+float(fid(1.0))
+t0 = time.perf_counter(); [float(fid(float(i))) for i in range(5)]
+print(f"RTT per tiny call: {(time.perf_counter()-t0)/5*1e3:.1f} ms")
+
+def bcast(row, s):
+    return jax.lax.broadcast_in_dim(row[0, :], (s, 128), (1,))
+
+def fwd_call(G, scatter):
+    def kern(pk_ref, val_ref, w_ref, z_ref):
+        bg = pl.program_id(1)
+        zc = jnp.zeros((RT, 128), jnp.float32)
+        for gi in range(G):
+            pk = pk_ref[pl.ds(gi * spv, spv), :] if G > 1 else pk_ref[:]
+            vv = val_ref[pl.ds(gi * spv, spv), :] if G > 1 else val_ref[:]
+            rl = jax.lax.shift_right_logical(pk, 7)
+            lane = jax.lax.bitwise_and(pk, 127)
+            wb = bcast(w_ref[pl.ds(bg * G + gi, 1), :], spv)
+            p = jnp.take_along_axis(wb, lane, axis=1) * vv
+            if not scatter:
+                zc = zc + jnp.sum(p) * jnp.float32(1e-9)
+                continue
+            for s in range(spv):
+                rl_row = rl[s : s + 1, :]
+                rhi = jax.lax.shift_right_logical(rl_row, 7)
+                rlo = jax.lax.bitwise_and(rl_row, 127)
+                orh = jax.lax.broadcasted_iota(jnp.int32, (RT, 128), 0) == bcast(rhi, RT)
+                p1 = jnp.where(orh, bcast(p[s : s + 1, :], RT), 0.0)
+                orlt = (
+                    jax.lax.broadcasted_iota(jnp.int32, (128, 128), 0) == bcast(rlo, 128)
+                ).astype(jnp.float32)
+                zc = zc + jax.lax.dot_general(
+                    p1, orlt, dimension_numbers=(((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32, precision=PREC)
+        @pl.when(bg == 0)
+        def _():
+            z_ref[:] = zc
+        @pl.when(bg > 0)
+        def _():
+            z_ref[:] += zc
+
+    return pl.pallas_call(
+        kern,
+        grid=(T, B // G),
+        in_specs=[
+            pl.BlockSpec((G * spv, 128), lambda t, bg: (t * (B // G) + bg, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((G * spv, 128), lambda t, bg: (t * (B // G) + bg, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((B, 128), lambda t, bg: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((RT, 128), lambda t, bg: (t, 0), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((T * RT, 128), jnp.float32),
+    )
+
+def bwd_call(G):
+    def kern(pk_ref, val_ref, u_ref, g_ref):
+        bg = pl.program_id(0)
+        t = pl.program_id(1)
+        u2 = u_ref[:]
+        for gi in range(G):
+            pk = pk_ref[pl.ds(gi * spv, spv), :] if G > 1 else pk_ref[:]
+            vv = val_ref[pl.ds(gi * spv, spv), :] if G > 1 else val_ref[:]
+            rl = jax.lax.shift_right_logical(pk, 7)
+            lane = jax.lax.bitwise_and(pk, 127)
+            gc = jnp.zeros((1, 128), jnp.float32)
+            for s in range(spv):
+                rl_row = rl[s : s + 1, :]
+                rhi = jax.lax.shift_right_logical(rl_row, 7)
+                rlo = jax.lax.bitwise_and(rl_row, 127)
+                tu = jnp.take_along_axis(u2, bcast(rlo, RT), axis=1)
+                orh = jax.lax.broadcasted_iota(jnp.int32, (RT, 128), 0) == bcast(rhi, RT)
+                u_sel = jnp.sum(jnp.where(orh, tu, 0.0), axis=0, keepdims=True)
+                a = u_sel * vv[s : s + 1, :]
+                olt = (
+                    jax.lax.broadcasted_iota(jnp.int32, (128, 128), 0) == bcast(rlo * 0 + jax.lax.bitwise_and(pk[s:s+1,:], 127), 128)
+                ).astype(jnp.float32)
+                gc = gc + jax.lax.dot_general(
+                    a, olt, dimension_numbers=(((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32, precision=PREC)
+            bidx = bg * G + gi
+            @pl.when(t == 0)
+            def _():
+                g_ref[pl.ds(bidx, 1), :] = gc
+            @pl.when(t > 0)
+            def _():
+                g_ref[pl.ds(bidx, 1), :] += gc
+
+    return pl.pallas_call(
+        kern,
+        grid=(B // G, T),
+        in_specs=[
+            pl.BlockSpec((G * spv, 128), lambda bg, t: (t * (B // G) + bg, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((G * spv, 128), lambda bg, t: (t * (B // G) + bg, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((RT, 128), lambda bg, t: (t, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((B, 128), lambda bg, t: (0, 0), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((B, 128), jnp.float32),
+    )
+
+def scan_time(name, call, vec, transform):
+    """call(pk, val, x) -> array; scan REPS with x perturbed per rep."""
+    @jax.jit
+    def f(pk, v, x):
+        def one(c, i):
+            return c + jnp.sum(call(pk, v, transform(x * (1.0 + i * 1e-4)))), None
+        tot, _ = jax.lax.scan(one, 0.0, jnp.arange(REPS, dtype=jnp.float32))
+        return tot
+    try:
+        float(f(bf.packed, bf.values, vec))
+    except Exception as e:
+        print(f"{name}: FAIL {str(e)[:200]}")
+        return
+    ent = np.random.default_rng()
+    ts = []
+    for r in range(3):
+        xr = vec * (1.0 + float(ent.uniform(1e-4, 1e-2)))
+        t0 = time.perf_counter()
+        float(f(bf.packed, bf.values, xr))
+        ts.append((time.perf_counter() - t0) / REPS)
+    print(f"{name}: {min(ts)*1e3:.1f} ms/eval  (all {[f'{x*1e3:.1f}' for x in ts]})")
+
+scan_time("fwd G=1 full      ", lambda pk, v, w2: fwd_call(1, True)(pk, v, w2), w, lambda x: x.reshape(B, 128))
+scan_time("fwd G=1 no-scatter", lambda pk, v, w2: fwd_call(1, False)(pk, v, w2), w, lambda x: x.reshape(B, 128))
+scan_time("fwd G=8 full      ", lambda pk, v, w2: fwd_call(8, True)(pk, v, w2), w, lambda x: x.reshape(B, 128))
+scan_time("fwd G=8 no-scatter", lambda pk, v, w2: fwd_call(8, False)(pk, v, w2), w, lambda x: x.reshape(B, 128))
+scan_time("fwd G=32 full     ", lambda pk, v, w2: fwd_call(32, True)(pk, v, w2), w, lambda x: x.reshape(B, 128))
+scan_time("bwd G=8 full      ", lambda pk, v, u2: bwd_call(8)(pk, v, u2), u, lambda x: jnp.pad(x, (0, T * 2048 - N)).reshape(T * RT, 128))
+print("done")

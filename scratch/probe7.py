@@ -1,0 +1,218 @@
+"""Optimization round: RT=8 tiles, sublane-gather u-select, identity-gather
+one-hot, dimension_semantics, hi/lo precision. All scan-timed (RTT-amortized).
+Also correctness-checked against f64."""
+import sys, time, functools
+sys.path.insert(0, "/root/repo")
+import numpy as np
+import jax, jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+N, K, D = 1 << 20, 64, 16384
+REPS = 8
+rng = np.random.default_rng(0)
+idx = rng.integers(0, D, size=(N, K)).astype(np.int64)
+val = rng.normal(size=(N, K)).astype(np.float32)
+u_np = rng.normal(size=N).astype(np.float32)
+w_np = (rng.normal(size=D) * 0.1).astype(np.float32)
+
+# --- quick capability check: sublane gather with S=16 ---
+def cap_kernel(a_ref, i_ref, o_ref):
+    o_ref[:] = jnp.take_along_axis(a_ref[:], i_ref[:], axis=0)
+try:
+    a16 = jnp.asarray(rng.normal(size=(16, 128)).astype(np.float32))
+    i16 = jnp.asarray(rng.integers(0, 16, size=(16, 128)).astype(np.int32))
+    out = pl.pallas_call(
+        cap_kernel,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 2,
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((16, 128), jnp.float32),
+    )(a16, i16)
+    ref = np.take_along_axis(np.asarray(a16), np.asarray(i16), axis=0)
+    print("sublane gather S=16: ok, err", np.abs(np.asarray(out) - ref).max())
+except Exception as e:
+    print("sublane gather S=16: FAIL", str(e)[:120])
+
+# --- pack with parametrizable tile rows ---
+def pack(tile_rows):
+    B = D // 128
+    tile = (np.repeat(np.arange(N, dtype=np.int64), K)) // tile_rows
+    rl = (np.repeat(np.arange(N, dtype=np.int64), K)) % tile_rows
+    bucket = idx.reshape(-1) // 128
+    lane = idx.reshape(-1) % 128
+    T = -(-N // tile_rows)
+    seg = tile * B + bucket
+    n_seg = T * B
+    counts = np.bincount(seg, minlength=n_seg)
+    order = np.argsort(seg, kind="stable")
+    seg_s = seg[order]
+    starts = np.zeros(n_seg + 1, np.int64); np.cumsum(counts, out=starts[1:])
+    pos = np.arange(N * K, dtype=np.int64) - starts[seg_s]
+    sp = -(-int(counts.max()) // 1024) * 1024
+    spv = sp // 128
+    packed = np.zeros((n_seg, sp), np.int32)
+    values = np.zeros((n_seg, sp), np.float32)
+    packed[seg_s, pos] = (rl[order].astype(np.int32) << 7) | lane[order].astype(np.int32)
+    values[seg_s, pos] = val.reshape(-1)[order]
+    return (jnp.asarray(packed.reshape(n_seg * spv, 128)),
+            jnp.asarray(values.reshape(n_seg * spv, 128)), T, B, spv)
+
+def bcast(row, s):
+    return jax.lax.broadcast_in_dim(row[0, :], (s, 128), (1,))
+
+def fwd(pkd, G, RT, spv, T, B, prec, ident_onehot=False, semantics=None):
+    tile_rows = RT * 128
+    def kern(pk_ref, val_ref, w_ref, z_ref):
+        bg = pl.program_id(1)
+        zc = jnp.zeros((RT, 128), jnp.float32)
+        for gi in range(G):
+            pk = pk_ref[pl.ds(gi * spv, spv), :]
+            vv = val_ref[pl.ds(gi * spv, spv), :]
+            rl = jax.lax.shift_right_logical(pk, 7)
+            lane = jax.lax.bitwise_and(pk, 127)
+            wb = bcast(w_ref[pl.ds(bg * G + gi, 1), :], spv)
+            p = jnp.take_along_axis(wb, lane, axis=1) * vv
+            for s in range(spv):
+                rl_row = rl[s : s + 1, :]
+                rhi = jax.lax.shift_right_logical(rl_row, 7)
+                rlo = jax.lax.bitwise_and(rl_row, 127)
+                orh = jax.lax.broadcasted_iota(jnp.int32, (RT, 128), 0) == bcast(rhi, RT)
+                p1 = jnp.where(orh, bcast(p[s : s + 1, :], RT), 0.0)
+                if ident_onehot:
+                    eye = jax.lax.broadcasted_iota(jnp.int32, (128, 128), 0) == jax.lax.broadcasted_iota(jnp.int32, (128, 128), 1)
+                    orlt = jnp.take_along_axis(eye.astype(jnp.float32), bcast(rlo, 128), axis=1)
+                else:
+                    orlt = (
+                        jax.lax.broadcasted_iota(jnp.int32, (128, 128), 0) == bcast(rlo, 128)
+                    ).astype(jnp.float32)
+                if prec == "hilo":
+                    p_hi = (p1.astype(jnp.bfloat16)).astype(jnp.float32)
+                    p_lo = p1 - p_hi
+                    zc = zc + jax.lax.dot_general(p_hi, orlt, dimension_numbers=(((1,), (1,)), ((), ())), preferred_element_type=jnp.float32, precision=jax.lax.Precision.DEFAULT)
+                    zc = zc + jax.lax.dot_general(p_lo, orlt, dimension_numbers=(((1,), (1,)), ((), ())), preferred_element_type=jnp.float32, precision=jax.lax.Precision.DEFAULT)
+                else:
+                    zc = zc + jax.lax.dot_general(p1, orlt, dimension_numbers=(((1,), (1,)), ((), ())), preferred_element_type=jnp.float32, precision=prec)
+        @pl.when(bg == 0)
+        def _():
+            z_ref[:] = zc
+        @pl.when(bg > 0)
+        def _():
+            z_ref[:] += zc
+
+    params = {}
+    if semantics:
+        params["compiler_params"] = pltpu.CompilerParams(dimension_semantics=semantics)
+    return pl.pallas_call(
+        kern,
+        grid=(T, B // G),
+        in_specs=[
+            pl.BlockSpec((G * spv, 128), lambda t, bg: (t * (B // G) + bg, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((G * spv, 128), lambda t, bg: (t * (B // G) + bg, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((B, 128), lambda t, bg: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((RT, 128), lambda t, bg: (t, 0), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((T * RT, 128), jnp.float32),
+        **params,
+    )
+
+def bwd(pkd, G, RT, spv, T, B, prec, sub_gather=False, semantics=None):
+    def kern(pk_ref, val_ref, u_ref, g_ref):
+        bg = pl.program_id(0)
+        t = pl.program_id(1)
+        u2 = u_ref[:]
+        for gi in range(G):
+            pk = pk_ref[pl.ds(gi * spv, spv), :]
+            vv = val_ref[pl.ds(gi * spv, spv), :]
+            rl = jax.lax.shift_right_logical(pk, 7)
+            lane = jax.lax.bitwise_and(pk, 127)
+            gc = jnp.zeros((1, 128), jnp.float32)
+            for s in range(spv):
+                rl_row = rl[s : s + 1, :]
+                rhi = jax.lax.shift_right_logical(rl_row, 7)
+                rlo = jax.lax.bitwise_and(rl_row, 127)
+                tu = jnp.take_along_axis(u2, bcast(rlo, RT), axis=1)
+                if sub_gather:
+                    u_sel = jnp.take_along_axis(tu, bcast(rhi, RT), axis=0)[0:1, :]
+                else:
+                    orh = jax.lax.broadcasted_iota(jnp.int32, (RT, 128), 0) == bcast(rhi, RT)
+                    u_sel = jnp.sum(jnp.where(orh, tu, 0.0), axis=0, keepdims=True)
+                a = u_sel * vv[s : s + 1, :]
+                olt = (
+                    jax.lax.broadcasted_iota(jnp.int32, (128, 128), 0) == bcast(lane[s : s + 1, :], 128)
+                ).astype(jnp.float32)
+                if prec == "hilo":
+                    a_hi = (a.astype(jnp.bfloat16)).astype(jnp.float32)
+                    a_lo = a - a_hi
+                    gc = gc + jax.lax.dot_general(a_hi, olt, dimension_numbers=(((1,), (1,)), ((), ())), preferred_element_type=jnp.float32, precision=jax.lax.Precision.DEFAULT)
+                    gc = gc + jax.lax.dot_general(a_lo, olt, dimension_numbers=(((1,), (1,)), ((), ())), preferred_element_type=jnp.float32, precision=jax.lax.Precision.DEFAULT)
+                else:
+                    gc = gc + jax.lax.dot_general(a, olt, dimension_numbers=(((1,), (1,)), ((), ())), preferred_element_type=jnp.float32, precision=prec)
+            bidx = bg * G + gi
+            @pl.when(t == 0)
+            def _():
+                g_ref[pl.ds(bidx, 1), :] = gc
+            @pl.when(t > 0)
+            def _():
+                g_ref[pl.ds(bidx, 1), :] += gc
+
+    params = {}
+    if semantics:
+        params["compiler_params"] = pltpu.CompilerParams(dimension_semantics=semantics)
+    return pl.pallas_call(
+        kern,
+        grid=(B // G, T),
+        in_specs=[
+            pl.BlockSpec((G * spv, 128), lambda bg, t: (t * (B // G) + bg, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((G * spv, 128), lambda bg, t: (t * (B // G) + bg, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((RT, 128), lambda bg, t: (t, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((B, 128), lambda bg, t: (0, 0), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((B, 128), jnp.float32),
+        **params,
+    )
+
+z_ref64 = np.einsum("nk,nk->n", w_np[idx].astype(np.float64), val)
+g_ref64 = np.zeros(D); np.add.at(g_ref64, idx.reshape(-1), (val.astype(np.float64) * u_np[:, None]).reshape(-1))
+
+def scan_time(name, call, vec, transform, check=None):
+    @jax.jit
+    def f(pk, v, x):
+        def one(c, i):
+            return c + jnp.sum(call(pk, v, transform(x * (1.0 + i * 1e-4)))), None
+        tot, _ = jax.lax.scan(one, 0.0, jnp.arange(REPS, dtype=jnp.float32))
+        return tot
+    try:
+        float(f(PK, VV, vec))
+    except Exception as e:
+        print(f"{name}: FAIL {str(e)[:170]}")
+        return
+    ent = np.random.default_rng()
+    ts = []
+    for r in range(3):
+        xr = vec * (1.0 + float(ent.uniform(1e-4, 1e-2)))
+        t0 = time.perf_counter()
+        float(f(PK, VV, xr))
+        ts.append((time.perf_counter() - t0) / REPS)
+    extra = ""
+    if check is not None:
+        m = 1.0 + float(ent.uniform(1e-4, 1e-2))
+        out = np.asarray(jax.jit(lambda pk, v, x: call(pk, v, transform(x)))(PK, VV, vec * m))
+        extra = "  " + check(out, m)
+    print(f"{name}: {min(ts)*1e3:.1f} ms/eval  (all {[f'{x*1e3:.1f}' for x in ts]}){extra}")
+
+for RT in (8, 16):
+    PK, VV, T, B, spv = pack(RT * 128)
+    w = jnp.asarray(w_np); u = jnp.asarray(u_np)
+    wt = lambda x: x.reshape(B, 128)
+    ut = lambda x: jnp.pad(x, (0, T * RT * 128 - N)).reshape(T * RT, 128)
+    zchk = lambda out, m: f"err {np.abs(out.reshape(-1)[:N] - z_ref64*m).max()/np.abs(z_ref64).max():.1e}"
+    gchk = lambda out, m: f"err {np.abs(out.reshape(-1)[:D] - g_ref64*m).max()/np.abs(g_ref64).max():.1e}"
+    print(f"--- RT={RT} spv={spv} T={T}")
+    scan_time(f"fwd RT={RT} G=32 default", lambda pk, v, w2: fwd(None, 32, RT, spv, T, B, jax.lax.Precision.DEFAULT)(pk, v, w2), w, wt, zchk)
+    scan_time(f"fwd RT={RT} G=32 hilo   ", lambda pk, v, w2: fwd(None, 32, RT, spv, T, B, "hilo")(pk, v, w2), w, wt, zchk)
+    scan_time(f"fwd RT={RT} G=32 highest", lambda pk, v, w2: fwd(None, 32, RT, spv, T, B, jax.lax.Precision.HIGHEST)(pk, v, w2), w, wt, zchk)
+    scan_time(f"fwd RT={RT} G=32 dflt sem", lambda pk, v, w2: fwd(None, 32, RT, spv, T, B, jax.lax.Precision.DEFAULT, semantics=("parallel", "arbitrary"))(pk, v, w2), w, wt, zchk)
+    scan_time(f"bwd RT={RT} G=32 default", lambda pk, v, u2: bwd(None, 32, RT, spv, T, B, jax.lax.Precision.DEFAULT)(pk, v, u2), u, ut, gchk)
+    scan_time(f"bwd RT={RT} G=32 subg   ", lambda pk, v, u2: bwd(None, 32, RT, spv, T, B, jax.lax.Precision.DEFAULT, sub_gather=True)(pk, v, u2), u, ut, gchk)
+    scan_time(f"bwd RT={RT} G=32 subg hilo", lambda pk, v, u2: bwd(None, 32, RT, spv, T, B, "hilo", sub_gather=True)(pk, v, u2), u, ut, gchk)
+print("done")

@@ -1,0 +1,32 @@
+import sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np, jax, jax.numpy as jnp
+from photon_ml_tpu.data.bucketed import pack_bucketed, BucketedSparseFeatures
+from photon_ml_tpu.ops import pallas_sparse as ps
+
+N, K, D = 1 << 20, 64, 16384
+rng = np.random.default_rng(0)
+idx = rng.integers(0, D, size=(N, K)).astype(np.int64)
+val = rng.normal(size=(N, K)).astype(np.float32)
+w_np = (rng.normal(size=D) * 0.1).astype(np.float32)
+t0 = time.perf_counter()
+rows = np.repeat(np.arange(N, dtype=np.int64), K)
+bf = pack_bucketed(rows, idx.reshape(-1), val.reshape(-1), N, D)
+print(f"pack: {time.perf_counter()-t0:.1f}s  {bf.density_report()}", flush=True)
+w = jnp.asarray(w_np)
+
+empty = bf.overflow_vals[:0]
+bf1 = BucketedSparseFeatures(level1=bf.level1, level2=None,
+    overflow_rows=bf.overflow_rows[:0], overflow_cols=bf.overflow_cols[:0],
+    overflow_vals=empty, n_rows=N, dim=D)
+t0 = time.perf_counter()
+z = float(jnp.sum(ps.matvec(bf1, w)))
+print(f"L1 matvec compile+run: {time.perf_counter()-t0:.1f}s", flush=True)
+
+bf2 = BucketedSparseFeatures(level1=bf.level2, level2=None,
+    overflow_rows=bf.overflow_rows[:0], overflow_cols=bf.overflow_cols[:0],
+    overflow_vals=empty, n_rows=N, dim=D)
+t0 = time.perf_counter()
+z2 = float(jnp.sum(ps.matvec(bf2, w)))
+print(f"L2 matvec compile+run: {time.perf_counter()-t0:.1f}s", flush=True)
+print("done", flush=True)

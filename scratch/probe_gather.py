@@ -1,0 +1,134 @@
+"""Measure tpu.dynamic_gather throughput at various table widths, plus honest
+XLA scatter/gather baselines (perturbed inputs inside one jit defeat the axon
+execution cache)."""
+import functools, time
+import numpy as np
+import jax, jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+REPS = 16
+
+def bench(name, build):
+    try:
+        fn, args = build()
+        out = jax.block_until_ready(fn(*args))  # compile
+        t0 = time.perf_counter()
+        out = jax.block_until_ready(fn(*args))
+        dt = (time.perf_counter() - t0) / REPS
+        print(f"{name}: {dt*1e3:.2f} ms/rep")
+        return dt
+    except Exception as e:
+        print(f"{name}: FAIL {type(e).__name__}: {str(e)[:200]}")
+        return None
+
+# ---------------- dynamic_gather lane (axis=1) at width L ----------------
+def lane_gather_probe(S, L, n_entries):
+    """Gather n_entries total from an L-wide table; entries processed in
+    (S, L)-shaped calls => grid = n_entries // (S*L)."""
+    rng = np.random.default_rng(0)
+    G = n_entries // (S * L)
+    idx = jnp.asarray(rng.integers(0, L, size=(G * S, L)).astype(np.int32))
+    tab = jnp.asarray(rng.normal(size=(S, L)).astype(np.float32))
+
+    def kernel(idx_ref, tab_ref, out_ref):
+        g = jnp.take_along_axis(tab_ref[:], idx_ref[:], axis=1)
+        out_ref[0, 0] = jnp.sum(g)
+
+    def call(idx, tab):
+        return pl.pallas_call(
+            kernel,
+            grid=(G,),
+            in_specs=[
+                pl.BlockSpec((S, L), lambda i: (i, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((S, L), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+            out_shape=jax.ShapeDtypeStruct((1, 1), jnp.float32),
+        )(idx, tab)
+
+    @jax.jit
+    def fn(idx, tab):
+        def one(c, i):
+            return c + call(idx, tab * (1.0 + i * 1e-6))[0, 0], None
+        tot, _ = jax.lax.scan(one, 0.0, jnp.arange(REPS, dtype=jnp.float32))
+        return tot
+
+    return fn, (idx, tab)
+
+# ---------------- axis=0 (sublane) gather, table height S ----------------
+def sub_gather_probe(S, L, n_entries):
+    rng = np.random.default_rng(0)
+    G = n_entries // (S * L)
+    idx = jnp.asarray(rng.integers(0, S, size=(G * S, L)).astype(np.int32))
+    tab = jnp.asarray(rng.normal(size=(S, L)).astype(np.float32))
+
+    def kernel(idx_ref, tab_ref, out_ref):
+        g = jnp.take_along_axis(tab_ref[:], idx_ref[:], axis=0)
+        out_ref[0, 0] = jnp.sum(g)
+
+    def call(idx, tab):
+        return pl.pallas_call(
+            kernel,
+            grid=(G,),
+            in_specs=[
+                pl.BlockSpec((S, L), lambda i: (i, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((S, L), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+            out_shape=jax.ShapeDtypeStruct((1, 1), jnp.float32),
+        )(idx, tab)
+
+    @jax.jit
+    def fn(idx, tab):
+        def one(c, i):
+            return c + call(idx, tab * (1.0 + i * 1e-6))[0, 0], None
+        tot, _ = jax.lax.scan(one, 0.0, jnp.arange(REPS, dtype=jnp.float32))
+        return tot
+
+    return fn, (idx, tab)
+
+E = 1 << 23  # 8.4M entries per rep
+for S, L in [(8, 128), (8, 2048), (8, 16384), (256, 128), (1024, 128), (8, 65536)]:
+    dt = bench(f"lane-gather S={S} L={L}", lambda S=S, L=L: lane_gather_probe(S, L, E))
+    if dt:
+        print(f"   -> {dt / E * 1e9:.3f} ns/entry, {E/dt/1e9:.1f} G entries/s")
+
+for S, L in [(8, 128), (64, 128), (2048, 128), (16384, 128)]:
+    dt = bench(f"sub-gather  S={S} L={L}", lambda S=S, L=L: sub_gather_probe(S, L, E))
+    if dt:
+        print(f"   -> {dt / E * 1e9:.3f} ns/entry, {E/dt/1e9:.1f} G entries/s")
+
+# ---------------- honest XLA baselines (N=1M, K=64, D=16384) -------------
+N, K, D = 1 << 20, 64, 16384
+rng = np.random.default_rng(0)
+idx = jnp.asarray(rng.integers(0, D, size=(N, K)).astype(np.int32))
+val = jnp.asarray(rng.normal(size=(N, K)).astype(np.float32))
+u = jnp.asarray(rng.normal(size=(N,)).astype(np.float32))
+w = jnp.asarray(rng.normal(size=(D,)).astype(np.float32))
+
+@jax.jit
+def xla_fwd(idx, val, w):
+    def one(c, i):
+        z = jnp.einsum("nk,nk->n", jnp.take(w * (1.0 + i * 1e-6), idx, axis=-1), val)
+        return c + jnp.sum(z), None
+    tot, _ = jax.lax.scan(one, 0.0, jnp.arange(REPS, dtype=jnp.float32))
+    return tot
+
+@jax.jit
+def xla_bwd(idx, val, u):
+    def one(c, i):
+        fv = (val * (u * (1.0 + i * 1e-6))[:, None]).reshape(-1)
+        g = jnp.zeros((D,), jnp.float32).at[idx.reshape(-1)].add(fv)
+        return c + jnp.sum(g), None
+    tot, _ = jax.lax.scan(one, 0.0, jnp.arange(REPS, dtype=jnp.float32))
+    return tot
+
+for name, fn, args in [("XLA fwd gather-matvec", xla_fwd, (idx, val, w)),
+                       ("XLA bwd scatter-add", xla_bwd, (idx, val, u))]:
+    out = jax.block_until_ready(fn(*args))
+    t0 = time.perf_counter()
+    jax.block_until_ready(fn(*args))
+    dt = (time.perf_counter() - t0) / REPS
+    print(f"{name}: {dt*1e3:.1f} ms/eval ({N*K/dt/1e9:.2f} G entries/s)")
+print("done")

@@ -1,0 +1,232 @@
+"""Measure candidate sparse-ELL kernel formulations end-to-end on v5e.
+
+Layout: transposed ELL (K, N) so ELL rows are lanes. Per grid step, a
+(K=64, TN=128) tile = 8192 entries; row-locality is the lane index (static).
+w lives in VMEM as (128, 128) [d = hi*128 + lo].
+
+  F1 fwd: 128-iter masked lane-gather loop (VPU, f32 exact)
+  F2 fwd: one-hot(hi) @ w2 MXU + lane-gather of the result row
+  B1 bwd: grad[j,l] = A^T @ O with A = a*onehot(hi), O = onehot(lo)  (MXU)
+  FUSED: F2-style fwd + B1 bwd sharing the tile loads
+
+Timing: one jit per variant, lax.scan over REPS perturbing w/u, so the axon
+execution cache cannot serve repeats. Numerics checked vs numpy on the first
+rep's parameters.
+"""
+import functools, time
+import numpy as np
+import jax, jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+REPS = 8
+N, K, D = 1 << 20, 64, 16384
+HI, LO = D // 128, 128
+TN = 128  # ELL rows per tile (lanes)
+GRID = N // TN
+
+rng = np.random.default_rng(0)
+idx_nk = rng.integers(0, D, size=(N, K)).astype(np.int32)
+val_nk = rng.normal(size=(N, K)).astype(np.float32)
+u_np = rng.normal(size=(N,)).astype(np.float32)
+w_np = (rng.normal(size=(D,)) * 0.1).astype(np.float32)
+
+# transposed ELL: (K, N)
+idxT = jnp.asarray(idx_nk.T.copy())
+valT = jnp.asarray(val_nk.T.copy())
+u = jnp.asarray(u_np)
+w = jnp.asarray(w_np)
+
+z_ref_np = np.einsum("nk,nk->n", w_np[idx_nk], val_nk)
+g_ref_np = np.zeros(D, np.float32)
+np.add.at(g_ref_np, idx_nk.reshape(-1), (val_nk * u_np[:, None]).reshape(-1))
+
+
+def timeit(name, fn, args, check=None):
+    try:
+        out = jax.block_until_ready(fn(*args))
+    except Exception as e:
+        print(f"{name}: FAIL {type(e).__name__}: {str(e)[:250]}")
+        return
+    t0 = time.perf_counter()
+    out = jax.block_until_ready(fn(*args))
+    dt = (time.perf_counter() - t0) / REPS
+    msg = f"{name}: {dt*1e3:.1f} ms/eval"
+    if check is not None:
+        msg += f"   [{check(out)}]"
+    print(msg)
+
+
+# ---------------- F1: select-loop fwd ----------------
+def f1_kernel(idx_ref, val_ref, w2_ref, z_ref):
+    idx = idx_ref[:]
+    hi = jax.lax.shift_right_logical(idx, 7)
+    lo = jax.lax.bitwise_and(idx, 127)
+    acc = jnp.zeros((K, TN), jnp.float32)
+    w2 = w2_ref[:]
+    for j in range(HI):
+        wrow = jax.lax.broadcast_in_dim(w2[j, :], (K, TN), (1,))
+        g = jnp.take_along_axis(wrow, lo, axis=1)
+        acc = acc + jnp.where(hi == j, g, 0.0)
+    z_ref[:] = jnp.sum(acc * val_ref[:], axis=0, keepdims=True)
+
+
+@jax.jit
+def f1(idxT, valT, w):
+    w2 = w.reshape(HI, LO)
+
+    def call(w2):
+        return pl.pallas_call(
+            f1_kernel,
+            grid=(GRID,),
+            in_specs=[
+                pl.BlockSpec((K, TN), lambda i: (0, i), memory_space=pltpu.VMEM),
+                pl.BlockSpec((K, TN), lambda i: (0, i), memory_space=pltpu.VMEM),
+                pl.BlockSpec((HI, LO), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((1, TN), lambda i: (0, i), memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((1, N), jnp.float32),
+        )(idxT, valT, w2)
+
+    def one(c, i):
+        return c + call(w2 * (1.0 + i * 1e-6))[0, :7], None
+
+    tot, _ = jax.lax.scan(one, jnp.zeros(7), jnp.arange(REPS, dtype=jnp.float32))
+    return tot
+
+
+# ---------------- F2: MXU one-hot fwd ----------------
+def f2_kernel(idx_ref, val_ref, w2_ref, z_ref):
+    idx = idx_ref[:].reshape(K * TN // 128, 128)  # entries as (S,128)
+    hi = jax.lax.shift_right_logical(idx, 7)
+    lo = jax.lax.bitwise_and(idx, 127)
+    S = K * TN // 128
+    # one-hot(hi): (S*128, HI) ... build as (S,128)->? need (E,HI) 2D.
+    # Reshape entries to (E, 1)? E=8192 sublanes. Build one-hot via iota cmp:
+    hi_col = hi.reshape(K * TN, 1)
+    oh = (jax.lax.broadcasted_iota(jnp.int32, (K * TN, HI), 1) == hi_col).astype(
+        jnp.float32
+    )
+    t = jax.lax.dot_general(
+        oh, w2_ref[:], dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # (E, 128)
+    lo_e = lo.reshape(K * TN, 1)
+    g = jnp.take_along_axis(t, jax.lax.broadcast_in_dim(lo_e[:, 0], (K * TN, 128), (0,)), axis=1)[:, :1]
+    g2 = g.reshape(K, TN)
+    z_ref[:] = jnp.sum(g2 * val_ref[:], axis=0, keepdims=True)
+
+
+@jax.jit
+def f2(idxT, valT, w):
+    w2 = w.reshape(HI, LO)
+
+    def call(w2):
+        return pl.pallas_call(
+            f2_kernel,
+            grid=(GRID,),
+            in_specs=[
+                pl.BlockSpec((K, TN), lambda i: (0, i), memory_space=pltpu.VMEM),
+                pl.BlockSpec((K, TN), lambda i: (0, i), memory_space=pltpu.VMEM),
+                pl.BlockSpec((HI, LO), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((1, TN), lambda i: (0, i), memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((1, N), jnp.float32),
+        )(idxT, valT, w2)
+
+    def one(c, i):
+        return c + call(w2 * (1.0 + i * 1e-6))[0, :7], None
+
+    tot, _ = jax.lax.scan(one, jnp.zeros(7), jnp.arange(REPS, dtype=jnp.float32))
+    return tot
+
+
+# ---------------- B1: MXU one-hot bwd ----------------
+def b1_kernel(idx_ref, val_ref, u_ref, g_ref):
+    i = pl.program_id(0)
+    idx = idx_ref[:]
+    a = val_ref[:] * jax.lax.broadcast_in_dim(u_ref[0, :], (K, TN), (1,))
+    E = K * TN
+    hi = jax.lax.shift_right_logical(idx, 7).reshape(E, 1)
+    lo = jax.lax.bitwise_and(idx, 127).reshape(E, 1)
+    af = a.reshape(E, 1)
+    A = jnp.where(
+        jax.lax.broadcasted_iota(jnp.int32, (E, HI), 1) == hi, af, 0.0
+    )  # (E, HI) f32
+    O = (jax.lax.broadcasted_iota(jnp.int32, (E, LO), 1) == lo).astype(jnp.float32)
+    contrib = jax.lax.dot_general(
+        A, O, dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # (HI, LO)
+
+    @pl.when(i == 0)
+    def _():
+        g_ref[:] = contrib
+
+    @pl.when(i > 0)
+    def _():
+        g_ref[:] += contrib
+
+
+@jax.jit
+def b1(idxT, valT, u):
+    def call(u):
+        return pl.pallas_call(
+            b1_kernel,
+            grid=(GRID,),
+            in_specs=[
+                pl.BlockSpec((K, TN), lambda i: (0, i), memory_space=pltpu.VMEM),
+                pl.BlockSpec((K, TN), lambda i: (0, i), memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, TN), lambda i: (0, i), memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((HI, LO), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((HI, LO), jnp.float32),
+        )(idxT, valT, u.reshape(1, N))
+
+    def one(c, i):
+        return c + call(u * (1.0 + i * 1e-6)).reshape(-1)[:7], None
+
+    tot, _ = jax.lax.scan(one, jnp.zeros(7), jnp.arange(REPS, dtype=jnp.float32))
+    return tot
+
+
+def chk_z(out):
+    got = np.asarray(out)
+    want = sum(z_ref_np[:7] * (1.0 + i * 1e-6) for i in range(REPS))
+    return f"max err {np.max(np.abs(got - want)):.2e}"
+
+
+def chk_g(out):
+    got = np.asarray(out)
+    want = sum(g_ref_np[:7] * (1.0 + i * 1e-6) for i in range(REPS))
+    return f"max err {np.max(np.abs(got - want)):.2e}"
+
+
+timeit("F1 fwd select-loop ", f1, (idxT, valT, w), chk_z)
+timeit("F2 fwd MXU one-hot ", f2, (idxT, valT, w), chk_z)
+timeit("B1 bwd MXU one-hot ", b1, (idxT, valT, u), chk_g)
+
+# honest XLA baselines with same scan-perturb protocol
+idx2 = jnp.asarray(idx_nk)
+val2 = jnp.asarray(val_nk)
+
+@jax.jit
+def xla_fwd(idx, val, w):
+    def one(c, i):
+        z = jnp.einsum("nk,nk->n", jnp.take(w * (1.0 + i * 1e-6), idx, axis=-1), val)
+        return c + z[:7], None
+    tot, _ = jax.lax.scan(one, jnp.zeros(7), jnp.arange(REPS, dtype=jnp.float32))
+    return tot
+
+@jax.jit
+def xla_bwd(idx, val, u):
+    def one(c, i):
+        fv = (val * (u * (1.0 + i * 1e-6))[:, None]).reshape(-1)
+        g = jnp.zeros((D,), jnp.float32).at[idx.reshape(-1)].add(fv)
+        return c + g[:7], None
+    tot, _ = jax.lax.scan(one, jnp.zeros(7), jnp.arange(REPS, dtype=jnp.float32))
+    return tot
+
+timeit("XLA fwd gather     ", xla_fwd, (idx2, val2, w), chk_z)
+timeit("XLA bwd scatter    ", xla_bwd, (idx2, val2, u), chk_g)
+print("done")

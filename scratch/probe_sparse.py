@@ -1,0 +1,192 @@
+"""Probe: which sparse gather/scatter formulations compile + their speed on v5e.
+
+Candidates for the sparse-ELL objective kernel:
+  A. XLA status quo: gather-matvec + scatter-add rmatvec  (the 840 ms/eval path)
+  B. XLA CSC-transpose: grad via gather of u (static pattern, transpose once)
+  C. Pallas: jnp.take(w, idx) gather inside kernel (does Mosaic lower it?)
+  D. Pallas: one-hot matmul for both directions
+"""
+import functools, time, sys
+import numpy as np
+import jax, jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+N, K, D = 1 << 20, 64, 16384
+rng = np.random.default_rng(0)
+idx = jnp.asarray(rng.integers(0, D, size=(N, K)).astype(np.int32))
+val = jnp.asarray(rng.normal(size=(N, K)).astype(np.float32))
+u = jnp.asarray(rng.normal(size=(N,)).astype(np.float32))
+w = jnp.asarray(rng.normal(size=(D,)).astype(np.float32))
+
+
+def timed(name, fn, *args):
+    try:
+        out = jax.block_until_ready(fn(*args))
+    except Exception as e:
+        print(f"{name}: FAIL {type(e).__name__}: {str(e)[:300]}")
+        return None
+    ts = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        ts.append(time.perf_counter() - t0)
+    print(f"{name}: {min(ts)*1e3:.1f} ms")
+    return out
+
+
+# ---- A: XLA baselines ----
+@jax.jit
+def xla_matvec(idx, val, w):
+    return jnp.einsum("nk,nk->n", jnp.take(w, idx, axis=-1), val)
+
+@jax.jit
+def xla_rmatvec(idx, val, u):
+    flat_idx = idx.reshape(-1)
+    flat_val = (val * u[:, None]).reshape(-1)
+    return jnp.zeros((D,), jnp.float32).at[flat_idx].add(flat_val)
+
+z_ref = timed("A fwd xla gather-matvec", xla_matvec, idx, val, w)
+g_ref = timed("A bwd xla scatter-add  ", xla_rmatvec, idx, val, u)
+
+# ---- B: CSC transpose (host, one-time) + XLA gather ----
+t0 = time.perf_counter()
+flat_i = np.asarray(idx).reshape(-1)
+order = np.argsort(flat_i, kind="stable")
+rowT = (order // K).astype(np.int32)
+colT = flat_i[order]
+valT = np.asarray(val).reshape(-1)[order]
+counts = np.bincount(colT, minlength=D)
+KT = int(counts.max())
+print(f"B transpose host: {time.perf_counter()-t0:.1f}s, max col len {KT}, mean {counts.mean():.0f}")
+# pad to ELL-T (D, KT) -- KT ~ N*K/D * smallish factor
+offs = np.zeros(D + 1, np.int64); np.cumsum(counts, out=offs[1:])
+rT = np.zeros((D, KT), np.int32); vT = np.zeros((D, KT), np.float32)
+for d in range(D):
+    lo, hi = offs[d], offs[d + 1]
+    rT[d, : hi - lo] = rowT[lo:hi]
+    vT[d, : hi - lo] = valT[lo:hi]
+rT = jnp.asarray(rT); vT = jnp.asarray(vT)
+
+@jax.jit
+def xla_csc_grad(rT, vT, u):
+    return jnp.einsum("dk,dk->d", jnp.take(u, rT, axis=-1), vT)
+
+g_b = timed("B bwd xla csc-gather   ", xla_csc_grad, rT, vT, u)
+if g_b is not None:
+    print("  B vs A max err:", float(jnp.max(jnp.abs(g_b - g_ref))))
+
+# ---- C: Pallas gather kernel ----
+TILE = 1024
+
+def c_fwd_kernel(idx_ref, val_ref, w_ref, z_ref):
+    g = jnp.take(w_ref[:], idx_ref[:], axis=0)  # (TILE,K) gather from (D,)
+    z_ref[:] = jnp.sum(g * val_ref[:], axis=1, keepdims=True)
+
+@jax.jit
+def pallas_fwd(idx, val, w):
+    return pl.pallas_call(
+        c_fwd_kernel,
+        grid=(N // TILE,),
+        in_specs=[
+            pl.BlockSpec((TILE, K), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((TILE, K), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((D,), lambda i: (0,), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((TILE, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((N, 1), jnp.float32),
+    )(idx, val, w)
+
+z_c = timed("C fwd pallas take      ", pallas_fwd, idx, val, w)
+if z_c is not None and z_ref is not None:
+    print("  C vs A max err:", float(jnp.max(jnp.abs(z_c[:, 0] - z_ref))))
+
+# C2: gather from 2D w (D,1) via take_along_axis style
+def c2_fwd_kernel(idx_ref, val_ref, w_ref, z_ref):
+    w = w_ref[:]  # (1, D)
+    g = jnp.take(w[0], idx_ref[:], axis=0)
+    z_ref[:] = jnp.sum(g * val_ref[:], axis=1, keepdims=True)
+
+@jax.jit
+def pallas_fwd2(idx, val, w2):
+    return pl.pallas_call(
+        c2_fwd_kernel,
+        grid=(N // TILE,),
+        in_specs=[
+            pl.BlockSpec((TILE, K), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((TILE, K), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, D), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((TILE, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((N, 1), jnp.float32),
+    )(idx, val, w.reshape(1, D))
+
+z_c2 = timed("C2 fwd pallas take 2d  ", pallas_fwd2, idx, val, w)
+if z_c2 is not None and z_ref is not None:
+    print("  C2 vs A max err:", float(jnp.max(jnp.abs(z_c2[:, 0] - z_ref))))
+
+# ---- C3: Pallas CSC gather for gradient (u in VMEM: N*4B = 4MB) ----
+TD = 512  # dim tile
+
+def c3_kernel(rT_ref, vT_ref, u_ref, g_ref):
+    g = jnp.take(u_ref[0], rT_ref[:], axis=0)  # (TD, KT) gather from (N,)
+    g_ref[:] = jnp.sum(g * vT_ref[:], axis=1, keepdims=True)
+
+@jax.jit
+def pallas_csc_grad(rT, vT, u2):
+    return pl.pallas_call(
+        c3_kernel,
+        grid=(D // TD,),
+        in_specs=[
+            pl.BlockSpec((TD, KT), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((TD, KT), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, N), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((TD, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((D, 1), jnp.float32),
+    )(rT, vT, u.reshape(1, N))
+
+g_c3 = timed("C3 bwd pallas csc take ", pallas_csc_grad, rT, vT, u)
+if g_c3 is not None:
+    print("  C3 vs A max err:", float(jnp.max(jnp.abs(g_c3[:, 0] - g_ref))))
+
+# ---- D: Pallas one-hot matmul bwd (dim-blocked) ----
+DB = 2048
+TN = 512
+
+def d_kernel(idx_ref, a_ref, g_ref):
+    j = pl.program_id(1)
+    base = j * DB
+    idxf = idx_ref[:].reshape(TN * K)  # entries
+    af = a_ref[:].reshape(TN * K, 1)
+    lanes = jax.lax.broadcasted_iota(jnp.int32, (TN * K, DB), 1) + base
+    onehot = (lanes == idxf[:, None]).astype(jnp.float32)
+    contrib = jax.lax.dot_general(
+        onehot, af, dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # (DB, 1)
+    @pl.when(pl.program_id(0) == 0)
+    def _():
+        g_ref[:] = contrib
+    @pl.when(pl.program_id(0) > 0)
+    def _():
+        g_ref[:] += contrib
+
+@jax.jit
+def pallas_onehot_grad(idx, a):
+    return pl.pallas_call(
+        d_kernel,
+        grid=(N // TN, D // DB),
+        in_specs=[
+            pl.BlockSpec((TN, K), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((TN, K), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((DB, 1), lambda i, j: (j, 0), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((D, 1), jnp.float32),
+    )(idx, a)
+
+a = val * u[:, None]
+g_d = timed("D bwd pallas onehot    ", pallas_onehot_grad, idx, a)
+if g_d is not None:
+    print("  D vs A max err:", float(jnp.max(jnp.abs(g_d[:, 0] - g_ref))))
+print("done")

@@ -83,6 +83,144 @@ def test_sharded_fixed_effect_matches_single_device(rng):
     assert len(sharded.labels.sharding.device_set) == 8
 
 
+class TestRingCollectives:
+    """ring_gather_rows / ring_scatter_rows: exact row movement over the mesh
+    (no arithmetic), so results must be bit-identical to local indexing."""
+
+    def test_ring_gather_matches_local_gather(self, rng):
+        from photon_ml_tpu.parallel.mesh import (
+            batch_sharding,
+            make_mesh,
+            matrix_row_sharding,
+            ring_gather_rows,
+        )
+
+        mesh = make_mesh()
+        ndev = mesh.devices.size
+        R, D, S = 4 * ndev, 6, 5 * ndev
+        M = jnp.asarray(rng.normal(size=(R, D)).astype(np.float32))
+        rows = jnp.asarray(rng.integers(0, R, size=S).astype(np.int32))
+        Ms = jax.device_put(M, matrix_row_sharding(mesh))
+        rows_s = jax.device_put(rows, batch_sharding(mesh, 1))
+        got = np.asarray(ring_gather_rows(Ms, rows_s, mesh))
+        assert np.array_equal(got, np.asarray(M)[np.asarray(rows)])
+
+    def test_ring_gather_2d_rows(self, rng):
+        from photon_ml_tpu.parallel.mesh import (
+            batch_sharding,
+            make_mesh,
+            matrix_row_sharding,
+            ring_gather_rows,
+        )
+
+        mesh = make_mesh()
+        ndev = mesh.devices.size
+        R, D = 2 * ndev, 4
+        M = jnp.asarray(rng.normal(size=(R, D)).astype(np.float32))
+        rows = jnp.asarray(rng.integers(0, R, size=(2 * ndev, 3)).astype(np.int32))
+        got = np.asarray(
+            ring_gather_rows(
+                jax.device_put(M, matrix_row_sharding(mesh)),
+                jax.device_put(rows, batch_sharding(mesh, 2)),
+                mesh,
+            )
+        )
+        assert np.array_equal(got, np.asarray(M)[np.asarray(rows)])
+
+    def test_ring_scatter_matches_local_set(self, rng):
+        from photon_ml_tpu.parallel.mesh import (
+            batch_sharding,
+            make_mesh,
+            matrix_row_sharding,
+            ring_scatter_rows,
+        )
+
+        mesh = make_mesh()
+        ndev = mesh.devices.size
+        R, D, S = 4 * ndev, 6, 2 * ndev
+        M = jnp.asarray(rng.normal(size=(R, D)).astype(np.float32))
+        # unique target rows (the coordinate's contract within a bucket)
+        rows = jnp.asarray(
+            rng.choice(R, size=S, replace=False).astype(np.int32)
+        )
+        vals = jnp.asarray(rng.normal(size=(S, D)).astype(np.float32))
+        got = np.asarray(
+            ring_scatter_rows(
+                jax.device_put(M, matrix_row_sharding(mesh)),
+                jax.device_put(rows, batch_sharding(mesh, 1)),
+                jax.device_put(vals, batch_sharding(mesh, 2)),
+                mesh,
+            )
+        )
+        want = np.asarray(M).copy()
+        want[np.asarray(rows)] = np.asarray(vals)
+        assert np.array_equal(got, want)
+
+    def test_trained_re_matrix_is_row_sharded(self, rng):
+        ds = _dataset(rng)
+        mesh = make_mesh()
+        padded = pad_game_dataset(ds, mesh.devices.size)
+        sharded = shard_game_dataset(padded, mesh)
+        red = shard_random_effect_dataset(
+            build_random_effect_dataset(
+                sharded, RandomEffectDataConfig("entityId", "per_entity")
+            ),
+            mesh,
+        )
+        rand = RandomEffectCoordinate(sharded, red, _cfg(1.0), TaskType.LOGISTIC_REGRESSION)
+        assert rand._entity_mesh is not None
+        model, _ = rand.train(sharded.offsets)
+        m = model.coefficients_matrix
+        shard_bytes = [s.data.nbytes for s in m.addressable_shards]
+        assert len(shard_bytes) == mesh.devices.size
+        assert max(shard_bytes) <= m.nbytes // mesh.devices.size
+        # sharded scoring matches the replicated gather
+        s_sharded = np.asarray(rand.score(model))
+        from photon_ml_tpu.game.model import random_effect_margins
+
+        s_repl = np.asarray(
+            random_effect_margins(
+                sharded.shards["per_entity"],
+                red.sample_entity_rows,
+                jax.device_put(m, jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())),
+                None,
+            )
+        )
+        np.testing.assert_allclose(s_sharded, s_repl, rtol=1e-6, atol=1e-6)
+
+    def test_sharded_margins_match_replicated_with_norm(self, rng):
+        """Guards the deliberate duplication between random_effect_margins and
+        its sharded twin: norm algebra must stay numerically identical."""
+        from photon_ml_tpu.game.model import (
+            random_effect_margins,
+            random_effect_margins_sharded,
+        )
+        from photon_ml_tpu.ops.normalization import NormalizationContext
+        from photon_ml_tpu.parallel.mesh import (
+            batch_sharding,
+            make_mesh,
+            matrix_row_sharding,
+        )
+
+        mesh = make_mesh()
+        ndev = mesh.devices.size
+        R, D, N = 4 * ndev, 6, 3 * ndev + 1  # N deliberately not divisible
+        M = jnp.asarray(rng.normal(size=(R, D)).astype(np.float32))
+        rows = jnp.asarray(rng.integers(0, R, size=N).astype(np.int32))
+        X = jnp.asarray(rng.normal(size=(N, D)).astype(np.float32))
+        norm = NormalizationContext(
+            factors=jnp.asarray(rng.uniform(0.5, 2.0, size=D).astype(np.float32)),
+            shifts=jnp.asarray(rng.normal(size=D).astype(np.float32) * 0.1),
+        )
+        want = np.asarray(random_effect_margins(X, rows, M, norm))
+        got = np.asarray(
+            random_effect_margins_sharded(
+                X, rows, jax.device_put(M, matrix_row_sharding(mesh)), norm, mesh
+            )
+        )
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
 def test_sharded_game_training_matches_single_device(rng):
     ds = _dataset(rng)
     cfg_re = RandomEffectDataConfig("entityId", "per_entity")
