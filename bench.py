@@ -168,19 +168,46 @@ def _child() -> None:
     coords = {"fixed": fixed, "per-entity": rand}
     variants = {}
 
+    def _force(out) -> float:
+        """Round-trip a combining scalar to the host: on the remote-tunnel
+        backend block_until_ready can return before execution finishes
+        (observed: sub-ms walls for hundred-ms programs), so completion is
+        proven by fetching a value computed from every output leaf."""
+        leaves = [x for x in jax.tree_util.tree_leaves(out) if hasattr(x, "dtype")]
+        if not leaves:
+            return 0.0
+        return float(_force_sum(tuple(jnp.sum(x) for x in leaves)))
+
+    @jax.jit
+    def _force_sum(parts):
+        return sum(parts[1:], parts[0])
+
+    # The force step costs one tiny dispatch + one scalar fetch; measure that
+    # overhead on a trivial program and subtract it from every wall.
+    def _measure_rtt() -> float:
+        ts = []
+        for i in range(5):
+            t0 = time.perf_counter()
+            _force(jnp.ones(4) * float(i + 1))
+            ts.append(time.perf_counter() - t0)
+        return min(ts)
+
+    _force(jnp.ones(2))  # compile the force path before measuring it
+    rtt = _measure_rtt()
+    _mark(f"scalar round-trip overhead {rtt*1e3:.0f} ms (subtracted from walls)")
+
     def timed(fn, label="", warm=None):
         # Warm-up runs a PERTURBED-input call: the execution layer may cache
         # results for bit-identical repeat invocations, which would flatter
         # a timed-equals-warm-up protocol.
         t_c = time.perf_counter()
-        out = (warm or fn)()  # warm-up/compile
-        jax.block_until_ready(out)
+        _force((warm or fn)())  # warm-up/compile
         sys.stderr.write(f"bench: {label} warm-up {time.perf_counter() - t_c:.1f}s\n")
         sys.stderr.flush()
         t0 = time.perf_counter()
         out = fn()
-        jax.block_until_ready(out)
-        return time.perf_counter() - t0, out
+        _force(out)
+        return max(time.perf_counter() - t0 - rtt, 1e-9), out
 
     offsets_warm = ds.offsets + jnp.float32(1e-3)
 
@@ -260,20 +287,15 @@ def _child() -> None:
     _mark(f"sparse coordinate built (bucketed={sparse_kernel}, {pack_s:.1f}s)")
     sp_wall, res_sp = timed(lambda: sp_coord.train(ds_sp.offsets)[1], "sparse_ell", warm=lambda: sp_coord.train(offsets_warm)[1])
     sstats = _solve_stats(res_sp)
-    # Bytes per objective evaluation: the bucketed kernels stream
-    # packed+values once per direction (8 B/slot incl padding); the XLA path
-    # reads the ELL (indices+values) twice (gather-matvec + scatter-rmatvec).
-    if sparse_kernel:
-        bf = sp_coord._features
-        slots = bf.level1.packed.size + (
-            bf.level2.packed.size if bf.level2 is not None else 0
-        )
-        bytes_per_eval = 2 * 8 * slots
-        pack_report = bf.density_report()
-    else:
-        bytes_per_eval = n * k_nnz * 8 * 2
-        pack_report = None
-    sp_bytes = sstats["fn_evals"] * bytes_per_eval
+    # Work-normalized bytes per objective evaluation: the ELL entry bytes
+    # (indices+values) counted once per direction — the same formula r02
+    # used for the XLA path, kept so achieved_gb_per_s is comparable across
+    # rounds regardless of which kernel (fused single-stream, composed
+    # two-stream, or XLA gather/scatter) actually ran.
+    pack_report = (
+        sp_coord._features.density_report() if sparse_kernel else None
+    )
+    sp_bytes = sstats["fn_evals"] * n * k_nnz * 8 * 2
     variants["sparse_ell_lbfgs"] = dict(
         sstats,
         nnz_per_row=k_nnz,

@@ -201,23 +201,11 @@ class FixedEffectCoordinate:
 
 def _infer_entity_mesh(re_dataset):
     """The 1-D mesh the RE dataset's entity blocks are sharded over, if any."""
-    from jax.sharding import NamedSharding
+    from photon_ml_tpu.parallel.mesh import leading_axis_mesh
 
-    try:
-        if not re_dataset.buckets:
-            return None
-        sh = re_dataset.buckets[0].entity_rows.sharding
-        if (
-            isinstance(sh, NamedSharding)
-            and len(sh.mesh.axis_names) == 1
-            and len(sh.device_set) > 1
-            and sh.spec
-            and sh.spec[0] == sh.mesh.axis_names[0]
-        ):
-            return sh.mesh
-    except Exception:
+    if not re_dataset.buckets:
         return None
-    return None
+    return leading_axis_mesh(re_dataset.buckets[0].entity_rows)
 
 
 class RandomEffectCoordinate:

@@ -84,8 +84,26 @@ class FixedEffectModel:
         return mean_for_task(self.task, self.score(data) + data.offsets)
 
 
+import functools as _functools
+
+
+@_functools.lru_cache(maxsize=16)
+def _margins_sharded_fn(mesh):
+    """One jitted program per mesh (scoring is a per-CD-iteration hot path;
+    an eager pad + vmap + einsum chain would dispatch op-by-op)."""
+    return jax.jit(
+        _functools.partial(_random_effect_margins_sharded_impl, mesh=mesh)
+    )
+
+
 def random_effect_margins_sharded(
     features, entity_rows: Array, matrix: Array, norm, mesh
+) -> Array:
+    return _margins_sharded_fn(mesh)(features, entity_rows, matrix, norm)
+
+
+def _random_effect_margins_sharded_impl(
+    features, entity_rows: Array, matrix: Array, norm, *, mesh
 ) -> Array:
     """Sharded-gather scoring: the row-sharded coefficient matrix is read via
     the ring collective (parallel/mesh.ring_gather_rows) so no device ever

@@ -74,6 +74,14 @@ def artifact_from_game_model(
 
             matrix = m.coefficients_matrix
             variances = m.variances_matrix
+            # Mesh-trained matrices are row-padded past E+1 (entity-sharded
+            # store); slice BEFORE per-entity transforms/back-projection,
+            # whose tables are (E+1)-row shaped.
+            logical_rows = m.num_entities + 1
+            if matrix.shape[0] > logical_rows:
+                matrix = matrix[:logical_rows]
+                if variances is not None:
+                    variances = variances[:logical_rows]
             if isinstance(norm, PerEntityNormalization):
                 # Projected-space contexts: per-entity factor/shift rows
                 # (IndexMapProjectorRDD.scala:133), still in projected space;

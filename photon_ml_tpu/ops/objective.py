@@ -132,9 +132,27 @@ def value_and_gradient(
     cannot see sharding or vmap context — is bypassed. None = auto.
     """
     w_eff, shift = _eff(w, norm)
-    if use_pallas is None:
+    # An explicit use_pallas=False (the caller's escape hatch for contexts
+    # the trace-time heuristics cannot see) disables the fused sparse path
+    # too; wide problems whose tiles exceed the fused kernel's VMEM budget
+    # fall through to the grouped matvec/rmatvec composition below.
+    fused_sparse = (
+        use_pallas is not False
+        and isinstance(data.features, BucketedSparseFeatures)
+        and pallas_sparse.should_use(data.features)
+        and pallas_sparse.fused_feasible(data.features)
+    )
+    if use_pallas is None and not fused_sparse:
         use_pallas = pallas_glm.should_use(data.features, w_eff)
-    if isinstance(use_pallas, pallas_glm.ShardedDispatch):
+    if fused_sparse:
+        # Sparse fused path: one stream over the bucketed entries computes
+        # value, u and the gradient together (pallas_sparse._fused_kernel) —
+        # same raw-sum contract as the dense fused kernel below.
+        val, g, sum_u = pallas_sparse.fused_value_gradient_sums(
+            loss, w_eff, shift, data.features, data.labels, data.offsets,
+            data.weights, interpret=pallas_glm.FORCE_INTERPRET,
+        )
+    elif isinstance(use_pallas, pallas_glm.ShardedDispatch):
         val, g, sum_u = pallas_glm.sharded_value_gradient_sums(
             loss, w_eff, shift, data.features, data.labels, data.offsets,
             data.weights, mesh=use_pallas.mesh, axis=use_pallas.axis,

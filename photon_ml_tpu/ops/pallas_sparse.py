@@ -35,8 +35,12 @@ is split hi/lo into two bf16 MXU passes, which matches f32 accumulation to
 PHOTON_SPARSE_PRECISION=default for single-pass bf16 (~1.7e-3 relative) when
 raw speed matters more than line-search quality.
 
-Measured on v5e at 1M x 64 nnz, dim 16384 (uniform): forward ~16 ms, backward
-~21 ms per pass at hi/lo precision vs 592 / 465 ms for the XLA path — see
+Measured on v5e at 1M x 64 nnz, dim 16384 (uniform), hi/lo precision:
+matvec ~26 ms, rmatvec ~35 ms per pass vs 592 / 465 ms for the XLA
+gather/scatter path; the fused value+gradient kernel (one stream, loss and u
+computed in-kernel) evaluates the full objective in ~58 ms vs ~840 ms for
+the r02 XLA objective. The remaining ceiling is VPU one-hot construction
+(~128 lane-ops per entry per scatter side), not HBM or MXU — see
 BENCH_r03.json for the bench-protocol numbers.
 """
 
@@ -54,9 +58,11 @@ try:  # pragma: no cover - absent only on CPU-only installs
     from jax.experimental.pallas import tpu as pltpu
 
     _VMEM = pltpu.VMEM
+    _SMEM = pltpu.SMEM
 except Exception:  # pragma: no cover
     pltpu = None
     _VMEM = None
+    _SMEM = None
 
 from photon_ml_tpu.data.bucketed import (
     BUCKET,
@@ -92,6 +98,18 @@ _GROUP = 32
 
 def _bcast_row(row: Array, sublanes: int) -> Array:
     return jax.lax.broadcast_in_dim(row[0, :], (sublanes, 128), (1,))
+
+
+def _onehot_rows(idx_row: Array, rows: int) -> Array:
+    """(rows, 128) one-hot: out[r, e] = (idx_row[0, e] == r), f32.
+
+    Iota-compare is the measured-fastest build (an identity-matrix
+    lane-gather variant measured ~35% slower: Mosaic does not hoist the eye
+    constant out of the segment loop).
+    """
+    return (
+        jax.lax.broadcasted_iota(jnp.int32, (rows, 128), 0) == _bcast_row(idx_row, rows)
+    ).astype(jnp.float32)
 
 
 def _onehot_contract(values_row: Array, onehot: Array) -> Array:
@@ -133,14 +151,8 @@ def _matvec_kernel(spv: int, rt: int, group: int, pk_ref, val_ref, w_ref, z_ref)
             rl_row = rl[s : s + 1, :]
             rhi = jax.lax.shift_right_logical(rl_row, 7)
             rlo = jax.lax.bitwise_and(rl_row, 127)
-            orh = jax.lax.broadcasted_iota(jnp.int32, (rt, 128), 0) == _bcast_row(
-                rhi, rt
-            )
-            p1 = jnp.where(orh, _bcast_row(p[s : s + 1, :], rt), 0.0)
-            orlt = (
-                jax.lax.broadcasted_iota(jnp.int32, (128, 128), 0)
-                == _bcast_row(rlo, 128)
-            ).astype(jnp.float32)
+            p1 = _onehot_rows(rhi, rt) * _bcast_row(p[s : s + 1, :], rt)
+            orlt = _onehot_rows(rlo, 128)
             zc = zc + _onehot_contract(p1, orlt)
 
     @pl.when(bg == 0)
@@ -171,15 +183,9 @@ def _rmatvec_kernel(
             rhi = jax.lax.shift_right_logical(rl_row, 7)
             rlo = jax.lax.bitwise_and(rl_row, 127)
             tu = jnp.take_along_axis(u2, _bcast_row(rlo, rt), axis=1)
-            orh = jax.lax.broadcasted_iota(jnp.int32, (rt, 128), 0) == _bcast_row(
-                rhi, rt
-            )
-            u_sel = jnp.sum(jnp.where(orh, tu, 0.0), axis=0, keepdims=True)
+            u_sel = jnp.sum(_onehot_rows(rhi, rt) * tu, axis=0, keepdims=True)
             a = u_sel * vv[s : s + 1, :]
-            olt = (
-                jax.lax.broadcasted_iota(jnp.int32, (128, 128), 0)
-                == _bcast_row(lane[s : s + 1, :], 128)
-            ).astype(jnp.float32)
+            olt = _onehot_rows(lane[s : s + 1, :], 128)
             gc = gc + _onehot_contract(a, olt)
         bidx = bg * group + gi
 
@@ -291,6 +297,18 @@ def should_use(bf: BucketedSparseFeatures) -> bool:
 # segment) stays on XLA.
 MAX_PAD_BLOWUP = 4.0
 
+# The fused kernel loads one whole tile's (B*spv, 128) packed+values blocks
+# into VMEM; cap the segment-row count so two f32 blocks plus working set
+# stay well inside the ~16 MB budget (4096 rows = 4 MB of inputs). Wider
+# problems fall back to the grouped matvec/rmatvec kernels.
+MAX_FUSED_ROWS = 4096
+
+
+def fused_feasible(bf: BucketedSparseFeatures) -> bool:
+    """Can the single-stream fused kernel hold a full tile in VMEM?"""
+    B = bf.num_buckets
+    return B * bf.level1.spv <= MAX_FUSED_ROWS
+
 
 def maybe_pack(feats, n_samples: int) -> Optional[BucketedSparseFeatures]:
     """Repack an ELL `SparseFeatures` shard into the bucketed layout iff the
@@ -369,6 +387,181 @@ def rmatvec(
             ov = ov * ov
         g = g.at[bf.overflow_cols].add(ov * jnp.take(u_f, bf.overflow_rows))
     return g
+
+
+# ---------------------------------------------------------- fused objective
+
+
+def _fused_kernel(
+    loss,
+    spv: int,
+    rt: int,
+    B: int,
+    pk_ref,
+    val_ref,
+    y_ref,
+    off_ref,
+    wt_ref,
+    w_ref,
+    zx_ref,
+    stats_ref,
+    g_ref,
+    u_ref,
+):
+    """One pass over a tile's entries: margins, loss value, u, gradient.
+
+    The tile's entries stay VMEM-resident between the forward and backward
+    sweeps, so packed+values stream from HBM exactly once per objective
+    evaluation — the sparse analog of the dense fused kernel
+    (pallas_glm._value_grad_kernel). `zx` carries the level-2/COO margin
+    contributions computed outside so u sees complete margins.
+    """
+    t = pl.program_id(0)
+
+    def fwd_body(b, zc):
+        pk = pk_ref[pl.ds(b * spv, spv), :]
+        vv = val_ref[pl.ds(b * spv, spv), :]
+        lane = jax.lax.bitwise_and(pk, BUCKET - 1)
+        rl = jax.lax.shift_right_logical(pk, _ROW_SHIFT)
+        wb = _bcast_row(w_ref[pl.ds(b, 1), :], spv)
+        p = jnp.take_along_axis(wb, lane, axis=1) * vv
+        for s in range(spv):
+            rl_row = rl[s : s + 1, :]
+            rhi = jax.lax.shift_right_logical(rl_row, 7)
+            rlo = jax.lax.bitwise_and(rl_row, 127)
+            p1 = _onehot_rows(rhi, rt) * _bcast_row(p[s : s + 1, :], rt)
+            orlt = _onehot_rows(rlo, 128)
+            zc = zc + _onehot_contract(p1, orlt)
+        return zc
+
+    z = jax.lax.fori_loop(0, B, fwd_body, zx_ref[:]) + off_ref[:]
+    y = y_ref[:]
+    wt = wt_ref[:]
+    val = jnp.sum(wt * loss.loss(z, y))
+    u2 = wt * loss.d1(z, y)
+    u_ref[:] = u2
+    sum_u = jnp.sum(u2)
+
+    @pl.when(t == 0)
+    def _():
+        stats_ref[0, 0] = val
+        stats_ref[0, 1] = sum_u
+        g_ref[:] = jnp.zeros_like(g_ref)
+
+    @pl.when(t > 0)
+    def _():
+        stats_ref[0, 0] += val
+        stats_ref[0, 1] += sum_u
+
+    def bwd_body(b, carry):
+        pk = pk_ref[pl.ds(b * spv, spv), :]
+        vv = val_ref[pl.ds(b * spv, spv), :]
+        lane = jax.lax.bitwise_and(pk, BUCKET - 1)
+        rl = jax.lax.shift_right_logical(pk, _ROW_SHIFT)
+        gc = jnp.zeros((1, 128), jnp.float32)
+        for s in range(spv):
+            rl_row = rl[s : s + 1, :]
+            rhi = jax.lax.shift_right_logical(rl_row, 7)
+            rlo = jax.lax.bitwise_and(rl_row, 127)
+            tu = jnp.take_along_axis(u2, _bcast_row(rlo, rt), axis=1)
+            u_sel = jnp.sum(_onehot_rows(rhi, rt) * tu, axis=0, keepdims=True)
+            a = u_sel * vv[s : s + 1, :]
+            olt = _onehot_rows(lane[s : s + 1, :], 128)
+            gc = gc + _onehot_contract(a, olt)
+        g_ref[pl.ds(b, 1), :] += gc
+        return carry
+
+    jax.lax.fori_loop(0, B, bwd_body, 0)
+
+
+@functools.partial(jax.jit, static_argnames=("loss", "interpret"))
+def fused_value_gradient_sums(
+    loss,
+    w_eff: Array,
+    shift: Array,
+    bf: BucketedSparseFeatures,
+    labels: Array,
+    offsets: Array,
+    weights: Array,
+    *,
+    interpret: bool = False,
+) -> Tuple[Array, Array, Array]:
+    """Raw fused sums for the weighted GLM objective on bucketed features.
+
+    Returns (value, grad_raw, sum_u) with the same semantics as the dense
+    pallas_glm.value_gradient_sums, so ops/objective.py post-processes
+    normalization/L2 identically. Level 1 runs the single-stream fused
+    kernel; level-2/COO margins enter as z_extra and their gradient terms
+    compose from the kernel's materialized u.
+    """
+    lvl = bf.level1
+    B = bf.num_buckets
+    T = lvl.num_tiles(bf.n_rows)
+    rt = lvl.tile_rows // 128
+    spv = lvl.spv
+    pad_rows = T * lvl.tile_rows
+    n = bf.n_rows
+
+    w_pad2 = jnp.pad(w_eff.astype(jnp.float32), (0, B * BUCKET - bf.dim)).reshape(
+        B, BUCKET
+    )
+    # Margin contributions the level-1 kernel cannot see.
+    z_extra = jnp.zeros(pad_rows, jnp.float32)
+    if bf.level2 is not None:
+        z_extra = z_extra.at[:n].add(
+            _level_matvec(bf.level2, n, bf.dim, w_pad2, interpret)
+        )
+    if bf.overflow_vals.shape[0]:
+        z_extra = z_extra.at[bf.overflow_rows].add(
+            bf.overflow_vals * jnp.take(w_pad2.reshape(-1), bf.overflow_cols)
+        )
+
+    def tile2(a, fill=0.0):
+        return jnp.pad(
+            a.astype(jnp.float32), (0, pad_rows - n), constant_values=fill
+        ).reshape(T * rt, 128)
+
+    stats, grad1, u2 = pl.pallas_call(
+        functools.partial(_fused_kernel, loss, spv, rt, B),
+        grid=(T,),
+        in_specs=[
+            pl.BlockSpec((B * spv, 128), lambda t: (t, 0), memory_space=_VMEM),
+            pl.BlockSpec((B * spv, 128), lambda t: (t, 0), memory_space=_VMEM),
+            pl.BlockSpec((rt, 128), lambda t: (t, 0), memory_space=_VMEM),
+            pl.BlockSpec((rt, 128), lambda t: (t, 0), memory_space=_VMEM),
+            pl.BlockSpec((rt, 128), lambda t: (t, 0), memory_space=_VMEM),
+            pl.BlockSpec((B, 128), lambda t: (0, 0), memory_space=_VMEM),
+            pl.BlockSpec((rt, 128), lambda t: (t, 0), memory_space=_VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 2), lambda t: (0, 0), memory_space=_SMEM),
+            pl.BlockSpec((B, 128), lambda t: (0, 0), memory_space=_VMEM),
+            pl.BlockSpec((rt, 128), lambda t: (t, 0), memory_space=_VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, 2), jnp.float32),
+            jax.ShapeDtypeStruct((B, 128), jnp.float32),
+            jax.ShapeDtypeStruct((T * rt, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(
+        lvl.packed,
+        lvl.values,
+        tile2(labels),
+        tile2(offsets + shift),
+        tile2(weights),
+        w_pad2,
+        z_extra.reshape(T * rt, 128),
+    )
+    grad = grad1.reshape(-1)[: bf.dim]
+    u_flat = u2.reshape(-1)[:n]
+    if bf.level2 is not None:
+        grad = grad + _level_rmatvec(bf.level2, n, B, u_flat, False, interpret)[: bf.dim]
+    if bf.overflow_vals.shape[0]:
+        grad = grad.at[bf.overflow_cols].add(
+            bf.overflow_vals * jnp.take(u_flat, bf.overflow_rows)
+        )
+    return stats[0, 0], grad, stats[0, 1]
 
 
 # ------------------------------------------------------------- XLA reference

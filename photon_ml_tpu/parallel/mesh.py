@@ -131,6 +131,31 @@ def matrix_row_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(mesh.axis_names[0], None))
 
 
+def leading_axis_mesh(array, *, require_divisible: bool = False) -> Optional[Mesh]:
+    """The 1-D mesh `array` is sharded over along its leading axis, if any.
+
+    The single inspector behind both the coordinate's entity-mesh inference
+    and the transformer's sharded-matrix detection (they must agree on when
+    the sharded paths engage). `require_divisible` additionally demands the
+    leading dim split evenly (the ring collectives' contract for matrices).
+    """
+    try:
+        sh = array.sharding
+        if (
+            isinstance(sh, NamedSharding)
+            and len(sh.mesh.axis_names) == 1
+            and len(sh.device_set) > 1
+            and sh.spec
+            and sh.spec[0] == sh.mesh.axis_names[0]
+        ):
+            if require_divisible and array.shape[0] % sh.mesh.devices.size != 0:
+                return None
+            return sh.mesh
+    except Exception:
+        return None
+    return None
+
+
 @functools.lru_cache(maxsize=64)
 def _sharded_zeros_fn(shape, dtype, sharding):
     return jax.jit(lambda: jnp.zeros(shape, dtype), out_shardings=sharding)

@@ -119,24 +119,11 @@ def _re_margins(features: Features, entity_rows: Array, matrix: Array, norm) -> 
     return random_effect_margins(features, entity_rows, matrix, norm)
 
 
-def _entity_sharded_mesh(matrix) -> "object | None":
+def _entity_sharded_mesh(matrix):
     """The 1-D mesh a row-sharded coefficient matrix lives on, if any."""
-    from jax.sharding import NamedSharding
+    from photon_ml_tpu.parallel.mesh import leading_axis_mesh
 
-    try:
-        sh = matrix.sharding
-        if (
-            isinstance(sh, NamedSharding)
-            and len(sh.mesh.axis_names) == 1
-            and len(sh.device_set) > 1
-            and sh.spec
-            and sh.spec[0] == sh.mesh.axis_names[0]
-            and matrix.shape[0] % sh.mesh.devices.size == 0
-        ):
-            return sh.mesh
-    except Exception:
-        return None
-    return None
+    return leading_axis_mesh(matrix, require_divisible=True)
 
 
 @jax.jit
