@@ -343,6 +343,55 @@ def _child() -> None:
         reps=SCORE_REPS,
     )
 
+    # ---- Avro ingest (native block decoder vs pure-Python codec) ----------
+    import tempfile
+
+    import photon_ml_tpu.io.avro_data as ad
+    from photon_ml_tpu.native.build import load_native
+
+    rng_np = np.random.default_rng(7)
+    n_ing, d_ing, k_ing = 30000, 4000, 24
+    feats_ing = [
+        [
+            (f"f{j}", float(v))
+            for j, v in zip(
+                rng_np.choice(d_ing, size=k_ing, replace=False),
+                rng_np.normal(size=k_ing),
+            )
+        ]
+        for _ in range(n_ing)
+    ]
+    with tempfile.TemporaryDirectory() as td:
+        pth = os.path.join(td, "bench.avro")
+        ad.write_training_examples(
+            pth,
+            feats_ing,
+            (rng_np.uniform(size=n_ing) > 0.5).astype(float),
+            id_tags={"entityId": rng_np.integers(0, 1000, size=n_ing)},
+        )
+        mb = os.path.getsize(pth) / 1e6
+        cfg_ing = {"g": ad.FeatureShardConfig(("features",), True)}
+        t0 = time.perf_counter()
+        ad.read_game_dataset(pth, cfg_ing, id_tag_fields=["entityId"])
+        t_native = time.perf_counter() - t0
+        os.environ["PHOTON_DISABLE_NATIVE"] = "1"
+        try:
+            t0 = time.perf_counter()
+            ad.read_game_dataset(pth, cfg_ing, id_tag_fields=["entityId"])
+            t_python = time.perf_counter() - t0
+        finally:
+            del os.environ["PHOTON_DISABLE_NATIVE"]
+    variants["avro_ingest"] = dict(
+        file_mb=round(mb, 1),
+        native_available=load_native() is not None,
+        native_s=round(t_native, 2),
+        native_mb_per_s=round(mb / t_native, 1),
+        python_s=round(t_python, 2),
+        python_mb_per_s=round(mb / t_python, 1),
+        speedup=round(t_python / t_native, 1),
+    )
+    _mark(f"ingest measured ({mb:.1f} MB, {t_python/t_native:.1f}x)")
+
     # ---- measured baseline surrogate --------------------------------------
     surrogate = _measure_baseline_surrogate(n, d_fixed, stats["fn_evals"])
     vs_baseline = round(surrogate["estimated_wall_s"] / dense_wall, 2)

@@ -158,11 +158,27 @@ def pack_csr_to_ell(
     failing); by default max_nnz = max row length, i.e. lossless.
     """
     n = len(indptr) - 1
+    indptr = np.asarray(indptr, np.int64)
+    indices = np.asarray(indices)
+    values = np.asarray(values)
     row_lens = np.diff(indptr)
-    k = int(row_lens.max()) if max_nnz is None else int(max_nnz)
+    k_full = int(row_lens.max()) if n else 0
+    k = k_full if max_nnz is None else int(max_nnz)
     k = max(k, 1)
     out_idx = np.zeros((n, k), dtype=np.int32)
     out_val = np.zeros((n, k), dtype=dtype)
+
+    rows = np.repeat(np.arange(n, dtype=np.int64), row_lens)
+    key = rows * np.int64(dim) + indices.astype(np.int64)
+    clean = len(np.unique(key)) == len(key)  # no duplicate (row, col)
+    if clean and k_full <= k:
+        # Fast path (the common case): one vectorized scatter preserving the
+        # CSR entry order within each row.
+        pos = np.arange(len(rows), dtype=np.int64) - np.repeat(indptr[:-1], row_lens)
+        out_idx[rows, pos] = indices
+        out_val[rows, pos] = values
+        return SparseFeatures(jnp.asarray(out_idx), jnp.asarray(out_val), dim)
+
     for r in range(n):
         lo, hi = indptr[r], indptr[r + 1]
         ri, rv = indices[lo:hi], values[lo:hi]

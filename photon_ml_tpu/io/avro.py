@@ -355,10 +355,8 @@ def write_container(
     return count_total
 
 
-def read_container(path: str) -> tuple[Schema, List[Any]]:
-    """Read every record from an Avro object container file."""
-    with open(path, "rb") as f:
-        data = f.read()
+def read_header(data: bytes, path: str = "<bytes>"):
+    """Parse the container header: (schema, codec, sync, body_start)."""
     if data[:4] != MAGIC:
         raise ValueError(f"{path} is not an Avro container file")
     dec = BinaryDecoder(data, 4)
@@ -375,6 +373,26 @@ def read_container(path: str) -> tuple[Schema, List[Any]]:
     schema = json.loads(meta["avro.schema"].decode("utf-8"))
     codec = meta.get("avro.codec", b"null").decode("utf-8")
     sync = dec.read_fixed(SYNC_SIZE)
+    return schema, codec, sync, dec.pos
+
+
+def list_container_files(path: str) -> List[str]:
+    """The .avro part files `read_directory` would read, in its order."""
+    if os.path.isfile(path):
+        return [path]
+    return [
+        os.path.join(path, name)
+        for name in sorted(os.listdir(path))
+        if not name.startswith((".", "_")) and name.endswith(".avro")
+    ]
+
+
+def read_container(path: str) -> tuple[Schema, List[Any]]:
+    """Read every record from an Avro object container file."""
+    with open(path, "rb") as f:
+        data = f.read()
+    schema, codec, sync, pos = read_header(data, path)
+    dec = BinaryDecoder(data, pos)
     names = _Names()
     _collect_names(schema, names)
 

@@ -120,6 +120,27 @@ def read_game_dataset(
     path).
     """
     paths = [path] if isinstance(path, str) else list(path)
+
+    if columns is not None and response_field != RESPONSE:
+        raise ValueError(
+            "pass the response name through `columns`, not both `columns` "
+            "and `response_field`"
+        )
+    cols_early = columns or InputColumnNames(response=response_field)
+    # Fast path: block-level native decode (photon_ml_tpu/io/avro_fast.py).
+    # Falls back to the per-datum Python codec for any schema shape the
+    # native op-program compiler cannot express.
+    try:
+        from photon_ml_tpu.io import avro_fast
+
+        fast = avro_fast.try_read_native(
+            paths, shard_configs, index_maps, id_tag_fields, cols_early, LABEL
+        )
+    except Exception:
+        fast = None
+    if fast is not None:
+        return fast
+
     records: List[dict] = []
     for p in paths:
         _, recs = avro_io.read_directory(p)
@@ -149,12 +170,7 @@ def read_game_dataset(
         v = rec.get(field)
         return default if v is None else float(v)
 
-    if columns is not None and response_field != RESPONSE:
-        raise ValueError(
-            "pass the response name through `columns`, not both `columns` "
-            "and `response_field`"
-        )
-    cols = columns or InputColumnNames(response=response_field)
+    cols = cols_early
     labels = np.empty(n, np.float32)
     offsets = np.empty(n, np.float32)
     weights = np.empty(n, np.float32)

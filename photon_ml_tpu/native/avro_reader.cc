@@ -1,0 +1,627 @@
+// Native block decoder for TrainingExample-shaped Avro container files.
+//
+// The pure-Python codec (photon_ml_tpu/io/avro.py) is a correct from-spec
+// implementation but decodes per-datum recursively; at the north-star
+// dataset scale (SURVEY/BASELINE: MovieLens/KDD-class inputs) ingest
+// wall-time dwarfs training. This decoder handles the hot shape — flat
+// records of (possibly union-typed) scalars, feature arrays and string maps,
+// the layouts of TrainingExampleAvro / the reference's integ-test fixtures
+// (photon-avro-schemas, read by AvroDataReader.scala:85-220) — as a tight
+// loop over container blocks.
+//
+// The Python side parses the schema (it owns the Avro type system) and
+// compiles it into a flat op program; this file never interprets schema
+// JSON. Anything the program cannot express falls back to the Python codec,
+// so coverage is a fast path, not a fork of the format.
+//
+// Op stream (int32), each op self-delimiting:
+//   1 NUM_COL   target nb k...   union-typed numeric -> label/offset/weight
+//   2 NUM_COL_P target k         plain numeric column
+//   3 TAG       slot nb k...     union-typed tag (string/varint branches)
+//   4 TAG_P     slot k           plain tag
+//   5 FEATURES  bag nullable     array<record> via the feature op stream
+//   6 META      nullable         map<string,string>: fill empty tag slots
+//   7 SKIP      nb k...          union skip
+//   8 SKIP_P    k                plain skip
+//   9 SKIP_MAP  nullable nvk k.. map with union-typed values, skipped
+//  10 SKIP_FARR nullable n sub.. array<record> skipped (sub = ops 7/8)
+// Feature ops: 20 FNAME | 21 FTERM nb k... | 22 FTERM_P | 23 FVALUE nb k...
+//  24 FVALUE_P k | plus 7/8 skips.
+// Numeric/skip kinds: 0 null, 1 double, 2 float, 3 varint(int/long),
+//  4 boolean, 5 string/bytes (numeric contexts parse with strtod; an
+//  unparseable string aborts the decode so Python re-raises identically).
+
+#include <zlib.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Reader {
+  const uint8_t* p;
+  const uint8_t* end;
+  bool ok = true;
+
+  bool need(size_t n) {
+    if ((size_t)(end - p) < n) {
+      ok = false;
+      return false;
+    }
+    return true;
+  }
+  int64_t read_long() {
+    uint64_t n = 0;
+    int shift = 0;
+    while (true) {
+      if (!need(1)) return 0;
+      uint8_t b = *p++;
+      n |= (uint64_t)(b & 0x7F) << shift;
+      if (!(b & 0x80)) break;
+      shift += 7;
+      if (shift > 63) {
+        ok = false;
+        return 0;
+      }
+    }
+    return (int64_t)(n >> 1) ^ -(int64_t)(n & 1);
+  }
+  double read_double() {
+    if (!need(8)) return 0.0;
+    double v;
+    std::memcpy(&v, p, 8);
+    p += 8;
+    return v;
+  }
+  float read_float() {
+    if (!need(4)) return 0.0f;
+    float v;
+    std::memcpy(&v, p, 4);
+    p += 4;
+    return v;
+  }
+  void skip(size_t n) {
+    if (need(n)) p += n;
+  }
+  void skip_bytes() {
+    int64_t n = read_long();
+    if (n < 0) {
+      ok = false;
+      return;
+    }
+    skip((size_t)n);
+  }
+  std::pair<const char*, int64_t> read_str() {
+    int64_t n = read_long();
+    if (n < 0 || !need((size_t)n)) {
+      ok = false;
+      return {nullptr, 0};
+    }
+    const char* s = (const char*)p;
+    p += n;
+    return {s, n};
+  }
+};
+
+// Skip one value of numeric/skip kind k.
+void skip_kind(Reader& r, int32_t k) {
+  switch (k) {
+    case 0:
+      break;
+    case 1:
+      r.read_double();
+      break;
+    case 2:
+      r.read_float();
+      break;
+    case 3:
+      r.read_long();
+      break;
+    case 4:
+      r.skip(1);
+      break;
+    case 5:
+      r.skip_bytes();
+      break;
+    default:
+      r.ok = false;
+  }
+}
+
+// Read one numeric value of kind k ("has" reports null).
+double read_numeric_kind(Reader& r, int32_t k, bool* has) {
+  *has = true;
+  switch (k) {
+    case 0:
+      *has = false;
+      return 0.0;
+    case 1:
+      return r.read_double();
+    case 2:
+      return (double)r.read_float();
+    case 3:
+      return (double)r.read_long();
+    case 4: {
+      if (!r.need(1)) return 0.0;
+      return (double)*r.p++;
+    }
+    case 5: {
+      auto s = r.read_str();
+      if (!r.ok) return 0.0;
+      std::string tmp(s.first, (size_t)s.second);
+      char* endp = nullptr;
+      double v = std::strtod(tmp.c_str(), &endp);
+      if (endp == tmp.c_str() || *endp != '\0') r.ok = false;  // not numeric
+      return v;
+    }
+    default:
+      r.ok = false;
+      return 0.0;
+  }
+}
+
+struct Interner {
+  std::unordered_map<std::string, int32_t> map;
+  std::vector<char> bytes;
+  std::vector<int64_t> offsets{0};
+
+  int32_t intern(const std::string& key) {
+    auto it = map.find(key);
+    if (it != map.end()) return it->second;
+    int32_t id = (int32_t)offsets.size() - 1;
+    map.emplace(key, id);
+    bytes.insert(bytes.end(), key.begin(), key.end());
+    offsets.push_back((int64_t)bytes.size());
+    return id;
+  }
+};
+
+struct Bag {
+  std::vector<int64_t> indptr{0};
+  std::vector<int32_t> keys;
+  std::vector<float> vals;
+};
+
+struct Result {
+  std::vector<double> labels, offsets, weights;
+  std::vector<Bag> bags;
+  Interner keys;
+  Interner tag_vals;
+  std::vector<int32_t> tag_ids;  // n_records * n_tags, -1 = absent
+};
+
+// One feature-array item; appends (key id, value) to the bag.
+void decode_feature_item(Reader& r, const int32_t* fops, int n_fops,
+                         const std::string& delim, Result& out, Bag& bag,
+                         std::string& keybuf) {
+  keybuf.clear();
+  double value = 0.0;
+  for (int f = 0; f < n_fops && r.ok; ++f) {
+    switch (fops[f]) {
+      case 20: {
+        auto s = r.read_str();
+        if (r.ok) keybuf.assign(s.first, (size_t)s.second);
+        break;
+      }
+      case 21: {  // FTERM union
+        int nb = fops[++f];
+        int64_t br = r.read_long();
+        if (br < 0 || br >= nb) {
+          r.ok = false;
+          break;
+        }
+        int32_t k = fops[f + 1 + (int)br];
+        if (k == 1) {
+          auto s = r.read_str();
+          // feature_key(name, term): empty/null term leaves the bare name.
+          if (r.ok && s.second > 0) {
+            keybuf += delim;
+            keybuf.append(s.first, (size_t)s.second);
+          }
+        } else if (k != 0) {
+          r.ok = false;
+        }
+        f += nb;
+        break;
+      }
+      case 22: {  // FTERM plain string
+        auto s = r.read_str();
+        if (r.ok && s.second > 0) {
+          keybuf += delim;
+          keybuf.append(s.first, (size_t)s.second);
+        }
+        break;
+      }
+      case 23: {  // FVALUE union
+        int nb = fops[++f];
+        int64_t br = r.read_long();
+        if (br < 0 || br >= nb) {
+          r.ok = false;
+          break;
+        }
+        bool has;
+        value = read_numeric_kind(r, fops[f + 1 + (int)br], &has);
+        if (!has) r.ok = false;  // Python float(None) raises; stay identical
+        f += nb;
+        break;
+      }
+      case 24: {
+        bool has;
+        value = read_numeric_kind(r, fops[++f], &has);
+        if (!has) r.ok = false;
+        break;
+      }
+      case 7: {
+        int nb = fops[++f];
+        int64_t br = r.read_long();
+        if (br < 0 || br >= nb) {
+          r.ok = false;
+          break;
+        }
+        skip_kind(r, fops[f + 1 + (int)br]);
+        f += nb;
+        break;
+      }
+      case 8:
+        skip_kind(r, fops[++f]);
+        break;
+      default:
+        r.ok = false;
+    }
+  }
+  if (r.ok) {
+    bag.keys.push_back(out.keys.intern(keybuf));
+    bag.vals.push_back((float)value);
+  }
+}
+
+bool decode_block(Reader& r, int64_t count, const int32_t* rops, int n_rops,
+                  const int32_t* fops, int n_fops,
+                  const std::vector<std::string>& tag_names, int n_meta_tags,
+                  const std::string& delim, Result& out) {
+  const int n_tags = (int)tag_names.size();
+  std::string keybuf;
+  for (int64_t rec = 0; rec < count && r.ok; ++rec) {
+    out.labels.push_back(0.0);
+    out.offsets.push_back(0.0);
+    out.weights.push_back(1.0);
+    size_t tag_base = out.tag_ids.size();
+    out.tag_ids.resize(tag_base + n_tags, -1);
+    for (int i = 0; i < n_rops && r.ok; ++i) {
+      switch (rops[i]) {
+        case 1:
+        case 2: {
+          bool is_union = rops[i] == 1;
+          int target = rops[++i];
+          int32_t k;
+          int nb = 1;
+          if (is_union) {
+            nb = rops[++i];
+            int64_t br = r.read_long();
+            if (br < 0 || br >= nb) {
+              r.ok = false;
+              break;
+            }
+            k = rops[i + 1 + (int)br];
+            i += nb;
+          } else {
+            k = rops[++i];
+          }
+          bool has;
+          double v = read_numeric_kind(r, k, &has);
+          if (r.ok && has) {
+            if (target == 1)
+              out.labels.back() = v;
+            else if (target == 2)
+              out.offsets.back() = v;
+            else
+              out.weights.back() = v;
+          }
+          break;
+        }
+        case 3:
+        case 4: {
+          bool is_union = rops[i] == 3;
+          int slot = rops[++i];
+          int32_t k;
+          if (is_union) {
+            int nb = rops[++i];
+            int64_t br = r.read_long();
+            if (br < 0 || br >= nb) {
+              r.ok = false;
+              break;
+            }
+            k = rops[i + 1 + (int)br];
+            i += nb;
+          } else {
+            k = rops[++i];
+          }
+          if (k == 1) {
+            auto s = r.read_str();
+            if (r.ok)
+              out.tag_ids[tag_base + slot] =
+                  out.tag_vals.intern(std::string(s.first, (size_t)s.second));
+          } else if (k == 3) {
+            char buf[24];
+            std::snprintf(buf, sizeof buf, "%lld", (long long)r.read_long());
+            if (r.ok) out.tag_ids[tag_base + slot] = out.tag_vals.intern(buf);
+          } else if (k != 0) {
+            r.ok = false;
+          }
+          break;
+        }
+        case 5: {
+          int bag_slot = rops[++i];
+          int nullable = rops[++i];
+          if (nullable && r.read_long() != 1) break;
+          Bag& bag = out.bags[bag_slot];
+          for (int64_t n = r.read_long(); n != 0 && r.ok; n = r.read_long()) {
+            if (n < 0) {
+              r.read_long();
+              n = -n;
+            }
+            for (int64_t j = 0; j < n && r.ok; ++j)
+              decode_feature_item(r, fops, n_fops, delim, out, bag, keybuf);
+          }
+          break;
+        }
+        case 6: {
+          int nullable = rops[++i];
+          if (nullable && r.read_long() != 1) break;
+          for (int64_t n = r.read_long(); n != 0 && r.ok; n = r.read_long()) {
+            if (n < 0) {
+              r.read_long();
+              n = -n;
+            }
+            for (int64_t j = 0; j < n && r.ok; ++j) {
+              auto k = r.read_str();
+              auto v = r.read_str();
+              if (!r.ok) continue;
+              for (int t = 0; t < n_meta_tags; ++t) {
+                if (out.tag_ids[tag_base + t] == -1 &&
+                    (int64_t)tag_names[t].size() == k.second &&
+                    std::memcmp(tag_names[t].data(), k.first, k.second) == 0) {
+                  out.tag_ids[tag_base + t] = out.tag_vals.intern(
+                      std::string(v.first, (size_t)v.second));
+                }
+              }
+            }
+          }
+          break;
+        }
+        case 7: {
+          int nb = rops[++i];
+          int64_t br = r.read_long();
+          if (br < 0 || br >= nb) {
+            r.ok = false;
+            break;
+          }
+          skip_kind(r, rops[i + 1 + (int)br]);
+          i += nb;
+          break;
+        }
+        case 8:
+          skip_kind(r, rops[++i]);
+          break;
+        case 9: {
+          int nullable = rops[++i];
+          int nvk = rops[++i];
+          const int32_t* vkinds = rops + i + 1;
+          i += nvk;
+          if (nullable && r.read_long() != 1) break;
+          for (int64_t n = r.read_long(); n != 0 && r.ok; n = r.read_long()) {
+            if (n < 0) {
+              r.read_long();
+              n = -n;
+            }
+            for (int64_t j = 0; j < n && r.ok; ++j) {
+              r.skip_bytes();  // key string
+              int32_t k;
+              if (nvk > 1) {
+                int64_t br = r.read_long();
+                if (br < 0 || br >= nvk) {
+                  r.ok = false;
+                  break;
+                }
+                k = vkinds[br];
+              } else {
+                k = vkinds[0];
+              }
+              skip_kind(r, k);
+            }
+          }
+          break;
+        }
+        case 10: {
+          int nullable = rops[++i];
+          int n_sub = rops[++i];
+          const int32_t* sub = rops + i + 1;
+          i += n_sub;
+          if (nullable && r.read_long() != 1) break;
+          for (int64_t n = r.read_long(); n != 0 && r.ok; n = r.read_long()) {
+            if (n < 0) {
+              r.read_long();
+              n = -n;
+            }
+            for (int64_t j = 0; j < n && r.ok; ++j) {
+              for (int f = 0; f < n_sub && r.ok; ++f) {
+                if (sub[f] == 8) {
+                  skip_kind(r, sub[++f]);
+                } else if (sub[f] == 7) {
+                  int nb = sub[++f];
+                  int64_t br = r.read_long();
+                  if (br < 0 || br >= nb) {
+                    r.ok = false;
+                    break;
+                  }
+                  skip_kind(r, sub[f + 1 + (int)br]);
+                  f += nb;
+                } else {
+                  r.ok = false;
+                }
+              }
+            }
+          }
+          break;
+        }
+        default:
+          r.ok = false;
+      }
+    }
+    for (auto& bag : out.bags) bag.indptr.push_back((int64_t)bag.keys.size());
+  }
+  return r.ok;
+}
+
+bool inflate_raw(const uint8_t* src, size_t n, std::vector<uint8_t>& out) {
+  z_stream zs;
+  std::memset(&zs, 0, sizeof zs);
+  if (inflateInit2(&zs, -15) != Z_OK) return false;
+  zs.next_in = const_cast<uint8_t*>(src);
+  zs.avail_in = (uInt)n;
+  out.resize(n * 4 + 4096);
+  size_t written = 0;
+  int rc;
+  do {
+    if (written == out.size()) out.resize(out.size() * 2);
+    zs.next_out = out.data() + written;
+    zs.avail_out = (uInt)(out.size() - written);
+    rc = inflate(&zs, Z_NO_FLUSH);
+    written = out.size() - zs.avail_out;
+  } while (rc == Z_OK);
+  inflateEnd(&zs);
+  if (rc != Z_STREAM_END) return false;
+  out.resize(written);
+  return true;
+}
+
+struct CResult {
+  int64_t n_records;
+  double* labels;
+  double* offsets;
+  double* weights;
+  int32_t n_bags;
+  int64_t** bag_indptr;
+  int32_t** bag_keys;
+  float** bag_vals;
+  int64_t* bag_nnz;
+  int64_t n_keys;
+  char* key_bytes;
+  int64_t* key_offsets;
+  int32_t n_tags;
+  int32_t* tag_ids;
+  int64_t n_tag_vals;
+  char* tag_val_bytes;
+  int64_t* tag_val_offsets;
+};
+
+template <typename T>
+T* steal(std::vector<T>& v) {
+  T* out = (T*)std::malloc(v.size() * sizeof(T) + 1);
+  std::memcpy(out, v.data(), v.size() * sizeof(T));
+  return out;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Decode `data` (a whole container file already read into memory).
+// codec: 0 = null, 1 = deflate. Returns a malloc'd CResult* or nullptr on
+// any structural error (caller falls back to the Python codec).
+void* photon_avro_decode(const uint8_t* data, int64_t data_len,
+                         int64_t body_start, int32_t codec,
+                         const uint8_t* sync, const int32_t* rops,
+                         int32_t n_rops, const int32_t* fops, int32_t n_fops,
+                         int32_t n_bags, const char* tag_names_joined,
+                         int32_t n_tags, int32_t n_meta_tags,
+                         const char* delim) {
+  Result res;
+  res.bags.resize(n_bags);
+  std::vector<std::string> tag_names;
+  {
+    const char* s = tag_names_joined;
+    for (int i = 0; i < n_tags; ++i) {
+      size_t n = std::strlen(s);
+      tag_names.emplace_back(s, n);
+      s += n + 1;
+    }
+  }
+  Reader file{data + body_start, data + data_len};
+  std::vector<uint8_t> scratch;
+  while (file.ok && file.p < file.end) {
+    int64_t count = file.read_long();
+    int64_t size = file.read_long();
+    if (!file.ok || size < 0 || !file.need((size_t)size + 16)) return nullptr;
+    const uint8_t* block = file.p;
+    file.p += size;
+    if (std::memcmp(file.p, sync, 16) != 0) return nullptr;
+    file.p += 16;
+    Reader r{block, block + size};
+    if (codec == 1) {
+      if (!inflate_raw(block, (size_t)size, scratch)) return nullptr;
+      r = Reader{scratch.data(), scratch.data() + scratch.size()};
+    }
+    if (!decode_block(r, count, rops, n_rops, fops, n_fops, tag_names,
+                      n_meta_tags, delim, res))
+      return nullptr;
+    if (r.p != r.end) return nullptr;  // trailing bytes = mis-decoded block
+  }
+  if (!file.ok) return nullptr;
+
+  CResult* c = (CResult*)std::calloc(1, sizeof(CResult));
+  c->n_records = (int64_t)res.labels.size();
+  c->labels = steal(res.labels);
+  c->offsets = steal(res.offsets);
+  c->weights = steal(res.weights);
+  c->n_bags = n_bags;
+  c->bag_indptr = (int64_t**)std::malloc(sizeof(void*) * n_bags + 1);
+  c->bag_keys = (int32_t**)std::malloc(sizeof(void*) * n_bags + 1);
+  c->bag_vals = (float**)std::malloc(sizeof(void*) * n_bags + 1);
+  c->bag_nnz = (int64_t*)std::malloc(sizeof(int64_t) * n_bags + 1);
+  for (int b = 0; b < n_bags; ++b) {
+    c->bag_indptr[b] = steal(res.bags[b].indptr);
+    c->bag_keys[b] = steal(res.bags[b].keys);
+    c->bag_vals[b] = steal(res.bags[b].vals);
+    c->bag_nnz[b] = (int64_t)res.bags[b].keys.size();
+  }
+  c->n_keys = (int64_t)res.keys.offsets.size() - 1;
+  c->key_bytes = steal(res.keys.bytes);
+  c->key_offsets = steal(res.keys.offsets);
+  c->n_tags = n_tags;
+  c->tag_ids = steal(res.tag_ids);
+  c->n_tag_vals = (int64_t)res.tag_vals.offsets.size() - 1;
+  c->tag_val_bytes = steal(res.tag_vals.bytes);
+  c->tag_val_offsets = steal(res.tag_vals.offsets);
+  return c;
+}
+
+void photon_avro_free(void* ptr) {
+  if (!ptr) return;
+  CResult* c = (CResult*)ptr;
+  std::free(c->labels);
+  std::free(c->offsets);
+  std::free(c->weights);
+  for (int b = 0; b < c->n_bags; ++b) {
+    std::free(c->bag_indptr[b]);
+    std::free(c->bag_keys[b]);
+    std::free(c->bag_vals[b]);
+  }
+  std::free(c->bag_indptr);
+  std::free(c->bag_keys);
+  std::free(c->bag_vals);
+  std::free(c->bag_nnz);
+  std::free(c->key_bytes);
+  std::free(c->key_offsets);
+  std::free(c->tag_ids);
+  std::free(c->tag_val_bytes);
+  std::free(c->tag_val_offsets);
+  std::free(c);
+}
+
+}  // extern "C"

@@ -1,0 +1,57 @@
+// Native bucketed-layout packer (see photon_ml_tpu/data/bucketed.py).
+//
+// The pure-numpy pack of the TPU sparse layout costs a radix argsort plus
+// three random-access gather/scatter passes over the entry arrays (~45-90 s
+// at 67M entries under load); this is the same computation as a two-pass
+// counting sort: histogram segment sizes, prefix-sum, then place each entry
+// directly into its (segment, position) slot or append it to the spill list.
+// Two linear passes over the input, one scattered write per entry.
+//
+// Counterpart in spirit of the reference's executor-parallel ingest path
+// (photon-client data/avro/AvroDataReader.scala:85-220): layout preparation
+// is host-native work the accelerator should never wait on.
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+extern "C" {
+
+// rows/cols: int32 entry coordinates; vals: float values; nnz entries.
+// tile_shift: log2(tile_rows). bucket ids are cols >> 7 (BUCKET = 128).
+// n_buckets = ceil(dim / 128); n_seg = n_tiles * n_buckets.
+// out_packed/out_values: zero-initialized n_seg * sp slots (row-major by
+// segment). spill_out: capacity nnz entry indices; returns spill count.
+// Returns -1 on invalid arguments.
+int64_t photon_pack_level(const int32_t* rows, const int32_t* cols,
+                          const float* vals, int64_t nnz, int64_t n_tiles,
+                          int64_t n_buckets, int32_t tile_shift, int64_t sp,
+                          int32_t* out_packed, float* out_values,
+                          int64_t* spill_out) {
+  if (nnz < 0 || n_tiles <= 0 || n_buckets <= 0 || sp <= 0 || tile_shift < 0)
+    return -1;
+  const int64_t n_seg = n_tiles * n_buckets;
+  const int32_t row_mask = (1 << tile_shift) - 1;
+
+  // One placement pass: cursor tracks each segment's fill level, which both
+  // assigns positions and detects overflow (entries keep input order within
+  // a segment, matching the numpy stable sort).
+  std::vector<int64_t> cursor(n_seg, 0);
+  int64_t n_spill = 0;
+  for (int64_t i = 0; i < nnz; ++i) {
+    const int32_t r = rows[i];
+    const int32_t c = cols[i];
+    const int64_t seg = (int64_t)(r >> tile_shift) * n_buckets + (c >> 7);
+    const int64_t pos = cursor[seg]++;
+    if (pos < sp) {
+      const int64_t slot = seg * sp + pos;
+      out_packed[slot] = ((r & row_mask) << 7) | (c & 127);
+      out_values[slot] = vals[i];
+    } else {
+      spill_out[n_spill++] = i;
+    }
+  }
+  return n_spill;
+}
+
+}  // extern "C"

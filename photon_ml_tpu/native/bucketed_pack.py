@@ -1,0 +1,85 @@
+"""ctypes binding for the native bucketed-layout packer (bucketed_pack.cc).
+
+`pack_level_native` mirrors the hot part of data/bucketed._pack_level: place
+COO entries into fixed-width (tile, bucket) segments, spilling overflow. The
+numpy path stays as the no-compiler fallback and as the semantics oracle
+(tests assert identical layouts)."""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Optional, Tuple
+
+import numpy as np
+
+from photon_ml_tpu.native.build import load_native
+
+_CONFIGURED = False
+
+
+def _lib() -> Optional[ctypes.CDLL]:
+    global _CONFIGURED
+    lib = load_native()
+    if lib is None:
+        return None
+    if not _CONFIGURED:
+        lib.photon_pack_level.restype = ctypes.c_int64
+        lib.photon_pack_level.argtypes = [
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.c_int32,
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        _CONFIGURED = True
+    return lib
+
+
+def _ptr(a: np.ndarray, ctype):
+    return a.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def pack_level_native(
+    rows: np.ndarray,
+    cols: np.ndarray,
+    vals: np.ndarray,
+    n_tiles: int,
+    n_buckets: int,
+    tile_shift: int,
+    sp: int,
+) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+    """Returns (packed (n_seg*sp,) i32, values (n_seg*sp,) f32,
+    spill entry indices) or None when the native library is unavailable."""
+    lib = _lib()
+    if lib is None:
+        return None
+    rows32 = np.ascontiguousarray(rows, np.int32)
+    cols32 = np.ascontiguousarray(cols, np.int32)
+    vals32 = np.ascontiguousarray(vals, np.float32)
+    nnz = len(vals32)
+    n_seg = n_tiles * n_buckets
+    packed = np.zeros(n_seg * sp, np.int32)
+    values = np.zeros(n_seg * sp, np.float32)
+    spill = np.empty(nnz, np.int64)
+    n_spill = lib.photon_pack_level(
+        _ptr(rows32, ctypes.c_int32),
+        _ptr(cols32, ctypes.c_int32),
+        _ptr(vals32, ctypes.c_float),
+        nnz,
+        n_tiles,
+        n_buckets,
+        tile_shift,
+        sp,
+        _ptr(packed, ctypes.c_int32),
+        _ptr(values, ctypes.c_float),
+        _ptr(spill, ctypes.c_int64),
+    )
+    if n_spill < 0:
+        return None
+    return packed, values, spill[:n_spill]

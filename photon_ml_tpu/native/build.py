@@ -22,7 +22,7 @@ import threading
 from typing import Optional
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SOURCES = ["index_store.cc", "libsvm_parser.cc"]
+_SOURCES = ["index_store.cc", "libsvm_parser.cc", "bucketed_pack.cc", "avro_reader.cc"]
 _LOCK = threading.RLock()  # reentrant: load_native holds it across
 # native_library_path so concurrent first calls cannot race past a
 # half-initialized handle
@@ -68,7 +68,7 @@ def native_library_path() -> Optional[str]:
                 "-fPIC",
                 "-o",
                 tmp,
-            ] + [os.path.join(_DIR, s) for s in _SOURCES]
+            ] + [os.path.join(_DIR, s) for s in _SOURCES] + ["-lz"]
             subprocess.run(
                 cmd, check=True, capture_output=True, timeout=120
             )

@@ -1,0 +1,169 @@
+"""Native Avro block decoder + native bucketed packer: parity vs the pure
+Python implementations on generated data and the reference's own fixtures
+(DriverIntegTest heart.avro, GameIntegTest yahoo-music-train.avro)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import photon_ml_tpu.io.avro_data as ad
+from photon_ml_tpu.io import avro_fast
+from photon_ml_tpu.native.build import load_native
+
+REF = "/root/reference/photon-client/src/integTest/resources"
+DRIVER_IN = os.path.join(REF, "DriverIntegTest/input")
+GAME_IN = os.path.join(REF, "GameIntegTest/input")
+
+needs_native = pytest.mark.skipif(
+    load_native() is None, reason="native library unavailable"
+)
+
+
+def _dense(ds, shard, size):
+    sp = ds.shards[shard]
+    n = ds.num_samples
+    M = np.zeros((n, size))
+    idx, val = np.asarray(sp.indices), np.asarray(sp.values)
+    np.add.at(M, (np.repeat(np.arange(n), idx.shape[1]), idx.ravel()), val.ravel())
+    return M
+
+
+def _assert_parity(path, cfgs, tags=()):
+    cols = ad.InputColumnNames()
+    fast = avro_fast.try_read_native([path], cfgs, None, list(tags), cols, ad.LABEL)
+    assert fast is not None, "native decoder fell back on a supported fixture"
+    ds_n, maps_n = fast
+    os.environ["PHOTON_DISABLE_NATIVE"] = "1"
+    try:
+        ds_p, maps_p = ad.read_game_dataset(path, cfgs, id_tag_fields=list(tags))
+    finally:
+        del os.environ["PHOTON_DISABLE_NATIVE"]
+    assert ds_n.num_samples == ds_p.num_samples
+    for k in ("labels", "offsets", "weights"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(ds_n, k)), np.asarray(getattr(ds_p, k)), err_msg=k
+        )
+    assert set(ds_n.id_tags) == set(ds_p.id_tags)
+    for t in ds_p.id_tags:
+        assert np.array_equal(ds_n.id_tags[t], ds_p.id_tags[t]), t
+    for shard in cfgs:
+        assert maps_n[shard].size == maps_p[shard].size
+        np.testing.assert_allclose(
+            _dense(ds_n, shard, maps_n[shard].size),
+            _dense(ds_p, shard, maps_p[shard].size),
+        )
+
+
+@needs_native
+class TestReferenceFixtureParity:
+    def test_heart(self):
+        _assert_parity(
+            os.path.join(DRIVER_IN, "heart.avro"),
+            {"g": ad.FeatureShardConfig(("features",), True)},
+        )
+
+    def test_heart_validation(self):
+        _assert_parity(
+            os.path.join(DRIVER_IN, "heart_validation.avro"),
+            {"g": ad.FeatureShardConfig(("features",), True)},
+        )
+
+    def test_yahoo_music_multi_shard_with_tags(self):
+        import glob
+
+        ym = glob.glob(GAME_IN + "/**/yahoo-music-train.avro", recursive=True)
+        assert ym
+        _assert_parity(
+            ym[0],
+            {
+                "g": ad.FeatureShardConfig(("features",), True),
+                "s": ad.FeatureShardConfig(("songFeatures",), True),
+                "u": ad.FeatureShardConfig(("userFeatures",), False),
+            },
+            tags=("userId", "songId"),
+        )
+
+
+@needs_native
+class TestGeneratedParity:
+    def test_roundtrip_with_tags_offsets_weights(self, tmp_path):
+        rng = np.random.default_rng(0)
+        n, d = 700, 80
+        feats = [
+            [(f"f{j}", float(rng.normal())) for j in rng.choice(d, size=6, replace=False)]
+            for _ in range(n)
+        ]
+        labels = (rng.uniform(size=n) > 0.5).astype(float)
+        p = str(tmp_path / "t.avro")
+        ad.write_training_examples(
+            p,
+            feats,
+            labels,
+            offsets=rng.normal(size=n) * 0.1,
+            weights=rng.uniform(0.5, 1.5, size=n),
+            uids=[f"u{i}" for i in range(n)],
+            id_tags={"entityId": rng.integers(0, 9, size=n)},
+        )
+        _assert_parity(
+            p, {"g": ad.FeatureShardConfig(("features",), True)}, tags=("entityId",)
+        )
+
+    def test_supplied_index_map_drops_unseen(self, tmp_path):
+        from photon_ml_tpu.data.index_map import IndexMap
+
+        rng = np.random.default_rng(1)
+        n = 100
+        feats = [[(f"f{i % 7}", 1.0), (f"g{i % 5}", 2.0)] for i in range(n)]
+        p = str(tmp_path / "t.avro")
+        ad.write_training_examples(p, feats, np.zeros(n))
+        imap = IndexMap.from_feature_names({f"f{i}" for i in range(7)}, add_intercept=True)
+        cfgs = {"g": ad.FeatureShardConfig(("features",), True)}
+        cols = ad.InputColumnNames()
+        fast = avro_fast.try_read_native([p], cfgs, {"g": imap}, [], cols, ad.LABEL)
+        assert fast is not None
+        ds_n, maps_n = fast
+        os.environ["PHOTON_DISABLE_NATIVE"] = "1"
+        try:
+            ds_p, maps_p = ad.read_game_dataset(p, cfgs, index_maps={"g": imap})
+        finally:
+            del os.environ["PHOTON_DISABLE_NATIVE"]
+        np.testing.assert_allclose(
+            _dense(ds_n, "g", imap.size), _dense(ds_p, "g", imap.size)
+        )
+
+    def test_falls_back_on_dotted_tags(self, tmp_path):
+        rng = np.random.default_rng(2)
+        n = 20
+        p = str(tmp_path / "t.avro")
+        ad.write_training_examples(p, [[("f0", 1.0)]] * n, np.zeros(n))
+        cols = ad.InputColumnNames()
+        cfgs = {"g": ad.FeatureShardConfig(("features",), True)}
+        assert (
+            avro_fast.try_read_native([p], cfgs, None, ["ids.member"], cols, ad.LABEL)
+            is None
+        )
+
+
+@needs_native
+class TestNativePacker:
+    def test_bit_identical_to_numpy(self):
+        from photon_ml_tpu.data.bucketed import pack_bucketed, to_coo
+
+        rng = np.random.default_rng(3)
+        nnz = 300_000
+        rows = np.repeat(np.arange(nnz // 10, dtype=np.int64), 10)
+        cols = rng.integers(0, 3000, size=nnz)
+        cols[: nnz // 20] = 7  # hot feature: exercise spill
+        vals = rng.normal(size=nnz).astype(np.float32)
+        bf_n = pack_bucketed(rows, cols, vals, nnz // 10, 3000)
+        os.environ["PHOTON_DISABLE_NATIVE"] = "1"
+        try:
+            bf_p = pack_bucketed(rows, cols, vals, nnz // 10, 3000)
+        finally:
+            del os.environ["PHOTON_DISABLE_NATIVE"]
+        for a, b in zip(to_coo(bf_n), to_coo(bf_p)):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+        np.testing.assert_array_equal(
+            np.asarray(bf_n.level1.packed), np.asarray(bf_p.level1.packed)
+        )
