@@ -114,6 +114,13 @@ class FixedEffectCoordinate:
             bf = pallas_sparse.maybe_pack(feats, dataset.num_samples)
             if bf is not None:
                 self._features = bf
+                # The bucketed repack succeeded, so the objective's fused
+                # sparse gate (objective.value_and_gradient: `use_pallas is
+                # not False and isinstance(..., BucketedSparseFeatures)`)
+                # must be allowed to engage: None = auto.  False stays the
+                # caller's genuine escape hatch for shards where the pack was
+                # declined and the ELL/XLA composition is the right path.
+                self._use_pallas = None
         self._build_jits()
 
     def _build_jits(self) -> None:

@@ -108,6 +108,25 @@ struct Reader {
   }
 };
 
+// Array/map block count. A negative count encodes (-count, byte-size) per
+// the Avro spec. INT64_MIN cannot be negated (signed-overflow UB), and a
+// count exceeding the remaining bytes is structurally impossible (every
+// item is at least one byte) — both abort the decode so the caller falls
+// back to the Python codec, which raises its own structured error.
+int64_t read_block_count(Reader& r) {
+  int64_t n = r.read_long();
+  if (n < 0) {
+    if (n == INT64_MIN) {
+      r.ok = false;
+      return 0;
+    }
+    r.read_long();  // byte size of the block, unused on this path
+    n = -n;
+  }
+  if (n > (int64_t)(r.end - r.p)) r.ok = false;
+  return r.ok ? n : 0;
+}
+
 // Skip one value of numeric/skip kind k.
 void skip_kind(Reader& r, int32_t k) {
   switch (k) {
@@ -360,11 +379,8 @@ bool decode_block(Reader& r, int64_t count, const int32_t* rops, int n_rops,
           int nullable = rops[++i];
           if (nullable && r.read_long() != 1) break;
           Bag& bag = out.bags[bag_slot];
-          for (int64_t n = r.read_long(); n != 0 && r.ok; n = r.read_long()) {
-            if (n < 0) {
-              r.read_long();
-              n = -n;
-            }
+          for (int64_t n = read_block_count(r); n != 0 && r.ok;
+               n = read_block_count(r)) {
             for (int64_t j = 0; j < n && r.ok; ++j)
               decode_feature_item(r, fops, n_fops, delim, out, bag, keybuf);
           }
@@ -373,11 +389,8 @@ bool decode_block(Reader& r, int64_t count, const int32_t* rops, int n_rops,
         case 6: {
           int nullable = rops[++i];
           if (nullable && r.read_long() != 1) break;
-          for (int64_t n = r.read_long(); n != 0 && r.ok; n = r.read_long()) {
-            if (n < 0) {
-              r.read_long();
-              n = -n;
-            }
+          for (int64_t n = read_block_count(r); n != 0 && r.ok;
+               n = read_block_count(r)) {
             for (int64_t j = 0; j < n && r.ok; ++j) {
               auto k = r.read_str();
               auto v = r.read_str();
@@ -414,11 +427,8 @@ bool decode_block(Reader& r, int64_t count, const int32_t* rops, int n_rops,
           const int32_t* vkinds = rops + i + 1;
           i += nvk;
           if (nullable && r.read_long() != 1) break;
-          for (int64_t n = r.read_long(); n != 0 && r.ok; n = r.read_long()) {
-            if (n < 0) {
-              r.read_long();
-              n = -n;
-            }
+          for (int64_t n = read_block_count(r); n != 0 && r.ok;
+               n = read_block_count(r)) {
             for (int64_t j = 0; j < n && r.ok; ++j) {
               r.skip_bytes();  // key string
               int32_t k;
@@ -443,11 +453,8 @@ bool decode_block(Reader& r, int64_t count, const int32_t* rops, int n_rops,
           const int32_t* sub = rops + i + 1;
           i += n_sub;
           if (nullable && r.read_long() != 1) break;
-          for (int64_t n = r.read_long(); n != 0 && r.ok; n = r.read_long()) {
-            if (n < 0) {
-              r.read_long();
-              n = -n;
-            }
+          for (int64_t n = read_block_count(r); n != 0 && r.ok;
+               n = read_block_count(r)) {
             for (int64_t j = 0; j < n && r.ok; ++j) {
               for (int f = 0; f < n_sub && r.ok; ++f) {
                 if (sub[f] == 8) {
@@ -520,9 +527,18 @@ struct CResult {
   int64_t* tag_val_offsets;
 };
 
+// malloc can fail on huge malformed inputs (a corrupted count that survived
+// the structural checks); every allocation is checked and failure unwinds
+// through photon_avro_free so the caller falls back to the Python codec
+// instead of dereferencing null.
 template <typename T>
-T* steal(std::vector<T>& v) {
+T* steal(std::vector<T>& v, bool& ok) {
+  if (!ok) return nullptr;  // a prior failure: skip further large allocations
   T* out = (T*)std::malloc(v.size() * sizeof(T) + 1);
+  if (!out) {
+    ok = false;
+    return nullptr;
+  }
   std::memcpy(out, v.data(), v.size() * sizeof(T));
   return out;
 }
@@ -530,6 +546,8 @@ T* steal(std::vector<T>& v) {
 }  // namespace
 
 extern "C" {
+
+void photon_avro_free(void* ptr);
 
 // Decode `data` (a whole container file already read into memory).
 // codec: 0 = null, 1 = deflate. Returns a malloc'd CResult* or nullptr on
@@ -575,29 +593,36 @@ void* photon_avro_decode(const uint8_t* data, int64_t data_len,
   if (!file.ok) return nullptr;
 
   CResult* c = (CResult*)std::calloc(1, sizeof(CResult));
+  if (!c) return nullptr;
+  bool ok = true;
   c->n_records = (int64_t)res.labels.size();
-  c->labels = steal(res.labels);
-  c->offsets = steal(res.offsets);
-  c->weights = steal(res.weights);
+  c->labels = steal(res.labels, ok);
+  c->offsets = steal(res.offsets, ok);
+  c->weights = steal(res.weights, ok);
   c->n_bags = n_bags;
-  c->bag_indptr = (int64_t**)std::malloc(sizeof(void*) * n_bags + 1);
-  c->bag_keys = (int32_t**)std::malloc(sizeof(void*) * n_bags + 1);
-  c->bag_vals = (float**)std::malloc(sizeof(void*) * n_bags + 1);
-  c->bag_nnz = (int64_t*)std::malloc(sizeof(int64_t) * n_bags + 1);
-  for (int b = 0; b < n_bags; ++b) {
-    c->bag_indptr[b] = steal(res.bags[b].indptr);
-    c->bag_keys[b] = steal(res.bags[b].keys);
-    c->bag_vals[b] = steal(res.bags[b].vals);
+  c->bag_indptr = (int64_t**)std::calloc(n_bags + 1, sizeof(void*));
+  c->bag_keys = (int32_t**)std::calloc(n_bags + 1, sizeof(void*));
+  c->bag_vals = (float**)std::calloc(n_bags + 1, sizeof(void*));
+  c->bag_nnz = (int64_t*)std::calloc(n_bags + 1, sizeof(int64_t));
+  if (!c->bag_indptr || !c->bag_keys || !c->bag_vals || !c->bag_nnz) ok = false;
+  for (int b = 0; ok && b < n_bags; ++b) {
+    c->bag_indptr[b] = steal(res.bags[b].indptr, ok);
+    c->bag_keys[b] = steal(res.bags[b].keys, ok);
+    c->bag_vals[b] = steal(res.bags[b].vals, ok);
     c->bag_nnz[b] = (int64_t)res.bags[b].keys.size();
   }
   c->n_keys = (int64_t)res.keys.offsets.size() - 1;
-  c->key_bytes = steal(res.keys.bytes);
-  c->key_offsets = steal(res.keys.offsets);
+  c->key_bytes = steal(res.keys.bytes, ok);
+  c->key_offsets = steal(res.keys.offsets, ok);
   c->n_tags = n_tags;
-  c->tag_ids = steal(res.tag_ids);
+  c->tag_ids = steal(res.tag_ids, ok);
   c->n_tag_vals = (int64_t)res.tag_vals.offsets.size() - 1;
-  c->tag_val_bytes = steal(res.tag_vals.bytes);
-  c->tag_val_offsets = steal(res.tag_vals.offsets);
+  c->tag_val_bytes = steal(res.tag_vals.bytes, ok);
+  c->tag_val_offsets = steal(res.tag_vals.offsets, ok);
+  if (!ok) {
+    photon_avro_free(c);
+    return nullptr;
+  }
   return c;
 }
 
@@ -608,9 +633,9 @@ void photon_avro_free(void* ptr) {
   std::free(c->offsets);
   std::free(c->weights);
   for (int b = 0; b < c->n_bags; ++b) {
-    std::free(c->bag_indptr[b]);
-    std::free(c->bag_keys[b]);
-    std::free(c->bag_vals[b]);
+    if (c->bag_indptr) std::free(c->bag_indptr[b]);
+    if (c->bag_keys) std::free(c->bag_keys[b]);
+    if (c->bag_vals) std::free(c->bag_vals[b]);
   }
   std::free(c->bag_indptr);
   std::free(c->bag_keys);

@@ -42,6 +42,12 @@ def _source_hash() -> str:
     return h.hexdigest()[:16]
 
 
+def _zlib_failure(stderr: bytes) -> bool:
+    """Did the compile/link fail because zlib is absent on this host?"""
+    s = stderr.decode("utf-8", "replace")
+    return "-lz" in s or "zlib.h" in s
+
+
 def native_library_path() -> Optional[str]:
     """Path to the compiled shared library, or None if unbuildable/disabled."""
     global _CACHED, _ATTEMPTED
@@ -53,6 +59,13 @@ def native_library_path() -> Optional[str]:
         _ATTEMPTED = True
         build_dir = os.path.join(_DIR, "_build")
         so_path = os.path.join(build_dir, f"libphoton_native-{_source_hash()}.so")
+        # The zlib-free degraded build caches under a DISTINCT name: a full
+        # build must never be masked by a cached degraded one, and a process
+        # that finds only the degraded artifact still retries the full build
+        # (cheap, and self-healing once libz appears).
+        nozlib_path = os.path.join(
+            build_dir, f"libphoton_native-{_source_hash()}-nozlib.so"
+        )
         if os.path.exists(so_path):
             _CACHED = so_path
             return _CACHED
@@ -60,21 +73,50 @@ def native_library_path() -> Optional[str]:
             os.makedirs(build_dir, exist_ok=True)
             tmp = f"{so_path}.tmp.{os.getpid()}"  # per-process: concurrent
             # first-time builds must not interleave into one tmp file
-            cmd = [
-                "g++",
-                "-O2",
-                "-std=c++17",
-                "-shared",
-                "-fPIC",
-                "-o",
-                tmp,
-            ] + [os.path.join(_DIR, s) for s in _SOURCES] + ["-lz"]
-            subprocess.run(
-                cmd, check=True, capture_output=True, timeout=120
-            )
-            os.replace(tmp, so_path)
-            _CACHED = so_path
-        except (OSError, subprocess.SubprocessError):
+
+            def _compile(sources: list[str], libs: list[str]):
+                """None on success, else captured stderr bytes."""
+                cmd = [
+                    "g++",
+                    "-O2",
+                    "-std=c++17",
+                    "-shared",
+                    "-fPIC",
+                    "-o",
+                    tmp,
+                ] + [os.path.join(_DIR, s) for s in sources] + libs
+                try:
+                    subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+                    return None
+                except subprocess.CalledProcessError as e:
+                    return e.stderr or b""
+                except (OSError, subprocess.SubprocessError):
+                    return b""
+
+            err = _compile(_SOURCES, ["-lz"])
+            if err is None:
+                os.replace(tmp, so_path)
+                _CACHED = so_path
+            elif _zlib_failure(err):
+                # Only avro_reader.cc needs zlib (deflate containers). On a
+                # host without libz, rebuild with just the zlib-free
+                # components so the index store, LibSVM parser and bucketed
+                # packer survive; the Avro binding sees the missing symbol
+                # and falls back to the Python codec. Any other failure
+                # (transient OOM, genuine compile error) caches nothing so
+                # the next process retries the full build.
+                if os.path.exists(nozlib_path):
+                    _CACHED = nozlib_path
+                elif _compile(
+                    [s for s in _SOURCES if s != "avro_reader.cc"], []
+                ) is None:
+                    os.replace(tmp, nozlib_path)
+                    _CACHED = nozlib_path
+                else:
+                    _CACHED = None
+            else:
+                _CACHED = None
+        except OSError:
             _CACHED = None
         return _CACHED
 
