@@ -150,12 +150,20 @@ def pack_csr_to_ell(
     *,
     max_nnz: Optional[int] = None,
     dtype=np.float32,
+    assume_clean: bool = False,
+    extra_col: Optional[Tuple[int, float]] = None,
 ) -> SparseFeatures:
     """Host-side CSR -> padded ELL conversion.
 
     Rows with more than `max_nnz` entries keep their largest-|value| entries
     (mirrors the spirit of the reference's active-feature filters rather than
     failing); by default max_nnz = max row length, i.e. lossless.
+
+    `assume_clean=True` asserts no (row, col) duplicates exist — callers that
+    decoded through the native reader get this per-record from the decoder
+    (avro_reader.cc check_row_dups) and skip an O(nnz log nnz) check here.
+    `extra_col=(index, value)` appends one constant dense column (the
+    intercept) host-side, avoiding a CSR rebuild + re-sort in the caller.
     """
     n = len(indptr) - 1
     indptr = np.asarray(indptr, np.int64)
@@ -165,12 +173,19 @@ def pack_csr_to_ell(
     k_full = int(row_lens.max()) if n else 0
     k = k_full if max_nnz is None else int(max_nnz)
     k = max(k, 1)
-    out_idx = np.zeros((n, k), dtype=np.int32)
-    out_val = np.zeros((n, k), dtype=dtype)
+    extra = 1 if extra_col is not None else 0
+    out_idx = np.zeros((n, k + extra), dtype=np.int32)
+    out_val = np.zeros((n, k + extra), dtype=dtype)
+    if extra_col is not None:
+        out_idx[:, k] = extra_col[0]
+        out_val[:, k] = extra_col[1]
 
     rows = np.repeat(np.arange(n, dtype=np.int64), row_lens)
-    key = rows * np.int64(dim) + indices.astype(np.int64)
-    clean = len(np.unique(key)) == len(key)  # no duplicate (row, col)
+    if assume_clean:
+        clean = True
+    else:
+        key = rows * np.int64(dim) + indices.astype(np.int64)
+        clean = len(np.unique(key)) == len(key)  # no duplicate (row, col)
     if clean and k_full <= k:
         # Fast path (the common case): one vectorized scatter preserving the
         # CSR entry order within each row.

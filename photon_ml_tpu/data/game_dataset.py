@@ -95,6 +95,19 @@ class GameDataset:
     offsets: Array
     weights: Array
     id_tags: Dict[str, np.ndarray]
+    # Host-side COO triplets per shard (rows, cols, values, dim) stashed by
+    # the ingest path. Lets the bucketed sparse pack (ops/pallas_sparse
+    # maybe_pack) run in the data plane — straight from host arrays, before
+    # any device transfer — instead of pulling device ELL arrays back to
+    # host (the reference builds its layout once at dataset construction,
+    # RandomEffectDataset.scala:229-264). Consumed (popped) by the first
+    # coordinate that packs the shard, so the triplets don't pin host RAM
+    # for the training run's lifetime. Absent for hand-built datasets.
+    host_coo: Dict[str, tuple] = dataclasses.field(default_factory=dict)
+    # Pack-once cache: the bucketed layout is a property of the shard data,
+    # so reg-weight sweeps / warm-start chains that rebuild coordinates
+    # reuse it instead of re-packing per configuration.
+    bucketed_cache: Dict[str, object] = dataclasses.field(default_factory=dict)
 
     @property
     def num_samples(self) -> int:

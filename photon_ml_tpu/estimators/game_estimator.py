@@ -363,6 +363,13 @@ class GameEstimator:
                 )
                 for cid in self.update_sequence
             }
+            if ci == 0:
+                # Every fixed-effect coordinate that wanted the ingest's
+                # host-COO stash has consumed it by now (its pack decision
+                # is cached on the dataset); shards that feed only
+                # random-effect coordinates never pop theirs — release them
+                # so the triplets don't pin host RAM for the rest of fit.
+                getattr(data, "host_coo", {}).clear()
             reg_weights = {cid: cfgs[cid].reg_weight for cid in cfgs}
 
             validation_scorer = None

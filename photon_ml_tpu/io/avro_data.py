@@ -251,6 +251,7 @@ def write_training_examples(
     weights: Optional[Sequence[float]] = None,
     uids: Optional[Sequence[str]] = None,
     id_tags: Optional[Mapping[str, Sequence]] = None,
+    codec: str = "deflate",
 ) -> int:
     """AvroDataWriter equivalent: write TrainingExampleAvro records.
 
@@ -281,4 +282,6 @@ def write_training_examples(
                 "metadataMap": meta,
             }
 
-    return avro_io.write_container(path, schemas.TRAINING_EXAMPLE, records())
+    return avro_io.write_container(
+        path, schemas.TRAINING_EXAMPLE, records(), codec=codec
+    )

@@ -12,6 +12,7 @@ reference fixtures).
 
 from __future__ import annotations
 
+import os
 from typing import Dict, List, Mapping, Optional, Sequence, Tuple
 
 import numpy as np
@@ -21,6 +22,17 @@ from photon_ml_tpu.data.game_dataset import GameDataset
 from photon_ml_tpu.data.index_map import DELIMITER, IndexMap
 from photon_ml_tpu.io import avro as avro_io
 from photon_ml_tpu.native import avro_reader
+
+
+def _stash_worthwhile(n_samples: int) -> bool:
+    """Would the data-plane bucketed pack even consider this dataset? The
+    gates live in pallas_sparse so ingest and pack cannot drift apart."""
+    try:
+        from photon_ml_tpu.ops import pallas_sparse
+
+        return pallas_sparse.pack_worth_considering(n_samples)
+    except Exception:
+        return False
 
 
 def try_read_native(
@@ -44,14 +56,42 @@ def try_read_native(
             if b not in bag_names:
                 bag_names.append(b)
 
-    decoded: List[avro_reader.DecodedFile] = []
+    # Compile one program per file from its header alone; the heavy decode
+    # then fans out across files on a thread pool — ctypes releases the GIL,
+    # and each in-file decode additionally threads over container blocks, so
+    # the TOTAL thread budget (pool width x per-file decode threads) stays
+    # within the machine/env cap (the reference reads its mapred splits
+    # executor-parallel the same way, AvroUtils.scala:47). Each task reads
+    # its own file's bytes so peak memory holds pool-width files, not all.
+    compiled = []
     tag_slots: Optional[Tuple[str, ...]] = None
     for path in files:
+        # Header only: schema + codec + sync live in the first few KB; the
+        # reader re-reads the whole file inside the decode task. A header
+        # that straddles the probe boundary can parse with a silently
+        # truncated sync marker — detect that and re-parse from the full
+        # file rather than handing a short sync buffer to the native side.
         with open(path, "rb") as f:
-            data = f.read()
+            head = f.read(1 << 20)
         try:
-            schema, codec, sync, body = avro_io.read_header(data, path)
-        except (ValueError, KeyError):
+            probe_miss = False
+            try:
+                schema, codec, sync, body = avro_io.read_header(head, path)
+                probe_miss = len(sync) != 16 or body > len(head)
+            except (ValueError, KeyError, IndexError):
+                if len(head) < (1 << 20):  # whole file read: genuinely bad
+                    return None
+                probe_miss = True
+            if probe_miss:
+                # Header straddles the probe boundary (huge schema, or a
+                # silently truncated sync marker): re-parse from the full
+                # file before giving up on the native path.
+                with open(path, "rb") as f:
+                    head = f.read()
+                schema, codec, sync, body = avro_io.read_header(head, path)
+                if len(sync) != 16:
+                    return None
+        except (ValueError, KeyError, IndexError):
             return None
         program = avro_reader.compile_program(
             schema,
@@ -70,20 +110,53 @@ def try_read_native(
             tag_slots = program.tag_slots
         elif tag_slots != program.tag_slots:
             return None
-        out = avro_reader.decode_file_native(
-            data, body, codec, sync, program, DELIMITER
+        compiled.append((path, body, codec, sync, program))
+
+    budget = avro_reader._default_threads() or (os.cpu_count() or 1)
+
+    def _decode_one(c, n_threads):
+        path, body, codec, sync, program = c
+        with open(path, "rb") as f:
+            data = f.read()
+        return avro_reader.decode_file_native(
+            data, body, codec, sync, program, DELIMITER, n_threads=n_threads
         )
-        if out is None:
-            return None
-        decoded.append(out)
+
+    if len(compiled) > 1 and budget > 1:
+        from concurrent.futures import ThreadPoolExecutor
+
+        width = min(budget, len(compiled))
+        per_file = max(1, budget // width)
+        with ThreadPoolExecutor(max_workers=width) as pool:
+            decoded = list(
+                pool.map(lambda c: _decode_one(c, per_file), compiled)
+            )
+    else:
+        decoded = [_decode_one(c, budget) for c in compiled]
+    if any(d is None for d in decoded):
+        return None
 
     # ---- concatenate files; remap per-file interned keys to global ids ----
     n = sum(len(d.labels) for d in decoded)
     if n == 0:
         return None
-    labels = np.concatenate([d.labels for d in decoded]).astype(np.float32)
-    offsets = np.concatenate([d.offsets for d in decoded]).astype(np.float32)
-    weights = np.concatenate([d.weights for d in decoded]).astype(np.float32)
+
+    def _concat(parts, empty_dtype):
+        # np.concatenate copies even for a single part; most reads are one
+        # container file, so skip the copy there.
+        if not parts:
+            return np.empty(0, empty_dtype)
+        return parts[0] if len(parts) == 1 else np.concatenate(parts)
+
+    labels = _concat([d.labels for d in decoded], np.float64).astype(
+        np.float32, copy=False
+    )
+    offsets = _concat([d.offsets for d in decoded], np.float64).astype(
+        np.float32, copy=False
+    )
+    weights = _concat([d.weights for d in decoded], np.float64).astype(
+        np.float32, copy=False
+    )
 
     global_ids: Dict[str, int] = {}
     key_list: List[str] = []
@@ -99,7 +172,8 @@ def try_read_native(
             out[i] = g
         return out
 
-    # Intern each file's key dictionary once (not once per bag).
+    # Intern each file's key dictionary once (not once per bag). The first
+    # file's local ids ARE the global ids by construction — no remap gather.
     file_l2g = [_global(d.keys) for d in decoded]
 
     bag_rows: List[np.ndarray] = []
@@ -114,15 +188,17 @@ def try_read_native(
             rows_parts.append(
                 np.repeat(np.arange(len(counts), dtype=np.int64) + row0, counts)
             )
-            keys_parts.append(
-                local_to_global[d.bag_keys[b]] if len(d.bag_keys[b]) else
-                np.empty(0, np.int64)
-            )
+            if not len(d.bag_keys[b]):
+                keys_parts.append(np.empty(0, np.int64))
+            elif fi == 0:
+                keys_parts.append(d.bag_keys[b])  # identity remap (int32 ok)
+            else:
+                keys_parts.append(local_to_global[d.bag_keys[b]])
             vals_parts.append(d.bag_vals[b])
             row0 += len(counts)
-        bag_rows.append(np.concatenate(rows_parts) if rows_parts else np.empty(0, np.int64))
-        bag_gkeys.append(np.concatenate(keys_parts) if keys_parts else np.empty(0, np.int64))
-        bag_vals.append(np.concatenate(vals_parts) if vals_parts else np.empty(0, np.float32))
+        bag_rows.append(_concat(rows_parts, np.int64))
+        bag_gkeys.append(_concat(keys_parts, np.int64))
+        bag_vals.append(_concat(vals_parts, np.float32))
 
     # ---- id tags --------------------------------------------------------
     id_tags: Dict[str, np.ndarray] = {}
@@ -148,24 +224,45 @@ def try_read_native(
     # ---- per-shard merge, index maps, ELL pack --------------------------
     built: Dict[str, IndexMap] = {}
     shards = {}
+    host_coo: Dict[str, tuple] = {}
     bag_index = {b: i for i, b in enumerate(bag_names)}
     key_arr = np.asarray(key_list, dtype=object)
     for shard, cfg in shard_configs.items():
         idxs = [bag_index[b] for b in cfg.feature_bags]
+        single_bag = len(idxs) == 1
         rows = np.concatenate([bag_rows[i] for i in idxs])
         gkeys = np.concatenate([bag_gkeys[i] for i in idxs])
         vals = np.concatenate([bag_vals[i] for i in idxs])
-        # Stable sort by record reproduces the Python path's order: bags in
-        # config order, entries in record order within each bag.
-        order = np.argsort(rows, kind="stable")
-        rows, gkeys, vals = rows[order], gkeys[order], vals[order]
+        if not single_bag:
+            # Stable sort by record reproduces the Python path's order: bags
+            # in config order, entries in record order within each bag. The
+            # single-bag case skips it — per-file segments are already in
+            # record order and file offsets increase.
+            order = np.argsort(rows, kind="stable")
+            rows, gkeys, vals = rows[order], gkeys[order], vals[order]
+        # The decoder certifies per-record key uniqueness per bag; a record
+        # can still repeat a key ACROSS bags, so the multi-bag merge keeps
+        # the duplicate check in pack_csr_to_ell.
+        clean = single_bag and not any(
+            d.bag_has_dups[idxs[0]]
+            for d in decoded
+            if len(d.bag_has_dups) > idxs[0]
+        ) and all(len(d.bag_has_dups) > idxs[0] for d in decoded)
 
+        # gids are dense interned ints, so "which keys appear in this shard"
+        # is a bincount mask and gid -> index-map id is one LUT gather — no
+        # np.unique / argsort over the nnz entries anywhere on this path.
+        present = (
+            np.bincount(gkeys, minlength=len(key_list)).astype(bool)
+            if len(gkeys)
+            else np.zeros(len(key_list), bool)
+        )
+        present_gids = np.nonzero(present)[0]
         if index_maps is not None and shard in index_maps:
             imap = index_maps[shard]
         else:
-            uniq = np.unique(gkeys) if len(gkeys) else np.empty(0, np.int64)
             imap = IndexMap.from_feature_names(
-                set(key_arr[uniq]), add_intercept=cfg.has_intercept
+                set(key_arr[present_gids]), add_intercept=cfg.has_intercept
             )
         built[shard] = imap
         intercept_idx = imap.intercept_index
@@ -175,31 +272,64 @@ def try_read_native(
                 "the index map has no intercept entry — rebuild the index "
                 "store with the intercept key or set has_intercept=False"
             )
-        # gid -> index-map id (vectorized over unique gids only).
-        uniq, inv = (
-            np.unique(gkeys, return_inverse=True)
-            if len(gkeys)
-            else (np.empty(0, np.int64), np.empty(0, np.int64))
-        )
-        uniq_idx = np.asarray(
-            [imap.get_index(k) for k in key_arr[uniq]], np.int64
-        ) if len(uniq) else np.empty(0, np.int64)
-        fidx = uniq_idx[inv] if len(gkeys) else np.empty(0, np.int64)
+        lut = np.full(len(key_list) + 1, -1, np.int64)
+        for gid in present_gids:
+            lut[gid] = imap.get_index(key_arr[gid])
+        fidx = lut[gkeys] if len(gkeys) else np.empty(0, np.int64)
         keep = fidx >= 0
-        rows_k, fidx_k, vals_k = rows[keep], fidx[keep], vals[keep]
+        if keep.all():  # no unmapped features: skip three large copies
+            rows_k, fidx_k, vals_k = rows, fidx, vals
+        else:
+            rows_k, fidx_k, vals_k = rows[keep], fidx[keep], vals[keep]
+        vals_k = vals_k.astype(np.float32, copy=False)
+        # Intercept: appended as one constant ELL column unless the data
+        # itself carries the intercept key (then the CSR rebuild + re-sort
+        # keeps the dedupe semantics of the Python path).
+        extra_col = None
         if cfg.has_intercept:
-            rows_k = np.concatenate([rows_k, np.arange(n, dtype=np.int64)])
-            fidx_k = np.concatenate([fidx_k, np.full(n, intercept_idx, np.int64)])
-            vals_k = np.concatenate([vals_k, np.ones(n, np.float32)])
-            order = np.argsort(rows_k, kind="stable")
-            rows_k, fidx_k, vals_k = rows_k[order], fidx_k[order], vals_k[order]
+            if clean and not np.any(fidx_k == intercept_idx):
+                extra_col = (intercept_idx, 1.0)
+            else:
+                rows_k = np.concatenate([rows_k, np.arange(n, dtype=np.int64)])
+                fidx_k = np.concatenate(
+                    [fidx_k, np.full(n, intercept_idx, np.int64)]
+                )
+                vals_k = np.concatenate([vals_k, np.ones(n, np.float32)])
+                order = np.argsort(rows_k, kind="stable")
+                rows_k, fidx_k, vals_k = rows_k[order], fidx_k[order], vals_k[order]
+                clean = False
         indptr = np.zeros(n + 1, np.int64)
         np.cumsum(np.bincount(rows_k, minlength=n), out=indptr[1:])
         shards[shard] = pack_csr_to_ell(
-            indptr, fidx_k, vals_k.astype(np.float32), imap.size
+            indptr,
+            fidx_k,
+            vals_k,
+            imap.size,
+            assume_clean=clean,
+            extra_col=extra_col,
         )
+        # Stash host COO triplets (entry order is irrelevant to the bucketed
+        # pack — it re-sorts by segment) so the data-plane sparse pack runs
+        # from host arrays with no device round trip. Stash only when a pack
+        # could actually engage (backend + size gates) — otherwise the
+        # triplets would pin ~20 bytes/nnz of host RAM with no consumer.
+        # The intercept column, when appended as an ELL extra_col, is
+        # appended here unsorted.
+        if _stash_worthwhile(n):
+            if extra_col is not None:
+                coo_rows = np.concatenate(
+                    [rows_k, np.arange(n, dtype=np.int64)]
+                )
+                coo_cols = np.concatenate(
+                    [fidx_k, np.full(n, intercept_idx, np.int64)]
+                )
+                coo_vals = np.concatenate([vals_k, np.ones(n, np.float32)])
+            else:
+                coo_rows, coo_cols, coo_vals = rows_k, fidx_k, vals_k
+            host_coo[shard] = (coo_rows, coo_cols, coo_vals, imap.size)
 
     ds = GameDataset.build(
         shards, labels, offsets=offsets, weights=weights, id_tags=id_tags
     )
+    ds.host_coo = host_coo
     return ds, built

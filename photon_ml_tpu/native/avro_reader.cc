@@ -9,6 +9,15 @@
 // (photon-avro-schemas, read by AvroDataReader.scala:85-220) — as a tight
 // loop over container blocks.
 //
+// Parallelism: Avro container blocks are independent (each is
+// count/size/payload/sync), so the decode fans out one worker thread per
+// contiguous span of blocks — the TPU-native stand-in for the reference's
+// executor-parallel block reads (AvroUtils.scala:47 mapred splits). Each
+// worker owns its own Result (arrays + string interners); the merge
+// concatenates workers in block order and re-interns their dictionaries, so
+// the output — including interned-id assignment order — is bit-identical to
+// a sequential decode.
+//
 // The Python side parses the schema (it owns the Avro type system) and
 // compiles it into a flat op program; this file never interprets schema
 // JSON. Anything the program cannot express falls back to the Python codec,
@@ -33,12 +42,14 @@
 
 #include <zlib.h>
 
+#include <algorithm>
+#include <atomic>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
 #include <string>
-#include <unordered_map>
+#include <thread>
 #include <vector>
 
 namespace {
@@ -56,6 +67,11 @@ struct Reader {
     return true;
   }
   int64_t read_long() {
+    // Fast path: almost every varint in real data is one byte.
+    if (p < end && !(*p & 0x80)) {
+      uint64_t n = *p++;
+      return (int64_t)(n >> 1) ^ -(int64_t)(n & 1);
+    }
     uint64_t n = 0;
     int shift = 0;
     while (true) {
@@ -184,19 +200,75 @@ double read_numeric_kind(Reader& r, int32_t k, bool* has) {
   }
 }
 
+// Open-addressing string interner over a byte arena: the decode-loop hot
+// path (one intern per feature entry) must not pay std::string allocation
+// or unordered_map bucket chasing. FNV-1a hash, linear probing, 2x growth.
 struct Interner {
-  std::unordered_map<std::string, int32_t> map;
   std::vector<char> bytes;
   std::vector<int64_t> offsets{0};
+  std::vector<int32_t> slots;
+  size_t mask;
 
-  int32_t intern(const std::string& key) {
-    auto it = map.find(key);
-    if (it != map.end()) return it->second;
-    int32_t id = (int32_t)offsets.size() - 1;
-    map.emplace(key, id);
-    bytes.insert(bytes.end(), key.begin(), key.end());
+  Interner() : slots(1024, -1), mask(1023) {}
+
+  // Word-at-a-time mix (feature keys are 4-30 bytes; a byte-wise FNV loop
+  // was a measurable fraction of the whole decode).
+  static uint64_t hash(const char* p, size_t n) {
+    const uint64_t M = 0x9DDFEA08EB382D69ull;
+    uint64_t h = 0x9E3779B97F4A7C15ull ^ (uint64_t)n;
+    while (n >= 8) {
+      uint64_t w;
+      std::memcpy(&w, p, 8);
+      h = (h ^ w) * M;
+      h ^= h >> 29;
+      p += 8;
+      n -= 8;
+    }
+    if (n) {
+      uint64_t w = 0;
+      std::memcpy(&w, p, n);
+      h = (h ^ w) * M;
+    }
+    h ^= h >> 32;
+    return h;
+  }
+  size_t size() const { return offsets.size() - 1; }
+  const char* str(int32_t id, size_t* n) const {
+    *n = (size_t)(offsets[id + 1] - offsets[id]);
+    return bytes.data() + offsets[id];
+  }
+  bool eq(int32_t id, const char* p, size_t n) const {
+    int64_t off = offsets[id];
+    return (int64_t)n == offsets[id + 1] - off &&
+           std::memcmp(bytes.data() + off, p, n) == 0;
+  }
+  int32_t intern(const char* p, size_t n) {
+    size_t i = hash(p, n) & mask;
+    while (true) {
+      int32_t s = slots[i];
+      if (s < 0) break;
+      if (eq(s, p, n)) return s;
+      i = (i + 1) & mask;
+    }
+    int32_t id = (int32_t)size();
+    slots[i] = id;
+    bytes.insert(bytes.end(), p, p + n);
     offsets.push_back((int64_t)bytes.size());
+    if (size() * 2 > mask) grow();
     return id;
+  }
+  void grow() {
+    size_t nm = (mask + 1) * 2;
+    std::vector<int32_t> ns(nm, -1);
+    for (int32_t id = 0; id < (int32_t)size(); ++id) {
+      size_t n;
+      const char* p = str(id, &n);
+      size_t j = hash(p, n) & (nm - 1);
+      while (ns[j] >= 0) j = (j + 1) & (nm - 1);
+      ns[j] = id;
+    }
+    slots.swap(ns);
+    mask = nm - 1;
   }
 };
 
@@ -204,6 +276,7 @@ struct Bag {
   std::vector<int64_t> indptr{0};
   std::vector<int32_t> keys;
   std::vector<float> vals;
+  bool has_row_dups = false;
 };
 
 struct Result {
@@ -212,6 +285,7 @@ struct Result {
   Interner keys;
   Interner tag_vals;
   std::vector<int32_t> tag_ids;  // n_records * n_tags, -1 = absent
+  std::vector<int32_t> dup_scratch;
 };
 
 // One feature-array item; appends (key id, value) to the bag.
@@ -294,23 +368,103 @@ void decode_feature_item(Reader& r, const int32_t* fops, int n_fops,
     }
   }
   if (r.ok) {
-    bag.keys.push_back(out.keys.intern(keybuf));
+    bag.keys.push_back(out.keys.intern(keybuf.data(), keybuf.size()));
     bag.vals.push_back((float)value);
   }
 }
 
+// The two feature-record layouts that cover TrainingExampleAvro as written
+// by photon-avro-schemas codegen (name, value, nullable term — the
+// reference's fixtures) and by our own writer (name, term, value) get fused
+// loops: no per-op switch, no union dispatch. Everything else runs the
+// generic op interpreter above with identical semantics.
+enum FeatPattern {
+  FEAT_GENERIC = 0,
+  FEAT_NAME_TERMP_VALD = 1,    // fops {20, 22, 24, 1}
+  FEAT_NAME_VALD_TERMU01 = 2,  // fops {20, 24, 1, 21, 2, 0, 1}
+};
+
+FeatPattern detect_pattern(const int32_t* fops, int n_fops) {
+  static const int32_t pat_b[4] = {20, 22, 24, 1};
+  static const int32_t pat_a[7] = {20, 24, 1, 21, 2, 0, 1};
+  if (n_fops == 4 && !std::memcmp(fops, pat_b, sizeof pat_b))
+    return FEAT_NAME_TERMP_VALD;
+  if (n_fops == 7 && !std::memcmp(fops, pat_a, sizeof pat_a))
+    return FEAT_NAME_VALD_TERMU01;
+  return FEAT_GENERIC;
+}
+
+inline void item_name_termp_vald(Reader& r, const std::string& delim,
+                                 Result& out, Bag& bag, std::string& keybuf) {
+  auto s = r.read_str();
+  if (!r.ok) return;
+  keybuf.assign(s.first, (size_t)s.second);
+  auto t = r.read_str();
+  if (!r.ok) return;
+  if (t.second > 0) {
+    keybuf += delim;
+    keybuf.append(t.first, (size_t)t.second);
+  }
+  double v = r.read_double();
+  if (!r.ok) return;
+  bag.keys.push_back(out.keys.intern(keybuf.data(), keybuf.size()));
+  bag.vals.push_back((float)v);
+}
+
+inline void item_name_vald_termu(Reader& r, const std::string& delim,
+                                 Result& out, Bag& bag, std::string& keybuf) {
+  auto s = r.read_str();
+  if (!r.ok) return;
+  keybuf.assign(s.first, (size_t)s.second);
+  double v = r.read_double();
+  int64_t br = r.read_long();
+  if (br == 1) {
+    auto t = r.read_str();
+    if (r.ok && t.second > 0) {
+      keybuf += delim;
+      keybuf.append(t.first, (size_t)t.second);
+    }
+  } else if (br != 0) {
+    r.ok = false;
+  }
+  if (!r.ok) return;
+  bag.keys.push_back(out.keys.intern(keybuf.data(), keybuf.size()));
+  bag.vals.push_back((float)v);
+}
+
+// Did this record contribute duplicate feature keys to `bag`? Interned ids
+// make this an integer problem; rows are short, so a sort + adjacent scan on
+// a reused scratch is ~free. The flag lets the Python assembly skip its
+// O(nnz log nnz) whole-dataset duplicate check (pack_csr_to_ell).
+void check_row_dups(Result& out, Bag& bag, size_t row_start) {
+  size_t n = bag.keys.size() - row_start;
+  if (n < 2 || bag.has_row_dups) return;
+  auto& s = out.dup_scratch;
+  s.assign(bag.keys.begin() + row_start, bag.keys.end());
+  std::sort(s.begin(), s.end());
+  for (size_t i = 1; i < s.size(); ++i) {
+    if (s[i] == s[i - 1]) {
+      bag.has_row_dups = true;
+      return;
+    }
+  }
+}
+
 bool decode_block(Reader& r, int64_t count, const int32_t* rops, int n_rops,
-                  const int32_t* fops, int n_fops,
+                  const int32_t* fops, int n_fops, FeatPattern pattern,
                   const std::vector<std::string>& tag_names, int n_meta_tags,
                   const std::string& delim, Result& out) {
   const int n_tags = (int)tag_names.size();
   std::string keybuf;
+  std::vector<size_t> row_starts(out.bags.size());
   for (int64_t rec = 0; rec < count && r.ok; ++rec) {
     out.labels.push_back(0.0);
     out.offsets.push_back(0.0);
     out.weights.push_back(1.0);
     size_t tag_base = out.tag_ids.size();
     out.tag_ids.resize(tag_base + n_tags, -1);
+    for (size_t b = 0; b < out.bags.size(); ++b)
+      row_starts[b] = out.bags[b].keys.size();
     for (int i = 0; i < n_rops && r.ok; ++i) {
       switch (rops[i]) {
         case 1:
@@ -364,11 +518,14 @@ bool decode_block(Reader& r, int64_t count, const int32_t* rops, int n_rops,
             auto s = r.read_str();
             if (r.ok)
               out.tag_ids[tag_base + slot] =
-                  out.tag_vals.intern(std::string(s.first, (size_t)s.second));
+                  out.tag_vals.intern(s.first, (size_t)s.second);
           } else if (k == 3) {
             char buf[24];
-            std::snprintf(buf, sizeof buf, "%lld", (long long)r.read_long());
-            if (r.ok) out.tag_ids[tag_base + slot] = out.tag_vals.intern(buf);
+            int len =
+                std::snprintf(buf, sizeof buf, "%lld", (long long)r.read_long());
+            if (r.ok)
+              out.tag_ids[tag_base + slot] =
+                  out.tag_vals.intern(buf, (size_t)len);
           } else if (k != 0) {
             r.ok = false;
           }
@@ -381,8 +538,20 @@ bool decode_block(Reader& r, int64_t count, const int32_t* rops, int n_rops,
           Bag& bag = out.bags[bag_slot];
           for (int64_t n = read_block_count(r); n != 0 && r.ok;
                n = read_block_count(r)) {
-            for (int64_t j = 0; j < n && r.ok; ++j)
-              decode_feature_item(r, fops, n_fops, delim, out, bag, keybuf);
+            switch (pattern) {
+              case FEAT_NAME_TERMP_VALD:
+                for (int64_t j = 0; j < n && r.ok; ++j)
+                  item_name_termp_vald(r, delim, out, bag, keybuf);
+                break;
+              case FEAT_NAME_VALD_TERMU01:
+                for (int64_t j = 0; j < n && r.ok; ++j)
+                  item_name_vald_termu(r, delim, out, bag, keybuf);
+                break;
+              default:
+                for (int64_t j = 0; j < n && r.ok; ++j)
+                  decode_feature_item(r, fops, n_fops, delim, out, bag,
+                                      keybuf);
+            }
           }
           break;
         }
@@ -399,8 +568,8 @@ bool decode_block(Reader& r, int64_t count, const int32_t* rops, int n_rops,
                 if (out.tag_ids[tag_base + t] == -1 &&
                     (int64_t)tag_names[t].size() == k.second &&
                     std::memcmp(tag_names[t].data(), k.first, k.second) == 0) {
-                  out.tag_ids[tag_base + t] = out.tag_vals.intern(
-                      std::string(v.first, (size_t)v.second));
+                  out.tag_ids[tag_base + t] =
+                      out.tag_vals.intern(v.first, (size_t)v.second);
                 }
               }
             }
@@ -480,7 +649,11 @@ bool decode_block(Reader& r, int64_t count, const int32_t* rops, int n_rops,
           r.ok = false;
       }
     }
-    for (auto& bag : out.bags) bag.indptr.push_back((int64_t)bag.keys.size());
+    for (size_t b = 0; b < out.bags.size(); ++b) {
+      Bag& bag = out.bags[b];
+      check_row_dups(out, bag, row_starts[b]);
+      bag.indptr.push_back((int64_t)bag.keys.size());
+    }
   }
   return r.ok;
 }
@@ -507,6 +680,114 @@ bool inflate_raw(const uint8_t* src, size_t n, std::vector<uint8_t>& out) {
   return true;
 }
 
+struct BlockInfo {
+  const uint8_t* p;
+  int64_t size;
+  int64_t count;
+};
+
+// Serial structural walk: block boundaries + sync validation only (varint
+// reads and one memcmp per block — runs at GB/s, not worth threading).
+bool scan_blocks(Reader& file, const uint8_t* sync,
+                 std::vector<BlockInfo>& out) {
+  while (file.ok && file.p < file.end) {
+    int64_t count = file.read_long();
+    int64_t size = file.read_long();
+    if (!file.ok || size < 0 || count < 0 || !file.need((size_t)size + 16))
+      return false;
+    // A record cannot deflate below 1/1032 of a byte, so count beyond
+    // size*1032 is structurally impossible — this keeps the downstream
+    // reserve() calls from attempting absurd allocations on a corrupted
+    // header (size is already bounded by the real file length here, so the
+    // multiply cannot overflow).
+    if (count > size * 1032 + 64) return false;
+    const uint8_t* block = file.p;
+    file.p += size;
+    if (std::memcmp(file.p, sync, 16) != 0) return false;
+    file.p += 16;
+    out.push_back({block, size, count});
+  }
+  return file.ok;
+}
+
+struct DecodeJob {
+  const std::vector<BlockInfo>* blocks;
+  size_t begin, end;  // block span
+  const int32_t* rops;
+  int n_rops;
+  const int32_t* fops;
+  int n_fops;
+  FeatPattern pattern;
+  const std::vector<std::string>* tag_names;
+  int n_meta_tags;
+  const std::string* delim;
+  int codec;
+  Result res;
+  bool ok = false;
+};
+
+void run_job_impl(DecodeJob* job, std::atomic<bool>* failed);
+
+void run_job(DecodeJob* job, std::atomic<bool>* failed) {
+  // Nothing may escape a worker thread (an uncaught exception is
+  // std::terminate): treat any allocation failure as a decode failure and
+  // let the caller fall back to the Python codec.
+  try {
+    run_job_impl(job, failed);
+  } catch (...) {
+    failed->store(true, std::memory_order_relaxed);
+  }
+}
+
+void run_job_impl(DecodeJob* job, std::atomic<bool>* failed) {
+  std::vector<uint8_t> scratch;
+  // Record counts are known up front from the block headers: reserve the
+  // scalar columns exactly (vector growth reallocs were measurable). The
+  // reserve is advisory — cap it so a pathological-but-valid header (or one
+  // that slipped past scan_blocks' bound) cannot demand an absurd upfront
+  // allocation; vectors still grow geometrically past the cap.
+  int64_t span_records = 0;
+  for (size_t i = job->begin; i < job->end; ++i)
+    span_records += (*job->blocks)[i].count;
+  int64_t reserve_records = std::min<int64_t>(span_records, int64_t{1} << 27);
+  job->res.labels.reserve(reserve_records);
+  job->res.offsets.reserve(reserve_records);
+  job->res.weights.reserve(reserve_records);
+  job->res.tag_ids.reserve(reserve_records * (int64_t)job->tag_names->size());
+  for (auto& bag : job->res.bags) bag.indptr.reserve(reserve_records + 1);
+  for (size_t i = job->begin; i < job->end; ++i) {
+    if (failed->load(std::memory_order_relaxed)) return;
+    const BlockInfo& b = (*job->blocks)[i];
+    Reader r{b.p, b.p + b.size};
+    if (job->codec == 1) {
+      if (!inflate_raw(b.p, (size_t)b.size, scratch)) {
+        failed->store(true, std::memory_order_relaxed);
+        return;
+      }
+      r = Reader{scratch.data(), scratch.data() + scratch.size()};
+    }
+    if (!decode_block(r, b.count, job->rops, job->n_rops, job->fops,
+                      job->n_fops, job->pattern, *job->tag_names,
+                      job->n_meta_tags, *job->delim, job->res) ||
+        r.p != r.end) {  // trailing bytes = mis-decoded block
+      failed->store(true, std::memory_order_relaxed);
+      return;
+    }
+    if (i == job->begin && span_records > 0) {
+      // Extrapolate bag nnz from the first block to size the entry arrays.
+      int64_t done = b.count > 0 ? b.count : 1;
+      for (auto& bag : job->res.bags) {
+        size_t est =
+            (size_t)((double)bag.keys.size() / done * span_records * 1.05);
+        est = std::min<size_t>(est, size_t{1} << 28);  // advisory, capped
+        bag.keys.reserve(est);
+        bag.vals.reserve(est);
+      }
+    }
+  }
+  job->ok = true;
+}
+
 struct CResult {
   int64_t n_records;
   double* labels;
@@ -517,6 +798,7 @@ struct CResult {
   int32_t** bag_keys;
   float** bag_vals;
   int64_t* bag_nnz;
+  int32_t* bag_has_dups;
   int64_t n_keys;
   char* key_bytes;
   int64_t* key_offsets;
@@ -532,16 +814,42 @@ struct CResult {
 // through photon_avro_free so the caller falls back to the Python codec
 // instead of dereferencing null.
 template <typename T>
-T* steal(std::vector<T>& v, bool& ok) {
+T* alloc_n(size_t n, bool& ok) {
   if (!ok) return nullptr;  // a prior failure: skip further large allocations
-  T* out = (T*)std::malloc(v.size() * sizeof(T) + 1);
-  if (!out) {
-    ok = false;
-    return nullptr;
-  }
-  std::memcpy(out, v.data(), v.size() * sizeof(T));
+  T* out = (T*)std::malloc(n * sizeof(T) + 1);
+  if (!out) ok = false;
   return out;
 }
+
+template <typename T>
+T* steal(std::vector<T>& v, bool& ok) {
+  T* out = alloc_n<T>(v.size(), ok);
+  if (out) std::memcpy(out, v.data(), v.size() * sizeof(T));
+  return out;
+}
+
+// Build a worker-local-id -> global-id map by re-interning the worker's
+// dictionary into `global` in order. Workers are merged in block order, so
+// global ids reproduce the exact first-encounter order of a sequential
+// decode.
+std::vector<int32_t> remap_interner(const Interner& local, Interner& global) {
+  std::vector<int32_t> l2g(local.size());
+  for (int32_t id = 0; id < (int32_t)local.size(); ++id) {
+    size_t n;
+    const char* p = local.str(id, &n);
+    l2g[id] = global.intern(p, n);
+  }
+  return l2g;
+}
+
+void* photon_avro_decode_impl(const uint8_t* data, int64_t data_len,
+                              int64_t body_start, int32_t codec,
+                              const uint8_t* sync, const int32_t* rops,
+                              int32_t n_rops, const int32_t* fops,
+                              int32_t n_fops, int32_t n_bags,
+                              const char* tag_names_joined, int32_t n_tags,
+                              int32_t n_meta_tags, const char* delim_c,
+                              int32_t n_threads);
 
 }  // namespace
 
@@ -550,17 +858,38 @@ extern "C" {
 void photon_avro_free(void* ptr);
 
 // Decode `data` (a whole container file already read into memory).
-// codec: 0 = null, 1 = deflate. Returns a malloc'd CResult* or nullptr on
-// any structural error (caller falls back to the Python codec).
+// codec: 0 = null, 1 = deflate. n_threads: 0 = hardware concurrency.
+// Returns a malloc'd CResult* or nullptr on any structural error (caller
+// falls back to the Python codec).
 void* photon_avro_decode(const uint8_t* data, int64_t data_len,
                          int64_t body_start, int32_t codec,
                          const uint8_t* sync, const int32_t* rops,
                          int32_t n_rops, const int32_t* fops, int32_t n_fops,
                          int32_t n_bags, const char* tag_names_joined,
                          int32_t n_tags, int32_t n_meta_tags,
-                         const char* delim) {
-  Result res;
-  res.bags.resize(n_bags);
+                         const char* delim_c, int32_t n_threads) {
+  try {
+    return photon_avro_decode_impl(data, data_len, body_start, codec, sync,
+                                   rops, n_rops, fops, n_fops, n_bags,
+                                   tag_names_joined, n_tags, n_meta_tags,
+                                   delim_c, n_threads);
+  } catch (...) {
+    return nullptr;  // bad_alloc etc.: Python codec fallback
+  }
+}
+
+}  // extern "C"
+
+namespace {
+
+void* photon_avro_decode_impl(const uint8_t* data, int64_t data_len,
+                              int64_t body_start, int32_t codec,
+                              const uint8_t* sync, const int32_t* rops,
+                              int32_t n_rops, const int32_t* fops,
+                              int32_t n_fops, int32_t n_bags,
+                              const char* tag_names_joined, int32_t n_tags,
+                              int32_t n_meta_tags, const char* delim_c,
+                              int32_t n_threads) {
   std::vector<std::string> tag_names;
   {
     const char* s = tag_names_joined;
@@ -570,61 +899,154 @@ void* photon_avro_decode(const uint8_t* data, int64_t data_len,
       s += n + 1;
     }
   }
+  std::string delim(delim_c);
   Reader file{data + body_start, data + data_len};
-  std::vector<uint8_t> scratch;
-  while (file.ok && file.p < file.end) {
-    int64_t count = file.read_long();
-    int64_t size = file.read_long();
-    if (!file.ok || size < 0 || !file.need((size_t)size + 16)) return nullptr;
-    const uint8_t* block = file.p;
-    file.p += size;
-    if (std::memcmp(file.p, sync, 16) != 0) return nullptr;
-    file.p += 16;
-    Reader r{block, block + size};
-    if (codec == 1) {
-      if (!inflate_raw(block, (size_t)size, scratch)) return nullptr;
-      r = Reader{scratch.data(), scratch.data() + scratch.size()};
+  std::vector<BlockInfo> blocks;
+  if (!scan_blocks(file, sync, blocks)) return nullptr;
+
+  int hw = (int)std::thread::hardware_concurrency();
+  int W = n_threads > 0 ? n_threads : (hw > 0 ? hw : 1);
+  W = std::min<int>({W, (int)blocks.size() > 0 ? (int)blocks.size() : 1, 32});
+
+  // Contiguous spans balanced by compressed bytes.
+  int64_t total_bytes = 0;
+  for (const auto& b : blocks) total_bytes += b.size;
+  std::vector<DecodeJob> jobs(W);
+  {
+    size_t bi = 0;
+    int64_t acc = 0;
+    for (int w = 0; w < W; ++w) {
+      DecodeJob& j = jobs[w];
+      j.blocks = &blocks;
+      j.begin = bi;
+      int64_t target = total_bytes * (int64_t)(w + 1) / W;
+      while (bi < blocks.size() && (w == W - 1 || acc < target)) {
+        acc += blocks[bi].size;
+        ++bi;
+      }
+      j.end = bi;
+      j.rops = rops;
+      j.n_rops = n_rops;
+      j.fops = fops;
+      j.n_fops = n_fops;
+      j.pattern = detect_pattern(fops, n_fops);
+      j.tag_names = &tag_names;
+      j.n_meta_tags = n_meta_tags;
+      j.delim = &delim;
+      j.codec = codec;
+      j.res.bags.resize(n_bags);
     }
-    if (!decode_block(r, count, rops, n_rops, fops, n_fops, tag_names,
-                      n_meta_tags, delim, res))
-      return nullptr;
-    if (r.p != r.end) return nullptr;  // trailing bytes = mis-decoded block
   }
-  if (!file.ok) return nullptr;
+
+  std::atomic<bool> failed{false};
+  if (W == 1) {
+    run_job(&jobs[0], &failed);
+  } else {
+    std::vector<std::thread> threads;
+    threads.reserve(W);
+    for (int w = 0; w < W; ++w)
+      threads.emplace_back(run_job, &jobs[w], &failed);
+    for (auto& t : threads) t.join();
+  }
+  if (failed.load()) return nullptr;
+  for (const auto& j : jobs)
+    if (!j.ok) return nullptr;
+
+  // ---- merge workers in block order --------------------------------------
+  int64_t n = 0;
+  for (const auto& j : jobs) n += (int64_t)j.res.labels.size();
 
   CResult* c = (CResult*)std::calloc(1, sizeof(CResult));
   if (!c) return nullptr;
   bool ok = true;
-  c->n_records = (int64_t)res.labels.size();
-  c->labels = steal(res.labels, ok);
-  c->offsets = steal(res.offsets, ok);
-  c->weights = steal(res.weights, ok);
+  c->n_records = n;
+  c->labels = alloc_n<double>(n, ok);
+  c->offsets = alloc_n<double>(n, ok);
+  c->weights = alloc_n<double>(n, ok);
   c->n_bags = n_bags;
   c->bag_indptr = (int64_t**)std::calloc(n_bags + 1, sizeof(void*));
   c->bag_keys = (int32_t**)std::calloc(n_bags + 1, sizeof(void*));
   c->bag_vals = (float**)std::calloc(n_bags + 1, sizeof(void*));
   c->bag_nnz = (int64_t*)std::calloc(n_bags + 1, sizeof(int64_t));
-  if (!c->bag_indptr || !c->bag_keys || !c->bag_vals || !c->bag_nnz) ok = false;
-  for (int b = 0; ok && b < n_bags; ++b) {
-    c->bag_indptr[b] = steal(res.bags[b].indptr, ok);
-    c->bag_keys[b] = steal(res.bags[b].keys, ok);
-    c->bag_vals[b] = steal(res.bags[b].vals, ok);
-    c->bag_nnz[b] = (int64_t)res.bags[b].keys.size();
-  }
-  c->n_keys = (int64_t)res.keys.offsets.size() - 1;
-  c->key_bytes = steal(res.keys.bytes, ok);
-  c->key_offsets = steal(res.keys.offsets, ok);
+  c->bag_has_dups = (int32_t*)std::calloc(n_bags + 1, sizeof(int32_t));
   c->n_tags = n_tags;
-  c->tag_ids = steal(res.tag_ids, ok);
-  c->n_tag_vals = (int64_t)res.tag_vals.offsets.size() - 1;
-  c->tag_val_bytes = steal(res.tag_vals.bytes, ok);
-  c->tag_val_offsets = steal(res.tag_vals.offsets, ok);
+  c->tag_ids = alloc_n<int32_t>((size_t)n * n_tags, ok);
+  if (!c->bag_indptr || !c->bag_keys || !c->bag_vals || !c->bag_nnz ||
+      !c->bag_has_dups)
+    ok = false;
+
+  Interner gkeys, gtags;
+  std::vector<std::vector<int32_t>> key_l2g(jobs.size()), tag_l2g(jobs.size());
+  for (size_t w = 0; ok && w < jobs.size(); ++w) {
+    key_l2g[w] = remap_interner(jobs[w].res.keys, gkeys);
+    tag_l2g[w] = remap_interner(jobs[w].res.tag_vals, gtags);
+  }
+
+  // scalar columns + tag ids
+  if (ok) {
+    int64_t at = 0;
+    for (const auto& j : jobs) {
+      size_t jn = j.res.labels.size();
+      std::memcpy(c->labels + at, j.res.labels.data(), jn * sizeof(double));
+      std::memcpy(c->offsets + at, j.res.offsets.data(), jn * sizeof(double));
+      std::memcpy(c->weights + at, j.res.weights.data(), jn * sizeof(double));
+      at += (int64_t)jn;
+    }
+    int64_t tat = 0;
+    for (size_t w = 0; w < jobs.size(); ++w) {
+      const auto& ids = jobs[w].res.tag_ids;
+      const auto& l2g = tag_l2g[w];
+      for (size_t i = 0; i < ids.size(); ++i)
+        c->tag_ids[tat + (int64_t)i] = ids[i] < 0 ? -1 : l2g[ids[i]];
+      tat += (int64_t)ids.size();
+    }
+  }
+
+  for (int b = 0; ok && b < n_bags; ++b) {
+    int64_t nnz = 0;
+    bool dups = false;
+    for (const auto& j : jobs) {
+      nnz += (int64_t)j.res.bags[b].keys.size();
+      dups = dups || j.res.bags[b].has_row_dups;
+    }
+    c->bag_nnz[b] = nnz;
+    c->bag_has_dups[b] = dups ? 1 : 0;
+    c->bag_indptr[b] = alloc_n<int64_t>((size_t)n + 1, ok);
+    c->bag_keys[b] = alloc_n<int32_t>((size_t)nnz, ok);
+    c->bag_vals[b] = alloc_n<float>((size_t)nnz, ok);
+    if (!ok) break;
+    int64_t row_at = 0, ent_at = 0;
+    c->bag_indptr[b][0] = 0;
+    for (size_t w = 0; w < jobs.size(); ++w) {
+      const Bag& bag = jobs[w].res.bags[b];
+      const auto& l2g = key_l2g[w];
+      for (size_t i = 1; i < bag.indptr.size(); ++i)
+        c->bag_indptr[b][row_at + (int64_t)i] = bag.indptr[i] + ent_at;
+      for (size_t i = 0; i < bag.keys.size(); ++i)
+        c->bag_keys[b][ent_at + (int64_t)i] = l2g[bag.keys[i]];
+      std::memcpy(c->bag_vals[b] + ent_at, bag.vals.data(),
+                  bag.vals.size() * sizeof(float));
+      row_at += (int64_t)bag.indptr.size() - 1;
+      ent_at += (int64_t)bag.keys.size();
+    }
+  }
+
+  c->n_keys = (int64_t)gkeys.size();
+  c->key_bytes = steal(gkeys.bytes, ok);
+  c->key_offsets = steal(gkeys.offsets, ok);
+  c->n_tag_vals = (int64_t)gtags.size();
+  c->tag_val_bytes = steal(gtags.bytes, ok);
+  c->tag_val_offsets = steal(gtags.offsets, ok);
   if (!ok) {
     photon_avro_free(c);
     return nullptr;
   }
   return c;
 }
+
+}  // namespace
+
+extern "C" {
 
 void photon_avro_free(void* ptr) {
   if (!ptr) return;
@@ -641,6 +1063,7 @@ void photon_avro_free(void* ptr) {
   std::free(c->bag_keys);
   std::free(c->bag_vals);
   std::free(c->bag_nnz);
+  std::free(c->bag_has_dups);
   std::free(c->key_bytes);
   std::free(c->key_offsets);
   std::free(c->tag_ids);

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import ctypes
 import dataclasses
+import os
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -308,6 +309,9 @@ class DecodedFile:
     keys: List[str]  # interned key id -> string
     tag_ids: np.ndarray  # (n_records, n_tags) int32, -1 absent
     tag_values: List[str]
+    # Per bag: did any single record carry the same feature key twice? When
+    # False the assembly can skip its whole-dataset duplicate check.
+    bag_has_dups: List[bool] = dataclasses.field(default_factory=list)
 
 
 class _CResult(ctypes.Structure):
@@ -321,6 +325,7 @@ class _CResult(ctypes.Structure):
         ("bag_keys", ctypes.POINTER(ctypes.POINTER(ctypes.c_int32))),
         ("bag_vals", ctypes.POINTER(ctypes.POINTER(ctypes.c_float))),
         ("bag_nnz", ctypes.POINTER(ctypes.c_int64)),
+        ("bag_has_dups", ctypes.POINTER(ctypes.c_int32)),
         ("n_keys", ctypes.c_int64),
         ("key_bytes", ctypes.POINTER(ctypes.c_char)),
         ("key_offsets", ctypes.POINTER(ctypes.c_int64)),
@@ -361,6 +366,7 @@ def _lib() -> Optional[ctypes.CDLL]:
             ctypes.c_int32,
             ctypes.c_int32,
             ctypes.c_char_p,
+            ctypes.c_int32,
         ]
         lib.photon_avro_free.restype = None
         lib.photon_avro_free.argtypes = [ctypes.c_void_p]
@@ -377,6 +383,15 @@ def _strings(byte_ptr, offsets_ptr, n: int) -> List[str]:
     return [raw[offs[i] : offs[i + 1]].decode("utf-8") for i in range(n)]
 
 
+def _default_threads() -> int:
+    """Decode worker count: PHOTON_INGEST_THREADS overrides, 0 = hw auto."""
+    v = os.environ.get("PHOTON_INGEST_THREADS", "")
+    try:
+        return max(0, int(v)) if v else 0
+    except ValueError:
+        return 0
+
+
 def decode_file_native(
     data: bytes,
     body_start: int,
@@ -384,6 +399,7 @@ def decode_file_native(
     sync: bytes,
     program: Program,
     delimiter: str,
+    n_threads: Optional[int] = None,
 ) -> Optional[DecodedFile]:
     lib = _lib()
     if lib is None:
@@ -409,6 +425,7 @@ def decode_file_native(
         len(program.tag_slots),
         program.n_meta_tags,
         delimiter.encode("utf-8"),
+        _default_threads() if n_threads is None else n_threads,
     )
     if not handle:
         return None
@@ -440,6 +457,7 @@ def decode_file_native(
                 c.tag_ids, shape=(max(n * int(c.n_tags), 1),)
             )[: n * int(c.n_tags)].copy().reshape(n, int(c.n_tags)),
             tag_values=_strings(c.tag_val_bytes, c.tag_val_offsets, int(c.n_tag_vals)),
+            bag_has_dups=[bool(c.bag_has_dups[b]) for b in range(c.n_bags)],
         )
     finally:
         lib.photon_avro_free(handle)

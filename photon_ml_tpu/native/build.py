@@ -80,6 +80,7 @@ def native_library_path() -> Optional[str]:
                     "g++",
                     "-O2",
                     "-std=c++17",
+                    "-pthread",
                     "-shared",
                     "-fPIC",
                     "-o",

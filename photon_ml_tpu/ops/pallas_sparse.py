@@ -276,6 +276,23 @@ def _level_rmatvec(
     return g2.reshape(-1)
 
 
+def kernels_eligible() -> bool:
+    """Backend/enablement gate shared by every pack decision: bucketed
+    layouts only pay off when the Pallas kernels will actually run."""
+    return pallas_glm.is_enabled() and (
+        jax.default_backend() == "tpu" or pallas_glm.FORCE_INTERPRET
+    )
+
+
+def pack_worth_considering(n_samples: int) -> bool:
+    """The cheap engagement gates (backend + size) shared by the pack
+    functions here AND by ingest's decision to stash host COO triplets —
+    one predicate so the two can't drift apart."""
+    from photon_ml_tpu.data.bucketed import L1_TILE_ROWS
+
+    return n_samples >= 4 * L1_TILE_ROWS and kernels_eligible()
+
+
 def should_use(bf: BucketedSparseFeatures) -> bool:
     """Trace-safe kernel dispatch gate (static metadata only): TPU backend
     (or forced interpret for tests) and in-contract segment widths. The
@@ -327,9 +344,7 @@ def maybe_pack(feats, n_samples: int) -> Optional[BucketedSparseFeatures]:
 
     if not isinstance(feats, SparseFeatures) or feats.indices.ndim != 2:
         return None
-    if not pallas_glm.is_enabled():
-        return None
-    if jax.default_backend() != "tpu" and not pallas_glm.FORCE_INTERPRET:
+    if not kernels_eligible():
         return None
     if feats.values.dtype != jnp.float32:
         return None
@@ -344,6 +359,31 @@ def maybe_pack(feats, n_samples: int) -> Optional[BucketedSparseFeatures]:
     if n_samples < 4 * L1_TILE_ROWS:
         return None
     bf = pack_from_ell(feats)
+    if not should_use(bf):
+        return None
+    if bf.density_report()["pad_blowup"] > MAX_PAD_BLOWUP:
+        return None
+    return bf
+
+
+def maybe_pack_coo(
+    rows, cols, vals, n_samples: int, dim: int
+) -> Optional[BucketedSparseFeatures]:
+    """Data-plane variant of `maybe_pack`: pack host COO triplets produced by
+    ingest (GameDataset.host_coo) straight into the bucketed layout — no
+    device ELL pull-back, mirroring the reference's build-layout-once-at-
+    dataset-construction placement (RandomEffectDataset.scala:229-264).
+    Applies the same engagement gates; sharding cannot apply (host arrays).
+    """
+    import numpy as np
+
+    from photon_ml_tpu.data.bucketed import pack_bucketed
+
+    if not pack_worth_considering(n_samples):
+        return None
+    if np.asarray(vals).dtype != np.float32:
+        return None
+    bf = pack_bucketed(rows, cols, vals, n_samples, dim)
     if not should_use(bf):
         return None
     if bf.density_report()["pad_blowup"] > MAX_PAD_BLOWUP:

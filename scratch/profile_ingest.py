@@ -1,0 +1,92 @@
+"""Profile the ingest pipeline stage by stage to find the real bottleneck."""
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+import photon_ml_tpu.io.avro_data as ad
+from photon_ml_tpu.io import avro_fast
+from photon_ml_tpu.io import avro as avro_io
+from photon_ml_tpu.native import avro_reader
+from photon_ml_tpu.data.index_map import DELIMITER
+
+n, d, k = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000, 4000, 24
+rng = np.random.default_rng(7)
+t0 = time.perf_counter()
+feats = [
+    [(f"f{j}", float(v)) for j, v in zip(
+        rng.choice(d, size=k, replace=False), rng.normal(size=k))]
+    for _ in range(n)
+]
+print(f"gen: {time.perf_counter()-t0:.2f}s")
+
+td = tempfile.mkdtemp()
+pth = os.path.join(td, "bench.avro")
+t0 = time.perf_counter()
+ad.write_training_examples(
+    pth, feats, (rng.uniform(size=n) > 0.5).astype(float),
+    id_tags={"entityId": rng.integers(0, 1000, size=n)},
+)
+mb = os.path.getsize(pth) / 1e6
+print(f"write: {time.perf_counter()-t0:.2f}s  ({mb:.1f} MB)")
+
+cfgs = {"g": ad.FeatureShardConfig(("features",), True)}
+cols = ad.InputColumnNames()
+
+# stage 1: read file bytes
+t0 = time.perf_counter()
+with open(pth, "rb") as f:
+    data = f.read()
+print(f"read bytes: {time.perf_counter()-t0:.3f}s")
+
+schema, codec, sync, body = avro_io.read_header(data, pth)
+print("codec:", codec)
+program = avro_reader.compile_program(
+    schema, response=cols.response, fallback_label=ad.LABEL,
+    offset=cols.offset, weight=cols.weight, uid=cols.uid,
+    metadata_map=cols.metadata_map, bag_names=["features"],
+    tag_fields=("entityId",),
+)
+assert program is not None
+
+# stage 2: native decode only
+t0 = time.perf_counter()
+out = avro_reader.decode_file_native(data, body, codec, sync, program, DELIMITER)
+t_dec = time.perf_counter() - t0
+assert out is not None
+print(f"native decode: {t_dec:.3f}s  ({mb/t_dec:.1f} MB/s)  nnz={len(out.bag_keys[0])}")
+
+# stage 3: full try_read_native (decode + assembly + ELL + device upload)
+t0 = time.perf_counter()
+r = avro_fast.try_read_native([pth], cfgs, None, ["entityId"], cols, ad.LABEL)
+t_full = time.perf_counter() - t0
+assert r is not None
+print(f"try_read_native total: {t_full:.3f}s  ({mb/t_full:.1f} MB/s)")
+print(f"  -> assembly+pack+upload: {t_full - t_dec - 0.05:.3f}s (approx)")
+
+# block structure of the file
+cnt = 0
+p = body
+r2 = data
+import photon_ml_tpu.io.avro as A
+br = A.BinaryReader(data, p) if hasattr(A, "BinaryReader") else None
+# quick manual block walk
+def read_long(buf, pos):
+    n_ = 0; shift = 0
+    while True:
+        b = buf[pos]; pos += 1
+        n_ |= (b & 0x7F) << shift
+        if not (b & 0x80): break
+        shift += 7
+    return (n_ >> 1) ^ -(n_ & 1), pos
+
+pos = body
+sizes = []
+while pos < len(data):
+    c, pos = read_long(data, pos)
+    s, pos = read_long(data, pos)
+    sizes.append((c, s))
+    pos += s + 16
+print(f"blocks: {len(sizes)}, median size {np.median([s for _, s in sizes])/1e3:.0f} KB")

@@ -167,3 +167,125 @@ class TestNativePacker:
         np.testing.assert_array_equal(
             np.asarray(bf_n.level1.packed), np.asarray(bf_p.level1.packed)
         )
+
+
+@needs_native
+class TestThreadedDecode:
+    """Block-parallel decode must be bit-identical to sequential (merge
+    preserves interned-id first-encounter order; avro_reader.cc run_job)."""
+
+    def _decode(self, path, n_threads, tags=("e",)):
+        from photon_ml_tpu.data.index_map import DELIMITER
+        from photon_ml_tpu.io import avro as avro_io
+        from photon_ml_tpu.native import avro_reader
+
+        cols = ad.InputColumnNames()
+        with open(path, "rb") as f:
+            data = f.read()
+        schema, codec, sync, body = avro_io.read_header(data, path)
+        prog = avro_reader.compile_program(
+            schema,
+            response=cols.response,
+            fallback_label=ad.LABEL,
+            offset=cols.offset,
+            weight=cols.weight,
+            uid=cols.uid,
+            metadata_map=cols.metadata_map,
+            bag_names=["features"],
+            tag_fields=tuple(tags),
+        )
+        assert prog is not None
+        return avro_reader.decode_file_native(
+            data, body, codec, sync, prog, DELIMITER, n_threads=n_threads
+        )
+
+    def test_thread_count_invariance(self, tmp_path):
+        rng = np.random.default_rng(11)
+        n = 30_000  # enough records for several container blocks
+        feats = [
+            [
+                (f"f{j}", float(v))
+                for j, v in zip(
+                    rng.choice(400, size=8, replace=False), rng.normal(size=8)
+                )
+            ]
+            for _ in range(n)
+        ]
+        p = str(tmp_path / "t.avro")
+        ad.write_training_examples(
+            p, feats, rng.uniform(size=n),
+            id_tags={"e": rng.integers(0, 40, size=n)},
+        )
+        a = self._decode(p, 1)
+        for w in (2, 5):
+            b = self._decode(p, w)
+            assert a.keys == b.keys
+            assert a.tag_values == b.tag_values
+            assert a.bag_has_dups == b.bag_has_dups
+            np.testing.assert_array_equal(a.labels, b.labels)
+            np.testing.assert_array_equal(a.tag_ids, b.tag_ids)
+            for x, y in zip(
+                (a.bag_indptr[0], a.bag_keys[0], a.bag_vals[0]),
+                (b.bag_indptr[0], b.bag_keys[0], b.bag_vals[0]),
+            ):
+                np.testing.assert_array_equal(x, y)
+
+    def test_dup_flag(self, tmp_path):
+        p1 = str(tmp_path / "clean.avro")
+        ad.write_training_examples(p1, [[("a", 1.0), ("b", 2.0)]] * 5, np.zeros(5))
+        assert self._decode(p1, 1, tags=()).bag_has_dups == [False]
+        p2 = str(tmp_path / "dups.avro")
+        ad.write_training_examples(
+            p2, [[("a", 1.0), ("b", 2.0), ("a", 3.0)]] * 5, np.zeros(5)
+        )
+        d = self._decode(p2, 1, tags=())
+        assert d.bag_has_dups == [True]
+
+    def test_dup_records_still_match_python_path(self, tmp_path):
+        # The dup flag forces the slow dedupe path; results must equal the
+        # pure-Python codec's accumulate-duplicates semantics.
+        p = str(tmp_path / "dups.avro")
+        feats = [[("a", 1.0), ("b", 2.0), ("a", 3.0)], [("b", 1.0)]] * 40
+        ad.write_training_examples(p, feats, np.zeros(80))
+        _assert_parity(p, {"g": ad.FeatureShardConfig(("features",), True)})
+
+
+@needs_native
+class TestHostCooStash:
+    def test_small_or_ineligible_not_stashed(self, tmp_path):
+        # Below the pack size gate (or on a non-kernel backend) the COO
+        # stash would pin host RAM with no consumer — it must stay empty.
+        n = 500
+        p = str(tmp_path / "t.avro")
+        ad.write_training_examples(p, [[("a", 1.0)]] * n, np.zeros(n))
+        cfgs = {"g": ad.FeatureShardConfig(("features",), True)}
+        cols = ad.InputColumnNames()
+        ds, _ = avro_fast.try_read_native([p], cfgs, None, [], cols, ad.LABEL)
+        assert ds.host_coo == {}
+
+    def test_ingest_stashes_host_coo(self, tmp_path):
+        from photon_ml_tpu.ops import pallas_glm
+
+        rng = np.random.default_rng(12)
+        n = 9000  # >= the pack size gate (4 * L1_TILE_ROWS)
+        feats = [
+            [(f"f{j}", float(rng.normal())) for j in rng.choice(50, size=4, replace=False)]
+            for _ in range(n)
+        ]
+        p = str(tmp_path / "t.avro")
+        ad.write_training_examples(p, feats, np.zeros(n))
+        cfgs = {"g": ad.FeatureShardConfig(("features",), True)}
+        cols = ad.InputColumnNames()
+        old = pallas_glm.FORCE_INTERPRET
+        pallas_glm.FORCE_INTERPRET = True  # make kernels_eligible() true on CPU
+        try:
+            ds, maps = avro_fast.try_read_native([p], cfgs, None, [], cols, ad.LABEL)
+        finally:
+            pallas_glm.FORCE_INTERPRET = old
+        assert "g" in ds.host_coo
+        rows, cols_, vals, dim = ds.host_coo["g"]
+        assert dim == maps["g"].size
+        # host COO must reproduce the device ELL contents exactly
+        M_coo = np.zeros((n, dim))
+        np.add.at(M_coo, (np.asarray(rows), np.asarray(cols_)), np.asarray(vals))
+        np.testing.assert_allclose(M_coo, _dense(ds, "g", dim))

@@ -244,3 +244,48 @@ class TestObjectiveIntegration:
         s1 = np.asarray(coord.score(model))
         s2 = np.asarray(coord_ell.score(model))
         np.testing.assert_allclose(s1, s2, rtol=1e-4, atol=1e-4)
+
+
+class TestHostCooPack:
+    def test_coordinate_packs_from_host_coo(self, interpret_kernels, monkeypatch):
+        """Ingest-stashed host COO must feed the bucketed pack directly —
+        the device-ELL pull-back (maybe_pack) must not run."""
+        from photon_ml_tpu.data.game_dataset import GameDataset
+        from photon_ml_tpu.game.coordinate import FixedEffectCoordinate
+        from photon_ml_tpu.optimize.config import (
+            L2,
+            CoordinateOptimizationConfig,
+            OptimizerConfig,
+        )
+        from photon_ml_tpu.types import TaskType
+
+        rng = np.random.default_rng(9)
+        n, d, k = 9000, 200, 6
+        idx = rng.integers(0, d, size=(n, k)).astype(np.int32)
+        val = rng.normal(size=(n, k)).astype(np.float32)
+        sp = SparseFeatures(jnp.asarray(idx), jnp.asarray(val), d)
+        y = (rng.uniform(size=n) > 0.5).astype(np.float32)
+        ds = GameDataset.build({"s": sp}, y)
+        ds.host_coo = {
+            "s": (
+                np.repeat(np.arange(n, dtype=np.int64), k),
+                idx.reshape(-1).astype(np.int64),
+                val.reshape(-1),
+                d,
+            )
+        }
+        monkeypatch.setattr(
+            pallas_sparse,
+            "maybe_pack",
+            lambda *a, **k: pytest.fail("device-ELL pull-back ran"),
+        )
+        cfg = CoordinateOptimizationConfig(
+            optimizer=OptimizerConfig(max_iterations=5, tolerance=1e-6),
+            regularization=L2,
+            reg_weight=1.0,
+        )
+        coord = FixedEffectCoordinate(ds, "s", cfg, TaskType.LOGISTIC_REGRESSION)
+        assert isinstance(coord._features, BucketedSparseFeatures)
+        assert coord._use_pallas is None
+        model, res = coord.train(ds.offsets)
+        assert np.isfinite(float(res.loss))
