@@ -65,14 +65,30 @@ def build_index_stores(
     shard_keys: Dict[str, Set[str]],
     output_dir: str,
     num_partitions: int,
+    store_format: str = "phidx",
 ) -> Dict[str, int]:
-    """Build one partitioned store per shard namespace + metadata JSON."""
+    """Build one partitioned store per shard namespace + metadata JSON.
+
+    `store_format='paldb'` writes the reference's PalDB v1 partitions
+    (loadable by PalDBIndexMap.scala:43-118 — two-way format interop; the
+    byte-level format fidelity is proven in tests/test_paldb.py against the
+    reference's own fixture stores) instead of this framework's PHIDX.
+    """
     os.makedirs(output_dir, exist_ok=True)
     counts: Dict[str, int] = {}
     for shard_name, keys in shard_keys.items():
-        counts[shard_name] = build_partitioned_store(
-            output_dir, sorted(keys), num_partitions, namespace=shard_name
-        )
+        if store_format == "paldb":
+            from photon_ml_tpu.io.paldb import write_index_map
+
+            counts[shard_name] = len(
+                write_index_map(
+                    output_dir, shard_name, sorted(keys), num_partitions
+                )
+            )
+        else:
+            counts[shard_name] = build_partitioned_store(
+                output_dir, sorted(keys), num_partitions, namespace=shard_name
+            )
         logger.info(
             "indexed %d features for shard %s (%d partitions)",
             counts[shard_name],
@@ -117,6 +133,13 @@ def main(argv: List[str] | None = None) -> int:
     )
     parser.add_argument("--num-partitions", type=int, default=1)
     parser.add_argument("--output-dir", required=True)
+    parser.add_argument(
+        "--output-format",
+        choices=("phidx", "paldb"),
+        default="phidx",
+        help="Store format: this framework's PHIDX (default) or the "
+        "reference's PalDB v1 partitions (readable by its PalDBIndexMap).",
+    )
     args = parser.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO, format="%(levelname)s %(name)s: %(message)s")
@@ -147,7 +170,9 @@ def main(argv: List[str] | None = None) -> int:
             records.extend(recs)
         shard_keys = collect_shard_keys(records, shard_configs)
 
-    build_index_stores(shard_keys, args.output_dir, args.num_partitions)
+    build_index_stores(
+        shard_keys, args.output_dir, args.num_partitions, args.output_format
+    )
     return 0
 
 

@@ -114,6 +114,9 @@ def run(args) -> dict:
             else None
         ),
     )
+    # Scoring never packs a bucketed layout; drop the ingest's host-COO
+    # stash rather than pin ~20 bytes/nnz of host RAM for the run.
+    dataset.host_coo.clear()
     logger.info("scoring %d samples", dataset.num_samples)
 
     transformer = GameTransformer(model, specs, artifact.task)

@@ -369,7 +369,11 @@ class GameEstimator:
                 # is cached on the dataset); shards that feed only
                 # random-effect coordinates never pop theirs — release them
                 # so the triplets don't pin host RAM for the rest of fit.
+                # The validation dataset never trains, so its stash has no
+                # consumer at all.
                 getattr(data, "host_coo", {}).clear()
+                if validation_data is not None:
+                    getattr(validation_data, "host_coo", {}).clear()
             reg_weights = {cid: cfgs[cid].reg_weight for cid in cfgs}
 
             validation_scorer = None

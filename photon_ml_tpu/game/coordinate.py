@@ -146,8 +146,7 @@ class FixedEffectCoordinate:
                         bf = pallas_sparse.maybe_pack(
                             feats, dataset.num_samples
                         )
-                    if isinstance(cache, dict):
-                        cache[config_data_shard] = bf
+                    cache[config_data_shard] = bf
                 else:
                     bf = cached
             if bf is not None:

@@ -122,18 +122,35 @@ def try_read_native(
             data, body, codec, sync, program, DELIMITER, n_threads=n_threads
         )
 
+    # One failed file means a full fallback to the Python codec, so stop
+    # decoding as soon as a failure surfaces instead of paying for the
+    # remaining files' native decode only to discard it.
+    failed = False
     if len(compiled) > 1 and budget > 1:
         from concurrent.futures import ThreadPoolExecutor
 
         width = min(budget, len(compiled))
         per_file = max(1, budget // width)
+
+        def _guarded(c):
+            nonlocal failed
+            if failed:
+                return None
+            out = _decode_one(c, per_file)
+            if out is None:
+                failed = True
+            return out
+
         with ThreadPoolExecutor(max_workers=width) as pool:
-            decoded = list(
-                pool.map(lambda c: _decode_one(c, per_file), compiled)
-            )
+            decoded = list(pool.map(_guarded, compiled))
     else:
-        decoded = [_decode_one(c, budget) for c in compiled]
-    if any(d is None for d in decoded):
+        decoded = []
+        for c in compiled:
+            out = _decode_one(c, budget)
+            if out is None:
+                return None
+            decoded.append(out)
+    if failed or any(d is None for d in decoded):
         return None
 
     # ---- concatenate files; remap per-file interned keys to global ids ----

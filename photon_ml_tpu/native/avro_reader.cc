@@ -688,19 +688,19 @@ struct BlockInfo {
 
 // Serial structural walk: block boundaries + sync validation only (varint
 // reads and one memcmp per block — runs at GB/s, not worth threading).
-bool scan_blocks(Reader& file, const uint8_t* sync,
+bool scan_blocks(Reader& file, const uint8_t* sync, int codec,
                  std::vector<BlockInfo>& out) {
   while (file.ok && file.p < file.end) {
     int64_t count = file.read_long();
     int64_t size = file.read_long();
     if (!file.ok || size < 0 || count < 0 || !file.need((size_t)size + 16))
       return false;
-    // A record cannot deflate below 1/1032 of a byte, so count beyond
-    // size*1032 is structurally impossible — this keeps the downstream
-    // reserve() calls from attempting absurd allocations on a corrupted
-    // header (size is already bounded by the real file length here, so the
-    // multiply cannot overflow).
-    if (count > size * 1032 + 64) return false;
+    // Structural record-count bound, to keep the downstream reserve()
+    // calls from attempting absurd allocations on a corrupted header (size
+    // is already bounded by the real file length here, so the multiply
+    // cannot overflow). Uncompressed blocks: every record is >= 1 byte.
+    // Deflate blocks: a record cannot compress below 1/1032 of a byte.
+    if (count > (codec == 1 ? size * 1032 + 64 : size + 64)) return false;
     const uint8_t* block = file.p;
     file.p += size;
     if (std::memcmp(file.p, sync, 16) != 0) return false;
@@ -902,7 +902,7 @@ void* photon_avro_decode_impl(const uint8_t* data, int64_t data_len,
   std::string delim(delim_c);
   Reader file{data + body_start, data + data_len};
   std::vector<BlockInfo> blocks;
-  if (!scan_blocks(file, sync, blocks)) return nullptr;
+  if (!scan_blocks(file, sync, codec, blocks)) return nullptr;
 
   int hw = (int)std::thread::hardware_concurrency();
   int W = n_threads > 0 ? n_threads : (hw > 0 ? hw : 1);
@@ -945,7 +945,15 @@ void* photon_avro_decode_impl(const uint8_t* data, int64_t data_len,
     std::vector<std::thread> threads;
     threads.reserve(W);
     for (int w = 0; w < W; ++w)
-      threads.emplace_back(run_job, &jobs[w], &failed);
+      try {
+        threads.emplace_back(run_job, &jobs[w], &failed);
+      } catch (...) {
+        // Thread creation failed (pid/thread cap): join what started, mark
+        // failed so the caller falls back — never unwind past joinable
+        // threads (that would std::terminate the whole process).
+        failed.store(true, std::memory_order_relaxed);
+        break;
+      }
     for (auto& t : threads) t.join();
   }
   if (failed.load()) return nullptr;

@@ -46,15 +46,17 @@ automatically; no flags thread through the optimizer stack. On non-TPU
 backends the kernels run only in interpret mode (tests); the XLA path is
 used otherwise.
 
-Measured roofline (v5e, 1M x 512 f32, full LBFGS solve / fn_evals):
-  ~17 ms per fused pass = ~125 GB/s sustained at HIGHEST precision. The
-  kernel is MXU-bound, not HBM-bound, at these shapes: the width-1/2 RHS
-  pads to the 128-lane MXU tile and HIGHEST multiplies the passes, so
-  bf16 X (half the HBM bytes) measures the SAME wall per pass, and a
-  VPU-only formulation (multiply + cross-sublane reduce) is ~6x slower.
-  DEFAULT precision reaches ~10 ms/pass (~200 GB/s) but its bf16-rounded
-  gradients cost ~1.5x more line-search evaluations — net wash, worse
-  quality, hence the HIGHEST default (see PHOTON_PALLAS_PRECISION).
+Precision/roofline history (v5e, 1M x 512 f32): at HIGHEST (6 bf16 MXU
+  passes per matmul) the kernels were MXU-bound, not HBM-bound — the
+  width-1/2 RHS pads to the 128-lane MXU tile and HIGHEST multiplies the
+  passes, so bf16 X (half the HBM bytes) measured the SAME wall per pass
+  (r03: 179-217 GB/s effective). DEFAULT was faster but its bf16-rounded
+  gradients cost ~1.5x more line-search evaluations. The current default
+  'hilo' (see the PHOTON_PALLAS_PRECISION block below) computes each
+  matmul in TWO bf16 passes over a hi/lo split of X with the RHS's hi/lo
+  halves stacked along the free dimension — all four cross products, 3x
+  less MXU work than HIGHEST, at ~2e-5 agreement with a float64 host
+  reference (f32 accumulation is the shared accuracy floor).
 """
 
 from __future__ import annotations
@@ -91,7 +93,7 @@ Array = jax.Array
 # default with a warning instead of making the whole package unimportable
 # for code paths that never touch the kernels.
 def _env_tile() -> int:
-    raw = os.environ.get("PHOTON_PALLAS_TILE", "512")
+    raw = os.environ.get("PHOTON_PALLAS_TILE", "1024")
     try:
         tile = int(raw)
         if tile < 8 or tile % 8 != 0:
@@ -102,42 +104,57 @@ def _env_tile() -> int:
 
         logging.getLogger(__name__).warning(
             "PHOTON_PALLAS_TILE=%r: must be a positive multiple of 8 (TPU "
-            "sublane alignment); using the default 512",
+            "sublane alignment); using the default 1024",
             raw,
         )
-        return 512
+        return 1024
 
 
 _TILE_N = _env_tile()
-# VMEM budget for one X tile (bytes). Above this, fall back to XLA rather
-# than blocking the feature dimension (a D-blocked variant would need a
-# second pass for margins; XLA is already fine for very wide problems).
+# VMEM budget for one X tile's WORKING SET (bytes): the f32 tile plus, in
+# hilo mode, its bf16 hi/lo copies (another 4 bytes/elem). Wider problems
+# shrink the row tile (amortizing grid overhead less) down to _TILE_MIN;
+# wider still falls back to XLA rather than blocking the feature dimension
+# (a D-blocked variant would need a second pass for margins; XLA is already
+# fine for very wide problems). Tile 1024 measured 281 GB/s vs 179 at 512
+# on v5e (grid-step overhead amortization), with slightly FEWER line-search
+# evals; 2048 blows VMEM and collapses to ~13 GB/s.
 _TILE_BYTES_LIMIT = 8 * 1024 * 1024
-_MIN_ROWS = 4 * _TILE_N
+_TILE_MIN = 256
+_MIN_ROWS = max(2048, 2 * _TILE_N)
 _MIN_COLS = 128
 
 _DISABLE_ENV = "PHOTON_DISABLE_PALLAS"
 
-# MXU precision for the kernels' thin matmuls. HIGHEST (6-pass bf16 = full
-# f32) matches a float64 host reference to ~2e-5 and is the default; the
-# kernels are HBM-bound at these shapes, so the extra MXU passes are cheap.
-# Override with PHOTON_PALLAS_PRECISION=high|default to trade accuracy for
-# MXU throughput on wider problems.
+# MXU precision for the kernels' thin matmuls. The default 'hilo' runs TWO
+# bf16 passes over a hi/lo split of X with the tiny RHS's hi/lo halves
+# stacked along the free (lane) dimension — the MXU pads that dimension to
+# 128 anyway, so the extra RHS columns are free and all four cross products
+# land in 2 passes instead of HIGHEST's 6 (the r03 kernels were MXU-bound
+# at HIGHEST precisely because of those passes; see the module docstring's
+# roofline note). Accuracy: each operand is represented hi+lo to ~2^-16
+# relative, so results match a float64 host reference to ~2e-5 — the same
+# level HIGHEST achieved (f32 accumulation is the shared floor). This is
+# the same decomposition pallas_sparse._onehot_contract uses.
+# PHOTON_PALLAS_PRECISION=highest|high|default selects a classic MXU
+# precision instead.
 _PRECISION_NAMES = {
     "highest": jax.lax.Precision.HIGHEST,
     "high": jax.lax.Precision.HIGH,
     "default": jax.lax.Precision.DEFAULT,
+    "hilo": None,  # handled by _dot_hilo_parts, not lax precision
 }
-_prec_name = os.environ.get("PHOTON_PALLAS_PRECISION", "highest").strip().lower()
+_prec_name = os.environ.get("PHOTON_PALLAS_PRECISION", "hilo").strip().lower()
 if _prec_name not in _PRECISION_NAMES:
     import logging
 
     logging.getLogger(__name__).warning(
-        "PHOTON_PALLAS_PRECISION=%r: expected one of %s; using 'highest'",
+        "PHOTON_PALLAS_PRECISION=%r: expected one of %s; using 'hilo'",
         _prec_name,
         sorted(_PRECISION_NAMES),
     )
-    _prec_name = "highest"
+    _prec_name = "hilo"
+_PREC_MODE = _prec_name
 _PRECISION = _PRECISION_NAMES[_prec_name]
 
 # Kill switch. Initialized from PHOTON_DISABLE_PALLAS at import; flip at
@@ -278,9 +295,10 @@ def _static_checks(features, w, n_rows: int) -> bool:
         return False
     if features.dtype not in (jnp.float32, jnp.bfloat16):
         return False
-    # Budget the tile at its f32 WORKING size: bf16 inputs upcast to f32 in
-    # VMEM, so the input itemsize would under-count by 2x.
-    if _TILE_N * d * 4 > _TILE_BYTES_LIMIT:
+    # Budget at the WORKING size (f32 upcast + hilo's bf16 hi/lo copies);
+    # a too-wide problem shrinks the row tile until grid overhead would
+    # dominate, then falls back to XLA.
+    if _tile_for(d) < _TILE_MIN:
         return False
     return True
 
@@ -359,8 +377,18 @@ def should_use(features, w: Array) -> bool:
     return dispatch(features, w) is True
 
 
-def _row_mask(n: int) -> Array:
-    """(TILE_N, 1) validity mask for the current grid step's rows.
+def _tile_for(d: int) -> int:
+    """Row-tile height for feature width d: the largest multiple of 8 not
+    above _TILE_N whose VMEM working set (f32 tile + hilo's bf16 hi/lo
+    copies) fits the budget. Below _TILE_MIN the grid overhead dominates —
+    callers fall back to XLA (_static_checks)."""
+    per_row = d * (8 if _PREC_MODE == "hilo" else 4)
+    tile = min(_TILE_N, _TILE_BYTES_LIMIT // max(per_row, 1))
+    return max(8, tile - tile % 8)
+
+
+def _row_mask(n: int, tile: int) -> Array:
+    """(tile, 1) validity mask for the current grid step's rows.
 
     Array sizes need not divide the block shape: Pallas pads boundary-block
     reads with undefined values, so every input is masked to exact zeros
@@ -368,32 +396,66 @@ def _row_mask(n: int) -> Array:
     and masking x/y/offset as well as weight keeps NaN/Inf garbage from the
     padded lanes out of 0*NaN traps in the losses).
     """
-    base = pl.program_id(0) * _TILE_N
-    rows = base + jax.lax.broadcasted_iota(jnp.int32, (_TILE_N, 1), 0)
+    base = pl.program_id(0) * tile
+    rows = base + jax.lax.broadcasted_iota(jnp.int32, (tile, 1), 0)
     return rows < n
 
 
-def _value_grad_kernel(loss: PointwiseLoss, n: int, x_ref, y_ref, off_ref,
-                       wt_ref, w_ref, stats_ref, grad_ref):
+def _hilo_split(a: Array) -> Tuple[Array, Array]:
+    """Represent f32 `a` as bf16 hi + bf16 lo (exact to ~2^-16 relative)."""
+    hi = a.astype(jnp.bfloat16)
+    lo = (a - hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    return hi, lo
+
+
+def _dot_hilo_parts(xhi: Array, xlo: Array, rhs: Array, dims) -> Array:
+    """f32-quality matmul in 2 bf16 MXU passes over a pre-split X.
+
+    The RHS's hi/lo halves are stacked along its free dimension, which the
+    MXU pads to 128 lanes regardless — so each X pass computes both cross
+    products for free, and hi/lo X costs 2 passes total (vs HIGHEST's 6).
+    """
+    k = rhs.shape[1]
+    rhi, rlo = _hilo_split(rhs)
+    rhs2 = jnp.concatenate([rhi, rlo], axis=1)
+    out = jax.lax.dot_general(
+        xhi, rhs2, dimension_numbers=(dims, ((), ())),
+        preferred_element_type=jnp.float32,
+    ) + jax.lax.dot_general(
+        xlo, rhs2, dimension_numbers=(dims, ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return out[:, :k] + out[:, k:]
+
+
+def _dot_pair(x, x_split, rhs, dims):
+    """One kernel matmul under the configured precision mode. `x_split` is
+    the hi/lo pair (computed once per tile, shared by both contractions)."""
+    if _PREC_MODE == "hilo":
+        return _dot_hilo_parts(x_split[0], x_split[1], rhs, dims)
+    return jax.lax.dot_general(
+        x, rhs, dimension_numbers=(dims, ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=_PRECISION,
+    )
+
+
+def _value_grad_kernel(loss: PointwiseLoss, n: int, tile: int, x_ref, y_ref,
+                       off_ref, wt_ref, w_ref, stats_ref, grad_ref):
     i = pl.program_id(0)
-    valid = _row_mask(n)
+    valid = _row_mask(n, tile)
     # bf16 X streams at half the HBM traffic; compute stays f32 in VMEM
     # (Mosaic rejects mixed-dtype matmul operands).
     x = jnp.where(valid, x_ref[:], 0.0).astype(jnp.float32)
-    z = jax.lax.dot_general(
-        x, w_ref[:], dimension_numbers=(((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-        precision=_PRECISION,
+    x_split = _hilo_split(x) if _PREC_MODE == "hilo" else None
+    z = _dot_pair(
+        x, x_split, w_ref[:], (((1,), (0,)))
     ) + jnp.where(valid, off_ref[:], 0.0)
     y = jnp.where(valid, y_ref[:], 0.0)
     wt = jnp.where(valid, wt_ref[:], 0.0)
     val = jnp.sum(wt * loss.loss(z, y))
     u = wt * loss.d1(z, y)
-    g = jax.lax.dot_general(
-        x, u, dimension_numbers=(((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-        precision=_PRECISION,
-    )
+    g = _dot_pair(x, x_split, u, (((0,), (0,))))
     sum_u = jnp.sum(u)
 
     @pl.when(i == 0)
@@ -409,24 +471,17 @@ def _value_grad_kernel(loss: PointwiseLoss, n: int, x_ref, y_ref, off_ref,
         grad_ref[:] += g
 
 
-def _hvp_kernel(loss: PointwiseLoss, n: int, x_ref, y_ref, off_ref, wt_ref,
-                wv_ref, vshift_ref, stats_ref, hv_ref):
+def _hvp_kernel(loss: PointwiseLoss, n: int, tile: int, x_ref, y_ref,
+                off_ref, wt_ref, wv_ref, vshift_ref, stats_ref, hv_ref):
     i = pl.program_id(0)
-    valid = _row_mask(n)
+    valid = _row_mask(n, tile)
     x = jnp.where(valid, x_ref[:], 0.0).astype(jnp.float32)
-    zq = jax.lax.dot_general(
-        x, wv_ref[:], dimension_numbers=(((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-        precision=_PRECISION,
-    )
+    x_split = _hilo_split(x) if _PREC_MODE == "hilo" else None
+    zq = _dot_pair(x, x_split, wv_ref[:], ((1,), (0,)))
     z = zq[:, 0:1] + jnp.where(valid, off_ref[:], 0.0)
     q = zq[:, 1:2] + vshift_ref[0, 0]
     r = jnp.where(valid, wt_ref[:], 0.0) * loss.d2(z, jnp.where(valid, y_ref[:], 0.0)) * q
-    hv = jax.lax.dot_general(
-        x, r, dimension_numbers=(((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-        precision=_PRECISION,
-    )
+    hv = _dot_pair(x, x_split, r, ((0,), (0,)))
     sum_r = jnp.sum(r)
 
     @pl.when(i == 0)
@@ -465,16 +520,17 @@ def value_gradient_sums(
     n, d = features.shape
     # Fold the scalar margin shift into offsets so the kernel sees one vector.
     offsets = offsets + shift
-    grid = (pl.cdiv(n, _TILE_N),)
+    tile = _tile_for(d)
+    grid = (pl.cdiv(n, tile),)
 
     col = lambda a: a.reshape(n, 1).astype(jnp.float32)
-    kernel = functools.partial(_value_grad_kernel, loss, n)
-    row_spec = pl.BlockSpec((_TILE_N, 1), lambda i: (i, 0), memory_space=_VMEM)
+    kernel = functools.partial(_value_grad_kernel, loss, n, tile)
+    row_spec = pl.BlockSpec((tile, 1), lambda i: (i, 0), memory_space=_VMEM)
     stats, grad = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((_TILE_N, d), lambda i: (i, 0), memory_space=_VMEM),
+            pl.BlockSpec((tile, d), lambda i: (i, 0), memory_space=_VMEM),
             row_spec,
             row_spec,
             row_spec,
@@ -526,19 +582,20 @@ def hessian_vector_sums(
     """
     n, d = features.shape
     offsets = offsets + shift
-    grid = (pl.cdiv(n, _TILE_N),)
+    tile = _tile_for(d)
+    grid = (pl.cdiv(n, tile),)
 
     col = lambda a: a.reshape(n, 1).astype(jnp.float32)
     wv = jnp.stack(
         [w_eff.astype(jnp.float32), v_eff.astype(jnp.float32)], axis=1
     )  # [D, 2]
-    kernel = functools.partial(_hvp_kernel, loss, n)
-    row_spec = pl.BlockSpec((_TILE_N, 1), lambda i: (i, 0), memory_space=_VMEM)
+    kernel = functools.partial(_hvp_kernel, loss, n, tile)
+    row_spec = pl.BlockSpec((tile, 1), lambda i: (i, 0), memory_space=_VMEM)
     stats, hv = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((_TILE_N, d), lambda i: (i, 0), memory_space=_VMEM),
+            pl.BlockSpec((tile, d), lambda i: (i, 0), memory_space=_VMEM),
             row_spec,
             row_spec,
             row_spec,

@@ -339,12 +339,12 @@ def maybe_pack(feats, n_samples: int) -> Optional[BucketedSparseFeatures]:
     the problem is too small to amortize; or the packed layout's padding
     blowup makes it a net loss.
     """
-    from photon_ml_tpu.data.bucketed import L1_TILE_ROWS, pack_from_ell
+    from photon_ml_tpu.data.bucketed import pack_from_ell
     from photon_ml_tpu.data.containers import SparseFeatures
 
     if not isinstance(feats, SparseFeatures) or feats.indices.ndim != 2:
         return None
-    if not kernels_eligible():
+    if not pack_worth_considering(n_samples):
         return None
     if feats.values.dtype != jnp.float32:
         return None
@@ -356,8 +356,6 @@ def maybe_pack(feats, n_samples: int) -> Optional[BucketedSparseFeatures]:
                 return None
         except Exception:
             return None
-    if n_samples < 4 * L1_TILE_ROWS:
-        return None
     bf = pack_from_ell(feats)
     if not should_use(bf):
         return None

@@ -1,0 +1,82 @@
+"""Honest hilo-vs-highest dense kernel timing on the remote TPU backend:
+perturbed inputs per rep (defeats result caching) + scalar force-fetch
+(block_until_ready is unreliable over the tunnel), rtt-subtracted."""
+import os
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+mode = sys.argv[1] if len(sys.argv) > 1 else "hilo"
+os.environ["PHOTON_PALLAS_PRECISION"] = mode
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.ops import pallas_glm
+from photon_ml_tpu.ops.losses import LOGISTIC
+
+print("backend:", jax.default_backend(), "mode:", pallas_glm._PREC_MODE, flush=True)
+n, d = 1 << 20, 512
+rng = np.random.default_rng(0)
+X = jnp.asarray(rng.normal(size=(n, d)).astype(np.float32))
+y = jnp.asarray((rng.uniform(size=n) > 0.5).astype(np.float32))
+off = jnp.zeros(n)
+wt = jnp.ones(n)
+w0 = jnp.asarray(rng.normal(size=d).astype(np.float32) * 0.1)
+zero = jnp.zeros(())
+
+
+def force(out):
+    return float(sum(float(jnp.sum(x)) for x in out))
+
+
+# rtt of a scalar fetch
+_ = force((jnp.ones(2),))
+rtt = min(
+    (lambda t0: (force((jnp.ones(4) * (i + 1),)), time.perf_counter() - t0)[1])(
+        time.perf_counter()
+    )
+    for i in range(5)
+)
+print(f"rtt {rtt*1e3:.0f} ms", flush=True)
+
+t0 = time.perf_counter()
+val, g, su = pallas_glm.value_gradient_sums(LOGISTIC, w0, zero, X, y, off, wt)
+force((val, g))
+print(f"compile+first: {time.perf_counter()-t0:.1f}s", flush=True)
+
+reps = 8
+walls = []
+for i in range(reps):
+    w = w0 * (1.0 + 1e-4 * (i + 1))  # perturbed input per rep
+    t0 = time.perf_counter()
+    val, g, su = pallas_glm.value_gradient_sums(LOGISTIC, w, zero, X, y, off, wt)
+    force((val, g))
+    walls.append(time.perf_counter() - t0 - rtt)
+per = min(walls)
+print(f"value+grad [{mode}]: {per*1e3:.2f} ms/pass  {n*d*4/per/1e9:.1f} GB/s", flush=True)
+
+t0 = time.perf_counter()
+hv, sr = pallas_glm.hessian_vector_sums(LOGISTIC, w0, zero, w0, zero, X, y, off, wt)
+force((hv,))
+print(f"hvp compile+first: {time.perf_counter()-t0:.1f}s", flush=True)
+walls = []
+for i in range(reps):
+    w = w0 * (1.0 + 1e-4 * (i + 1))
+    t0 = time.perf_counter()
+    hv, sr = pallas_glm.hessian_vector_sums(LOGISTIC, w, zero, w, zero, X, y, off, wt)
+    force((hv,))
+    walls.append(time.perf_counter() - t0 - rtt)
+per = min(walls)
+print(f"hvp        [{mode}]: {per*1e3:.2f} ms/pass  {n*d*4/per/1e9:.1f} GB/s", flush=True)
+
+# numerics: kernel gradient vs f32 XLA reference on-device (cheap, no host f64)
+from photon_ml_tpu.ops import objective
+from photon_ml_tpu.data.containers import LabeledData
+
+val_x, g_x = objective.value_and_gradient(
+    LOGISTIC, w0, LabeledData(X, y, off, wt), use_pallas=False
+)
+num = float(jnp.max(jnp.abs(g - g_x)) / (jnp.max(jnp.abs(g_x)) + 1e-9))
+print(f"grad vs XLA-f32 scale-relative err: {num:.2e}", flush=True)

@@ -1,0 +1,46 @@
+"""fn_evals stability across tile sizes/seeds for the dense LBFGS solve."""
+import os
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+tile = sys.argv[1] if len(sys.argv) > 1 else "512"
+os.environ["PHOTON_PALLAS_TILE"] = tile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.data.containers import LabeledData
+from photon_ml_tpu.optimize import problem
+from photon_ml_tpu.optimize.config import L2, CoordinateOptimizationConfig, OptimizerConfig
+from photon_ml_tpu.ops.losses import LOGISTIC
+
+n, d = 1 << 20, 512
+cfg = CoordinateOptimizationConfig(
+    optimizer=OptimizerConfig(max_iterations=20, tolerance=1e-7),
+    regularization=L2,
+    reg_weight=10.0,
+)
+
+@jax.jit
+def solve(X, y, off, wt, w0):
+    return problem.solve(
+        LOGISTIC, LabeledData(X, y, off, wt), cfg, w0, None, use_pallas=True
+    )
+
+for seed in (0, 1, 2):
+    key = jax.random.PRNGKey(seed)
+    k1, k2, k3 = jax.random.split(key, 3)
+    w_true = jax.random.normal(k1, (d,), jnp.float32) * 0.2
+    X = jax.random.normal(k2, (n, d), jnp.float32)
+    margin = X @ w_true
+    y = (jax.random.uniform(k3, (n,)) < jax.nn.sigmoid(margin)).astype(jnp.float32)
+    off = jnp.zeros(n); wt = jnp.ones(n); w0 = jnp.zeros(d)
+    jax.block_until_ready(X)
+    t0 = time.perf_counter()
+    res = solve(X, y, off, wt, w0)
+    it = int(np.asarray(res.iterations)); fe = int(np.asarray(res.fn_evals))
+    loss = float(np.asarray(res.loss)); rsn = int(np.asarray(res.reason))
+    wall = time.perf_counter() - t0
+    print(f"tile={tile} seed={seed}: iters={it} fn_evals={fe} loss={loss:.6f} reason={rsn} wall={wall:.2f}s", flush=True)

@@ -50,7 +50,12 @@ def test_value_gradient_sums_match_xla(rng, loss, n):
         loss, w, shift, X, y, off, wt, interpret=True
     )
     np.testing.assert_allclose(float(val), float(val_ref), rtol=2e-5)
-    np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref), rtol=2e-4, atol=2e-4)
+    # Scale-relative bound: hilo's 2-pass decomposition carries ~2^-16
+    # representation error of the LARGEST magnitudes, so tiny elements of a
+    # mixed-magnitude gradient can miss a per-element rtol while the result
+    # is accurate to ~1e-5 of the vector's scale.
+    g_scale = float(np.max(np.abs(np.asarray(g_ref)))) + 1e-6
+    assert float(np.max(np.abs(np.asarray(g) - np.asarray(g_ref)))) < 3e-5 * g_scale
     u = wt * loss.d1(X @ w + off, y)
     np.testing.assert_allclose(float(sum_u), float(jnp.sum(u)), rtol=2e-4, atol=2e-4)
 
@@ -66,7 +71,8 @@ def test_hessian_vector_sums_match_xla(rng, loss):
     hv, sum_r = pallas_glm.hessian_vector_sums(
         loss, w, jnp.zeros(()), v, jnp.zeros(()), X, y, off, wt, interpret=True
     )
-    np.testing.assert_allclose(np.asarray(hv), np.asarray(hv_ref), rtol=2e-4, atol=2e-4)
+    hv_scale = float(np.max(np.abs(np.asarray(hv_ref)))) + 1e-6
+    assert float(np.max(np.abs(np.asarray(hv) - np.asarray(hv_ref)))) < 3e-5 * hv_scale
     z = X @ w + off
     r = wt * loss.d2(z, y) * (X @ v)
     np.testing.assert_allclose(float(sum_r), float(jnp.sum(r)), rtol=2e-4, atol=2e-4)
