@@ -560,8 +560,6 @@ def _run_job(
             for i, r in enumerate(tuned_results):
                 _save(r, f"tuned-{i}")
 
-    with open(os.path.join(out_root, "training-summary.json"), "w") as f:
-        json.dump(summary, f, indent=2, default=str)
     for i, r in enumerate(all_results):
         logger.info(
             "config %d%s: %s",
@@ -574,6 +572,15 @@ def _run_job(
     for r in all_results:
         for section, seconds in r.timing.items():
             timings.record(f"coordinate {section}", seconds)
+    # Persist stage walls with the summary: benchmarks and users read the
+    # ingest/train/save split from the artifact instead of scraping logs
+    # (the reference logs its Timed sections the same way,
+    # GameTrainingDriver.scala:360-480).
+    summary["timings_s"] = {
+        name: round(total, 3) for name, total in timings.sections.items()
+    }
+    with open(os.path.join(out_root, "training-summary.json"), "w") as f:
+        json.dump(summary, f, indent=2, default=str)
     logger.info("timing summary:\n%s", timings.summary())
     if event_emitter is not None:
         event_emitter.send(

@@ -22,7 +22,13 @@ import threading
 from typing import Optional
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SOURCES = ["index_store.cc", "libsvm_parser.cc", "bucketed_pack.cc", "avro_reader.cc"]
+_SOURCES = [
+    "index_store.cc",
+    "libsvm_parser.cc",
+    "bucketed_pack.cc",
+    "avro_reader.cc",
+    "avro_writer.cc",
+]
 _LOCK = threading.RLock()  # reentrant: load_native holds it across
 # native_library_path so concurrent first calls cannot race past a
 # half-initialized handle

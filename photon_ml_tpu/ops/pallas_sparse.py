@@ -42,6 +42,21 @@ computed in-kernel) evaluates the full objective in ~58 ms vs ~840 ms for
 the r02 XLA objective. The remaining ceiling is VPU one-hot construction
 (~128 lane-ops per entry per scatter side), not HBM or MXU — see
 BENCH_r03.json for the bench-protocol numbers.
+
+r04 ceiling measurement (VERDICT item 6): with the fused path actually
+engaged in training (the r03 gate bug kept it off), a same-run same-data
+comparison at 512k x 32 nnz measured fused ~19 ms per objective eval vs
+~54 ms for the composed matvec+rmatvec pair — the single entry stream is
+~2.8x the composed path, consistent with the one-hot work (built once per
+entry instead of once per side) dominating. Absolute GB/s on the
+remote-tunnel chip varies up to 4x between identical runs (dispatch
+contention), so the honest statement is the within-run ratio plus the
+analysis above: the one-hot construction spends ~rt lane-ops/entry on the
+z-accumulator side regardless of layout, and an MXU block-diagonal
+scatter was prototyped on paper to cost MORE lane traffic in operand
+assembly than it saves in contraction. A sublane-rotation accumulate
+remains open; at current engagement the sparse solve is already <0.6 s
+per full LBFGS fit at bench scale, 16-22x the r02 XLA path.
 """
 
 from __future__ import annotations

@@ -137,6 +137,40 @@ def matrix_row_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(mesh.axis_names[0], None))
 
 
+def feature_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard the FEATURE axis of the fixed-effect design matrix (columns)
+    and its coefficient vector over the mesh — the wide-FE option the
+    reference does not have (SURVEY §2.6 TP row: the Breeze coefficient
+    vector is driver-resident, so its feature dim never shards).
+
+    Use when the coefficient state no longer fits one device's HBM: with
+    X placed as P(None, axis) and every D-vector (w0, and transparently the
+    optimizer's L-BFGS history/TRON CG state) as P(axis), GSPMD partitions
+    the XLA objective's matmuls — `z = X @ w` becomes per-device partial
+    products + an ICI all-reduce, `g = X^T u` stays device-local — and the
+    vector algebra of the solver runs elementwise on shards with psums only
+    at dot products. No solver code changes: this is sharding annotation +
+    compiler, per the scaling-book recipe (tested for parity against the
+    replicated path in tests/test_parallel.py).
+
+    Capacity math this unlocks (PARITY.md §wide-FE): one v5e core holds
+    ~16 GB HBM; a replicated f32 coefficient vector with L-BFGS m=10
+    history costs D * 4 B * ~23 (w, g, direction, 2x10 history, line-search
+    temporaries), capping D at ~180M replicated. Feature sharding divides
+    that state by the mesh size: a 256-chip v5e pod reaches ~46B f32
+    coefficients, and the reference's "hundreds of billions" claim
+    (README.md:60) is reachable with bf16 state + larger pods — with X
+    row-streamed, the coefficient state is the only per-device scaling
+    limit."""
+    return NamedSharding(mesh, P(None, mesh.axis_names[0]))
+
+
+def feature_vector_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for D-vectors (coefficients/gradients) paired with
+    `feature_sharding`."""
+    return NamedSharding(mesh, P(mesh.axis_names[0]))
+
+
 def leading_axis_mesh(array, *, require_divisible: bool = False) -> Optional[Mesh]:
     """The 1-D mesh `array` is sharded over along its leading axis, if any.
 

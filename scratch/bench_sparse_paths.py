@@ -1,0 +1,99 @@
+"""Fused vs composed sparse kernel timing on the real TPU (honest protocol:
+perturbed inputs, jitted combining scalar fetch, rtt-subtracted)."""
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.data.bucketed import pack_bucketed
+from photon_ml_tpu.ops import pallas_sparse
+from photon_ml_tpu.ops.losses import LOGISTIC
+
+print("backend:", jax.default_backend(), flush=True)
+n, k, d = 1 << 19, 32, 16384
+rng = np.random.default_rng(11)
+rows = np.repeat(np.arange(n, dtype=np.int64), k)
+cols = rng.integers(0, d, size=n * k).astype(np.int64)
+vals = rng.normal(size=n * k).astype(np.float32)
+t0 = time.perf_counter()
+bf = pack_bucketed(rows, cols, vals, n, d)
+jax.block_until_ready(bf.level1.packed)
+print(f"pack(host)+upload: {time.perf_counter()-t0:.1f}s  {bf.density_report()}", flush=True)
+
+y = jnp.asarray((rng.uniform(size=n) > 0.5).astype(np.float32))
+off = jnp.zeros(n)
+wt = jnp.ones(n)
+w0 = jnp.asarray(rng.normal(size=d).astype(np.float32) * 0.05)
+zero = jnp.zeros(())
+
+
+@jax.jit
+def force_sum(parts):
+    return sum((jnp.sum(p) for p in parts[1:]), jnp.sum(parts[0]))
+
+
+def force(parts):
+    return float(force_sum(tuple(parts)))
+
+
+force((jnp.ones(2),))
+rtt = min(
+    (lambda t0: (force((jnp.ones(4) * (i + 1),)), time.perf_counter() - t0)[1])(time.perf_counter())
+    for i in range(5)
+)
+print(f"rtt {rtt*1e3:.0f} ms", flush=True)
+
+entry_bytes = n * k * 8  # packed int32 + f32 value per entry
+
+
+def bench(label, fn, streams):
+    out = fn(w0)
+    force(out)
+    walls = []
+    for i in range(6):
+        w = w0 * (1.0 + 1e-4 * (i + 1))
+        t0 = time.perf_counter()
+        force(fn(w))
+        walls.append(time.perf_counter() - t0 - rtt)
+    per = min(walls)
+    print(f"{label}: {per*1e3:.1f} ms  {streams*entry_bytes/per/1e9:.1f} GB/s "
+          f"({streams} entry-stream(s))", flush=True)
+    return per
+
+
+# composed: one matvec (stream 1) ...
+bench("matvec           ", lambda w: (pallas_sparse.matvec(bf, w),), 1)
+u_fix = jnp.asarray(rng.normal(size=n).astype(np.float32))
+
+bench("rmatvec          ", lambda w: (pallas_sparse.rmatvec(bf, u_fix * w[0]),), 1)
+
+# composed objective eval = matvec + loss + rmatvec (2 streams); bf and the
+# label columns must be ARGUMENTS (a closure const-folds them into the
+# compile payload, which the remote compile service rejects at this size).
+import functools
+
+@functools.partial(jax.jit, static_argnames=())
+def composed(bf_, w, y_, off_, wt_):
+    z = pallas_sparse.matvec(bf_, w) + off_
+    u = wt_ * LOGISTIC.d1(z, y_)
+    val = jnp.sum(wt_ * LOGISTIC.loss(z, y_))
+    g = pallas_sparse.rmatvec(bf_, u)
+    return val, g
+
+
+bench("composed val+grad", lambda w: composed(bf, w, y, off, wt), 2)
+
+# fused single-stream kernel
+if pallas_sparse.fused_feasible(bf):
+    bench(
+        "fused val+grad   ",
+        lambda w: pallas_sparse.fused_value_gradient_sums(
+            LOGISTIC, w, zero, bf, y, off, wt
+        )[:2],
+        1,
+    )
+else:
+    print("fused infeasible:", bf.num_buckets * bf.level1.spv, flush=True)

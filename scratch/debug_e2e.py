@@ -1,0 +1,61 @@
+"""Find the e2e OOM stage on the TPU at reduced scale."""
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, "/root/repo")
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import photon_ml_tpu.io.avro_data as ad
+from photon_ml_tpu.data.game_dataset import FixedEffectDataConfig, RandomEffectDataConfig
+from photon_ml_tpu.estimators.game_estimator import GameEstimator
+from photon_ml_tpu.evaluation.suite import EvaluationSuite, EvaluatorType
+from photon_ml_tpu.native.avro_writer import write_training_examples_columnar as wcol
+from photon_ml_tpu.transformers.game_transformer import GameTransformer
+from photon_ml_tpu.types import TaskType
+from photon_ml_tpu.optimize.config import L2, CoordinateOptimizationConfig, OptimizerConfig
+
+rows = int(sys.argv[1]) if len(sys.argv) > 1 else 2_000_000
+t00 = time.perf_counter()
+def mark(m):
+    print(f"+{time.perf_counter()-t00:.1f}s {m}", flush=True)
+
+n_users, n_movies, k, d = max(200, rows // 145), max(50, rows // 740), 8, 200
+rng = np.random.default_rng(23)
+users = rng.integers(0, n_users, size=rows)
+movies = rng.integers(0, n_movies, size=rows)
+indptr = np.arange(rows + 1, dtype=np.int64) * k
+ids = rng.integers(0, d, size=rows * k).astype(np.int32)
+vals = rng.normal(size=rows * k)
+w_true = rng.normal(size=d) * 0.3
+margin = (vals * w_true[ids]).reshape(rows, k).sum(1) + rng.normal(size=n_users)[users] * 0.7 + rng.normal(size=n_movies)[movies] * 0.7
+labels = (rng.uniform(size=rows) < 1 / (1 + np.exp(-margin))).astype(np.float64)
+tags = np.char.add(np.char.add(users.astype(str), ":"), movies.astype(str))
+td = tempfile.mkdtemp()
+wcol(os.path.join(td, "p0.avro"), labels, indptr, ids, vals, [f"f{i}" for i in range(d)], tag_key="umId", tag_values=tags)
+mark("written")
+ds, _ = ad.read_game_dataset(td, {"g": ad.FeatureShardConfig(("features",), True)}, id_tag_fields=["umId"])
+mark(f"ingested {ds.num_samples}")
+um = np.char.partition(ds.id_tags["umId"].astype(str), ":")
+ds.id_tags["userId"] = um[:, 0]
+ds.id_tags["movieId"] = um[:, 2]
+mark("tags split")
+est = GameEstimator(
+    TaskType.LOGISTIC_REGRESSION,
+    {
+        "global": FixedEffectDataConfig("g"),
+        "per-user": RandomEffectDataConfig("userId", "g", active_upper_bound=256, min_bucket=8),
+        "per-movie": RandomEffectDataConfig("movieId", "g", active_upper_bound=512, min_bucket=8),
+    },
+    coordinate_descent_iterations=1,
+)
+cfg = lambda it, w: CoordinateOptimizationConfig(optimizer=OptimizerConfig(max_iterations=it, tolerance=1e-6), regularization=L2, reg_weight=w)
+results = est.fit(ds, None, [{"global": cfg(10, 1.0), "per-user": cfg(5, 10.0), "per-movie": cfg(5, 10.0)}])
+mark("trained")
+scores = GameTransformer(results[0].model, est.scoring_specs(), est.task).transform(ds)
+suite = EvaluationSuite([EvaluatorType("AUC")], jnp.asarray(labels.astype(np.float32)))
+res = suite.evaluate(scores.scores)
+mark(f"AUC {float(res.primary_value):.4f}")

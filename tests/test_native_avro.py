@@ -289,3 +289,70 @@ class TestHostCooStash:
         M_coo = np.zeros((n, dim))
         np.add.at(M_coo, (np.asarray(rows), np.asarray(cols_)), np.asarray(vals))
         np.testing.assert_allclose(M_coo, _dense(ds, "g", dim))
+
+
+@needs_native
+class TestColumnarWriter:
+    def test_native_and_python_writers_agree(self, tmp_path):
+        from photon_ml_tpu.native import avro_writer as aw
+
+        rng = np.random.default_rng(21)
+        n, k, d = 800, 5, 60
+        indptr = np.arange(n + 1, dtype=np.int64) * k
+        ids = rng.integers(0, d, size=n * k).astype(np.int32)
+        vals = rng.normal(size=n * k)
+        names = [f"f{i}" for i in range(d)]
+        labels = (rng.uniform(size=n) > 0.5).astype(np.float64)
+        offs = rng.normal(size=n) * 0.1
+        wts = rng.uniform(0.5, 1.5, size=n)
+        tags = rng.integers(0, 9, size=n).astype(str)
+
+        p_nat = str(tmp_path / "nat.avro")
+        aw.write_training_examples_columnar(
+            p_nat, labels, indptr, ids, vals, names,
+            offsets=offs, weights=wts, tag_key="entityId", tag_values=tags,
+        )
+        p_py = str(tmp_path / "py.avro")
+        aw._python_fallback(
+            p_py, labels, indptr, ids, vals, names,
+            offsets=offs, weights=wts, tag_key="entityId", tag_values=tags,
+        )
+        cfgs = {"g": ad.FeatureShardConfig(("features",), True)}
+        ds_n, m_n = ad.read_game_dataset(p_nat, cfgs, id_tag_fields=["entityId"])
+        ds_p, m_p = ad.read_game_dataset(p_py, cfgs, id_tag_fields=["entityId"])
+        for attr in ("labels", "offsets", "weights"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(ds_n, attr)), np.asarray(getattr(ds_p, attr))
+            )
+        assert np.array_equal(ds_n.id_tags["entityId"], ds_p.id_tags["entityId"])
+        assert m_n["g"].size == m_p["g"].size
+        np.testing.assert_allclose(
+            _dense(ds_n, "g", m_n["g"].size), _dense(ds_p, "g", m_p["g"].size)
+        )
+
+    def test_empty_rows_and_no_tags(self, tmp_path):
+        from photon_ml_tpu.native import avro_writer as aw
+
+        indptr = np.array([0, 2, 2, 3], np.int64)  # middle record empty
+        ids = np.array([0, 1, 0], np.int32)
+        vals = np.array([1.0, 2.0, 3.0])
+        p = str(tmp_path / "t.avro")
+        aw.write_training_examples_columnar(
+            p, np.array([1.0, 0.0, 1.0]), indptr, ids, vals, ["a", "b"]
+        )
+        cfgs = {"g": ad.FeatureShardConfig(("features",), False)}
+        ds, maps = ad.read_game_dataset(p, cfgs)
+        M = _dense(ds, "g", maps["g"].size)
+        assert M[1].sum() == 0  # empty record round-trips empty
+        assert ds.num_samples == 3
+
+    def test_bad_name_id_fails_cleanly(self, tmp_path):
+        from photon_ml_tpu.native import avro_writer as aw
+
+        indptr = np.array([0, 1], np.int64)
+        p = str(tmp_path / "t.avro")
+        with pytest.raises(OSError):
+            aw.write_training_examples_columnar(
+                p, np.array([1.0]), indptr, np.array([5], np.int32),
+                np.array([1.0]), ["only"],  # id 5 out of range
+            )
