@@ -89,15 +89,39 @@ def entity_rows_for_dataset(
     keys = dataset.id_tags[spec.random_effect_type]
     index = spec.entity_index
     unseen = len(index)
-    key_iter = keys.tolist()
     # Entity ids are strings in persisted artifacts (REId = String,
     # Types.scala:9-25) but may be ints in in-memory datasets; coerce lookup
     # keys to the index's key type so reloaded models resolve entities.
-    if index and isinstance(next(iter(index)), str) and keys.dtype.kind not in "USO":
-        key_iter = (str(k) for k in key_iter)
-    return np.fromiter(
-        (index.get(k, unseen) for k in key_iter), np.int64, count=len(keys)
+    coerce = (
+        index
+        and isinstance(next(iter(index)), str)
+        and keys.dtype.kind not in "USO"
     )
+    # Dict-lookup the UNIQUE keys only (entities repeat ~n/E times), then
+    # scatter through the inverse — the per-row Python loop was the last
+    # O(n) interpreter cost in the scoring path. np.unique needs orderable
+    # keys (it sorts); hand-built object-dtype tags with mixed types keep
+    # the hash-based per-row path.
+    try:
+        uniq, inv = np.unique(keys, return_inverse=True)
+    except TypeError:
+        return np.fromiter(
+            (
+                index.get(str(k) if coerce else k, unseen)
+                for k in keys.tolist()
+            ),
+            np.int64,
+            count=len(keys),
+        )
+    uniq_rows = np.fromiter(
+        (
+            index.get(str(k) if coerce else k, unseen)
+            for k in uniq.tolist()
+        ),
+        np.int64,
+        count=len(uniq),
+    )
+    return uniq_rows[inv]
 
 
 def prepare_coordinate_data(
