@@ -203,6 +203,14 @@ def _read_data(args, coordinate_configs: Dict[str, CoordinateConfiguration]):
         if getattr(args, "input_column_names", None)
         else None
     )
+    # NOTE: read_game_dataset supports per-process file slicing
+    # (process_index/process_count) for multi-host ingest, but this driver
+    # deliberately does NOT auto-engage it: the estimator trains on
+    # process-local arrays, so handing each host a disjoint slice without
+    # assembling global sharded arrays first (the
+    # jax.make_array_from_process_local_data step parallel/multihost.py
+    # demonstrates) would silently fit N divergent models. Multi-host
+    # pipelines call the reader directly and own that assembly.
     train, index_maps = avro_data.read_game_dataset(
         train_paths,
         shard_configs,

@@ -107,6 +107,8 @@ def read_game_dataset(
     id_tag_fields: Sequence[str] = (),
     response_field: str = RESPONSE,
     columns: Optional[InputColumnNames] = None,
+    process_index: Optional[int] = None,
+    process_count: Optional[int] = None,
 ) -> Tuple[GameDataset, Dict[str, IndexMap]]:
     """AvroDataReader.readMerged (:85-220) + GameConverters: Avro file(s)/
     dir(s) -> (GameDataset, per-shard IndexMaps).
@@ -118,8 +120,49 @@ def read_game_dataset(
     keys). When `index_maps` is given, unseen features are dropped (the
     scoring path); otherwise maps are built from the data (the training
     path).
+
+    Multi-host ingest: pass `process_index`/`process_count` (normally
+    `jax.process_index()` / `jax.process_count()`) and each host reads a
+    deterministic round-robin slice of the expanded FILE list — the
+    cluster-parallel reader split the reference gets from mapred input
+    splits across executors (AvroUtils.scala:47). Feature ids must then
+    agree across hosts, so a shared `index_maps` (an off-heap store built
+    by cli/build_index.py, as the reference shares PalDB partitions via
+    sc.addFile) is required.
     """
     paths = [path] if isinstance(path, str) else list(path)
+    if (process_index is None) != (process_count is None):
+        raise ValueError(
+            "process_index and process_count must be passed together — one "
+            "without the other would silently read the FULL dataset on "
+            "every host"
+        )
+    if process_count is not None and process_count > 1:
+        missing_maps = [
+            s
+            for s in shard_configs
+            if index_maps is None or s not in index_maps
+        ]
+        if missing_maps:
+            raise ValueError(
+                "multi-host ingest (process_count > 1) requires shared "
+                f"index_maps for every shard (missing: {missing_maps}) — "
+                "build an off-heap store first (cli/build_index.py) so "
+                "feature ids agree across hosts"
+            )
+        if process_index is None or not 0 <= process_index < process_count:
+            raise ValueError("process_index must be in [0, process_count)")
+        files: List[str] = []
+        for p in paths:
+            files.extend(avro_io.list_container_files(p))
+        my_files = files[process_index::process_count]
+        if not my_files:
+            raise ValueError(
+                f"process {process_index}/{process_count} has no input "
+                f"files ({len(files)} total) — split the data into at "
+                "least one container file per host"
+            )
+        paths = my_files
 
     if columns is not None and response_field != RESPONSE:
         raise ValueError(

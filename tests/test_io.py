@@ -353,3 +353,69 @@ def test_model_store_empty_part_file_keeps_variances(tmp_path, rng):
     lre = loaded.coordinates["per-user"]
     assert len(lre.entity_ids) == 2
     assert lre.variances is not None
+
+
+class TestMultihostIngest:
+    """File-sliced multi-host ingest: each process reads a deterministic
+    round-robin slice; shared index maps keep feature ids consistent."""
+
+    def _write(self, tmp_path, n_files=4, rows=50):
+        import photon_ml_tpu.io.avro_data as ad
+
+        rng = np.random.default_rng(5)
+        d = os.path.join(str(tmp_path), "train")
+        os.makedirs(d, exist_ok=True)
+        all_labels = []
+        for fi in range(n_files):
+            feats = [
+                [(f"f{j}", float(rng.normal())) for j in rng.choice(20, size=3, replace=False)]
+                for _ in range(rows)
+            ]
+            labels = (rng.uniform(size=rows) > 0.5).astype(float)
+            all_labels.append(labels)
+            ad.write_training_examples(
+                os.path.join(d, f"part-{fi}.avro"), feats, labels
+            )
+        return d, all_labels
+
+    def test_slices_partition_and_union(self, tmp_path):
+        import photon_ml_tpu.io.avro_data as ad
+        from photon_ml_tpu.data.index_map import IndexMap
+
+        d, all_labels = self._write(tmp_path)
+        cfgs = {"g": ad.FeatureShardConfig(("features",), True)}
+        imap = {"g": IndexMap.from_feature_names(
+            {f"f{i}" for i in range(20)}, add_intercept=True)}
+        parts = []
+        for pi in range(2):
+            ds, _ = ad.read_game_dataset(
+                d, cfgs, index_maps=imap, process_index=pi, process_count=2
+            )
+            parts.append(np.asarray(ds.labels))
+        # round-robin over sorted files: process 0 gets files 0,2; 1 gets 1,3
+        np.testing.assert_array_equal(
+            parts[0], np.concatenate([all_labels[0], all_labels[2]]).astype(np.float32)
+        )
+        np.testing.assert_array_equal(
+            parts[1], np.concatenate([all_labels[1], all_labels[3]]).astype(np.float32)
+        )
+
+    def test_requires_shared_index_maps(self, tmp_path):
+        import photon_ml_tpu.io.avro_data as ad
+
+        d, _ = self._write(tmp_path, n_files=2)
+        cfgs = {"g": ad.FeatureShardConfig(("features",), True)}
+        with pytest.raises(ValueError, match="shared"):
+            ad.read_game_dataset(d, cfgs, process_index=0, process_count=2)
+
+    def test_too_few_files_errors(self, tmp_path):
+        import photon_ml_tpu.io.avro_data as ad
+        from photon_ml_tpu.data.index_map import IndexMap
+
+        d, _ = self._write(tmp_path, n_files=1)
+        cfgs = {"g": ad.FeatureShardConfig(("features",), True)}
+        imap = {"g": IndexMap.from_feature_names({"f0"}, add_intercept=True)}
+        with pytest.raises(ValueError, match="no input"):
+            ad.read_game_dataset(
+                d, cfgs, index_maps=imap, process_index=1, process_count=2
+            )
