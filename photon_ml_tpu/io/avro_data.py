@@ -137,6 +137,14 @@ def read_game_dataset(
             "without the other would silently read the FULL dataset on "
             "every host"
         )
+    if process_count is not None:
+        # Validate the pair whenever passed (even process_count == 1):
+        # misconfigured cluster wiring must fail loudly, not silently read
+        # the full dataset.
+        if process_count < 1:
+            raise ValueError(f"process_count must be >= 1, got {process_count}")
+        if not 0 <= process_index < process_count:
+            raise ValueError("process_index must be in [0, process_count)")
     if process_count is not None and process_count > 1:
         missing_maps = [
             s
@@ -150,19 +158,20 @@ def read_game_dataset(
                 "build an off-heap store first (cli/build_index.py) so "
                 "feature ids agree across hosts"
             )
-        if process_index is None or not 0 <= process_index < process_count:
-            raise ValueError("process_index must be in [0, process_count)")
         files: List[str] = []
         for p in paths:
             files.extend(avro_io.list_container_files(p))
-        my_files = files[process_index::process_count]
-        if not my_files:
+        # Uniform check: every host computes the same sorted file list, so
+        # ALL hosts raise identically — an empty-slice host exiting alone
+        # would strand the others in their first collective until the
+        # distributed-runtime heartbeat timeout.
+        if len(files) < process_count:
             raise ValueError(
-                f"process {process_index}/{process_count} has no input "
-                f"files ({len(files)} total) — split the data into at "
-                "least one container file per host"
+                f"multi-host ingest needs at least one container file per "
+                f"process ({len(files)} files < {process_count} processes) "
+                "— split the data"
             )
-        paths = my_files
+        paths = files[process_index::process_count]
 
     if columns is not None and response_field != RESPONSE:
         raise ValueError(

@@ -67,25 +67,61 @@ def _worker(coordinator: str, num_processes: int, process_id: int, devices_per_p
     mesh = make_mesh()  # global mesh spanning every process's devices
     assert mesh.devices.size == n_devices
 
-    # Same global problem on every process (deterministic from the seed);
-    # each process materializes only ITS shard rows via
-    # make_array_from_callback — the multi-host ingestion pattern.
-    rng = np.random.default_rng(0)
-    n, d = 64 * n_devices, 16
-    X = rng.normal(size=(n, d)).astype(np.float32)
-    w_true = rng.normal(size=d).astype(np.float32)
-    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-(X @ w_true)))).astype(np.float32)
-
     s2 = NamedSharding(mesh, P(mesh.axis_names[0], None))
     s1 = NamedSharding(mesh, P(mesh.axis_names[0]))
-    Xs = jax.make_array_from_callback((n, d), s2, lambda idx: X[idx])
-    ys = jax.make_array_from_callback((n,), s1, lambda idx: y[idx])
-    zeros = jax.make_array_from_callback(
-        (n,), s1, lambda idx: np.zeros(n, np.float32)[idx]
+
+    data_dir = os.environ["PHOTON_MH_DATA"]  # written by the launcher
+    d = 16
+
+    def densify(dataset):
+        """ELL shard -> dense host matrix (padding values are exact zeros)."""
+        sp = dataset.shards["g"]
+        m = dataset.num_samples
+        out = np.zeros((m, d), np.float32)
+        idx, val = np.asarray(sp.indices), np.asarray(sp.values)
+        np.add.at(
+            out,
+            (np.repeat(np.arange(m), idx.shape[1]), idx.ravel()),
+            val.ravel(),
+        )
+        return out
+
+    # The full pod-scale ingest loop: each process reads ITS round-robin
+    # slice of the Avro files (read_game_dataset process slicing) with a
+    # shared deterministic index map, then promotes the process-local
+    # columns to ONE global sharded array — the
+    # make_array_from_process_local_data step the single-host driver
+    # deliberately leaves to multi-host pipelines (cli/train.py).
+    import photon_ml_tpu.io.avro_data as ad
+    from photon_ml_tpu.data.index_map import IndexMap
+
+    imap = IndexMap.from_feature_names(f"f{i}" for i in range(d))
+    cfgs = {"g": ad.FeatureShardConfig(("features",), False)}
+    ds, _ = ad.read_game_dataset(
+        data_dir,
+        cfgs,
+        index_maps={"g": imap},
+        process_index=process_id,
+        process_count=num_processes,
     )
-    ones = jax.make_array_from_callback(
-        (n,), s1, lambda idx: np.ones(n, np.float32)[idx]
+    n_loc = ds.num_samples
+    X_loc = densify(ds)
+    y_loc = np.asarray(ds.labels)
+    n = n_loc * num_processes
+    Xs = jax.make_array_from_process_local_data(s2, X_loc, (n, d))
+    ys = jax.make_array_from_process_local_data(s1, y_loc, (n,))
+    zeros = jax.make_array_from_process_local_data(
+        s1, np.zeros(n_loc, np.float32), (n,)
     )
+    ones = jax.make_array_from_process_local_data(
+        s1, np.ones(n_loc, np.float32), (n,)
+    )
+    # Global problem for the on-host optimality check: every worker can
+    # cheaply re-read ALL files (tiny fixture) without slicing.
+    ds_all, _ = ad.read_game_dataset(data_dir, cfgs, index_maps={"g": imap})
+    X = densify(ds_all)
+    y = np.asarray(ds_all.labels)
+    ingest_note = f"ingested {n_loc} rows/process from Avro slices, "
 
     cfg = CoordinateOptimizationConfig(
         optimizer=OptimizerConfig(max_iterations=30, tolerance=1e-8),
@@ -123,8 +159,8 @@ def _worker(coordinator: str, num_processes: int, process_id: int, devices_per_p
     if process_id == 0:
         print(
             f"dryrun_multihost OK: {num_processes} processes x "
-            f"{devices_per_proc} devices, {n} samples, grad-norm ratio "
-            f"{gnorm / g0:.2e}",
+            f"{devices_per_proc} devices, {ingest_note}{n} samples, "
+            f"grad-norm ratio {gnorm / g0:.2e}",
             flush=True,
         )
 
@@ -154,6 +190,36 @@ def dryrun_multihost(
     import tempfile
 
     with tempfile.TemporaryDirectory(prefix="photon_multihost_") as logdir:
+        # Pre-write one Avro file per process (equal row counts, dense 16
+        # features per record): workers ingest their round-robin slice and
+        # assemble the global sharded arrays — the pod-scale ingest loop,
+        # end to end. Generation stays deterministic so every worker can
+        # rebuild the global problem for the optimality check.
+        data_dir = os.path.join(logdir, "data")
+        os.makedirs(data_dir)
+        import numpy as np
+
+        import photon_ml_tpu.io.avro_data as avro_data
+
+        d = 16
+        rows_per_proc = 64 * devices_per_proc
+        rng = np.random.default_rng(0)
+        w_true = rng.normal(size=d).astype(np.float32)
+        for pid in range(n_processes):
+            Xp = rng.normal(size=(rows_per_proc, d)).astype(np.float32)
+            yp = (
+                rng.uniform(size=rows_per_proc)
+                < 1 / (1 + np.exp(-(Xp @ w_true)))
+            ).astype(np.float64)
+            feats = [
+                [(f"f{j}", float(Xp[i, j])) for j in range(d)]
+                for i in range(rows_per_proc)
+            ]
+            avro_data.write_training_examples(
+                os.path.join(data_dir, f"part-{pid}.avro"), feats, yp
+            )
+        env["PHOTON_MH_DATA"] = data_dir
+
         procs = []
         for pid in range(n_processes):
             out_f = open(os.path.join(logdir, f"w{pid}.out"), "w+")

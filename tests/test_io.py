@@ -415,7 +415,7 @@ class TestMultihostIngest:
         d, _ = self._write(tmp_path, n_files=1)
         cfgs = {"g": ad.FeatureShardConfig(("features",), True)}
         imap = {"g": IndexMap.from_feature_names({"f0"}, add_intercept=True)}
-        with pytest.raises(ValueError, match="no input"):
+        with pytest.raises(ValueError, match="at least one container file"):
             ad.read_game_dataset(
                 d, cfgs, index_maps=imap, process_index=1, process_count=2
             )
