@@ -275,10 +275,14 @@ def _child() -> None:
     # bucketed layout, so the ELL arrays are never uploaded here.
     sp = SparseFeatures(sp_idx_np, sp_val_np, d_sparse)
     ds_sp = GameDataset.build({"s": sp}, y)
+    from photon_ml_tpu.data.game_dataset import HostCSR
+
     coo_rows = np.repeat(np.arange(n, dtype=np.int64), k_nnz)
     coo_cols = sp_idx_np.reshape(-1).astype(np.int64)
     coo_vals = sp_val_np.reshape(-1)
-    ds_sp.host_coo["s"] = (coo_rows, coo_cols, coo_vals, d_sparse)
+    ds_sp.host_csr["s"] = HostCSR(
+        np.arange(n + 1, dtype=np.int64) * k_nnz, coo_cols, coo_vals, d_sparse
+    )
 
     # Host-only pack time (the data-plane cost proper, no device transfer):
     # measured by packing with the device upload stubbed out.

@@ -116,7 +116,7 @@ def run(args) -> dict:
     )
     # Scoring never packs a bucketed layout; drop the ingest's host-COO
     # stash rather than pin ~20 bytes/nnz of host RAM for the run.
-    dataset.host_coo.clear()
+    dataset.host_csr.clear()
     logger.info("scoring %d samples", dataset.num_samples)
 
     transformer = GameTransformer(model, specs, artifact.task)

@@ -83,6 +83,39 @@ class RandomEffectDataConfig:
 
 
 @dataclasses.dataclass
+class HostCSR:
+    """Host-side CSR stash from ingest for the data-plane bucketed pack.
+
+    Row-id expansion and the constant intercept column are deferred to
+    `to_coo()` (the pack consumer), so the ingest wall never pays the COO
+    concatenation — the reference likewise builds its per-partition layout
+    once at dataset construction (RandomEffectDataset.scala:229-264).
+    """
+
+    indptr: np.ndarray  # (n_rows + 1,) int64
+    cols: np.ndarray  # (nnz,) feature ids
+    vals: np.ndarray  # (nnz,) float32
+    dim: int
+    extra_col: Optional[tuple] = None  # (intercept index, value) per row
+
+    def to_coo(self):
+        """Expand to (rows, cols, vals, dim) COO triplets."""
+        n = len(self.indptr) - 1
+        rows = np.repeat(np.arange(n, dtype=np.int64), np.diff(self.indptr))
+        cols = self.cols.astype(np.int64, copy=False)
+        vals = self.vals
+        if self.extra_col is not None:
+            rows = np.concatenate([rows, np.arange(n, dtype=np.int64)])
+            cols = np.concatenate(
+                [cols, np.full(n, self.extra_col[0], np.int64)]
+            )
+            vals = np.concatenate(
+                [vals, np.full(n, self.extra_col[1], np.float32)]
+            )
+        return rows, cols, vals, self.dim
+
+
+@dataclasses.dataclass
 class GameDataset:
     """Columnar GAME data in fixed sample order (GameDatum.scala:38 columns).
 
@@ -95,15 +128,14 @@ class GameDataset:
     offsets: Array
     weights: Array
     id_tags: Dict[str, np.ndarray]
-    # Host-side COO triplets per shard (rows, cols, values, dim) stashed by
-    # the ingest path. Lets the bucketed sparse pack (ops/pallas_sparse
-    # maybe_pack) run in the data plane — straight from host arrays, before
-    # any device transfer — instead of pulling device ELL arrays back to
-    # host (the reference builds its layout once at dataset construction,
-    # RandomEffectDataset.scala:229-264). Consumed (popped) by the first
-    # coordinate that packs the shard, so the triplets don't pin host RAM
-    # for the training run's lifetime. Absent for hand-built datasets.
-    host_coo: Dict[str, tuple] = dataclasses.field(default_factory=dict)
+    # Host-side CSR per shard (HostCSR) stashed by the ingest path. Lets the
+    # bucketed sparse pack (ops/pallas_sparse maybe_pack) run in the data
+    # plane — straight from host arrays, before any device transfer —
+    # instead of pulling device ELL arrays back to host. Consumed (popped)
+    # by the first coordinate that packs the shard, so the arrays don't pin
+    # host RAM for the training run's lifetime. Absent for hand-built
+    # datasets.
+    host_csr: Dict[str, "HostCSR"] = dataclasses.field(default_factory=dict)
     # Pack-once cache: the bucketed layout is a property of the shard data,
     # so reg-weight sweeps / warm-start chains that rebuild coordinates
     # reuse it instead of re-packing per configuration.

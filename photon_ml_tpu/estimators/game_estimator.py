@@ -371,9 +371,9 @@ class GameEstimator:
                 # so the triplets don't pin host RAM for the rest of fit.
                 # The validation dataset never trains, so its stash has no
                 # consumer at all.
-                getattr(data, "host_coo", {}).clear()
+                getattr(data, "host_csr", {}).clear()
                 if validation_data is not None:
-                    getattr(validation_data, "host_coo", {}).clear()
+                    getattr(validation_data, "host_csr", {}).clear()
             reg_weights = {cid: cfgs[cid].reg_weight for cid in cfgs}
 
             validation_scorer = None

@@ -124,21 +124,23 @@ class FixedEffectCoordinate:
                 cache = getattr(dataset, "bucketed_cache", {})
                 cached = cache.get(config_data_shard, _PACK_UNDECIDED)
                 if cached is _PACK_UNDECIDED:
-                    # Preferred path: pack from the host COO triplets the
-                    # ingest stashed on the dataset — no device->host pull
-                    # of the ELL arrays (the r03 bench measured that round
-                    # trip at 275x the solve time on a remote-device
-                    # backend). The stash is consumed here so the triplets
-                    # don't pin host RAM for the run's lifetime. Fallback
-                    # keeps the device-ELL pack for hand-built datasets.
-                    coo = getattr(dataset, "host_coo", {}).pop(
+                    # Preferred path: pack from the host CSR the ingest
+                    # stashed on the dataset — no device->host pull of the
+                    # ELL arrays (the r03 bench measured that round trip at
+                    # 275x the solve time on a remote-device backend). The
+                    # stash is consumed here so the arrays don't pin host
+                    # RAM for the run's lifetime; COO expansion is deferred
+                    # to this point so ingest never pays it. Fallback keeps
+                    # the device-ELL pack for hand-built datasets.
+                    csr = getattr(dataset, "host_csr", {}).pop(
                         config_data_shard, None
                     )
-                    if coo is not None:
+                    if csr is not None:
                         # The stash holds the same matrix as the device ELL,
                         # so its pack decision is authoritative — a decline
                         # (size/padding economics) must NOT fall through to
                         # maybe_pack's device->host pull of identical data.
+                        coo = csr.to_coo()
                         bf = pallas_sparse.maybe_pack_coo(
                             coo[0], coo[1], coo[2], dataset.num_samples, coo[3]
                         )

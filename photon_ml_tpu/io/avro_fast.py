@@ -18,7 +18,7 @@ from typing import Dict, List, Mapping, Optional, Sequence, Tuple
 import numpy as np
 
 from photon_ml_tpu.data.containers import pack_csr_to_ell
-from photon_ml_tpu.data.game_dataset import GameDataset
+from photon_ml_tpu.data.game_dataset import GameDataset, HostCSR
 from photon_ml_tpu.data.index_map import DELIMITER, IndexMap
 from photon_ml_tpu.io import avro as avro_io
 from photon_ml_tpu.native import avro_reader
@@ -193,27 +193,35 @@ def try_read_native(
     # file's local ids ARE the global ids by construction — no remap gather.
     file_l2g = [_global(d.keys) for d in decoded]
 
-    bag_rows: List[np.ndarray] = []
+    # Per bag: concatenated CSR (indptr, global keys, values). Row ids are
+    # NOT materialized here — the clean single-bag path (the common case)
+    # flows indptr straight through to the native ELL fill; only the
+    # multi-bag merge expands rows for its record-order sort.
+    bag_indptr: List[np.ndarray] = []
     bag_gkeys: List[np.ndarray] = []
     bag_vals: List[np.ndarray] = []
     for b in range(len(bag_names)):
-        rows_parts, keys_parts, vals_parts = [], [], []
-        row0 = 0
+        if len(decoded) == 1:
+            d = decoded[0]
+            bag_indptr.append(d.bag_indptr[b])
+            bag_gkeys.append(d.bag_keys[b])
+            bag_vals.append(d.bag_vals[b])
+            continue
+        ip_parts = [np.zeros(1, np.int64)]
+        keys_parts, vals_parts = [], []
+        off = 0
         for fi, d in enumerate(decoded):
-            local_to_global = file_l2g[fi]
-            counts = np.diff(d.bag_indptr[b])
-            rows_parts.append(
-                np.repeat(np.arange(len(counts), dtype=np.int64) + row0, counts)
-            )
+            ip = d.bag_indptr[b]
+            ip_parts.append(ip[1:] + off)
+            off += int(ip[-1])
             if not len(d.bag_keys[b]):
                 keys_parts.append(np.empty(0, np.int64))
             elif fi == 0:
                 keys_parts.append(d.bag_keys[b])  # identity remap (int32 ok)
             else:
-                keys_parts.append(local_to_global[d.bag_keys[b]])
+                keys_parts.append(file_l2g[fi][d.bag_keys[b]])
             vals_parts.append(d.bag_vals[b])
-            row0 += len(counts)
-        bag_rows.append(_concat(rows_parts, np.int64))
+        bag_indptr.append(np.concatenate(ip_parts))
         bag_gkeys.append(_concat(keys_parts, np.int64))
         bag_vals.append(_concat(vals_parts, np.float32))
 
@@ -241,30 +249,40 @@ def try_read_native(
     # ---- per-shard merge, index maps, ELL pack --------------------------
     built: Dict[str, IndexMap] = {}
     shards = {}
-    host_coo: Dict[str, tuple] = {}
+    host_csr: Dict[str, HostCSR] = {}
     bag_index = {b: i for i, b in enumerate(bag_names)}
     key_arr = np.asarray(key_list, dtype=object)
+    stash_ok = _stash_worthwhile(n)
     for shard, cfg in shard_configs.items():
         idxs = [bag_index[b] for b in cfg.feature_bags]
         single_bag = len(idxs) == 1
-        rows = np.concatenate([bag_rows[i] for i in idxs])
-        gkeys = np.concatenate([bag_gkeys[i] for i in idxs])
-        vals = np.concatenate([bag_vals[i] for i in idxs])
-        if not single_bag:
-            # Stable sort by record reproduces the Python path's order: bags
-            # in config order, entries in record order within each bag. The
-            # single-bag case skips it — per-file segments are already in
-            # record order and file offsets increase.
+        if single_bag:
+            indptr = bag_indptr[idxs[0]]
+            gkeys = bag_gkeys[idxs[0]]
+            vals = bag_vals[idxs[0]]
+        else:
+            # Multi-bag union: expand row ids, stable sort by record to
+            # reproduce the Python path's order (bags in config order,
+            # entries in record order within each bag).
+            rows = np.concatenate(
+                [
+                    np.repeat(
+                        np.arange(n, dtype=np.int64), np.diff(bag_indptr[i])
+                    )
+                    for i in idxs
+                ]
+            )
+            gkeys = np.concatenate([bag_gkeys[i] for i in idxs])
+            vals = np.concatenate([bag_vals[i] for i in idxs])
             order = np.argsort(rows, kind="stable")
             rows, gkeys, vals = rows[order], gkeys[order], vals[order]
-        # The decoder certifies per-record key uniqueness per bag; a record
-        # can still repeat a key ACROSS bags, so the multi-bag merge keeps
-        # the duplicate check in pack_csr_to_ell.
-        clean = single_bag and not any(
-            d.bag_has_dups[idxs[0]]
-            for d in decoded
-            if len(d.bag_has_dups) > idxs[0]
-        ) and all(len(d.bag_has_dups) > idxs[0] for d in decoded)
+            indptr = np.zeros(n + 1, np.int64)
+            np.cumsum(np.bincount(rows, minlength=n), out=indptr[1:])
+        # The decoder ACCUMULATES in-record duplicate keys at decode time
+        # (avro_reader.cc dedup_row), so single-bag shards are always clean;
+        # a record can still repeat a key ACROSS bags, so the multi-bag
+        # merge keeps the duplicate pass in pack_csr_to_ell.
+        clean = single_bag
 
         # gids are dense interned ints, so "which keys appear in this shard"
         # is a bincount mask and gid -> index-map id is one LUT gather — no
@@ -275,12 +293,13 @@ def try_read_native(
             else np.zeros(len(key_list), bool)
         )
         present_gids = np.nonzero(present)[0]
-        if index_maps is not None and shard in index_maps:
-            imap = index_maps[shard]
-        else:
+        from_data = index_maps is None or shard not in index_maps
+        if from_data:
             imap = IndexMap.from_feature_names(
                 set(key_arr[present_gids]), add_intercept=cfg.has_intercept
             )
+        else:
+            imap = index_maps[shard]
         built[shard] = imap
         intercept_idx = imap.intercept_index
         if cfg.has_intercept and intercept_idx is None:
@@ -289,16 +308,24 @@ def try_read_native(
                 "the index map has no intercept entry — rebuild the index "
                 "store with the intercept key or set has_intercept=False"
             )
-        lut = np.full(len(key_list) + 1, -1, np.int64)
+        # int32 LUT: the native ELL fill consumes int32 ids without a
+        # conversion copy (feature spaces are < 2^31 by construction).
+        lut = np.full(len(key_list) + 1, -1, np.int32)
         for gid in present_gids:
             lut[gid] = imap.get_index(key_arr[gid])
-        fidx = lut[gkeys] if len(gkeys) else np.empty(0, np.int64)
-        keep = fidx >= 0
-        if keep.all():  # no unmapped features: skip three large copies
-            rows_k, fidx_k, vals_k = rows, fidx, vals
-        else:
-            rows_k, fidx_k, vals_k = rows[keep], fidx[keep], vals[keep]
-        vals_k = vals_k.astype(np.float32, copy=False)
+        fidx_k = lut[gkeys] if len(gkeys) else np.empty(0, np.int32)
+        vals_k = vals.astype(np.float32, copy=False)
+        if not from_data:
+            # Supplied maps (scoring / multi-host) may not cover every key:
+            # drop unmapped entries, shifting the CSR boundaries in one
+            # cumsum — no row-id expansion.
+            keep = fidx_k >= 0
+            if not keep.all():
+                cs = np.zeros(len(keep) + 1, np.int64)
+                np.cumsum(keep, out=cs[1:])
+                indptr = cs[indptr]
+                fidx_k = fidx_k[keep]
+                vals_k = vals_k[keep]
         # Intercept: appended as one constant ELL column unless the data
         # itself carries the intercept key (then the CSR rebuild + re-sort
         # keeps the dedupe semantics of the Python path).
@@ -307,16 +334,17 @@ def try_read_native(
             if clean and not np.any(fidx_k == intercept_idx):
                 extra_col = (intercept_idx, 1.0)
             else:
+                rows_k = np.repeat(np.arange(n, dtype=np.int64), np.diff(indptr))
                 rows_k = np.concatenate([rows_k, np.arange(n, dtype=np.int64)])
                 fidx_k = np.concatenate(
-                    [fidx_k, np.full(n, intercept_idx, np.int64)]
+                    [fidx_k.astype(np.int64), np.full(n, intercept_idx, np.int64)]
                 )
                 vals_k = np.concatenate([vals_k, np.ones(n, np.float32)])
                 order = np.argsort(rows_k, kind="stable")
                 rows_k, fidx_k, vals_k = rows_k[order], fidx_k[order], vals_k[order]
                 clean = False
-        indptr = np.zeros(n + 1, np.int64)
-        np.cumsum(np.bincount(rows_k, minlength=n), out=indptr[1:])
+                indptr = np.zeros(n + 1, np.int64)
+                np.cumsum(np.bincount(rows_k, minlength=n), out=indptr[1:])
         shards[shard] = pack_csr_to_ell(
             indptr,
             fidx_k,
@@ -325,28 +353,20 @@ def try_read_native(
             assume_clean=clean,
             extra_col=extra_col,
         )
-        # Stash host COO triplets (entry order is irrelevant to the bucketed
+        # Stash the host CSR (entry order is irrelevant to the bucketed
         # pack — it re-sorts by segment) so the data-plane sparse pack runs
         # from host arrays with no device round trip. Stash only when a pack
-        # could actually engage (backend + size gates) — otherwise the
-        # triplets would pin ~20 bytes/nnz of host RAM with no consumer.
-        # The intercept column, when appended as an ELL extra_col, is
-        # appended here unsorted.
-        if _stash_worthwhile(n):
-            if extra_col is not None:
-                coo_rows = np.concatenate(
-                    [rows_k, np.arange(n, dtype=np.int64)]
-                )
-                coo_cols = np.concatenate(
-                    [fidx_k, np.full(n, intercept_idx, np.int64)]
-                )
-                coo_vals = np.concatenate([vals_k, np.ones(n, np.float32)])
-            else:
-                coo_rows, coo_cols, coo_vals = rows_k, fidx_k, vals_k
-            host_coo[shard] = (coo_rows, coo_cols, coo_vals, imap.size)
+        # could actually engage (backend + size gates) — otherwise it would
+        # pin ~12 bytes/nnz of host RAM with no consumer. Row-id expansion
+        # and the intercept column are deferred to HostCSR.to_coo(), so the
+        # ingest path never pays the COO concatenation.
+        if stash_ok:
+            host_csr[shard] = HostCSR(
+                indptr, fidx_k, vals_k, imap.size, extra_col
+            )
 
     ds = GameDataset.build(
         shards, labels, offsets=offsets, weights=weights, id_tags=id_tags
     )
-    ds.host_coo = host_coo
+    ds.host_csr = host_csr
     return ds, built

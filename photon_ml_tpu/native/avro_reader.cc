@@ -285,7 +285,6 @@ struct Result {
   Interner keys;
   Interner tag_vals;
   std::vector<int32_t> tag_ids;  // n_records * n_tags, -1 = absent
-  std::vector<int32_t> dup_scratch;
 };
 
 // One feature-array item; appends (key id, value) to the bag.
@@ -432,22 +431,83 @@ inline void item_name_vald_termu(Reader& r, const std::string& delim,
   bag.vals.push_back((float)v);
 }
 
-// Did this record contribute duplicate feature keys to `bag`? Interned ids
-// make this an integer problem; rows are short, so a sort + adjacent scan on
-// a reused scratch is ~free. The flag lets the Python assembly skip its
-// O(nnz log nnz) whole-dataset duplicate check (pack_csr_to_ell).
-void check_row_dups(Result& out, Bag& bag, size_t row_start) {
+// Accumulate duplicate feature keys within one record's bag segment, in
+// place: the first occurrence keeps its slot and duplicate values sum in
+// FLOAT64 before one final cast — the same accumulate-then-round the
+// Python path's np.add.at(float64) performs, so the two readers cannot
+// diverge on records like [a:1e8, a:1, a:-1e8] (the reference sums
+// repeated (name, term) pairs into one vector slot the same way). The
+// decoder's output is therefore always per-record clean, letting the
+// Python assembly take pack_csr_to_ell's assume_clean path — the former
+// flag-only check pushed the whole dataset through a per-row dedup that
+// was 94% of assembly wall (VERDICT r04 item 1). Short rows (the norm)
+// use a first-occurrence scan; wide rows switch to a sort so a 50k-entry
+// record costs O(n log n), not O(n^2).
+void dedup_row(Bag& bag, size_t row_start, std::vector<double>& acc,
+               std::vector<int64_t>& order) {
   size_t n = bag.keys.size() - row_start;
-  if (n < 2 || bag.has_row_dups) return;
-  auto& s = out.dup_scratch;
-  s.assign(bag.keys.begin() + row_start, bag.keys.end());
-  std::sort(s.begin(), s.end());
-  for (size_t i = 1; i < s.size(); ++i) {
-    if (s[i] == s[i - 1]) {
-      bag.has_row_dups = true;
-      return;
+  if (n < 2) return;
+  int32_t* keys = bag.keys.data() + row_start;
+  float* vals = bag.vals.data() + row_start;
+  size_t w;
+  if (n < 64) {
+    acc.clear();
+    acc.push_back(vals[0]);
+    w = 1;
+    for (size_t i = 1; i < n; ++i) {
+      int32_t k = keys[i];
+      size_t j = 0;
+      while (j < w && keys[j] != k) ++j;
+      if (j < w) {
+        acc[j] += (double)vals[i];
+      } else {
+        keys[w] = k;
+        acc.push_back(vals[i]);
+        ++w;
+      }
     }
+    if (w == n) return;  // no duplicates: vals untouched
+    for (size_t j = 0; j < w; ++j) vals[j] = (float)acc[j];
+  } else {
+    // Wide record: sort (key, position), accumulate runs in position order
+    // (so sums match the sequential np.add.at order), then place compacted
+    // entries back at their first-occurrence positions.
+    order.resize(n);
+    for (size_t i = 0; i < n; ++i) order[i] = (int64_t)i;
+    std::sort(order.begin(), order.end(), [&](int64_t a, int64_t b) {
+      return keys[a] != keys[b] ? keys[a] < keys[b] : a < b;
+    });
+    acc.clear();
+    std::vector<std::pair<int64_t, int32_t>> firsts;  // (first pos, key)
+    size_t i = 0;
+    while (i < n) {
+      int32_t k = keys[order[i]];
+      double s = vals[order[i]];
+      int64_t first = order[i];
+      for (++i; i < n && keys[order[i]] == k; ++i) s += (double)vals[order[i]];
+      firsts.emplace_back(first, k);
+      acc.push_back(s);
+    }
+    w = firsts.size();
+    if (w == n) return;
+    // Compact in first-occurrence order (stable record order).
+    std::vector<size_t> by_pos(w);
+    for (size_t j = 0; j < w; ++j) by_pos[j] = j;
+    std::sort(by_pos.begin(), by_pos.end(), [&](size_t a, size_t b) {
+      return firsts[a].first < firsts[b].first;
+    });
+    std::vector<int32_t> ck(w);
+    std::vector<float> cv(w);
+    for (size_t j = 0; j < w; ++j) {
+      ck[j] = firsts[by_pos[j]].second;
+      cv[j] = (float)acc[by_pos[j]];
+    }
+    std::memcpy(keys, ck.data(), w * sizeof(int32_t));
+    std::memcpy(vals, cv.data(), w * sizeof(float));
   }
+  bag.has_row_dups = true;  // informational: dups existed and were summed
+  bag.keys.resize(row_start + w);
+  bag.vals.resize(row_start + w);
 }
 
 bool decode_block(Reader& r, int64_t count, const int32_t* rops, int n_rops,
@@ -457,6 +517,8 @@ bool decode_block(Reader& r, int64_t count, const int32_t* rops, int n_rops,
   const int n_tags = (int)tag_names.size();
   std::string keybuf;
   std::vector<size_t> row_starts(out.bags.size());
+  std::vector<double> dedup_acc;
+  std::vector<int64_t> dedup_order;
   for (int64_t rec = 0; rec < count && r.ok; ++rec) {
     out.labels.push_back(0.0);
     out.offsets.push_back(0.0);
@@ -651,7 +713,7 @@ bool decode_block(Reader& r, int64_t count, const int32_t* rops, int n_rops,
     }
     for (size_t b = 0; b < out.bags.size(); ++b) {
       Bag& bag = out.bags[b];
-      check_row_dups(out, bag, row_starts[b]);
+      dedup_row(bag, row_starts[b], dedup_acc, dedup_order);
       bag.indptr.push_back((int64_t)bag.keys.size());
     }
   }

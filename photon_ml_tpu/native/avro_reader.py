@@ -309,8 +309,9 @@ class DecodedFile:
     keys: List[str]  # interned key id -> string
     tag_ids: np.ndarray  # (n_records, n_tags) int32, -1 absent
     tag_values: List[str]
-    # Per bag: did any single record carry the same feature key twice? When
-    # False the assembly can skip its whole-dataset duplicate check.
+    # Per bag, informational: did any record carry the same feature key
+    # twice? Duplicates are ACCUMULATED at decode time (dedup_row), so the
+    # returned bags are always per-record clean regardless of this flag.
     bag_has_dups: List[bool] = dataclasses.field(default_factory=list)
 
 

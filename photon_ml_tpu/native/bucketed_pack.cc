@@ -54,4 +54,48 @@ int64_t photon_pack_level(const int32_t* rows, const int32_t* cols,
   return n_spill;
 }
 
+// CSR -> padded-ELL fill: one sequential pass placing each entry at its
+// (row, position) slot, replacing two 9.6M-element numpy fancy-index
+// scatters plus the position arithmetic in pack_csr_to_ell (the last
+// vectorizable chunk of Avro ingest assembly; the reference does this
+// placement executor-parallel inside its reader, AvroDataReader.scala:199).
+// row_lens: per-row entry counts (n). indices: feature ids, int64 when
+// idx_is_64 else int32 (the assembly's LUT output — no conversion copy).
+// vals: float32. out_idx/out_val: (n, width) zero-initialized; entries land
+// at columns [0, row_len), so width >= max(row_lens) (+1 if extra_idx >= 0,
+// which writes a constant trailing intercept column at `width - 1`).
+// Returns 0, or -1 on invalid arguments.
+int32_t photon_ell_fill(const int64_t* row_lens, const void* indices,
+                        int32_t idx_is_64, const float* vals, int64_t n,
+                        int64_t width, int64_t extra_idx, float extra_val,
+                        int32_t* out_idx, float* out_val) {
+  if (n < 0 || width <= 0) return -1;
+  const int64_t body = extra_idx >= 0 ? width - 1 : width;
+  const int64_t* idx64 = (const int64_t*)indices;
+  const int32_t* idx32 = (const int32_t*)indices;
+  int64_t p = 0;
+  for (int64_t r = 0; r < n; ++r) {
+    const int64_t len = row_lens[r];
+    if (len < 0 || len > body) return -1;
+    int32_t* oi = out_idx + r * width;
+    float* ov = out_val + r * width;
+    if (idx_is_64) {
+      for (int64_t j = 0; j < len; ++j, ++p) {
+        oi[j] = (int32_t)idx64[p];
+        ov[j] = vals[p];
+      }
+    } else {
+      for (int64_t j = 0; j < len; ++j, ++p) {
+        oi[j] = idx32[p];
+        ov[j] = vals[p];
+      }
+    }
+    if (extra_idx >= 0) {
+      oi[body] = (int32_t)extra_idx;
+      ov[body] = extra_val;
+    }
+  }
+  return 0;
+}
+
 }  // extern "C"

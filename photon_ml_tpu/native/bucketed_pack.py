@@ -37,6 +37,19 @@ def _lib() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_float),
             ctypes.POINTER(ctypes.c_int64),
         ]
+        lib.photon_ell_fill.restype = ctypes.c_int32
+        lib.photon_ell_fill.argtypes = [
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_void_p,
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.c_float,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_float),
+        ]
         _CONFIGURED = True
     return lib
 
@@ -83,3 +96,54 @@ def pack_level_native(
     if n_spill < 0:
         return None
     return packed, values, spill[:n_spill]
+
+
+def ell_fill_native(
+    row_lens: np.ndarray,
+    indices: np.ndarray,
+    vals: np.ndarray,
+    out_idx: np.ndarray,
+    out_val: np.ndarray,
+    extra_idx: int = -1,
+    extra_val: float = 1.0,
+) -> bool:
+    """CSR -> padded-ELL placement into preallocated (n, width) outputs.
+
+    Sequential native pass over the entries (photon_ell_fill); returns False
+    when the native library is unavailable or shapes/dtypes don't fit —
+    caller keeps the numpy scatter. `extra_idx >= 0` writes a constant
+    intercept column at the last slot.
+    """
+    lib = _lib()
+    if (
+        lib is None
+        or out_idx.dtype != np.int32
+        or out_val.dtype != np.float32
+        or not out_idx.flags.c_contiguous
+        or not out_val.flags.c_contiguous
+        or out_idx.shape != out_val.shape
+    ):
+        return False
+    lens64 = np.ascontiguousarray(row_lens, np.int64)
+    total = int(lens64.sum())
+    if len(lens64) != out_idx.shape[0] or len(indices) < total or len(vals) < total:
+        return False  # short entry arrays would read past the buffer in C
+    if indices.dtype == np.int32 and indices.flags.c_contiguous:
+        idx, idx_is_64 = indices, 0
+    else:
+        idx, idx_is_64 = np.ascontiguousarray(indices, np.int64), 1
+    vals32 = np.ascontiguousarray(vals, np.float32)
+    n, width = out_idx.shape
+    rc = lib.photon_ell_fill(
+        _ptr(lens64, ctypes.c_int64),
+        idx.ctypes.data_as(ctypes.c_void_p),
+        idx_is_64,
+        _ptr(vals32, ctypes.c_float),
+        n,
+        width,
+        int(extra_idx),
+        float(extra_val),
+        _ptr(out_idx, ctypes.c_int32),
+        _ptr(out_val, ctypes.c_float),
+    )
+    return rc == 0

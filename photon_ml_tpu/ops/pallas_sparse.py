@@ -383,7 +383,7 @@ def maybe_pack_coo(
     rows, cols, vals, n_samples: int, dim: int
 ) -> Optional[BucketedSparseFeatures]:
     """Data-plane variant of `maybe_pack`: pack host COO triplets produced by
-    ingest (GameDataset.host_coo) straight into the bucketed layout — no
+    ingest (GameDataset.host_csr) straight into the bucketed layout — no
     device ELL pull-back, mirroring the reference's build-layout-once-at-
     dataset-construction placement (RandomEffectDataset.scala:229-264).
     Applies the same engagement gates; sharding cannot apply (host arrays).

@@ -91,7 +91,7 @@ def pad_game_dataset(dataset: GameDataset, multiple: int) -> GameDataset:
         else:
             fill = np.full(rem, "\x00__pad__", dtype=v.dtype)
         id_tags[k] = np.concatenate([v, fill])
-    # host_coo / bucketed_cache are deliberately NOT carried over: the
+    # host_csr / bucketed_cache are deliberately NOT carried over: the
     # stash's row indices would be inconsistent with the padded sample
     # count, and the sharded path declines the bucketed pack anyway
     # (maybe_pack rejects multi-device arrays). Dropping them here is the
@@ -119,7 +119,7 @@ def shard_game_dataset(dataset: GameDataset, mesh: Mesh) -> GameDataset:
             )
         return jax.device_put(f, s2)
 
-    # host_coo / bucketed_cache intentionally dropped — see pad_game_dataset.
+    # host_csr / bucketed_cache intentionally dropped — see pad_game_dataset.
     return GameDataset(
         shards={k: put_feat(v) for k, v in dataset.shards.items()},
         labels=jax.device_put(dataset.labels, s1),

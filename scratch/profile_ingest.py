@@ -1,5 +1,7 @@
-"""Profile the ingest pipeline stage by stage to find the real bottleneck."""
+"""Profile read_game_dataset on a bench-shaped file to locate assembly cost."""
+import cProfile
 import os
+import pstats
 import sys
 import tempfile
 import time
@@ -7,86 +9,42 @@ import time
 import numpy as np
 
 import photon_ml_tpu.io.avro_data as ad
-from photon_ml_tpu.io import avro_fast
-from photon_ml_tpu.io import avro as avro_io
-from photon_ml_tpu.native import avro_reader
-from photon_ml_tpu.data.index_map import DELIMITER
+from photon_ml_tpu.native.avro_writer import write_training_examples_columnar
 
-n, d, k = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000, 4000, 24
+n_ing = int(sys.argv[1]) if len(sys.argv) > 1 else 200_000
+d_ing, k_ing = 4000, 24
 rng = np.random.default_rng(7)
-t0 = time.perf_counter()
-feats = [
-    [(f"f{j}", float(v)) for j, v in zip(
-        rng.choice(d, size=k, replace=False), rng.normal(size=k))]
-    for _ in range(n)
-]
-print(f"gen: {time.perf_counter()-t0:.2f}s")
+indptr = np.arange(n_ing + 1, dtype=np.int64) * k_ing
+ids = rng.integers(0, d_ing, size=n_ing * k_ing).astype(np.int32)
+vals = rng.normal(size=n_ing * k_ing)
+names = [f"f{i}" for i in range(d_ing)]
 
 td = tempfile.mkdtemp()
 pth = os.path.join(td, "bench.avro")
-t0 = time.perf_counter()
-ad.write_training_examples(
-    pth, feats, (rng.uniform(size=n) > 0.5).astype(float),
-    id_tags={"entityId": rng.integers(0, 1000, size=n)},
+write_training_examples_columnar(
+    pth,
+    (rng.uniform(size=n_ing) > 0.5).astype(np.float64),
+    indptr,
+    ids,
+    vals,
+    names,
+    tag_key="entityId",
+    tag_values=rng.integers(0, 1000, size=n_ing).astype(str),
 )
 mb = os.path.getsize(pth) / 1e6
-print(f"write: {time.perf_counter()-t0:.2f}s  ({mb:.1f} MB)")
+print(f"file: {mb:.1f} MB", flush=True)
 
-cfgs = {"g": ad.FeatureShardConfig(("features",), True)}
-cols = ad.InputColumnNames()
+cfg = {"g": ad.FeatureShardConfig(("features",), True)}
 
-# stage 1: read file bytes
 t0 = time.perf_counter()
-with open(pth, "rb") as f:
-    data = f.read()
-print(f"read bytes: {time.perf_counter()-t0:.3f}s")
+ad.read_game_dataset(pth, cfg, id_tag_fields=["entityId"])
+t1 = time.perf_counter() - t0
+print(f"warm full read: {t1:.2f}s -> {mb/t1:.1f} MB/s", flush=True)
 
-schema, codec, sync, body = avro_io.read_header(data, pth)
-print("codec:", codec)
-program = avro_reader.compile_program(
-    schema, response=cols.response, fallback_label=ad.LABEL,
-    offset=cols.offset, weight=cols.weight, uid=cols.uid,
-    metadata_map=cols.metadata_map, bag_names=["features"],
-    tag_fields=("entityId",),
-)
-assert program is not None
-
-# stage 2: native decode only
-t0 = time.perf_counter()
-out = avro_reader.decode_file_native(data, body, codec, sync, program, DELIMITER)
-t_dec = time.perf_counter() - t0
-assert out is not None
-print(f"native decode: {t_dec:.3f}s  ({mb/t_dec:.1f} MB/s)  nnz={len(out.bag_keys[0])}")
-
-# stage 3: full try_read_native (decode + assembly + ELL + device upload)
-t0 = time.perf_counter()
-r = avro_fast.try_read_native([pth], cfgs, None, ["entityId"], cols, ad.LABEL)
-t_full = time.perf_counter() - t0
-assert r is not None
-print(f"try_read_native total: {t_full:.3f}s  ({mb/t_full:.1f} MB/s)")
-print(f"  -> assembly+pack+upload: {t_full - t_dec - 0.05:.3f}s (approx)")
-
-# block structure of the file
-cnt = 0
-p = body
-r2 = data
-import photon_ml_tpu.io.avro as A
-br = A.BinaryReader(data, p) if hasattr(A, "BinaryReader") else None
-# quick manual block walk
-def read_long(buf, pos):
-    n_ = 0; shift = 0
-    while True:
-        b = buf[pos]; pos += 1
-        n_ |= (b & 0x7F) << shift
-        if not (b & 0x80): break
-        shift += 7
-    return (n_ >> 1) ^ -(n_ & 1), pos
-
-pos = body
-sizes = []
-while pos < len(data):
-    c, pos = read_long(data, pos)
-    s, pos = read_long(data, pos)
-    sizes.append((c, s))
-    pos += s + 16
-print(f"blocks: {len(sizes)}, median size {np.median([s for _, s in sizes])/1e3:.0f} KB")
+prof = cProfile.Profile()
+prof.enable()
+ad.read_game_dataset(pth, cfg, id_tag_fields=["entityId"])
+prof.disable()
+st = pstats.Stats(prof)
+st.sort_stats("cumulative").print_stats(30)
+st.sort_stats("tottime").print_stats(25)

@@ -242,11 +242,32 @@ class TestThreadedDecode:
         assert d.bag_has_dups == [True]
 
     def test_dup_records_still_match_python_path(self, tmp_path):
-        # The dup flag forces the slow dedupe path; results must equal the
-        # pure-Python codec's accumulate-duplicates semantics.
+        # In-record duplicates are accumulated at decode time; results must
+        # equal the pure-Python codec's accumulate-duplicates semantics.
         p = str(tmp_path / "dups.avro")
         feats = [[("a", 1.0), ("b", 2.0), ("a", 3.0)], [("b", 1.0)]] * 40
         ad.write_training_examples(p, feats, np.zeros(80))
+        _assert_parity(p, {"g": ad.FeatureShardConfig(("features",), True)})
+
+    def test_triple_dup_accumulates_in_float64(self, tmp_path):
+        # Catastrophic-cancellation probe: [a:1e8, a:1, a:-1e8] must sum to
+        # exactly 1.0 (float64 accumulation, one final float32 cast) on BOTH
+        # readers — a float32 running sum would silently produce 0.0.
+        p = str(tmp_path / "cancel.avro")
+        feats = [[("a", 1e8), ("a", 1.0), ("a", -1e8)], [("b", 2.0)]] * 20
+        ad.write_training_examples(p, feats, np.zeros(40))
+        cfgs = {"g": ad.FeatureShardConfig(("features",), True)}
+        ds_n, maps_n = ad.read_game_dataset(p, cfgs)
+        assert float(np.asarray(ds_n.shards["g"].values).max()) == 2.0
+        assert 1.0 in np.asarray(ds_n.shards["g"].values)
+        _assert_parity(p, cfgs)
+
+    def test_wide_record_dedup_matches(self, tmp_path):
+        # Wide records (>=64 entries) take the sort-based dedup path in the
+        # decoder; parity with the Python codec must hold there too.
+        p = str(tmp_path / "wide.avro")
+        feats = [[(f"f{i % 500}", float(i)) for i in range(2000)]] * 3
+        ad.write_training_examples(p, feats, np.zeros(3))
         _assert_parity(p, {"g": ad.FeatureShardConfig(("features",), True)})
 
 
@@ -261,9 +282,9 @@ class TestHostCooStash:
         cfgs = {"g": ad.FeatureShardConfig(("features",), True)}
         cols = ad.InputColumnNames()
         ds, _ = avro_fast.try_read_native([p], cfgs, None, [], cols, ad.LABEL)
-        assert ds.host_coo == {}
+        assert ds.host_csr == {}
 
-    def test_ingest_stashes_host_coo(self, tmp_path):
+    def test_ingest_stashes_host_csr(self, tmp_path):
         from photon_ml_tpu.ops import pallas_glm
 
         rng = np.random.default_rng(12)
@@ -282,8 +303,8 @@ class TestHostCooStash:
             ds, maps = avro_fast.try_read_native([p], cfgs, None, [], cols, ad.LABEL)
         finally:
             pallas_glm.FORCE_INTERPRET = old
-        assert "g" in ds.host_coo
-        rows, cols_, vals, dim = ds.host_coo["g"]
+        assert "g" in ds.host_csr
+        rows, cols_, vals, dim = ds.host_csr["g"].to_coo()
         assert dim == maps["g"].size
         # host COO must reproduce the device ELL contents exactly
         M_coo = np.zeros((n, dim))

@@ -247,10 +247,10 @@ class TestObjectiveIntegration:
 
 
 class TestHostCooPack:
-    def test_coordinate_packs_from_host_coo(self, interpret_kernels, monkeypatch):
-        """Ingest-stashed host COO must feed the bucketed pack directly —
+    def test_coordinate_packs_from_host_csr(self, interpret_kernels, monkeypatch):
+        """Ingest-stashed host CSR must feed the bucketed pack directly —
         the device-ELL pull-back (maybe_pack) must not run."""
-        from photon_ml_tpu.data.game_dataset import GameDataset
+        from photon_ml_tpu.data.game_dataset import GameDataset, HostCSR
         from photon_ml_tpu.game.coordinate import FixedEffectCoordinate
         from photon_ml_tpu.optimize.config import (
             L2,
@@ -266,9 +266,9 @@ class TestHostCooPack:
         sp = SparseFeatures(jnp.asarray(idx), jnp.asarray(val), d)
         y = (rng.uniform(size=n) > 0.5).astype(np.float32)
         ds = GameDataset.build({"s": sp}, y)
-        ds.host_coo = {
-            "s": (
-                np.repeat(np.arange(n, dtype=np.int64), k),
+        ds.host_csr = {
+            "s": HostCSR(
+                np.arange(n + 1, dtype=np.int64) * k,
                 idx.reshape(-1).astype(np.int64),
                 val.reshape(-1),
                 d,
