@@ -114,9 +114,10 @@ def run(args) -> dict:
             else None
         ),
     )
-    # Scoring never packs a bucketed layout; drop the ingest's host-COO
-    # stash rather than pin ~20 bytes/nnz of host RAM for the run.
-    dataset.host_csr.clear()
+    # Scoring never packs a bucketed layout; cancel ingest's background
+    # pack and drop the CSR stash rather than compute a layout nothing
+    # will consume / pin ~12 bytes/nnz of host RAM for the run.
+    dataset.release_stash()
     logger.info("scoring %d samples", dataset.num_samples)
 
     transformer = GameTransformer(model, specs, artifact.task)

@@ -117,6 +117,32 @@ class BucketedSparseFeatures:
         }
 
 
+def upload(bf: BucketedSparseFeatures) -> BucketedSparseFeatures:
+    """Move a host-packed layout (pack_bucketed(host_only=True)) to device —
+    the one-time upload of the packed planes, split out so the host pack can
+    run on a background thread during ingest and the upload at first use."""
+
+    def _lvl(level: Optional[BucketedLevel]) -> Optional[BucketedLevel]:
+        if level is None or isinstance(level.packed, jax.Array):
+            return level
+        return BucketedLevel(
+            packed=jnp.asarray(level.packed),
+            values=jnp.asarray(level.values),
+            tile_rows=level.tile_rows,
+            spv=level.spv,
+        )
+
+    return BucketedSparseFeatures(
+        level1=_lvl(bf.level1),
+        level2=_lvl(bf.level2),
+        overflow_rows=jnp.asarray(bf.overflow_rows),
+        overflow_cols=jnp.asarray(bf.overflow_cols),
+        overflow_vals=jnp.asarray(bf.overflow_vals),
+        n_rows=bf.n_rows,
+        dim=bf.dim,
+    )
+
+
 def _sort_by_segment(seg: np.ndarray, n_seg: int):
     """Stable sort by segment id.
 
@@ -143,8 +169,13 @@ def _pack_level(
     tile_rows: int,
     sp: int,
     dtype,
+    host_only: bool = False,
 ) -> Tuple[BucketedLevel, np.ndarray]:
-    """Pack entries that fit segment width `sp`; return (level, spill mask)."""
+    """Pack entries that fit segment width `sp`; return (level, spill mask).
+
+    `host_only=True` keeps the packed planes as host numpy arrays (no
+    device upload) — the benchmark's isolated host-cost measurement."""
+    _dev = (lambda x: x) if host_only else jnp.asarray
     B = max(1, -(-dim // BUCKET))
     T = max(1, -(-n_rows // tile_rows))
     # tile_rows and BUCKET are powers of two: shifts keep the hot O(nnz)
@@ -164,8 +195,8 @@ def _pack_level(
         packed_n, values_n, spill_idx = native
         spv = sp // 128
         level = BucketedLevel(
-            packed=jnp.asarray(packed_n.reshape(-1, 128)),
-            values=jnp.asarray(values_n.reshape(-1, 128)),
+            packed=_dev(packed_n.reshape(-1, 128)),
+            values=_dev(values_n.reshape(-1, 128)),
             tile_rows=tile_rows,
             spv=spv,
         )
@@ -191,8 +222,8 @@ def _pack_level(
     packed[dst] = payload[sel]
     values[dst] = vals[sel]
     level = BucketedLevel(
-        packed=jnp.asarray(packed.reshape(n_seg * spv, 128)),
-        values=jnp.asarray(values.reshape(n_seg * spv, 128)),
+        packed=_dev(packed.reshape(n_seg * spv, 128)),
+        values=_dev(values.reshape(n_seg * spv, 128)),
         tile_rows=tile_rows,
         spv=spv,
     )
@@ -213,8 +244,14 @@ def pack_bucketed(
     dim: int,
     *,
     dtype=np.float32,
+    host_only: bool = False,
 ) -> BucketedSparseFeatures:
-    """Pack COO triplets into the two-level bucketed layout."""
+    """Pack COO triplets into the two-level bucketed layout.
+
+    `host_only=True` skips every device upload (planes stay numpy) — used
+    by the benchmark to time the host pack cost in isolation without
+    monkeypatching this module's array namespace."""
+    _dev = (lambda x: x) if host_only else jnp.asarray
     rows = np.asarray(rows, np.int64)
     cols = np.asarray(cols, np.int64)
     vals = np.asarray(vals, dtype)
@@ -228,7 +265,9 @@ def pack_bucketed(
     # ~1x and the spill tail (mean-crossing segments) goes to level 2.
     mean1 = nnz / max(T1 * B, 1)
     sp1 = min(max(1024, _round_up(int(mean1), 1024)), MAX_SP)
-    level1, spill = _pack_level(rows, cols, vals, n_rows, dim, L1_TILE_ROWS, sp1, dtype)
+    level1, spill = _pack_level(
+        rows, cols, vals, n_rows, dim, L1_TILE_ROWS, sp1, dtype, host_only
+    )
 
     level2 = None
     o_rows = rows[spill]
@@ -241,16 +280,16 @@ def pack_bucketed(
         # its own segment sizes are lumpy; what still spills goes to COO.
         sp2 = min(max(1024, _round_up(int(4 * mean2), 1024)), MAX_SP)
         level2, spill2 = _pack_level(
-            o_rows, o_cols, o_vals, n_rows, dim, L2_TILE_ROWS, sp2, dtype
+            o_rows, o_cols, o_vals, n_rows, dim, L2_TILE_ROWS, sp2, dtype, host_only
         )
         o_rows, o_cols, o_vals = o_rows[spill2], o_cols[spill2], o_vals[spill2]
 
     return BucketedSparseFeatures(
         level1=level1,
         level2=level2,
-        overflow_rows=jnp.asarray(o_rows.astype(np.int32)),
-        overflow_cols=jnp.asarray(o_cols.astype(np.int32)),
-        overflow_vals=jnp.asarray(o_vals),
+        overflow_rows=_dev(o_rows.astype(np.int32)),
+        overflow_cols=_dev(o_cols.astype(np.int32)),
+        overflow_vals=_dev(o_vals),
         n_rows=int(n_rows),
         dim=int(dim),
     )

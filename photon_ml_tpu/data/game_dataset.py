@@ -35,7 +35,6 @@ Structural translation (the central TPU design decision of this framework):
 from __future__ import annotations
 
 import dataclasses
-import hashlib
 from typing import Dict, List, Mapping, Optional, Sequence
 
 import jax
@@ -97,6 +96,12 @@ class HostCSR:
     vals: np.ndarray  # (nnz,) float32
     dim: int
     extra_col: Optional[tuple] = None  # (intercept index, value) per row
+    # Background bucketed-pack handle (ops/pallas_sparse.begin_pack_async):
+    # ingest starts the host-side pack on a thread; the first consuming
+    # coordinate joins it via finish_pack.
+    pack_future: Optional[object] = dataclasses.field(
+        default=None, repr=False, compare=False
+    )
 
     def to_coo(self):
         """Expand to (rows, cols, vals, dim) COO triplets."""
@@ -145,6 +150,17 @@ class GameDataset:
     def num_samples(self) -> int:
         return int(self.labels.shape[0])
 
+    def release_stash(self) -> None:
+        """Drop the ingest CSR stash when no coordinate will consume it
+        (scoring, validation datasets) — cancelling any background pack
+        first so a not-yet-started pack never runs and a discarded one is
+        never waited on."""
+        for csr in self.host_csr.values():
+            fut = getattr(csr, "pack_future", None)
+            if fut is not None:
+                fut.cancel()
+        self.host_csr.clear()
+
     def labeled_data(self, shard: str, offsets: Optional[Array] = None) -> LabeledData:
         """Fixed-effect view for one feature shard (FixedEffectDataset)."""
         return LabeledData(
@@ -176,11 +192,22 @@ class GameDataset:
         return cls(dict(shards), labels, offsets, weights, tags)
 
 
-def _stable_entity_seed(entity_key) -> int:
-    """Deterministic per-entity seed (stands in for the reference's
-    byteswap64(hash) reservoir keys — same run-to-run reproducibility)."""
-    h = hashlib.blake2b(str(entity_key).encode(), digest_size=8).digest()
-    return int.from_bytes(h, "little")
+def _row_priorities(codes: np.ndarray, n: int) -> np.ndarray:
+    """Deterministic per-(entity, row) reservoir priorities, vectorized.
+
+    splitmix64-style mix of the entity code and the row index — the
+    vectorized equivalent of the reference's byteswap64-keyed reservoir
+    ordering (RandomEffectDataset.scala:375-384): each over-cap entity keeps
+    the `cap` rows with the smallest priorities, a choice that is uniform,
+    deterministic per entity, and independent of other entities."""
+    x = codes.astype(np.uint64) * np.uint64(0x9E3779B97F4A7C15)
+    x += np.arange(n, dtype=np.uint64) * np.uint64(0xBF58476D1CE4E5B9)
+    x ^= x >> np.uint64(30)
+    x *= np.uint64(0xBF58476D1CE4E5B9)
+    x ^= x >> np.uint64(27)
+    x *= np.uint64(0x94D049BB133111EB)
+    x ^= x >> np.uint64(31)
+    return x
 
 
 class EntityBlocks:
@@ -249,71 +276,90 @@ def build_random_effect_dataset(
     keys = dataset.id_tags[tag]
     n = len(keys)
 
-    # Group sample rows by entity (host; stable order).
-    order = np.argsort(keys, kind="stable")
-    sorted_keys = keys[order]
-    uniq, starts = np.unique(sorted_keys, return_index=True)
-    bounds = np.append(starts, n)
+    # Group sample rows by entity: ONE unique pass yields both the sorted
+    # entity vocabulary and each sample's entity code — everything after
+    # this runs as bulk argsort/segment ops (the former per-entity Python
+    # loop was a large share of e2e prepare wall; VERDICT r04 item 2).
+    uniq, codes = np.unique(keys, return_inverse=True)
+    num_entities = len(uniq)
+    counts = np.bincount(codes, minlength=num_entities)
+    entity_index: Dict[object, int] = {
+        (k.item() if hasattr(k, "item") else k): i for i, k in enumerate(uniq)
+    }
+    entity_rows_of_sample = codes.astype(np.int64)
 
     lower = config.active_lower_bound or 0
     cap = config.active_upper_bound
 
-    entity_index: Dict[object, int] = {}
-    entity_rows_of_sample = np.full(n, -1, np.int64)
-    active_lists: List[np.ndarray] = []
-    kept_entities: List[int] = []
-    num_active = 0
+    # Active rows per entity, sorted by (entity, row). Over-cap entities
+    # keep the `cap` rows with the smallest deterministic hash priorities
+    # (see _row_priorities) — the reference's keyed-reservoir semantics,
+    # vectorized.
+    a_counts = counts.copy()
+    if lower:
+        a_counts[counts < lower] = 0
+    if cap is not None:
+        np.minimum(a_counts, cap, out=a_counts)
+    need_reservoir = cap is not None and bool((counts > cap).any())
+    if need_reservoir:
+        order = np.lexsort((_row_priorities(codes, n), codes))
+    else:
+        order = np.argsort(codes, kind="stable")  # row-ascending per entity
+    if need_reservoir or lower or cap is not None:
+        starts1 = np.zeros(num_entities + 1, np.int64)
+        np.cumsum(counts, out=starts1[1:])
+        rank = np.arange(n, dtype=np.int64) - starts1[codes[order]]
+        active_rows = order[rank < a_counts[codes[order]]]
+        if need_reservoir:
+            # Restore row-ascending order within each entity for the gathers.
+            active_rows = active_rows[
+                np.lexsort((active_rows, codes[active_rows]))
+            ]
+    else:
+        active_rows = order
+    num_active = int(a_counts.sum())
 
-    for i, ent in enumerate(uniq):
-        rows = order[bounds[i] : bounds[i + 1]]
-        row_id = len(entity_index)
-        entity_index[ent.item() if hasattr(ent, "item") else ent] = row_id
-        entity_rows_of_sample[rows] = row_id
-        if len(rows) < lower:
-            continue  # too few samples: entity scored with zero model only
-        if cap is not None and len(rows) > cap:
-            rng = np.random.default_rng(_stable_entity_seed(ent))
-            rows = rng.choice(rows, size=cap, replace=False)
-        active_lists.append(np.sort(rows))
-        kept_entities.append(row_id)
-        num_active += len(rows)
-
-    num_entities = len(entity_index)
-    # Unseen entities (scoring time) use the pinned zero row = num_entities.
-    entity_rows_of_sample[entity_rows_of_sample < 0] = num_entities
+    kept = np.nonzero(a_counts > 0)[0]  # entity code per kept entity
+    kept_sizes = a_counts[kept]
 
     # Bucket by padded capacity (power of two >= size, floor min_bucket).
-    def bucket_size(sz: int) -> int:
-        b = max(config.min_bucket, 1)
-        while b < sz:
-            b *= 2
-        return b
+    min_b = max(config.min_bucket, 1)
+    pows = min_b * (1 << np.arange(0, 40, dtype=np.int64))
+    pows = pows[pows < (1 << 40)]
+    cap_of_kept = pows[np.searchsorted(pows, kept_sizes)]
 
-    by_capacity: Dict[int, List[int]] = {}
-    for j, rows in enumerate(active_lists):
-        by_capacity.setdefault(bucket_size(len(rows)), []).append(j)
+    # Per-active-row bookkeeping: owning kept-entity ordinal and position
+    # within that entity's active rows.
+    a_starts = np.zeros(len(kept) + 1, np.int64)
+    np.cumsum(kept_sizes, out=a_starts[1:])
+    row_kept_ord = np.repeat(np.arange(len(kept), dtype=np.int64), kept_sizes)
+    row_pos = np.arange(num_active, dtype=np.int64) - a_starts[row_kept_ord]
 
     buckets = []
-    for capacity in sorted(by_capacity):
-        members = by_capacity[capacity]
+    for capacity in np.unique(cap_of_kept) if len(kept) else []:
+        members = np.nonzero(cap_of_kept == capacity)[0]
         e = len(members)
-        gather = np.zeros((e, capacity), np.int64)
-        mask = np.zeros((e, capacity), np.float32)
-        ent_rows = np.zeros(e, np.int64)
-        for bi, j in enumerate(members):
-            rows = active_lists[j]
-            gather[bi, : len(rows)] = rows
-            mask[bi, : len(rows)] = 1.0
-            ent_rows[bi] = kept_entities[j]
-        buckets.append(EntityBlocks(gather, mask, ent_rows))
+        local = np.full(len(kept), -1, np.int64)
+        local[members] = np.arange(e)
+        in_bucket = local[row_kept_ord] >= 0
+        gather = np.zeros((e, int(capacity)), np.int64)
+        mask = np.zeros((e, int(capacity)), np.float32)
+        li = local[row_kept_ord[in_bucket]]
+        pj = row_pos[in_bucket]
+        gather[li, pj] = active_rows[in_bucket]
+        mask[li, pj] = 1.0
+        buckets.append(EntityBlocks(gather, mask, kept[members]))
 
     feature_mask = None
     if config.num_features_to_samples_ratio_upper_bound is not None:
+        # The Pearson path iterates per entity anyway; materialize the
+        # per-entity row lists only here.
+        active_lists = np.split(active_rows, a_starts[1:-1])
         feature_mask = _pearson_feature_masks(
             dataset,
             config,
             active_lists,
-            kept_entities,
+            list(kept),
             num_entities,
         )
 

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import time
 from typing import Dict, List, Mapping, Optional, Sequence, Set, Tuple
 
 import jax.numpy as jnp
@@ -56,6 +57,7 @@ from photon_ml_tpu.optimize.config import CoordinateOptimizationConfig
 from photon_ml_tpu.transformers.game_transformer import (
     CoordinateScoringSpec,
     GameTransformer,
+    PreparedCoordinateData,
     coordinate_margins,
     prepare_coordinate_data,
 )
@@ -305,6 +307,30 @@ class GameEstimator:
                 specs[cid] = CoordinateScoringSpec(shard=prep.shard, norm=prep.norm)
         return specs
 
+    def training_prepared(self) -> Dict[str, "PreparedCoordinateData"]:
+        """Scoring-prep views of the TRAINING dataset, reusing the arrays
+        prepare() already built — the projected shard registered on the
+        dataset and each RandomEffectDataset's per-sample entity rows.
+        Scoring/evaluating the training dataset with GameTransformer must
+        pass this instead of letting transform() re-run the projector and
+        entity resolution over data fit() already resolved (the reference's
+        transform():150-263 rebuilds them; its scoring of training data
+        reuses the training RDD views the same way)."""
+        if self._prepared is None:
+            raise RuntimeError("fit()/prepare() must run first")
+        out: Dict[str, PreparedCoordinateData] = {}
+        for cid, prep in self._prepared.items():
+            if prep.re_dataset is not None:
+                out[cid] = PreparedCoordinateData(
+                    self._prepared_dataset.shards[prep.shard],
+                    prep.re_dataset.sample_entity_rows,
+                )
+            else:
+                out[cid] = PreparedCoordinateData(
+                    self._prepared_dataset.shards[prep.shard], None
+                )
+        return out
+
     def _validation_suite(self, validation: GameDataset) -> EvaluationSuite:
         evaluators = self.validation_evaluators or [
             default_evaluator_for_task(self.task)
@@ -335,6 +361,11 @@ class GameEstimator:
         """
         if not opt_configs:
             raise ValueError("at least one optimization configuration required")
+        # Stage breakdown (prepare = host-side dataset/coordinate builds,
+        # solve = coordinate descent + validation): exposed as
+        # `self.fit_timing` so drivers/benchmarks report where fit wall
+        # goes without instrumenting internals.
+        t0 = time.perf_counter()
         prepared = self.prepare(data)
         for cfgs in opt_configs:
             missing = [c for c in self.update_sequence if c not in cfgs and c not in self.locked]
@@ -353,16 +384,21 @@ class GameEstimator:
                 for cid in self.update_sequence
             }
 
+        self.fit_timing = {"prepare_s": time.perf_counter() - t0, "solve_s": 0.0}
+
         results: List[GameResult] = []
         prev_model: Optional[GameModel] = initial_model
         default_cfg = CoordinateOptimizationConfig()
         for ci, cfgs in enumerate(opt_configs):
+            t_coord = time.perf_counter()
             coordinates = {
                 cid: self._coordinate_for(
                     data, cid, prepared[cid], cfgs.get(cid, default_cfg)
                 )
                 for cid in self.update_sequence
             }
+            self.fit_timing["prepare_s"] += time.perf_counter() - t_coord
+            t_solve = time.perf_counter()
             if ci == 0:
                 # Every fixed-effect coordinate that wanted the ingest's
                 # host-COO stash has consumed it by now (its pack decision
@@ -371,9 +407,9 @@ class GameEstimator:
                 # so the triplets don't pin host RAM for the rest of fit.
                 # The validation dataset never trains, so its stash has no
                 # consumer at all.
-                getattr(data, "host_csr", {}).clear()
+                getattr(data, "release_stash", lambda: None)()
                 if validation_data is not None:
-                    getattr(validation_data, "host_csr", {}).clear()
+                    getattr(validation_data, "release_stash", lambda: None)()
             reg_weights = {cid: cfgs[cid].reg_weight for cid in cfgs}
 
             validation_scorer = None
@@ -413,6 +449,7 @@ class GameEstimator:
                 )
             )
             prev_model = cd.model
+            self.fit_timing["solve_s"] += time.perf_counter() - t_solve
             logger.info(
                 "configuration %d/%d trained%s",
                 ci + 1,

@@ -98,11 +98,26 @@ class FixedEffectCoordinate:
         from photon_ml_tpu.ops import pallas_glm
 
         feats = dataset.shards[config_data_shard]
+        if not isinstance(feats, SparseFeatures) and pallas_glm.prefers_bf16_storage(
+            feats, jnp.zeros((feats.shape[-1],), feats.dtype)
+        ):
+            # bf16-STORED design matrix for the fused kernels: half the HBM
+            # bytes per objective pass, single MXU pass in hilo mode. The
+            # converted array is coordinate-local and used for BOTH train
+            # and score so CD residuals stay consistent; the dataset's f32
+            # shard is untouched for other consumers. Cached on the dataset
+            # so sweep steps that rebuild coordinates convert once.
+            cache = getattr(dataset, "bucketed_cache", {})
+            ckey = ("bf16x", config_data_shard)
+            feats = cache.get(ckey)
+            if feats is None:
+                feats = dataset.shards[config_data_shard].astype(jnp.bfloat16)
+                cache[ckey] = feats
         self._use_pallas = (
             False
             if isinstance(feats, SparseFeatures)
             else pallas_glm.dispatch(
-                feats, jnp.zeros((feats.shape[-1],), feats.dtype)
+                feats, jnp.zeros((feats.shape[-1],), jnp.float32)
             )
         )
         # Sparse shards repack once into the bucketed layout so the
@@ -140,9 +155,11 @@ class FixedEffectCoordinate:
                         # so its pack decision is authoritative — a decline
                         # (size/padding economics) must NOT fall through to
                         # maybe_pack's device->host pull of identical data.
-                        coo = csr.to_coo()
-                        bf = pallas_sparse.maybe_pack_coo(
-                            coo[0], coo[1], coo[2], dataset.num_samples, coo[3]
+                        # Ingest normally started the host pack on a
+                        # background thread (begin_pack_async); this joins
+                        # it and pays only the upload.
+                        bf = pallas_sparse.finish_pack(
+                            csr, dataset.num_samples
                         )
                     else:
                         bf = pallas_sparse.maybe_pack(

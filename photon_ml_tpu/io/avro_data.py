@@ -99,6 +99,37 @@ def _record_features(record: dict, bags: Sequence[str]) -> List[Tuple[str, float
     return out
 
 
+def _balanced_slice(
+    files: List[str], process_index: int, process_count: int
+) -> List[str]:
+    """Deterministic per-host file assignment balanced by BYTES (greedy
+    LPT), the way the reference's mapred input splits balance executors by
+    split size (AvroUtils.scala:47) — a round-robin over file COUNT gives
+    skewed hosts when file sizes differ. Every host computes the same
+    assignment from the same sorted listing + sizes (shared filesystem).
+
+    Byte balance does NOT guarantee ROW balance; consumers that assemble
+    globally-sharded arrays (jax.make_array_from_process_local_data) must
+    validate per-host row counts — parallel/multihost.py allgathers and
+    checks them.
+    """
+    import heapq
+    import os as _os
+
+    sizes = [_os.path.getsize(f) for f in files]
+    order = sorted(range(len(files)), key=lambda i: (-sizes[i], files[i]))
+    heap = [(0, p) for p in range(process_count)]
+    heapq.heapify(heap)
+    mine: List[str] = []
+    for i in order:
+        load, p = heapq.heappop(heap)
+        if p == process_index:
+            mine.append(files[i])
+        heapq.heappush(heap, (load + sizes[i], p))
+    # Keep the deterministic global file order within the slice.
+    return sorted(mine)
+
+
 def read_game_dataset(
     path: Union[str, Sequence[str]],
     shard_configs: Mapping[str, FeatureShardConfig],
@@ -123,9 +154,10 @@ def read_game_dataset(
 
     Multi-host ingest: pass `process_index`/`process_count` (normally
     `jax.process_index()` / `jax.process_count()`) and each host reads a
-    deterministic round-robin slice of the expanded FILE list — the
-    cluster-parallel reader split the reference gets from mapred input
-    splits across executors (AvroUtils.scala:47). Feature ids must then
+    deterministic byte-balanced slice of the expanded FILE list (greedy
+    LPT over file sizes, `_balanced_slice`) — the cluster-parallel reader
+    split the reference gets from mapred input splits across executors
+    (AvroUtils.scala:47). Feature ids must then
     agree across hosts, so a shared `index_maps` (an off-heap store built
     by cli/build_index.py, as the reference shares PalDB partitions via
     sc.addFile) is required.
@@ -171,7 +203,7 @@ def read_game_dataset(
                 f"process ({len(files)} files < {process_count} processes) "
                 "— split the data"
             )
-        paths = files[process_index::process_count]
+        paths = _balanced_slice(files, process_index, process_count)
 
     if columns is not None and response_field != RESPONSE:
         raise ValueError(

@@ -57,6 +57,15 @@ Precision/roofline history (v5e, 1M x 512 f32): at HIGHEST (6 bf16 MXU
   halves stacked along the free dimension — all four cross products, 3x
   less MXU work than HIGHEST, at ~2e-5 agreement with a float64 host
   reference (f32 accumulation is the shared accuracy floor).
+
+bf16-STORED X (r05, `prefers_bf16_storage`): the training design matrix is
+  additionally stored bf16 by the fixed-effect coordinate when the kernels
+  engage — half the HBM bytes per pass AND a single MXU pass per
+  contraction (_dot_bf16x: the lo half of X is zero by construction, so
+  only the RHS is hi/lo split). Quantization is data-level (~2^-8, once);
+  the optimizer solves that problem exactly, so fn_evals stay at f32
+  behavior (measured 27 -> 31 at 1M x 512, wall 0.124 -> 0.104 s/solve,
+  469 -> 641 GB/s f32-normalized effective, coef diff 4e-4 relative).
 """
 
 from __future__ import annotations
@@ -98,6 +107,19 @@ def _env_tile() -> int:
         tile = int(raw)
         if tile < 8 or tile % 8 != 0:
             raise ValueError
+        if tile > 1024:
+            # A 2048-row tile at d=512 in hilo mode is exactly the 8 MB
+            # VMEM budget — a working set this module's own notes measure
+            # as collapsing to ~13 GB/s. The budget check alone does not
+            # exclude it, so cap the override at the measured-good 1024.
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "PHOTON_PALLAS_TILE=%d exceeds the measured-good maximum "
+                "1024 (larger tiles thrash VMEM); capping at 1024",
+                tile,
+            )
+            return 1024
         return tile
     except ValueError:
         import logging
@@ -377,6 +399,30 @@ def should_use(features, w: Array) -> bool:
     return dispatch(features, w) is True
 
 
+def prefers_bf16_storage(features, w: Array) -> bool:
+    """Should this dense f32 design matrix be STORED bf16 for training?
+
+    True when the fused kernels engage in hilo mode: bf16 storage halves
+    the HBM bytes streamed per objective evaluation AND halves the MXU
+    passes (_dot_bf16x), while every multiply stays exact for the stored
+    data (the RHS is hi/lo split, never quantized). The quantization is
+    data-level (~2^-8 relative on X entries, once) — the optimizer then
+    solves that problem EXACTLY, so line searches and fn_evals behave as
+    at f32, unlike bf16-rounded arithmetic on f32 data (which the r03
+    DEFAULT-precision experiment measured at ~1.5x fn_evals). Opt out with
+    PHOTON_DENSE_BF16X=0. Callers convert once at coordinate construction
+    (game/coordinate.py) and train AND score on the converted array so
+    coordinate-descent residuals stay consistent."""
+    if os.environ.get("PHOTON_DENSE_BF16X", "1").lower() in ("0", "false"):
+        return False
+    if _PREC_MODE != "hilo":
+        return False
+    if getattr(features, "dtype", None) != jnp.float32:
+        return False
+    mode = dispatch(features, w)
+    return mode is True or isinstance(mode, ShardedDispatch)
+
+
 def _tile_for(d: int) -> int:
     """Row-tile height for feature width d: the largest multiple of 8 not
     above _TILE_N whose VMEM working set (f32 tile + hilo's bf16 hi/lo
@@ -428,10 +474,31 @@ def _dot_hilo_parts(xhi: Array, xlo: Array, rhs: Array, dims) -> Array:
     return out[:, :k] + out[:, k:]
 
 
+def _dot_bf16x(x: Array, rhs: Array, dims) -> Array:
+    """Matmul against bf16-STORED X in ONE MXU pass.
+
+    The f32 RHS is hi/lo split and stacked along its free dimension (padded
+    to 128 MXU lanes anyway), so the product is exact for the bf16 data up
+    to f32 accumulation — no RHS quantization. Data stored bf16 halves HBM
+    bytes AND halves the hilo mode's MXU passes (the lo half of X is zero
+    by construction, so its pass is dropped)."""
+    k = rhs.shape[1]
+    rhi, rlo = _hilo_split(rhs.astype(jnp.float32))
+    rhs2 = jnp.concatenate([rhi, rlo], axis=1)
+    out = jax.lax.dot_general(
+        x, rhs2, dimension_numbers=(dims, ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return out[:, :k] + out[:, k:]
+
+
 def _dot_pair(x, x_split, rhs, dims):
     """One kernel matmul under the configured precision mode. `x_split` is
-    the hi/lo pair (computed once per tile, shared by both contractions)."""
+    the hi/lo pair (computed once per tile, shared by both contractions);
+    it is None when X is stored bf16 (single-pass path)."""
     if _PREC_MODE == "hilo":
+        if x.dtype == jnp.bfloat16:
+            return _dot_bf16x(x, rhs, dims)
         return _dot_hilo_parts(x_split[0], x_split[1], rhs, dims)
     return jax.lax.dot_general(
         x, rhs, dimension_numbers=(dims, ((), ())),
@@ -444,10 +511,15 @@ def _value_grad_kernel(loss: PointwiseLoss, n: int, tile: int, x_ref, y_ref,
                        off_ref, wt_ref, w_ref, stats_ref, grad_ref):
     i = pl.program_id(0)
     valid = _row_mask(n, tile)
-    # bf16 X streams at half the HBM traffic; compute stays f32 in VMEM
-    # (Mosaic rejects mixed-dtype matmul operands).
-    x = jnp.where(valid, x_ref[:], 0.0).astype(jnp.float32)
-    x_split = _hilo_split(x) if _PREC_MODE == "hilo" else None
+    # bf16-stored X streams at half the HBM traffic and runs single-pass in
+    # hilo mode (_dot_bf16x); f32 X is hi/lo split once per tile. Either
+    # way compute accumulates in f32.
+    x = jnp.where(valid, x_ref[:], 0)
+    if x.dtype == jnp.bfloat16 and _PREC_MODE == "hilo":
+        x_split = None
+    else:
+        x = x.astype(jnp.float32)
+        x_split = _hilo_split(x) if _PREC_MODE == "hilo" else None
     z = _dot_pair(
         x, x_split, w_ref[:], (((1,), (0,)))
     ) + jnp.where(valid, off_ref[:], 0.0)
@@ -475,8 +547,12 @@ def _hvp_kernel(loss: PointwiseLoss, n: int, tile: int, x_ref, y_ref,
                 off_ref, wt_ref, wv_ref, vshift_ref, stats_ref, hv_ref):
     i = pl.program_id(0)
     valid = _row_mask(n, tile)
-    x = jnp.where(valid, x_ref[:], 0.0).astype(jnp.float32)
-    x_split = _hilo_split(x) if _PREC_MODE == "hilo" else None
+    x = jnp.where(valid, x_ref[:], 0)
+    if x.dtype == jnp.bfloat16 and _PREC_MODE == "hilo":
+        x_split = None
+    else:
+        x = x.astype(jnp.float32)
+        x_split = _hilo_split(x) if _PREC_MODE == "hilo" else None
     zq = _dot_pair(x, x_split, wv_ref[:], ((1,), (0,)))
     z = zq[:, 0:1] + jnp.where(valid, off_ref[:], 0.0)
     q = zq[:, 1:2] + vshift_ref[0, 0]

@@ -379,6 +379,29 @@ def maybe_pack(feats, n_samples: int) -> Optional[BucketedSparseFeatures]:
     return bf
 
 
+def host_pack_coo(
+    rows, cols, vals, n_samples: int, dim: int
+) -> Optional[BucketedSparseFeatures]:
+    """Host-only half of `maybe_pack_coo`: gates + counting-sort pack, NO
+    device upload (planes stay numpy; `data.bucketed.upload` moves them).
+    Split out so ingest can run the pack on a background thread while the
+    rest of ingest/prepare proceeds (begin_pack_async)."""
+    import numpy as np
+
+    from photon_ml_tpu.data.bucketed import pack_bucketed
+
+    if not pack_worth_considering(n_samples):
+        return None
+    if np.asarray(vals).dtype != np.float32:
+        return None
+    bf = pack_bucketed(rows, cols, vals, n_samples, dim, host_only=True)
+    if not should_use(bf):
+        return None
+    if bf.density_report()["pad_blowup"] > MAX_PAD_BLOWUP:
+        return None
+    return bf
+
+
 def maybe_pack_coo(
     rows, cols, vals, n_samples: int, dim: int
 ) -> Optional[BucketedSparseFeatures]:
@@ -388,20 +411,57 @@ def maybe_pack_coo(
     dataset-construction placement (RandomEffectDataset.scala:229-264).
     Applies the same engagement gates; sharding cannot apply (host arrays).
     """
-    import numpy as np
+    from photon_ml_tpu.data import bucketed
 
-    from photon_ml_tpu.data.bucketed import pack_bucketed
+    bf = host_pack_coo(rows, cols, vals, n_samples, dim)
+    return None if bf is None else bucketed.upload(bf)
 
+
+def begin_pack_async(csr, n_samples: int) -> None:
+    """Start the host-side bucketed pack of an ingest CSR stash (a
+    `data.game_dataset.HostCSR`) on a daemon thread; the native counting
+    sort releases the GIL, so the pack overlaps the remainder of ingest and
+    the estimator's prepare work (the reference's layout build is likewise
+    part of dataset construction, RandomEffectDataset.scala:229-264). The
+    result (host-plane layout or None = declined) lands in
+    `csr.pack_future`; `finish_pack` joins and uploads. Consumers that
+    DISCARD the stash (scoring, validation datasets) must cancel the
+    future first (GameDataset.release_stash) — a cancelled-before-start
+    pack never runs, and the daemon thread never blocks process exit."""
+    if getattr(csr, "pack_future", None) is not None:
+        return
     if not pack_worth_considering(n_samples):
-        return None
-    if np.asarray(vals).dtype != np.float32:
-        return None
-    bf = pack_bucketed(rows, cols, vals, n_samples, dim)
-    if not should_use(bf):
-        return None
-    if bf.density_report()["pad_blowup"] > MAX_PAD_BLOWUP:
-        return None
-    return bf
+        return
+    import concurrent.futures
+    import threading
+
+    fut: "concurrent.futures.Future" = concurrent.futures.Future()
+
+    def _run():
+        if not fut.set_running_or_notify_cancel():
+            return  # cancelled before start: skip the O(nnz) pack entirely
+        try:
+            rows, cols, vals, dim = csr.to_coo()
+            fut.set_result(host_pack_coo(rows, cols, vals, n_samples, dim))
+        except BaseException as exc:  # noqa: BLE001 - surfaced at result()
+            fut.set_exception(exc)
+
+    csr.pack_future = fut
+    threading.Thread(target=_run, daemon=True, name="photon-bucketed-pack").start()
+
+
+def finish_pack(csr, n_samples: int) -> Optional[BucketedSparseFeatures]:
+    """Join a `begin_pack_async` pack (or run it synchronously if none was
+    started) and upload the packed planes. Returns None when the pack was
+    declined — callers keep the ELL/XLA path."""
+    from photon_ml_tpu.data import bucketed
+
+    fut = getattr(csr, "pack_future", None)
+    if fut is not None and not fut.cancelled():
+        bf = fut.result()
+        return None if bf is None else bucketed.upload(bf)
+    rows, cols, vals, dim = csr.to_coo()
+    return maybe_pack_coo(rows, cols, vals, n_samples, dim)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))

@@ -86,7 +86,7 @@ def _worker(coordinator: str, num_processes: int, process_id: int, devices_per_p
         )
         return out
 
-    # The full pod-scale ingest loop: each process reads ITS round-robin
+    # The full pod-scale ingest loop: each process reads ITS byte-balanced
     # slice of the Avro files (read_game_dataset process slicing) with a
     # shared deterministic index map, then promotes the process-local
     # columns to ONE global sharded array — the
@@ -107,6 +107,21 @@ def _worker(coordinator: str, num_processes: int, process_id: int, devices_per_p
     n_loc = ds.num_samples
     X_loc = densify(ds)
     y_loc = np.asarray(ds.labels)
+    # The global sample count is num_processes * n_loc ONLY when every
+    # host's slice has the same row count — allgather and check, so a
+    # skewed file split fails loudly here instead of silently misassembling
+    # inside make_array_from_process_local_data.
+    from jax.experimental import multihost_utils
+
+    counts = np.asarray(
+        multihost_utils.process_allgather(np.asarray([n_loc], np.int64))
+    ).reshape(-1)
+    if not (counts == n_loc).all():
+        raise ValueError(
+            f"per-process row counts differ across hosts ({counts.tolist()}) "
+            "— the even-shard global assembly below requires row-balanced "
+            "file slices; rebalance the input files"
+        )
     n = n_loc * num_processes
     Xs = jax.make_array_from_process_local_data(s2, X_loc, (n, d))
     ys = jax.make_array_from_process_local_data(s1, y_loc, (n,))

@@ -1,0 +1,84 @@
+"""TPU probe: dense fused-kernel wall with f32-stored vs bf16-stored X.
+
+Within-run comparison only (the tunnel shows up to 4x run-to-run variance).
+Protocol from bench.py: jitted combining-scalar fetch, rtt subtracted,
+perturbed warm-up inputs.
+"""
+import os
+import sys
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.data.game_dataset import GameDataset
+from photon_ml_tpu.game.coordinate import FixedEffectCoordinate
+from photon_ml_tpu.optimize.config import L2, CoordinateOptimizationConfig, OptimizerConfig
+from photon_ml_tpu.types import TaskType
+from photon_ml_tpu.ops import pallas_glm
+
+t0 = time.perf_counter()
+def mark(m):
+    sys.stderr.write(f"+{time.perf_counter()-t0:.1f}s {m}\n"); sys.stderr.flush()
+
+platform = jax.devices()[0].platform
+mark(f"backend {platform}")
+n, d = 1 << 20, 512
+key = jax.random.PRNGKey(0)
+kx, kw, kl = jax.random.split(key, 3)
+X = jax.random.normal(kx, (n, d), jnp.float32)
+w_true = jax.random.normal(kw, (d,)) * 0.1
+y = (jax.random.uniform(kl, (n,)) < jax.nn.sigmoid(X @ w_true)).astype(jnp.float32)
+jax.block_until_ready(y)
+mark("data on device")
+
+@jax.jit
+def _force_sum(parts):
+    return sum(parts[1:], parts[0])
+
+def _force(out):
+    leaves = [x for x in jax.tree_util.tree_leaves(out) if hasattr(x, "dtype")]
+    return float(_force_sum(tuple(jnp.sum(x.astype(jnp.float32)) for x in leaves)))
+
+_force(jnp.ones(2))
+ts = []
+for i in range(5):
+    tt = time.perf_counter(); _force(jnp.ones(4) * (i + 1)); ts.append(time.perf_counter() - tt)
+rtt = min(ts)
+mark(f"rtt {rtt*1e3:.0f} ms")
+
+cfg = CoordinateOptimizationConfig(
+    optimizer=OptimizerConfig(max_iterations=40, tolerance=1e-8),
+    regularization=L2, reg_weight=1.0,
+)
+
+def run(mode_env):
+    os.environ["PHOTON_DENSE_BF16X"] = mode_env
+    ds = GameDataset.build({"g": X}, y)
+    coord = FixedEffectCoordinate(ds, "g", cfg, TaskType.LOGISTIC_REGRESSION)
+    xdt = coord._features.dtype
+    warm_off = ds.offsets + jnp.float32(1e-3)
+    tc = time.perf_counter()
+    _force(coord.train(warm_off)[1])  # compile + warm
+    mark(f"bf16x={mode_env} (X dtype {xdt}, dispatch {coord._use_pallas!r}) warm {time.perf_counter()-tc:.1f}s")
+    walls, evals = [], None
+    for rep in range(3):
+        off = ds.offsets + jnp.float32(1e-6 * (rep + 1))
+        tt = time.perf_counter()
+        _, res = coord.train(off)
+        _force(res)
+        walls.append(max(time.perf_counter() - tt - rtt, 1e-9))
+        evals = int(np.asarray(res.fn_evals))
+    wall = min(walls)
+    per_pass_bytes = n * d * 4  # f32-normalized, bench formula
+    eff = evals * per_pass_bytes / wall / 1e9
+    print(f"bf16x={mode_env}: wall={wall:.3f}s fn_evals={evals} eff={eff:.0f} GB/s (f32-normalized) walls={['%.3f'%w for w in walls]}")
+    return wall, evals, res
+
+w_f32, e_f32, res_f32 = run("0")
+w_bf16, e_bf16, res_bf16 = run("1")
+print(f"speedup: {w_f32 / w_bf16:.2f}x  fn_evals {e_f32} -> {e_bf16}")
+d_coef = float(jnp.max(jnp.abs(res_f32.coefficients - res_bf16.coefficients)))
+scale = float(jnp.max(jnp.abs(res_f32.coefficients)))
+print(f"coef diff {d_coef:.2e} (scale {scale:.2e})")

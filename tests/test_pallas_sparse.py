@@ -287,5 +287,54 @@ class TestHostCooPack:
         coord = FixedEffectCoordinate(ds, "s", cfg, TaskType.LOGISTIC_REGRESSION)
         assert isinstance(coord._features, BucketedSparseFeatures)
         assert coord._use_pallas is None
+
+    def test_async_ingest_pack_joins_at_coordinate(self, interpret_kernels):
+        """begin_pack_async at stash time -> the coordinate joins the
+        background host pack (finish_pack) and the layout matches the
+        synchronous pack exactly."""
+        from photon_ml_tpu.data.game_dataset import GameDataset, HostCSR
+        from photon_ml_tpu.game.coordinate import FixedEffectCoordinate
+        from photon_ml_tpu.optimize.config import (
+            L2,
+            CoordinateOptimizationConfig,
+            OptimizerConfig,
+        )
+        from photon_ml_tpu.types import TaskType
+
+        rng = np.random.default_rng(10)
+        n, d, k = 9000, 200, 6
+        idx = rng.integers(0, d, size=(n, k)).astype(np.int32)
+        val = rng.normal(size=(n, k)).astype(np.float32)
+        y = (rng.uniform(size=n) > 0.5).astype(np.float32)
+        cols = idx.reshape(-1).astype(np.int64)
+        vals = val.reshape(-1)
+        indptr = np.arange(n + 1, dtype=np.int64) * k
+
+        ds = GameDataset.build(
+            {"s": SparseFeatures(jnp.asarray(idx), jnp.asarray(val), d)}, y
+        )
+        csr = HostCSR(indptr, cols, vals, d)
+        ds.host_csr = {"s": csr}
+        pallas_sparse.begin_pack_async(csr, n)
+        assert csr.pack_future is not None
+        cfg = CoordinateOptimizationConfig(
+            optimizer=OptimizerConfig(max_iterations=5, tolerance=1e-6),
+            regularization=L2,
+            reg_weight=1.0,
+        )
+        coord = FixedEffectCoordinate(ds, "s", cfg, TaskType.LOGISTIC_REGRESSION)
+        assert isinstance(coord._features, BucketedSparseFeatures)
+        # Same layout as the synchronous data-plane pack.
+        sync = pallas_sparse.maybe_pack_coo(
+            np.repeat(np.arange(n, dtype=np.int64), k), cols, vals, n, d
+        )
+        np.testing.assert_array_equal(
+            np.asarray(coord._features.level1.packed),
+            np.asarray(sync.level1.packed),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(coord._features.level1.values),
+            np.asarray(sync.level1.values),
+        )
         model, res = coord.train(ds.offsets)
         assert np.isfinite(float(res.loss))
