@@ -40,6 +40,7 @@ pass) as a vectorized counting sort — O(nnz) numpy, no argsort.
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Optional, Tuple
 
 import jax
@@ -70,6 +71,13 @@ class BucketedLevel:
     values: Array  # f32
     tile_rows: int = dataclasses.field(metadata=dict(static=True))
     spv: int = dataclasses.field(metadata=dict(static=True))  # SP // 128
+    # Row-lane-aligned layout: entry at slot lane row_local & 127, payload
+    # (row_local >> 7) << 7 | feature_lane. The kernels' z-accumulate /
+    # u-select sides are then alignment-free (no 128-wide one-hot); only
+    # the gradient's feature-side scatter keeps one (ops/pallas_sparse.py).
+    row_aligned: bool = dataclasses.field(
+        default=False, metadata=dict(static=True)
+    )
 
     def num_tiles(self, n_rows: int) -> int:
         return -(-n_rows // self.tile_rows)
@@ -125,11 +133,10 @@ def upload(bf: BucketedSparseFeatures) -> BucketedSparseFeatures:
     def _lvl(level: Optional[BucketedLevel]) -> Optional[BucketedLevel]:
         if level is None or isinstance(level.packed, jax.Array):
             return level
-        return BucketedLevel(
+        return dataclasses.replace(
+            level,
             packed=jnp.asarray(level.packed),
             values=jnp.asarray(level.values),
-            tile_rows=level.tile_rows,
-            spv=level.spv,
         )
 
     return BucketedSparseFeatures(
@@ -170,6 +177,7 @@ def _pack_level(
     sp: int,
     dtype,
     host_only: bool = False,
+    row_aligned: bool = False,
 ) -> Tuple[BucketedLevel, np.ndarray]:
     """Pack entries that fit segment width `sp`; return (level, spill mask).
 
@@ -189,7 +197,7 @@ def _pack_level(
     from photon_ml_tpu.native import bucketed_pack as native_pack
 
     native = native_pack.pack_level_native(
-        rows32, cols32, vals, T, B, tile_shift, sp
+        rows32, cols32, vals, T, B, tile_shift, sp, row_aligned
     )
     if native is not None:
         packed_n, values_n, spill_idx = native
@@ -199,6 +207,7 @@ def _pack_level(
             values=_dev(values_n.reshape(-1, 128)),
             tile_rows=tile_rows,
             spv=spv,
+            row_aligned=row_aligned,
         )
         spill_mask = np.zeros(len(rows32), dtype=bool)
         spill_mask[spill_idx] = True
@@ -206,13 +215,40 @@ def _pack_level(
 
     seg = (rows32 >> tile_shift) * np.int32(B) + (cols32 >> 7)
     n_seg = T * B
+    spv = sp // 128
+    if row_aligned:
+        rl = rows32 & np.int32(tile_rows - 1)
+        lane = rl & np.int32(127)
+        seg_lane = seg.astype(np.int64) * 128 + lane
+        payload = ((rl >> 7) << _ROW_SHIFT) | (cols32 & np.int32(BUCKET - 1))
+        order, pos, _ = _sort_by_segment(seg_lane, n_seg * 128)
+        fits = pos < spv
+        sel = order[fits]
+        dst = (
+            seg[sel].astype(np.int64) * sp
+            + pos[fits] * 128
+            + lane[sel].astype(np.int64)
+        )
+        packed = np.zeros(n_seg * sp, np.int32)
+        values = np.zeros(n_seg * sp, dtype)
+        packed[dst] = payload[sel]
+        values[dst] = vals[sel]
+        level = BucketedLevel(
+            packed=_dev(packed.reshape(n_seg * spv, 128)),
+            values=_dev(values.reshape(n_seg * spv, 128)),
+            tile_rows=tile_rows,
+            spv=spv,
+            row_aligned=True,
+        )
+        spill_mask = np.zeros(len(seg), dtype=bool)
+        spill_mask[order[~fits]] = True
+        return level, spill_mask
     # Pack the per-entry payload BEFORE sorting so only two arrays need the
     # (random-access) reorder gather.
     payload = ((rows32 & np.int32(tile_rows - 1)) << _ROW_SHIFT) | (
         cols32 & np.int32(BUCKET - 1)
     )
     order, pos, _ = _sort_by_segment(seg, n_seg)
-    spv = sp // 128
     fits = pos < sp
     sel = order[fits]  # entry indices that fit, in segment order
     # Destinations are monotone in the sorted order -> sequential flat writes.
@@ -245,13 +281,23 @@ def pack_bucketed(
     *,
     dtype=np.float32,
     host_only: bool = False,
+    row_aligned: Optional[bool] = None,
 ) -> BucketedSparseFeatures:
     """Pack COO triplets into the two-level bucketed layout.
+
+    `row_aligned` (default from PHOTON_SPARSE_ROWALIGN, off — the measured
+    training-optimal choice; see the r05 note in ops/pallas_sparse.py)
+    selects the row-lane-aligned level-1 slot layout, see
+    BucketedLevel.row_aligned.
 
     `host_only=True` skips every device upload (planes stay numpy) — used
     by the benchmark to time the host pack cost in isolation without
     monkeypatching this module's array namespace."""
     _dev = (lambda x: x) if host_only else jnp.asarray
+    if row_aligned is None:
+        row_aligned = os.environ.get(
+            "PHOTON_SPARSE_ROWALIGN", "0"
+        ).lower() in ("1", "true")
     rows = np.asarray(rows, np.int64)
     cols = np.asarray(cols, np.int64)
     vals = np.asarray(vals, dtype)
@@ -264,9 +310,15 @@ def pack_bucketed(
     # Level-1 SP near the mean segment size (1024-granular): padding stays
     # ~1x and the spill tail (mean-crossing segments) goes to level 2.
     mean1 = nnz / max(T1 * B, 1)
-    sp1 = min(max(1024, _round_up(int(mean1), 1024)), MAX_SP)
+    # Row-aligned level 1 needs collision headroom: per-lane capacity is
+    # sp/128 and lane loads are ~Poisson(mean/128), so sizing at the mean
+    # spills ~half the lanes' tails (measured 14% of entries). 2x mean
+    # keeps L1 residency comparable to the legacy layout's.
+    m1 = 2 * mean1 if row_aligned else mean1
+    sp1 = min(max(1024, _round_up(int(m1), 1024)), MAX_SP)
     level1, spill = _pack_level(
-        rows, cols, vals, n_rows, dim, L1_TILE_ROWS, sp1, dtype, host_only
+        rows, cols, vals, n_rows, dim, L1_TILE_ROWS, sp1, dtype, host_only,
+        row_aligned,
     )
 
     level2 = None
@@ -279,8 +331,12 @@ def pack_bucketed(
         # Generous width (4x mean) — level-2 feeds from the variance tail, so
         # its own segment sizes are lumpy; what still spills goes to COO.
         sp2 = min(max(1024, _round_up(int(4 * mean2), 1024)), MAX_SP)
+        # Level 2 stays on the feature-lane layout regardless: its coarse
+        # tiles have rt = 128, so a row-aligned sublane-block select would
+        # cost exactly the 128-row one-hot the alignment exists to avoid.
         level2, spill2 = _pack_level(
-            o_rows, o_cols, o_vals, n_rows, dim, L2_TILE_ROWS, sp2, dtype, host_only
+            o_rows, o_cols, o_vals, n_rows, dim, L2_TILE_ROWS, sp2, dtype,
+            host_only, False,
         )
         o_rows, o_cols, o_vals = o_rows[spill2], o_cols[spill2], o_vals[spill2]
 
@@ -319,7 +375,13 @@ def level_entries(level: BucketedLevel, n_rows: int, dim: int):
     nz = vv != 0
     ent_seg, ent_pos = np.nonzero(nz)
     pkx = pk[ent_seg, ent_pos]
-    rows = t[ent_seg] * level.tile_rows + (pkx >> _ROW_SHIFT)
+    if level.row_aligned:
+        # slot lane IS row_local & 127; payload carries (row_local>>7)<<7
+        # in its high bits and the feature lane in its low 7.
+        row_local = (pkx >> _ROW_SHIFT << 7) | (ent_pos & (BUCKET - 1))
+        rows = t[ent_seg] * level.tile_rows + row_local
+    else:
+        rows = t[ent_seg] * level.tile_rows + (pkx >> _ROW_SHIFT)
     cols = b[ent_seg] * BUCKET + (pkx & (BUCKET - 1))
     return rows.astype(np.int64), cols.astype(np.int64), vv[ent_seg, ent_pos]
 

@@ -326,9 +326,18 @@ class GameEstimator:
                     prep.re_dataset.sample_entity_rows,
                 )
             else:
-                out[cid] = PreparedCoordinateData(
-                    self._prepared_dataset.shards[prep.shard], None
-                )
+                # Prefer the trained coordinate's features (bucketed layout
+                # or bf16-stored matrix): scoring through them avoids
+                # materializing the raw ELL on device when training never
+                # did (ShardDict lazy upload).
+                feats = None
+                for (ccid, _), coord in self._coordinate_cache.items():
+                    if ccid == cid:
+                        feats = coord._features
+                        break
+                if feats is None:
+                    feats = self._prepared_dataset.shards[prep.shard]
+                out[cid] = PreparedCoordinateData(feats, None)
         return out
 
     def _validation_suite(self, validation: GameDataset) -> EvaluationSuite:

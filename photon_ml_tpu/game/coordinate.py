@@ -97,7 +97,15 @@ class FixedEffectCoordinate:
         # a ShardedDispatch: per-device fused kernel + psum under shard_map.
         from photon_ml_tpu.ops import pallas_glm
 
-        feats = dataset.shards[config_data_shard]
+        # Peek without forcing a device upload: if the bucketed pack
+        # engages below, the raw ELL never ships to the device at all
+        # (ShardDict.host_view); dense shards pass through unchanged.
+        shards = dataset.shards
+        feats = (
+            shards.host_view(config_data_shard)
+            if hasattr(shards, "host_view")
+            else shards[config_data_shard]
+        )
         if not isinstance(feats, SparseFeatures) and pallas_glm.prefers_bf16_storage(
             feats, jnp.zeros((feats.shape[-1],), feats.dtype)
         ):
@@ -177,6 +185,12 @@ class FixedEffectCoordinate:
                 # caller's genuine escape hatch for shards where the pack was
                 # declined and the ELL/XLA composition is the right path.
                 self._use_pallas = None
+        if isinstance(self._features, SparseFeatures) and not isinstance(
+            self._features.indices, jax.Array
+        ):
+            # ELL path it is (pack declined/ineligible): materialize the
+            # device copy through the dataset so other consumers share it.
+            self._features = dataset.shards[config_data_shard]
         self._build_jits()
 
     def _build_jits(self) -> None:
@@ -490,8 +504,12 @@ class RandomEffectCoordinate:
             ],
             "total_iterations": int(sum(int(jnp.sum(its)) for its in bucket_iters)),
         }
-        # Keep the unseen-entity row pinned to zero.
+        # Keep the unseen-entity row pinned to zero — in BOTH matrices:
+        # dummy-padded chunk entities (build_random_effect_dataset block
+        # splitting) scatter their inert solves into this row.
         matrix = matrix.at[e_total].set(0.0)
+        if var_matrix is not None:
+            var_matrix = var_matrix.at[e_total].set(0.0)
         model = RandomEffectModel(
             matrix,
             var_matrix,

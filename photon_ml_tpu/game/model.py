@@ -141,8 +141,14 @@ def _random_effect_margins_sharded_impl(
         if norm.shifts is not None:
             shift = -(w_rows @ norm.shifts)
     if isinstance(features, _SF):
-        g = jnp.take_along_axis(w_rows, features.indices, axis=1)
-        out = jnp.sum(g * features.values, axis=-1)
+        if features.ell_axis == -2:  # transposed (K, N) projected planes
+            g = jnp.take_along_axis(
+                w_rows.T, features.indices.astype(jnp.int32), axis=0
+            )
+            out = jnp.sum(g * features.values, axis=0)
+        else:
+            g = jnp.take_along_axis(w_rows, features.indices, axis=1)
+            out = jnp.sum(g * features.values, axis=-1)
     else:
         out = jnp.einsum("nd,nd->n", features, w_rows)
     if shift is not None:
@@ -171,9 +177,15 @@ def random_effect_margins(features, entity_rows: Array, matrix: Array, norm) -> 
         if norm.shifts is not None:
             shift = -(matrix @ norm.shifts)  # (E+1,) margin shifts
     if isinstance(features, _SF):
-        # (N, K) gather out of the (E+1, D) matrix, then sparse dot.
-        rows = matrix[entity_rows[:, None], features.indices]
-        out = jnp.sum(rows * features.values, axis=-1)
+        if features.ell_axis == -2:
+            # Transposed (K, N) projected planes: broadcast the entity rows
+            # across K — same gather, no transpose materialization.
+            rows = matrix[entity_rows[None, :], features.indices.astype(jnp.int32)]
+            out = jnp.sum(rows * features.values, axis=0)
+        else:
+            # (N, K) gather out of the (E+1, D) matrix, then sparse dot.
+            rows = matrix[entity_rows[:, None], features.indices]
+            out = jnp.sum(rows * features.values, axis=-1)
     else:
         out = jnp.einsum("nd,nd->n", features, matrix[entity_rows])
     if shift is not None:

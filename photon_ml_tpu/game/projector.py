@@ -54,7 +54,9 @@ class IdentityProjector:
         self.original_dim = dim
         self.projected_dim = dim
 
-    def project_features(self, features: Features, entity_rows: np.ndarray) -> Features:
+    def project_features(
+        self, features: Features, entity_rows: np.ndarray, host_planes=None
+    ) -> Features:
         return features
 
     def back_project_matrix(self, matrix: Array) -> Array:
@@ -87,12 +89,19 @@ class IndexMapProjector:
         num_entities: int,
         *,
         pad_multiple: int = 8,
+        host_planes=None,
     ) -> "IndexMapProjector":
         """Collect each entity's distinct active feature indices
         (IndexMapProjectorRDD.scala:60-90 unions active+passive; here
-        `entity_rows` covers every sample so both are included)."""
-        idx = np.asarray(features.indices)
-        val = np.asarray(features.values)
+        `entity_rows` covers every sample so both are included).
+        `host_planes` is ingest's (indices, values) host copy
+        (GameDataset.host_ell) — without it, np.asarray on a remote-device
+        array pulls the whole shard back over the interconnect."""
+        if host_planes is not None:
+            idx, val = host_planes
+        else:
+            idx = np.asarray(features.indices)
+            val = np.asarray(features.values)
         ent = np.asarray(entity_rows)
         # Flatten to (entity, global-index) pairs for nonzero entries and
         # take per-entity distinct indices in one vectorized pass. The pair
@@ -119,15 +128,11 @@ class IndexMapProjector:
         tables[pair_ent, slot] = pair_idx
         return cls(tables, features.dim)
 
-    def project_features(
-        self, features: SparseFeatures, entity_rows: np.ndarray
-    ) -> SparseFeatures:
-        """Rewrite global ELL indices to per-entity local slots (host-side,
-        one-time). Entries whose feature is absent from the entity's table
-        (value-0 padding, or unseen entities) are zeroed out."""
-        idx = np.asarray(features.indices)
-        val = np.asarray(features.values)
-        ent = np.asarray(entity_rows)
+    def project_arrays(
+        self, idx: np.ndarray, val: np.ndarray, ent: np.ndarray
+    ):
+        """Host-side core of project_features on numpy planes; returns the
+        projected (indices int32, values) numpy pair."""
         # One GLOBAL searchsorted instead of a per-entity loop: each
         # entity's valid slots, keyed as entity * (dim + 1) + global_index,
         # concatenate into one array that is sorted by construction (tables
@@ -151,10 +156,28 @@ class IndexMapProjector:
             else np.zeros(idx.shape, bool)
         )
         local = pos_c - offsets[ent][:, None]
-        out = np.where(hit, local, 0)
+        out = np.where(hit, local, 0).astype(np.int32)
         val = np.where(hit, val, 0.0).astype(val.dtype)
+        return out, val
+
+    def project_features(
+        self,
+        features: SparseFeatures,
+        entity_rows: np.ndarray,
+        host_planes=None,
+    ) -> SparseFeatures:
+        """Rewrite global ELL indices to per-entity local slots (host-side,
+        one-time). Entries whose feature is absent from the entity's table
+        (value-0 padding, or unseen entities) are zeroed out. `host_planes`
+        avoids the remote-device pull (see build)."""
+        if host_planes is not None:
+            idx, val = host_planes
+        else:
+            idx = np.asarray(features.indices)
+            val = np.asarray(features.values)
+        out, v = self.project_arrays(idx, val, np.asarray(entity_rows))
         return SparseFeatures(
-            jnp.asarray(out, jnp.int32), jnp.asarray(val), self.projected_dim
+            jnp.asarray(out), jnp.asarray(v), self.projected_dim
         )
 
     def back_project_matrix(self, matrix: Array) -> Array:
@@ -209,7 +232,9 @@ class RandomProjector:
         )
         return cls(p)
 
-    def project_features(self, features: Features, entity_rows: np.ndarray) -> Array:
+    def project_features(
+        self, features: Features, entity_rows: np.ndarray, host_planes=None
+    ) -> Array:
         if isinstance(features, SparseFeatures):
             # Sparse x P: gather P rows at the ELL indices and reduce —
             # avoids densifying X itself.
@@ -241,6 +266,7 @@ def build_projector(
     *,
     projected_dim: Optional[int] = None,
     seed: int = 0,
+    host_planes=None,
 ) -> Projector:
     """RandomEffectProjector.build (RandomEffectProjector.scala:74). The
     default for random-effect coordinates is INDEX_MAP
@@ -259,7 +285,9 @@ def build_projector(
         if not isinstance(features, SparseFeatures):
             # Dense shards have nothing to compact per entity; identity.
             return IdentityProjector(dim)
-        return IndexMapProjector.build(features, entity_rows, num_entities)
+        return IndexMapProjector.build(
+            features, entity_rows, num_entities, host_planes=host_planes
+        )
     raise ValueError(f"unknown projector type {projector_type}")
 
 
@@ -288,13 +316,23 @@ def project_shard(
     """
     shard = re_dataset.feature_shard
     entity_rows = np.asarray(re_dataset.sample_entity_rows)
+    host_planes = getattr(dataset, "host_ell", {}).get(shard)
+    # Peek (ShardDict.host_view): projector construction must not force the
+    # raw shard's device upload — with host planes the projection runs
+    # entirely on host, and only the PROJECTED shard ships to the device.
+    feats_src = (
+        dataset.shards.host_view(shard)
+        if hasattr(dataset.shards, "host_view")
+        else dataset.shards[shard]
+    )
     projector = build_projector(
         projector_type,
-        dataset.shards[shard],
+        feats_src,
         entity_rows,
         re_dataset.num_entities,
         projected_dim=projected_dim,
         seed=seed,
+        host_planes=host_planes,
     )
     if isinstance(projector, IdentityProjector):
         return ProjectedShard(shard, projector)
@@ -305,8 +343,37 @@ def project_shard(
     while new_name in dataset.shards:
         new_name = f"{shard}@{re_dataset.config.random_effect_type}#{suffix}"
         suffix += 1
-    dataset.shards[new_name] = projector.project_features(
-        dataset.shards[shard], entity_rows
-    )
+    if isinstance(projector, IndexMapProjector) and host_planes is None:
+        # No ingest host copy (hand-built dataset): fall back to reading
+        # the (possibly device) arrays once.
+        host_planes = (
+            np.asarray(feats_src.indices),
+            np.asarray(feats_src.values),
+        )
+    if isinstance(projector, IndexMapProjector):
+        # Host-plane path: project on host, stash the projected planes
+        # (Pearson stats / downstream host consumers), then upload ONCE in
+        # the TRANSPOSED (K, N) block layout — the orientation the
+        # entity-block gathers consume directly (gather_block_features), so
+        # no per-bucket transpose copies ever materialize on device.
+        # Projected dims are small, so indices ship as int16 when they fit
+        # (halves the index-plane transfer and HBM residence).
+        out, v = projector.project_arrays(
+            host_planes[0], host_planes[1], entity_rows
+        )
+        dataset.host_ell[new_name] = (out, v)
+        idx_t = out.T
+        if projector.projected_dim < (1 << 15):
+            idx_t = idx_t.astype(np.int16)
+        dataset.shards[new_name] = SparseFeatures(
+            jnp.asarray(np.ascontiguousarray(idx_t)),
+            jnp.asarray(np.ascontiguousarray(v.T)),
+            projector.projected_dim,
+            ell_axis=-2,
+        )
+    else:
+        dataset.shards[new_name] = projector.project_features(
+            dataset.shards[shard], entity_rows
+        )
     re_dataset.config = dataclasses.replace(re_dataset.config, feature_shard=new_name)
     return ProjectedShard(new_name, projector)

@@ -226,30 +226,48 @@ def try_read_native(
         bag_vals.append(_concat(vals_parts, np.float32))
 
     # ---- id tags --------------------------------------------------------
+    # Factorized form: per-file interned value tables merge into ONE sorted
+    # global table; each tag column is then integer codes into it. The
+    # string columns (id_tags) are a cheap table gather, and the codes +
+    # table are kept on the dataset (tag_codes) so entity grouping
+    # (build_random_effect_dataset) and scoring-time entity resolution sort
+    # the SMALL value table instead of n_samples strings.
     id_tags: Dict[str, np.ndarray] = {}
+    tag_codes: Dict[str, tuple] = {}
     all_tag_ids = np.concatenate([d.tag_ids for d in decoded], axis=0)
-    val_tables = [np.asarray(d.tag_values + [""], dtype=object) for d in decoded]
-    # Rebuild per-file segments to index each file's own value table.
-    seg_starts = np.cumsum([0] + [len(d.labels) for d in decoded])
+    val_tables = [
+        np.asarray([str(v) for v in d.tag_values] + [""], dtype=object)
+        for d in decoded
+    ]
+    cat_tbl = np.concatenate(val_tables)
+    guniq, ginv = np.unique(cat_tbl.astype(str), return_inverse=True)
+    tbl_starts = np.cumsum([0] + [len(t) for t in val_tables])
+    file_maps = [
+        ginv[tbl_starts[fi] : tbl_starts[fi + 1]] for fi in range(len(decoded))
+    ]
     for slot, tag in enumerate(tag_slots):
-        parts = []
+        code_parts = []
         for fi, d in enumerate(decoded):
             ids = d.tag_ids[:, slot]
-            tbl = val_tables[fi]
-            parts.append(tbl[np.where(ids >= 0, ids, len(tbl) - 1)])
-        col = np.concatenate(parts)
+            fmap = file_maps[fi]
+            code_parts.append(fmap[np.where(ids >= 0, ids, len(fmap) - 1)])
+        codes = _concat(code_parts, np.int64).astype(np.int64, copy=False)
+        col = guniq[codes]
         if tag == cols.uid:
             if bool((all_tag_ids[:, slot] >= 0).any()):
                 from photon_ml_tpu.io.avro_data import UID
 
-                id_tags[UID] = col.astype(str)
+                id_tags[UID] = col
+                tag_codes[UID] = (codes, guniq)
         else:
-            id_tags[tag] = col.astype(str)
+            id_tags[tag] = col
+            tag_codes[tag] = (codes, guniq)
 
     # ---- per-shard merge, index maps, ELL pack --------------------------
     built: Dict[str, IndexMap] = {}
     shards = {}
     host_csr: Dict[str, HostCSR] = {}
+    host_ell: Dict[str, tuple] = {}
     bag_index = {b: i for i, b in enumerate(bag_names)}
     key_arr = np.asarray(key_list, dtype=object)
     stash_ok = _stash_worthwhile(n)
@@ -345,14 +363,17 @@ def try_read_native(
                 clean = False
                 indptr = np.zeros(n + 1, np.int64)
                 np.cumsum(np.bincount(rows_k, minlength=n), out=indptr[1:])
-        shards[shard] = pack_csr_to_ell(
+        shards[shard], host_planes = pack_csr_to_ell(
             indptr,
             fidx_k,
             vals_k,
             imap.size,
             assume_clean=clean,
             extra_col=extra_col,
+            return_host=True,
+            device=False,  # ShardDict uploads on first device use
         )
+        host_ell[shard] = host_planes
         # Stash the host CSR (entry order is irrelevant to the bucketed
         # pack — it re-sorts by segment) so the data-plane sparse pack runs
         # from host arrays with no device round trip. Stash only when a pack
@@ -383,4 +404,6 @@ def try_read_native(
         shards, labels, offsets=offsets, weights=weights, id_tags=id_tags
     )
     ds.host_csr = host_csr
+    ds.host_ell = host_ell
+    ds.tag_codes = tag_codes
     return ds, built

@@ -50,14 +50,21 @@ extern "C" {
 // Append `n` records as container blocks to `path` (header already
 // written by Python). Returns bytes appended, or -1 on any failure.
 // offsets/weights may be null (0.0 / 1.0). tag_bytes/tag_offs may be null
-// (metadataMap = null branch); otherwise each record carries one
-// {tag_key: tag_value} entry.
+// (no string tag); otherwise each record carries one {tag_key: tag_value}
+// entry. int_tag_keys ('\0'-separated, n_int_tags of them) with
+// int_tag_vals ((n_int_tags, n) row-major) additionally write integer-id
+// tags formatted as decimal strings IN C — entity-id tags at scale never
+// touch Python string handling (symmetric with the reader's integer TAG
+// branch). metadataMap is the null branch only when no tag of either kind
+// is present.
 int64_t photon_avro_write_training(
     const char* path, const uint8_t* sync, int64_t n, const double* labels,
     const double* offsets, const double* weights, const int64_t* indptr,
     const int32_t* name_ids, const double* values, const char* name_bytes,
     const int64_t* name_offs, int64_t n_names, const char* tag_key,
-    const char* tag_bytes, const int64_t* tag_offs, int64_t block_records) {
+    const char* tag_bytes, const int64_t* tag_offs, int32_t n_int_tags,
+    const char* int_tag_keys, const int64_t* int_tag_vals,
+    int64_t block_records) {
   if (block_records <= 0) block_records = 4096;
   // Pre-encode every feature name once as [varint len][bytes][0x00 term].
   std::vector<uint8_t> name_blob;
@@ -71,6 +78,18 @@ int64_t photon_avro_write_training(
   std::vector<uint8_t> key_enc;
   if (tag_key && tag_bytes && tag_offs)
     put_str(key_enc, tag_key, (int64_t)std::strlen(tag_key));
+  std::vector<std::vector<uint8_t>> int_key_enc;
+  if (n_int_tags > 0 && int_tag_keys && int_tag_vals) {
+    const char* p = int_tag_keys;
+    for (int32_t t = 0; t < n_int_tags; ++t) {
+      int64_t len = (int64_t)std::strlen(p);
+      int_key_enc.emplace_back();
+      put_str(int_key_enc.back(), p, len);
+      p += len + 1;
+    }
+  }
+  const int64_t n_map_entries =
+      (key_enc.empty() ? 0 : 1) + (int64_t)int_key_enc.size();
 
   std::FILE* f = std::fopen(path, "ab");
   if (!f) return -1;
@@ -101,11 +120,21 @@ int64_t photon_avro_write_training(
       buf.push_back(0);  // array terminator
       put_double(buf, weights ? weights[r] : 1.0);
       put_double(buf, offsets ? offsets[r] : 0.0);
-      if (!key_enc.empty()) {
+      if (n_map_entries > 0) {
         put_long(buf, 1);  // union branch: map
-        put_long(buf, 1);  // one map entry
-        buf.insert(buf.end(), key_enc.begin(), key_enc.end());
-        put_str(buf, tag_bytes + tag_offs[r], tag_offs[r + 1] - tag_offs[r]);
+        put_long(buf, n_map_entries);
+        if (!key_enc.empty()) {
+          buf.insert(buf.end(), key_enc.begin(), key_enc.end());
+          put_str(buf, tag_bytes + tag_offs[r],
+                  tag_offs[r + 1] - tag_offs[r]);
+        }
+        for (size_t t = 0; t < int_key_enc.size(); ++t) {
+          buf.insert(buf.end(), int_key_enc[t].begin(), int_key_enc[t].end());
+          char tmp[24];
+          int len = std::snprintf(tmp, sizeof tmp, "%lld",
+                                  (long long)int_tag_vals[t * n + r]);
+          put_str(buf, tmp, len);
+        }
         buf.push_back(0);  // map terminator
       } else {
         buf.push_back(0);  // union branch: null

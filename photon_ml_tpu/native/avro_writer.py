@@ -45,6 +45,9 @@ def _lib() -> Optional[ctypes.CDLL]:
             ctypes.c_char_p,  # tag_key (nullable)
             ctypes.c_char_p,  # tag_bytes (nullable)
             ctypes.POINTER(ctypes.c_int64),  # tag_offs (nullable)
+            ctypes.c_int32,  # n_int_tags
+            ctypes.c_char_p,  # int_tag_keys (nul-separated, nullable)
+            ctypes.POINTER(ctypes.c_int64),  # int_tag_vals (nullable)
             ctypes.c_int64,  # block_records
         ]
         _CONFIGURED = True
@@ -75,6 +78,7 @@ def write_training_examples_columnar(
     weights: Optional[np.ndarray] = None,
     tag_key: Optional[str] = None,
     tag_values: Optional[Sequence[str]] = None,
+    int_tags: Optional[dict] = None,
     block_records: int = 4096,
 ) -> int:
     """Write TrainingExampleAvro records from columnar arrays; returns n.
@@ -82,7 +86,10 @@ def write_training_examples_columnar(
     `feature_name_ids[e]` indexes `feature_names` (bare names; terms are
     written empty, matching write_training_examples' key handling for
     delimiter-free keys). `tag_values` (with `tag_key`) writes one
-    metadataMap entry per record.
+    metadataMap entry per record. `int_tags` maps tag key -> per-record
+    int64 array; values are formatted as decimal strings inside the native
+    writer, so entity-id tags at 10^7-row scale never touch Python string
+    handling (the reader's integer TAG branch is the symmetric fast path).
     """
     labels = np.ascontiguousarray(labels, np.float64)
     n = len(labels)
@@ -95,6 +102,13 @@ def write_training_examples_columnar(
         raise ValueError("feature entry arrays disagree with indptr")
     if (tag_key is None) != (tag_values is None):
         raise ValueError("tag_key and tag_values must be passed together")
+    int_tag_arrs = {}
+    if int_tags:
+        for k, v in int_tags.items():
+            arr = np.ascontiguousarray(v, np.int64)
+            if len(arr) != n:
+                raise ValueError(f"int tag {k!r} must have one value per record")
+            int_tag_arrs[str(k)] = arr
     # Range-check up front so BOTH backends fail identically (the native
     # path would stop mid-file; Python negative indexing would silently
     # write the wrong name).
@@ -107,7 +121,7 @@ def write_training_examples_columnar(
         return _python_fallback(
             path, labels, indptr, name_ids, values, feature_names,
             offsets=offsets, weights=weights, tag_key=tag_key,
-            tag_values=tag_values,
+            tag_values=tag_values, int_tags=int_tag_arrs,
         )
 
     from photon_ml_tpu.io import avro as avro_io
@@ -147,6 +161,15 @@ def write_training_examples_columnar(
         tag_offs_p = tag_offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
     else:
         tag_bytes, tag_key_b, tag_offs_p = None, None, None
+    if int_tag_arrs:
+        int_keys_b = b"".join(k.encode("utf-8") + b"\x00" for k in int_tag_arrs)
+        int_vals = np.ascontiguousarray(
+            np.stack([int_tag_arrs[k] for k in int_tag_arrs]), np.int64
+        )
+        int_vals_p = int_vals.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+        n_int = len(int_tag_arrs)
+    else:
+        int_keys_b, int_vals_p, n_int = None, None, 0
     rc = lib.photon_avro_write_training(
         path.encode(),
         sync,
@@ -163,6 +186,9 @@ def write_training_examples_columnar(
         tag_key_b,
         tag_bytes,
         tag_offs_p,
+        n_int,
+        int_keys_b,
+        int_vals_p,
         block_records,
     )
     if rc < 0:
@@ -178,7 +204,7 @@ def write_training_examples_columnar(
 
 def _python_fallback(
     path, labels, indptr, name_ids, values, feature_names, *,
-    offsets, weights, tag_key, tag_values,
+    offsets, weights, tag_key, tag_values, int_tags=None,
 ) -> int:
     from photon_ml_tpu.io import avro_data
 
@@ -190,11 +216,12 @@ def _python_fallback(
         ]
         for i in range(len(labels))
     ]
-    id_tags = (
-        {tag_key: [str(t) for t in tag_values]}
-        if tag_key is not None and tag_values is not None
-        else None
-    )
+    id_tags = {}
+    if tag_key is not None and tag_values is not None:
+        id_tags[tag_key] = [str(t) for t in tag_values]
+    for k, v in (int_tags or {}).items():
+        id_tags[k] = [str(int(x)) for x in v]
+    id_tags = id_tags or None
     return avro_data.write_training_examples(
         path, feats, labels, offsets=offsets, weights=weights,
         id_tags=id_tags, codec="null",

@@ -22,22 +22,52 @@ extern "C" {
 // n_buckets = ceil(dim / 128); n_seg = n_tiles * n_buckets.
 // out_packed/out_values: zero-initialized n_seg * sp slots (row-major by
 // segment). spill_out: capacity nnz entry indices; returns spill count.
+// row_aligned != 0 places each entry at slot LANE = row_local & 127 (rank
+// within its (segment, lane) run of sp/128 rows) with payload
+// (row_local>>7)<<7 | feature_lane — the layout whose z-accumulate /
+// u-select kernel sides need no 128-wide one-hot (see
+// ops/pallas_sparse.py). row_aligned == 0 is the feature-lane layout:
+// entries in input order, payload row_local<<7 | feature_lane.
 // Returns -1 on invalid arguments.
 int64_t photon_pack_level(const int32_t* rows, const int32_t* cols,
                           const float* vals, int64_t nnz, int64_t n_tiles,
                           int64_t n_buckets, int32_t tile_shift, int64_t sp,
-                          int32_t* out_packed, float* out_values,
-                          int64_t* spill_out) {
+                          int32_t row_aligned, int32_t* out_packed,
+                          float* out_values, int64_t* spill_out) {
   if (nnz < 0 || n_tiles <= 0 || n_buckets <= 0 || sp <= 0 || tile_shift < 0)
     return -1;
   const int64_t n_seg = n_tiles * n_buckets;
   const int32_t row_mask = (1 << tile_shift) - 1;
+  int64_t n_spill = 0;
+
+  if (row_aligned) {
+    if (sp % 128 != 0) return -1;
+    const int64_t spv = sp / 128;
+    // Cursor per (segment, lane): rank within the lane's spv slots.
+    std::vector<int32_t> cursor((size_t)(n_seg * 128), 0);
+    for (int64_t i = 0; i < nnz; ++i) {
+      const int32_t r = rows[i];
+      const int32_t c = cols[i];
+      const int64_t seg = (int64_t)(r >> tile_shift) * n_buckets + (c >> 7);
+      const int32_t rl = r & row_mask;
+      const int32_t lane = rl & 127;
+      const int64_t cur = seg * 128 + lane;
+      const int32_t rank = cursor[cur]++;
+      if (rank < spv) {
+        const int64_t slot = seg * sp + (int64_t)rank * 128 + lane;
+        out_packed[slot] = ((rl >> 7) << 7) | (c & 127);
+        out_values[slot] = vals[i];
+      } else {
+        spill_out[n_spill++] = i;
+      }
+    }
+    return n_spill;
+  }
 
   // One placement pass: cursor tracks each segment's fill level, which both
   // assigns positions and detects overflow (entries keep input order within
   // a segment, matching the numpy stable sort).
   std::vector<int64_t> cursor(n_seg, 0);
-  int64_t n_spill = 0;
   for (int64_t i = 0; i < nnz; ++i) {
     const int32_t r = rows[i];
     const int32_t c = cols[i];

@@ -33,6 +33,7 @@ def _lib() -> Optional[ctypes.CDLL]:
             ctypes.c_int64,
             ctypes.c_int32,
             ctypes.c_int64,
+            ctypes.c_int32,  # row_aligned
             ctypes.POINTER(ctypes.c_int32),
             ctypes.POINTER(ctypes.c_float),
             ctypes.POINTER(ctypes.c_int64),
@@ -66,6 +67,7 @@ def pack_level_native(
     n_buckets: int,
     tile_shift: int,
     sp: int,
+    row_aligned: bool = False,
 ) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
     """Returns (packed (n_seg*sp,) i32, values (n_seg*sp,) f32,
     spill entry indices) or None when the native library is unavailable."""
@@ -89,6 +91,7 @@ def pack_level_native(
         n_buckets,
         tile_shift,
         sp,
+        1 if row_aligned else 0,
         _ptr(packed, ctypes.c_int32),
         _ptr(values, ctypes.c_float),
         _ptr(spill, ctypes.c_int64),

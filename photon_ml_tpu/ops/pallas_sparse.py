@@ -51,12 +51,29 @@ comparison at 512k x 32 nnz measured fused ~19 ms per objective eval vs
 entry instead of once per side) dominating. Absolute GB/s on the
 remote-tunnel chip varies up to 4x between identical runs (dispatch
 contention), so the honest statement is the within-run ratio plus the
-analysis above: the one-hot construction spends ~rt lane-ops/entry on the
-z-accumulator side regardless of layout, and an MXU block-diagonal
-scatter was prototyped on paper to cost MORE lane traffic in operand
-assembly than it saves in contraction. A sublane-rotation accumulate
-remains open; at current engagement the sparse solve is already <0.6 s
-per full LBFGS fit at bench scale, 16-22x the r02 XLA path.
+analysis above. An MXU block-diagonal scatter was prototyped on paper to
+cost MORE lane traffic in operand assembly than it saves in contraction.
+
+r05 answer to the VPU one-hot ceiling — the ROW-LANE-ALIGNED layout
+(BucketedLevel.row_aligned): the r04 open idea was a "sublane-rotation
+accumulate"; alignment beats rotation because the PACK already controls
+where entries sit. Placing each entry at slot lane row_local & 127 makes
+the z-accumulate (forward) and u-select (backward) sides pure
+sublane-block selects — an rt-row one-hot (rt = 16 at level 1) instead of
+the 128-row lane one-hot + MXU contraction; forward accumulation becomes
+exact f32. MEASURED within-run on v5e, 1M x 64 nnz dim 16k, uniform
+(scratch/bench_rowalign.py, level 2 kept feature-lane since its rt = 128
+would cost the very one-hot alignment avoids): matvec 9.0 -> 4.5 ms/pass
+(2.01x); BUT rmatvec 17.5 -> 32.5 ms (0.54x) and the fused objective
+38.9 -> 43.3 ms (0.90x): the gradient's feature-side one-hot is
+alignment-INVARIANT, and per-lane collision padding (pad_blowup 1.13 ->
+2.13 at 2x-mean sizing) scales the whole backward stream. Conclusion: the
+sublane-alignment family cannot beat the fused kernel's ceiling — the
+backward one-hot survives any row-side layout and padding eats the
+forward win. The layout ships default-OFF (PHOTON_SPARSE_ROWALIGN=1
+enables it; it is the right choice for matvec-dominated/scoring-only
+workloads) and both layouts decode identically (to_coo/XLA fallbacks
+branch on the flag).
 """
 
 from __future__ import annotations
@@ -152,23 +169,36 @@ def _onehot_contract(values_row: Array, onehot: Array) -> Array:
     )
 
 
-def _matvec_kernel(spv: int, rt: int, group: int, pk_ref, val_ref, w_ref, z_ref):
+def _matvec_kernel(
+    spv: int, rt: int, group: int, row_aligned: bool, pk_ref, val_ref, w_ref,
+    z_ref,
+):
     bg = pl.program_id(1)
     zc = jnp.zeros((rt, 128), jnp.float32)
     for gi in range(group):
         pk = pk_ref[pl.ds(gi * spv, spv), :]
         vv = val_ref[pl.ds(gi * spv, spv), :]
-        rl = jax.lax.shift_right_logical(pk, _ROW_SHIFT)
         lane = jax.lax.bitwise_and(pk, BUCKET - 1)
         wb = _bcast_row(w_ref[pl.ds(bg * group + gi, 1), :], spv)
         p = jnp.take_along_axis(wb, lane, axis=1) * vv
-        for s in range(spv):
-            rl_row = rl[s : s + 1, :]
-            rhi = jax.lax.shift_right_logical(rl_row, 7)
-            rlo = jax.lax.bitwise_and(rl_row, 127)
-            p1 = _onehot_rows(rhi, rt) * _bcast_row(p[s : s + 1, :], rt)
-            orlt = _onehot_rows(rlo, 128)
-            zc = zc + _onehot_contract(p1, orlt)
+        if row_aligned:
+            # Slot lane IS the z lane: the scatter is a sublane-block
+            # select (rt-row one-hot) + add — no 128-wide lane one-hot, no
+            # MXU pass, and pure-f32 accumulation (exact).
+            rhi = jax.lax.shift_right_logical(pk, _ROW_SHIFT)
+            for s in range(spv):
+                zc = zc + _onehot_rows(rhi[s : s + 1, :], rt) * _bcast_row(
+                    p[s : s + 1, :], rt
+                )
+        else:
+            rl = jax.lax.shift_right_logical(pk, _ROW_SHIFT)
+            for s in range(spv):
+                rl_row = rl[s : s + 1, :]
+                rhi = jax.lax.shift_right_logical(rl_row, 7)
+                rlo = jax.lax.bitwise_and(rl_row, 127)
+                p1 = _onehot_rows(rhi, rt) * _bcast_row(p[s : s + 1, :], rt)
+                orlt = _onehot_rows(rlo, 128)
+                zc = zc + _onehot_contract(p1, orlt)
 
     @pl.when(bg == 0)
     def _():
@@ -180,7 +210,8 @@ def _matvec_kernel(spv: int, rt: int, group: int, pk_ref, val_ref, w_ref, z_ref)
 
 
 def _rmatvec_kernel(
-    spv: int, rt: int, group: int, square: bool, pk_ref, val_ref, u_ref, g_ref
+    spv: int, rt: int, group: int, square: bool, row_aligned: bool, pk_ref,
+    val_ref, u_ref, g_ref,
 ):
     bg = pl.program_id(0)
     t = pl.program_id(1)
@@ -195,10 +226,19 @@ def _rmatvec_kernel(
         gc = jnp.zeros((1, 128), jnp.float32)
         for s in range(spv):
             rl_row = rl[s : s + 1, :]
-            rhi = jax.lax.shift_right_logical(rl_row, 7)
-            rlo = jax.lax.bitwise_and(rl_row, 127)
-            tu = jnp.take_along_axis(u2, _bcast_row(rlo, rt), axis=1)
-            u_sel = jnp.sum(_onehot_rows(rhi, rt) * tu, axis=0, keepdims=True)
+            if row_aligned:
+                # Slot lane IS the u lane: select the sublane block with
+                # the rt-row one-hot; no u lane-gather needed.
+                u_sel = jnp.sum(
+                    _onehot_rows(rl_row, rt) * u2, axis=0, keepdims=True
+                )
+            else:
+                rhi = jax.lax.shift_right_logical(rl_row, 7)
+                rlo = jax.lax.bitwise_and(rl_row, 127)
+                tu = jnp.take_along_axis(u2, _bcast_row(rlo, rt), axis=1)
+                u_sel = jnp.sum(
+                    _onehot_rows(rhi, rt) * tu, axis=0, keepdims=True
+                )
             a = u_sel * vv[s : s + 1, :]
             olt = _onehot_rows(lane[s : s + 1, :], 128)
             gc = gc + _onehot_contract(a, olt)
@@ -231,7 +271,7 @@ def _level_matvec(
     spv = level.spv
     G = _pick_group(B, spv)
     z2 = pl.pallas_call(
-        functools.partial(_matvec_kernel, spv, rt, G),
+        functools.partial(_matvec_kernel, spv, rt, G, level.row_aligned),
         grid=(T, B // G),
         in_specs=[
             pl.BlockSpec(
@@ -268,7 +308,7 @@ def _level_rmatvec(
     G = _pick_group(B, spv)
     u2 = jnp.pad(u_pad, (0, T * level.tile_rows - u_pad.shape[0])).reshape(T * rt, 128)
     g2 = pl.pallas_call(
-        functools.partial(_rmatvec_kernel, spv, rt, G, square),
+        functools.partial(_rmatvec_kernel, spv, rt, G, square, level.row_aligned),
         grid=(B // G, T),
         in_specs=[
             pl.BlockSpec(
@@ -510,6 +550,7 @@ def _fused_kernel(
     spv: int,
     rt: int,
     B: int,
+    row_aligned: bool,
     pk_ref,
     val_ref,
     y_ref,
@@ -538,6 +579,14 @@ def _fused_kernel(
         rl = jax.lax.shift_right_logical(pk, _ROW_SHIFT)
         wb = _bcast_row(w_ref[pl.ds(b, 1), :], spv)
         p = jnp.take_along_axis(wb, lane, axis=1) * vv
+        if row_aligned:
+            # Slot lane IS the z lane: sublane-block select + add, no lane
+            # one-hot, no MXU pass, exact f32 accumulation.
+            for s in range(spv):
+                zc = zc + _onehot_rows(rl[s : s + 1, :], rt) * _bcast_row(
+                    p[s : s + 1, :], rt
+                )
+            return zc
         for s in range(spv):
             rl_row = rl[s : s + 1, :]
             rhi = jax.lax.shift_right_logical(rl_row, 7)
@@ -574,10 +623,18 @@ def _fused_kernel(
         gc = jnp.zeros((1, 128), jnp.float32)
         for s in range(spv):
             rl_row = rl[s : s + 1, :]
-            rhi = jax.lax.shift_right_logical(rl_row, 7)
-            rlo = jax.lax.bitwise_and(rl_row, 127)
-            tu = jnp.take_along_axis(u2, _bcast_row(rlo, rt), axis=1)
-            u_sel = jnp.sum(_onehot_rows(rhi, rt) * tu, axis=0, keepdims=True)
+            if row_aligned:
+                # u lanes align with slot lanes: sublane-block select only.
+                u_sel = jnp.sum(
+                    _onehot_rows(rl_row, rt) * u2, axis=0, keepdims=True
+                )
+            else:
+                rhi = jax.lax.shift_right_logical(rl_row, 7)
+                rlo = jax.lax.bitwise_and(rl_row, 127)
+                tu = jnp.take_along_axis(u2, _bcast_row(rlo, rt), axis=1)
+                u_sel = jnp.sum(
+                    _onehot_rows(rhi, rt) * tu, axis=0, keepdims=True
+                )
             a = u_sel * vv[s : s + 1, :]
             olt = _onehot_rows(lane[s : s + 1, :], 128)
             gc = gc + _onehot_contract(a, olt)
@@ -635,7 +692,7 @@ def fused_value_gradient_sums(
         ).reshape(T * rt, 128)
 
     stats, grad1, u2 = pl.pallas_call(
-        functools.partial(_fused_kernel, loss, spv, rt, B),
+        functools.partial(_fused_kernel, loss, spv, rt, B, lvl.row_aligned),
         grid=(T,),
         in_specs=[
             pl.BlockSpec((B * spv, 128), lambda t: (t, 0), memory_space=_VMEM),
@@ -686,7 +743,15 @@ def _level_coo(level: BucketedLevel, B: int):
     seg = jnp.arange(level.packed.shape[0]) // level.spv
     bucket = (seg % B)[:, None]
     tile = (seg // B)[:, None]
-    rows = tile * level.tile_rows + rl
+    if level.row_aligned:
+        # Slot lane carries row_local & 127; payload's high bits carry
+        # row_local >> 7 (see BucketedLevel.row_aligned).
+        slot_lane = jax.lax.broadcasted_iota(
+            jnp.int32, level.packed.shape, 1
+        )
+        rows = tile * level.tile_rows + (rl << 7) + slot_lane
+    else:
+        rows = tile * level.tile_rows + rl
     cols = bucket * BUCKET + lane
     return rows, cols
 

@@ -97,6 +97,17 @@ def entity_rows_for_dataset(
         and isinstance(next(iter(index)), str)
         and keys.dtype.kind not in "USO"
     )
+    # Ingest-factorized columns: resolve the small value table through the
+    # index and gather — no n_samples sort at all.
+    ct = getattr(dataset, "tag_codes", {}).get(spec.random_effect_type)
+    if ct is not None:
+        codes, tbl = ct
+        tbl_rows = np.fromiter(
+            (index.get(k, unseen) for k in tbl.tolist()),
+            np.int64,
+            count=len(tbl),
+        )
+        return tbl_rows[codes]
     # Dict-lookup the UNIQUE keys only (entities repeat ~n/E times), then
     # scatter through the inverse — the per-row Python loop was the last
     # O(n) interpreter cost in the scoring path. np.unique needs orderable
@@ -132,9 +143,24 @@ def prepare_coordinate_data(
     if not spec.is_random_effect:
         return PreparedCoordinateData(dataset.shards[spec.shard], None)
     rows = entity_rows_for_dataset(dataset, spec)
-    feats = dataset.shards[spec.shard]
-    if spec.projector is not None:
-        feats = spec.projector.project_features(feats, rows)
+    host_planes = getattr(dataset, "host_ell", {}).get(spec.shard)
+    if spec.projector is not None and host_planes is not None:
+        # Project from ingest's host planes: the raw ELL never ships to
+        # the device (ShardDict lazy upload) — only the projected shard
+        # does, inside project_features.
+        shards = dataset.shards
+        feats = (
+            shards.host_view(spec.shard)
+            if hasattr(shards, "host_view")
+            else shards[spec.shard]
+        )
+        feats = spec.projector.project_features(
+            feats, rows, host_planes=host_planes
+        )
+    else:
+        feats = dataset.shards[spec.shard]
+        if spec.projector is not None:
+            feats = spec.projector.project_features(feats, rows)
     return PreparedCoordinateData(feats, jnp.asarray(rows, jnp.int32))
 
 
@@ -152,7 +178,11 @@ def _entity_sharded_mesh(matrix):
 
 @jax.jit
 def _fe_margins(features: Features, w: Array, norm) -> Array:
-    n = features.values.shape[0] if isinstance(features, SparseFeatures) else features.shape[0]
+    # `features` may be an ELL SparseFeatures (either layout), a dense
+    # matrix, or the trained coordinate's BucketedSparseFeatures
+    # (training_prepared's preference) — all three expose the logical
+    # (n_rows, dim) via .shape, and compute_margins handles each.
+    n = features.shape[0]
     zeros = jnp.zeros((n,), w.dtype)
     return objective.compute_margins(w, LabeledData(features, zeros, zeros, zeros), norm)
 

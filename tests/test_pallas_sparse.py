@@ -38,10 +38,12 @@ def _dense(rows, cols, vals, n_rows, dim):
 
 
 class TestPacking:
-    def test_roundtrip_preserves_every_entry(self):
+    @pytest.mark.parametrize("row_aligned", [True, False])
+    def test_roundtrip_preserves_every_entry(self, row_aligned):
         rng = np.random.default_rng(0)
         rows, cols, vals = _random_coo(rng, 5000, 300, 40000, hot_fraction=0.1)
-        bf = pack_bucketed(rows, cols, vals, 5000, 300)
+        bf = pack_bucketed(rows, cols, vals, 5000, 300, row_aligned=row_aligned)
+        assert bf.level1.row_aligned == row_aligned
         r2, c2, v2 = to_coo(bf)
         assert np.array_equal(
             _dense(rows, cols, vals, 5000, 300), _dense(r2, c2, v2, 5000, 300)
@@ -87,12 +89,13 @@ def interpret_kernels():
 
 
 class TestKernelParity:
+    @pytest.mark.parametrize("row_aligned", [True, False])
     @pytest.mark.parametrize("shape", [(5000, 300, 35000), (9000, 700, 60000)])
-    def test_matvec_rmatvec_match_f64(self, shape, interpret_kernels):
+    def test_matvec_rmatvec_match_f64(self, shape, row_aligned, interpret_kernels):
         n, d, nnz = shape
         rng = np.random.default_rng(2)
         rows, cols, vals = _random_coo(rng, n, d, nnz, hot_fraction=0.05)
-        bf = pack_bucketed(rows, cols, vals, n, d)
+        bf = pack_bucketed(rows, cols, vals, n, d, row_aligned=row_aligned)
         M = _dense(rows, cols, vals, n, d)
         w = rng.normal(size=d).astype(np.float32)
         u = rng.normal(size=n).astype(np.float32)
@@ -108,10 +111,11 @@ class TestKernelParity:
         np.add.at(gs_ref, cols, vals.astype(np.float64) ** 2 * u[rows])
         np.testing.assert_allclose(gs, gs_ref, rtol=2e-5, atol=2e-5)
 
-    def test_xla_reference_matches_f64(self):
+    @pytest.mark.parametrize("row_aligned", [True, False])
+    def test_xla_reference_matches_f64(self, row_aligned):
         rng = np.random.default_rng(3)
         rows, cols, vals = _random_coo(rng, 3000, 500, 20000)
-        bf = pack_bucketed(rows, cols, vals, 3000, 500)
+        bf = pack_bucketed(rows, cols, vals, 3000, 500, row_aligned=row_aligned)
         M = _dense(rows, cols, vals, 3000, 500)
         w = rng.normal(size=500).astype(np.float32)
         u = rng.normal(size=3000).astype(np.float32)
