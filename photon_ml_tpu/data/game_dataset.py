@@ -200,6 +200,15 @@ class GameDataset:
     def num_samples(self) -> int:
         return int(self.labels.shape[0])
 
+    def peek_shard(self, name: str) -> Features:
+        """The shard WITHOUT triggering ShardDict's device materialization —
+        the accessor for decision-phase/host-plane consumers (pack gating,
+        projector construction, statistics)."""
+        shards = self.shards
+        if hasattr(shards, "host_view"):
+            return shards.host_view(name)
+        return shards[name]
+
     def release_stash(self) -> None:
         """Drop the ingest CSR stash when no coordinate will consume it
         (scoring, validation datasets) — cancelling any background pack
@@ -411,16 +420,24 @@ def build_random_effect_dataset(
         mask[li, pj] = 1.0
         ent_rows = kept[members]
         max_e = max(1, int(config.max_block_cells) // int(capacity))
-        if e <= max_e:
-            buckets.append(EntityBlocks(gather, mask, ent_rows))
-            continue
-        # Split the entity axis into equal chunks; the last is padded with
-        # inert dummies (gather row 0, mask 0, entity row = the pinned
-        # zero row num_entities) so every chunk runs the SAME compiled
-        # train_bucket program. Dummy scatters land on the zero row, which
-        # training re-zeroes at the end.
+        # Canonical entity counts: each chunk holds either max_e entities
+        # or the next power of two >= its entity count, padded with inert
+        # dummies (gather row 0, mask 0, entity row = the pinned zero row
+        # num_entities). Every (capacity, E) bucket shape then comes from a
+        # SMALL discrete set, so the per-bucket train programs compile once
+        # and are reused across buckets, chunks, and coordinates (each XLA
+        # compile costs seconds on a remote-compile backend; a GLMix fit
+        # had ~70). Dummy scatters land on the zero row, which training
+        # re-zeroes at the end.
         n_chunks = -(-e // max_e)
-        pad_e = n_chunks * max_e - e
+        if n_chunks == 1:
+            target = 8
+            while target < e:
+                target *= 2
+            target = min(target, max_e)
+        else:
+            target = max_e
+        pad_e = n_chunks * target - e
         if pad_e:
             gather = np.concatenate(
                 [gather, np.zeros((pad_e, int(capacity)), np.int64)]
@@ -432,7 +449,7 @@ def build_random_effect_dataset(
                 [ent_rows, np.full(pad_e, num_entities, np.int64)]
             )
         for c in range(n_chunks):
-            sl = slice(c * max_e, (c + 1) * max_e)
+            sl = slice(c * target, (c + 1) * target)
             buckets.append(EntityBlocks(gather[sl], mask[sl], ent_rows[sl]))
 
     feature_mask = None
@@ -478,8 +495,8 @@ def _pearson_feature_masks(
     # Peek (ShardDict.host_view): the sparse branch reads host_ell planes
     # and needs only dim/isinstance — never force the raw ELL upload here.
     features = (
-        dataset.shards.host_view(config.feature_shard)
-        if hasattr(dataset.shards, "host_view")
+        dataset.peek_shard(config.feature_shard)
+        if hasattr(dataset, "peek_shard")
         else dataset.shards[config.feature_shard]
     )
     labels_np = np.asarray(dataset.labels)

@@ -73,7 +73,7 @@ def _summarize_sparse(
     implicit-zero arithmetic — never densifies (the spark.mllib summarizer the
     reference wraps is likewise sparse-aware). Padding slots (value 0) drop
     out of every sum and of the nonzero max/min via masking."""
-    n = features.values.shape[0]
+    n = features.shape[0]  # layout-aware sample count (ell_axis either way)
     dim = features.dim
     dtype = features.values.dtype
     idx = features.indices.reshape(-1)

@@ -77,7 +77,11 @@ def validate_game_dataset(
 
     for shard, feats in dataset.shards.items():
         if isinstance(feats, SparseFeatures):
-            vals = np.asarray(feats.values)[rows]
+            planes = np.asarray(feats.values)
+            if feats.ell_axis == -2:  # transposed (K, N) projected shards
+                vals = planes[:, rows].T
+            else:
+                vals = planes[rows]
             check(f"finite features in shard {shard!r}", np.isfinite(vals).all(axis=-1))
         else:
             vals = np.asarray(feats)[rows]

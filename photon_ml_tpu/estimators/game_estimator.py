@@ -167,7 +167,21 @@ class GameEstimator:
                     "type, INDEX_MAP or IDENTITY projection"
                 )
             intercept = None
-        stats = summarize(dataset.shards[shard], intercept_index=intercept)
+        # Stats need a full pass over the entries, so a device transfer is
+        # unavoidable — but make it a TRANSIENT copy (freed after the
+        # summary) rather than ShardDict's cached materialization, which
+        # would pin the raw ELL in HBM for a training run that then uses
+        # only the bucketed/projected layouts.
+        feats = dataset.peek_shard(shard) if hasattr(dataset, "peek_shard") else dataset.shards[shard]
+        if isinstance(feats, SparseFeatures) and not isinstance(
+            feats.indices, jnp.ndarray
+        ):
+            feats = dataclasses.replace(
+                feats,
+                indices=jnp.asarray(feats.indices),
+                values=jnp.asarray(feats.values),
+            )
+        stats = summarize(feats, intercept_index=intercept)
         return from_feature_stats(
             self.normalization,
             mean=stats.mean,
@@ -329,12 +343,17 @@ class GameEstimator:
                 # Prefer the trained coordinate's features (bucketed layout
                 # or bf16-stored matrix): scoring through them avoids
                 # materializing the raw ELL on device when training never
-                # did (ShardDict lazy upload).
-                feats = None
-                for (ccid, _), coord in self._coordinate_cache.items():
-                    if ccid == cid:
-                        feats = coord._features
-                        break
+                # did (ShardDict lazy upload). All sweep entries of a cid
+                # share the same feature representation, so any cache hit
+                # serves (training_features is the public accessor).
+                feats = next(
+                    (
+                        coord.training_features
+                        for key, coord in self._coordinate_cache.items()
+                        if isinstance(key, tuple) and key and key[0] == cid
+                    ),
+                    None,
+                )
                 if feats is None:
                     feats = self._prepared_dataset.shards[prep.shard]
                 out[cid] = PreparedCoordinateData(feats, None)

@@ -64,6 +64,17 @@ Array = jax.Array
 # decline (None), so pack economics are decided once per dataset shard.
 _PACK_UNDECIDED = object()
 
+# Process-wide jitted-program cache for random-effect bucket solvers,
+# keyed by the STATIC training recipe (optimizer config statics, task,
+# sampling). Two coordinates with the same recipe (e.g. per-user and
+# per-movie trained under one GameOptimizationConfiguration) then share
+# compiled programs for equal block shapes — with the canonical bucket
+# shapes from build_random_effect_dataset this cuts a GLMix fit's XLA
+# program count by ~2x (each compile costs seconds on a remote-compile
+# backend). Only the norm-free case caches (normalization contexts carry
+# arrays, which must not leak across coordinates via a closure).
+_RE_JIT_CACHE: dict = {}
+
 
 def _config_with_traced_weight(
     config: CoordinateOptimizationConfig, reg_weight: Array
@@ -98,13 +109,11 @@ class FixedEffectCoordinate:
         from photon_ml_tpu.ops import pallas_glm
 
         # Peek without forcing a device upload: if the bucketed pack
-        # engages below, the raw ELL never ships to the device at all
-        # (ShardDict.host_view); dense shards pass through unchanged.
-        shards = dataset.shards
+        # engages below, the raw ELL never ships to the device at all.
         feats = (
-            shards.host_view(config_data_shard)
-            if hasattr(shards, "host_view")
-            else shards[config_data_shard]
+            dataset.peek_shard(config_data_shard)
+            if hasattr(dataset, "peek_shard")
+            else dataset.shards[config_data_shard]
         )
         if not isinstance(feats, SparseFeatures) and pallas_glm.prefers_bf16_storage(
             feats, jnp.zeros((feats.shape[-1],), feats.dtype)
@@ -222,11 +231,14 @@ class FixedEffectCoordinate:
             )
             return res
 
-        @jax.jit
         def score_fn(features, w):
-            zeros = jnp.zeros(self.dataset.labels.shape, w.dtype)
-            data = LabeledData(features, zeros, zeros, zeros)
-            return objective.compute_margins(w, data, norm)
+            # The transformer's jitted _fe_margins IS the scoring program:
+            # CD residual scoring compiles it and evaluation of the
+            # training dataset (training_prepared passes this coordinate's
+            # `_features`) reuses the compiled program.
+            from photon_ml_tpu.transformers.game_transformer import _fe_margins
+
+            return _fe_margins(features, w, norm)
 
         @jax.jit
         def variance_fn(features, labels, offsets, weights, w, reg_weight):
@@ -269,6 +281,13 @@ class FixedEffectCoordinate:
             )
         model = FixedEffectModel(Coefficients(res.coefficients, variances), self.task)
         return model, res
+
+    @property
+    def training_features(self):
+        """The representation training actually ran on (bucketed layout,
+        bf16-stored matrix, or the ELL) — scoring the training dataset
+        through it reuses compiled programs and device residency."""
+        return self._features
 
     def score(self, model: FixedEffectModel) -> Array:
         """Raw per-sample margins x.w — residual bookkeeping happens in the
@@ -366,42 +385,59 @@ class RandomEffectCoordinate:
 
             self._norm_blocks = norm_blocks
         else:
+            cache_key = None
+            if norm is None:
+                from photon_ml_tpu.optimize.config import static_config_key
 
-            @jax.jit
-            def train_bucket(block_data: LabeledData, w0_block, reg_weight):
-                # use_pallas=False: the per-entity solves are vmapped; the
-                # fused kernels are single-problem programs and the vmapped
-                # XLA path is the one that batches these small solves
-                # efficiently.
-                def one(data_e, w0_e):
-                    return problem.solve(
-                        loss,
-                        data_e,
-                        _config_with_traced_weight(cfg, reg_weight),
-                        w0_e,
-                        norm,
-                        use_pallas=False,
-                    )
+                cache_key = ("re", static_config_key(cfg), self.task)
+            cached = _RE_JIT_CACHE.get(cache_key) if cache_key else None
+            if cached is not None:
+                train_bucket, variance_bucket = cached
+            else:
 
-                return jax.vmap(one)(block_data, w0_block)
+                @jax.jit
+                def train_bucket(block_data: LabeledData, w0_block, reg_weight):
+                    # use_pallas=False: the per-entity solves are vmapped;
+                    # the fused kernels are single-problem programs and the
+                    # vmapped XLA path is the one that batches these small
+                    # solves efficiently.
+                    def one(data_e, w0_e):
+                        return problem.solve(
+                            loss,
+                            data_e,
+                            _config_with_traced_weight(cfg, reg_weight),
+                            w0_e,
+                            norm,
+                            use_pallas=False,
+                        )
 
-            @jax.jit
-            def variance_bucket(block_data: LabeledData, w_block, reg_weight):
-                def one(data_e, w_e):
-                    return problem.compute_variances(
-                        loss, data_e, _config_with_traced_weight(cfg, reg_weight), w_e, norm
-                    )
+                    return jax.vmap(one)(block_data, w0_block)
 
-                return jax.vmap(one)(block_data, w_block)
+                @jax.jit
+                def variance_bucket(block_data: LabeledData, w_block, reg_weight):
+                    def one(data_e, w_e):
+                        return problem.compute_variances(
+                            loss, data_e, _config_with_traced_weight(cfg, reg_weight), w_e, norm
+                        )
 
+                    return jax.vmap(one)(block_data, w_block)
+
+                if cache_key:
+                    _RE_JIT_CACHE[cache_key] = (train_bucket, variance_bucket)
             self._norm_blocks = None
         self._per_entity_norm = per_entity_norm
 
-        @jax.jit
         def score_fn(features, entity_rows, matrix):
-            from photon_ml_tpu.game.model import random_effect_margins
+            # THE shared scoring program: the transformer's jitted
+            # _re_margins, with norm passed as a pytree argument. The
+            # coordinate-descent residual scoring compiles it, and
+            # GameTransformer evaluation of the training dataset
+            # (training_prepared: same feature arrays, same shapes) then
+            # reuses the compiled program instead of paying a fresh
+            # multi-second remote compile per coordinate.
+            from photon_ml_tpu.transformers.game_transformer import _re_margins
 
-            return random_effect_margins(features, entity_rows, matrix, norm)
+            return _re_margins(features, entity_rows, matrix, norm)
 
         self._train_bucket = train_bucket
         self._variance_bucket = variance_bucket

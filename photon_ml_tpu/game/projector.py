@@ -321,8 +321,8 @@ def project_shard(
     # raw shard's device upload — with host planes the projection runs
     # entirely on host, and only the PROJECTED shard ships to the device.
     feats_src = (
-        dataset.shards.host_view(shard)
-        if hasattr(dataset.shards, "host_view")
+        dataset.peek_shard(shard)
+        if hasattr(dataset, "peek_shard")
         else dataset.shards[shard]
     )
     projector = build_projector(

@@ -72,10 +72,13 @@ def pad_game_dataset(dataset: GameDataset, multiple: int) -> GameDataset:
 
     def pad_feat(f):
         if isinstance(f, SparseFeatures):
-            return SparseFeatures(
-                jnp.pad(f.indices, ((0, rem), (0, 0))),
-                jnp.pad(f.values, ((0, rem), (0, 0))),
-                f.dim,
+            # Pad the SAMPLE axis: trailing in the standard layout,
+            # leading-of-last in the transposed (K, N) layout.
+            widths = ((0, 0), (0, rem)) if f.ell_axis == -2 else ((0, rem), (0, 0))
+            return dataclasses.replace(
+                f,
+                indices=jnp.pad(f.indices, widths),
+                values=jnp.pad(f.values, widths),
             )
         return jnp.pad(f, ((0, rem), (0, 0)))
 
@@ -114,8 +117,17 @@ def shard_game_dataset(dataset: GameDataset, mesh: Mesh) -> GameDataset:
 
     def put_feat(f):
         if isinstance(f, SparseFeatures):
-            return SparseFeatures(
-                jax.device_put(f.indices, s2), jax.device_put(f.values, s2), f.dim
+            # Shard the SAMPLE axis: leading in the standard layout,
+            # trailing in the transposed (K, N) layout.
+            sh = (
+                NamedSharding(mesh, P(None, mesh.axis_names[0]))
+                if f.ell_axis == -2
+                else s2
+            )
+            return dataclasses.replace(
+                f,
+                indices=jax.device_put(f.indices, sh),
+                values=jax.device_put(f.values, sh),
             )
         return jax.device_put(f, s2)
 

@@ -148,11 +148,10 @@ def prepare_coordinate_data(
         # Project from ingest's host planes: the raw ELL never ships to
         # the device (ShardDict lazy upload) — only the projected shard
         # does, inside project_features.
-        shards = dataset.shards
         feats = (
-            shards.host_view(spec.shard)
-            if hasattr(shards, "host_view")
-            else shards[spec.shard]
+            dataset.peek_shard(spec.shard)
+            if hasattr(dataset, "peek_shard")
+            else dataset.shards[spec.shard]
         )
         feats = spec.projector.project_features(
             feats, rows, host_planes=host_planes
