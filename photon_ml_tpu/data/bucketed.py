@@ -128,8 +128,15 @@ class BucketedSparseFeatures:
 def upload(bf: BucketedSparseFeatures) -> BucketedSparseFeatures:
     """Move a host-packed layout (pack_bucketed(host_only=True)) to device —
     the one-time upload of the packed planes, split out so the host pack can
-    run on a background thread during ingest and the upload at first use."""
+    run on a background thread during ingest and the upload at first use.
+    Recorded under the `upload` stage of the ambient timing scope."""
+    from photon_ml_tpu.utils.observability import stage_timer
 
+    with stage_timer("upload"):
+        return _upload(bf)
+
+
+def _upload(bf: BucketedSparseFeatures) -> BucketedSparseFeatures:
     def _lvl(level: Optional[BucketedLevel]) -> Optional[BucketedLevel]:
         if level is None or isinstance(level.packed, jax.Array):
             return level

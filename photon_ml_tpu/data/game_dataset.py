@@ -35,6 +35,7 @@ Structural translation (the central TPU design decision of this framework):
 from __future__ import annotations
 
 import dataclasses
+import threading
 from typing import Dict, List, Mapping, Optional, Sequence
 
 import jax
@@ -100,16 +101,65 @@ class ShardDict(dict):
     or projected layout NEVER ships its raw ELL to the device (at
     MovieLens-20M scale that is ~1.6 GB of HBM and, on a remote-device
     link, a minute of transfer).
+
+    `prefetch` extends the lazy upload to an ASYNC one: a consumer that
+    knows it will need a shard soon (the coordinate-descent loop, before
+    solving the previous coordinate; the transformer, before per-
+    coordinate prep) starts the upload on a background thread and the
+    eventual `__getitem__` joins it instead of faulting synchronously —
+    the upload overlaps device solve/host prep. Uploads are
+    double-buffered (pipeline.AsyncUploader, max 2 in flight) so host
+    staging memory stays bounded.
     """
+
+    _uploader = None  # lazily-built pipeline.AsyncUploader
+    # Guards the one-time _uploader creation: two threads prefetching
+    # concurrently on a fresh dict must share ONE uploader, or the loser's
+    # in-flight future is stranded in an overwritten instance and the
+    # consumer re-uploads the same shard in parallel.
+    _uploader_init_lock = threading.Lock()
+
+    def _materialize(self, v: SparseFeatures) -> SparseFeatures:
+        return dataclasses.replace(
+            v,
+            indices=jnp.asarray(v.indices),
+            values=jnp.asarray(v.values),
+        )
+
+    def prefetch(self, key) -> None:
+        """Start the device upload of `key` in the background (no-op when
+        the shard is dense, already device-resident, or already in
+        flight). Safe to call from any thread."""
+        try:
+            v = super().__getitem__(key)
+        except KeyError:
+            return
+        if not isinstance(v, SparseFeatures) or isinstance(v.indices, jax.Array):
+            return
+        if self._uploader is None:
+            from photon_ml_tpu.data.pipeline import AsyncUploader
+
+            with ShardDict._uploader_init_lock:
+                if self._uploader is None:
+                    self._uploader = AsyncUploader()
+        self._uploader.submit(key, lambda: self._materialize(v))
 
     def __getitem__(self, key):
         v = super().__getitem__(key)
         if isinstance(v, SparseFeatures) and not isinstance(v.indices, jax.Array):
-            v = dataclasses.replace(
-                v,
-                indices=jnp.asarray(v.indices),
-                values=jnp.asarray(v.values),
+            fut = (
+                self._uploader.pop(key) if self._uploader is not None else None
             )
+            if fut is not None:
+                # Prefetched: the uploader thread already recorded the
+                # upload wall where it ran; the join wait here is the
+                # (hopefully ~zero) non-overlapped remainder.
+                v = fut.result()
+            else:
+                from photon_ml_tpu.utils.observability import stage_timer
+
+                with stage_timer("upload"):
+                    v = self._materialize(v)
             super().__setitem__(key, v)
         return v
 
@@ -319,6 +369,18 @@ class RandomEffectDataset:
 
 
 def build_random_effect_dataset(
+    dataset: GameDataset, config: RandomEffectDataConfig
+) -> RandomEffectDataset:
+    """Stage-timed entry: records the build under the `re_build` stage of
+    the ambient scope (GameEstimator's fit breakdown) wherever it runs —
+    main thread or a prepare-pipeline worker."""
+    from photon_ml_tpu.utils.observability import stage_timer
+
+    with stage_timer("re_build"):
+        return _build_random_effect_dataset(dataset, config)
+
+
+def _build_random_effect_dataset(
     dataset: GameDataset, config: RandomEffectDataConfig
 ) -> RandomEffectDataset:
     """Host-side one-time construction of the entity-blocked layout.

@@ -289,6 +289,15 @@ class FixedEffectCoordinate:
         through it reuses compiled programs and device residency."""
         return self._features
 
+    def prefetch(self) -> None:
+        """Start any pending device upload this coordinate's train/score
+        will fault on (coordinate-descent calls this on coordinate k+1
+        while coordinate k solves). Fixed effects train and score through
+        `self._features`, which construction already materialized — and
+        deliberately NOT through the raw ELL shard when the bucketed pack
+        engaged — so there is nothing to ship: prefetching the shard here
+        would force the very upload the lazy ShardDict avoids."""
+
     def score(self, model: FixedEffectModel) -> Array:
         """Raw per-sample margins x.w — residual bookkeeping happens in the
         coordinate-descent loop, so no offsets here."""
@@ -321,7 +330,14 @@ class RandomEffectCoordinate:
         self.task = task
         self.loss = loss_for_task(task)
         self.norm = norm
-        feats = dataset.shards[re_dataset.feature_shard]
+        # Peek: construction needs only the dim — the shard's device upload
+        # is deferred to the first gather (prefetch-overlapped with the
+        # previous coordinate's solve by the coordinate-descent loop).
+        feats = (
+            dataset.peek_shard(re_dataset.feature_shard)
+            if hasattr(dataset, "peek_shard")
+            else dataset.shards[re_dataset.feature_shard]
+        )
         self.dim = feats.dim if isinstance(feats, SparseFeatures) else feats.shape[-1]
         # Entity-sharded coefficient store: when the RE dataset's entity
         # blocks are sharded over a mesh, the (E+1, D) matrix is row-sharded
@@ -553,6 +569,15 @@ class RandomEffectCoordinate:
             n_entities=e_total if matrix.shape[0] != e_total + 1 else None,
         )
         return model, stats
+
+    def prefetch(self) -> None:
+        """Start the background device upload of the feature shard the
+        entity-block gathers and residual scoring read — so the transfer
+        overlaps the previous coordinate's solve instead of faulting
+        synchronously at this coordinate's first gather."""
+        shards = self.dataset.shards
+        if hasattr(shards, "prefetch"):
+            shards.prefetch(self.re_dataset.feature_shard)
 
     def score(self, model: RandomEffectModel) -> Array:
         if self._entity_mesh is not None and model.coefficients_matrix.shape[0] % (

@@ -365,12 +365,26 @@ def project_shard(
         idx_t = out.T
         if projector.projected_dim < (1 << 15):
             idx_t = idx_t.astype(np.int16)
-        dataset.shards[new_name] = SparseFeatures(
-            jnp.asarray(np.ascontiguousarray(idx_t)),
-            jnp.asarray(np.ascontiguousarray(v.T)),
+        projected = SparseFeatures(
+            np.ascontiguousarray(idx_t),
+            np.ascontiguousarray(v.T),
             projector.projected_dim,
             ell_axis=-2,
         )
+        if hasattr(dataset.shards, "prefetch"):
+            # Lazy-upload ShardDict: register the HOST planes and let the
+            # data-plane pipeline ship them asynchronously (the coordinate-
+            # descent loop prefetches coordinate k+1's shard during
+            # coordinate k's solve) instead of paying the transfer
+            # synchronously inside prepare.
+            dataset.shards[new_name] = projected
+        else:
+            # Plain-dict datasets have no lazy materialization — upload now.
+            dataset.shards[new_name] = dataclasses.replace(
+                projected,
+                indices=jnp.asarray(projected.indices),
+                values=jnp.asarray(projected.values),
+            )
     else:
         dataset.shards[new_name] = projector.project_features(
             dataset.shards[shard], entity_rows

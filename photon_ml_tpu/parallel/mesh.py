@@ -109,7 +109,16 @@ def pad_game_dataset(dataset: GameDataset, multiple: int) -> GameDataset:
 
 
 def shard_game_dataset(dataset: GameDataset, mesh: Mesh) -> GameDataset:
-    """device_put the sample axis over the mesh (padding first if needed)."""
+    """device_put the sample axis over the mesh (padding first if needed).
+    The transfers record under the `upload` stage of the ambient timing
+    scope (the multi-device counterpart of ShardDict's lazy upload)."""
+    from photon_ml_tpu.utils.observability import stage_timer
+
+    with stage_timer("upload"):
+        return _shard_game_dataset(dataset, mesh)
+
+
+def _shard_game_dataset(dataset: GameDataset, mesh: Mesh) -> GameDataset:
     ndev = mesh.devices.size
     dataset = pad_game_dataset(dataset, ndev)
     s1 = batch_sharding(mesh, 1)
@@ -342,8 +351,18 @@ def shard_random_effect_dataset(
 
     Padding entities gather row 0 with mask 0 and write their (zero) solution
     into the pinned unseen row — harmless by construction (weight-0 data plus
-    L2 keeps a zero warm start at zero).
+    L2 keeps a zero warm start at zero). Transfers record under the
+    `upload` stage of the ambient timing scope.
     """
+    from photon_ml_tpu.utils.observability import stage_timer
+
+    with stage_timer("upload"):
+        return _shard_random_effect_dataset(red, mesh)
+
+
+def _shard_random_effect_dataset(
+    red: RandomEffectDataset, mesh: Mesh
+) -> RandomEffectDataset:
     ndev = mesh.devices.size
     s1 = batch_sharding(mesh, 1)
     s2 = batch_sharding(mesh, 2)

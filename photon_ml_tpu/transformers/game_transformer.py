@@ -135,6 +135,31 @@ def entity_rows_for_dataset(
     return uniq_rows[inv]
 
 
+def prefetch_fixed_effect_shards(
+    specs: Mapping[str, CoordinateScoringSpec],
+    coordinate_ids,
+    dataset: GameDataset,
+    pipeline: Optional[bool] = None,
+) -> None:
+    """Kick the async upload of every fixed-effect shard (ShardDict
+    prefetch, double-buffered) so the transfers overlap the host-side
+    entity-row resolution and projection of the random-effect coordinates
+    instead of each faulting synchronously in sequence. Random-effect
+    shards are NOT prefetched: their scoring view is the projected shard
+    `prepare_coordinate_data` builds/uploads itself — prefetching the raw
+    ELL would ship bytes scoring never reads. No-op when the host
+    data-plane pipeline is off (`pipeline` override, else data/pipeline.py
+    gating) — the single switch that must keep forced-synchronous runs
+    thread-free."""
+    from photon_ml_tpu.data.pipeline import pipeline_enabled
+
+    if not pipeline_enabled(pipeline) or not hasattr(dataset.shards, "prefetch"):
+        return
+    for cid in coordinate_ids:
+        if not specs[cid].is_random_effect:
+            dataset.shards.prefetch(specs[cid].shard)
+
+
 def prepare_coordinate_data(
     spec: CoordinateScoringSpec, dataset: GameDataset
 ) -> PreparedCoordinateData:
@@ -232,6 +257,8 @@ class GameTransformer:
         model: GameModel,
         specs: Mapping[str, CoordinateScoringSpec],
         task: TaskType,
+        *,
+        pipeline: Optional[bool] = None,
     ):
         missing = [c for c in model.coordinate_ids if c not in specs]
         if missing:
@@ -239,10 +266,20 @@ class GameTransformer:
         self.model = model
         self.specs = dict(specs)
         self.task = task
+        # Host data-plane pipelining override (see GameEstimator.pipeline);
+        # None = the data/pipeline.py env/auto gate.
+        self.pipeline = pipeline
 
     def prepare(self, dataset: GameDataset) -> Dict[str, PreparedCoordinateData]:
         """One-time host prep of `dataset` for every coordinate; pass the
-        result to transform() when scoring the same dataset repeatedly."""
+        result to transform() when scoring the same dataset repeatedly.
+
+        When the host data-plane pipeline is enabled, fixed-effect shard
+        uploads start asynchronously first so they overlap the
+        random-effect host prep (see `prefetch_fixed_effect_shards`)."""
+        prefetch_fixed_effect_shards(
+            self.specs, self.model.coordinate_ids, dataset, self.pipeline
+        )
         return {
             cid: prepare_coordinate_data(self.specs[cid], dataset)
             for cid in self.model.coordinate_ids

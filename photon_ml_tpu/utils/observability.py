@@ -19,8 +19,9 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import threading
 import time
-from contextlib import ContextDecorator
+from contextlib import ContextDecorator, contextmanager
 from typing import Callable, Dict, List, Optional, Type
 
 import numpy as np
@@ -34,15 +35,25 @@ logger = logging.getLogger("photon_ml_tpu")
 
 
 class TimingRegistry:
-    """Accumulates (section -> seconds) across a job for a final summary."""
+    """Accumulates (section -> seconds) across a job for a final summary.
+
+    Thread-safe: the host data-plane pipeline records stage walls from
+    producer threads (background pack, shard prefetch) concurrently with
+    the main thread's recording.
+    """
 
     def __init__(self) -> None:
         self.sections: Dict[str, float] = {}
         self.counts: Dict[str, int] = {}
+        self._lock = threading.Lock()
 
     def record(self, name: str, seconds: float) -> None:
-        self.sections[name] = self.sections.get(name, 0.0) + seconds
-        self.counts[name] = self.counts.get(name, 0) + 1
+        with self._lock:
+            self.sections[name] = self.sections.get(name, 0.0) + seconds
+            self.counts[name] = self.counts.get(name, 0) + 1
+
+    def get(self, name: str, default: float = 0.0) -> float:
+        return self.sections.get(name, default)
 
     def summary(self) -> str:
         if not self.sections:
@@ -85,6 +96,68 @@ class Timed(ContextDecorator):
         if self.registry is not None:
             self.registry.record(self.message, self.elapsed)
         return False
+
+
+# ------------------------------------------------------------- stage timing
+#
+# Ambient per-stage accounting for the host data plane (the counterpart of
+# the reference wrapping every pipeline stage in Timed,
+# GameTrainingDriver.scala:360-480). A caller that wants a stage breakdown
+# (GameEstimator.fit) opens a `stage_scope(registry)`; the data-plane
+# functions (RE dataset build, projector, stats, bucketed pack, device
+# uploads) then record their walls into it through `record_stage` /
+# `stage_timer`. The scope stack is THREAD-LOCAL: two estimators fitting
+# on parallel threads (a thread-parallel hyperparameter sweep) must not
+# cross-attribute each other's stage walls. Pipeline worker threads are
+# handed the spawner's registry explicitly — `AsyncUploader` captures
+# `current_stage_registry()` at submit time, and the prepare pool wraps
+# each build in `stage_scope(registry)` — so producer work still lands in
+# the fit that spawned it. With no scope open every record is a no-op, so
+# library code can instrument unconditionally.
+
+_STAGE_TLS = threading.local()
+
+
+def _stage_stack() -> List[TimingRegistry]:
+    stack = getattr(_STAGE_TLS, "stack", None)
+    if stack is None:
+        stack = _STAGE_TLS.stack = []
+    return stack
+
+
+@contextmanager
+def stage_scope(registry: TimingRegistry):
+    """Make `registry` this thread's ambient sink for `record_stage`."""
+    stack = _stage_stack()
+    stack.append(registry)
+    try:
+        yield registry
+    finally:
+        stack.pop()
+
+
+def current_stage_registry() -> Optional[TimingRegistry]:
+    """This thread's innermost open stage registry, or None."""
+    stack = _stage_stack()
+    return stack[-1] if stack else None
+
+
+def record_stage(name: str, seconds: float) -> None:
+    """Record into this thread's innermost stage scope (no-op without one)."""
+    registry = current_stage_registry()
+    if registry is not None:
+        registry.record(name, seconds)
+
+
+@contextmanager
+def stage_timer(name: str):
+    """`with stage_timer("upload"):` — record the block's wall clock into
+    the ambient stage scope."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        record_stage(name, time.perf_counter() - t0)
 
 
 # -------------------------------------------------------------- PhotonLogger

@@ -1,0 +1,310 @@
+"""Host data-plane pipeline tests.
+
+Covers the PR-1 tentpole contracts:
+  * a pipelined (threaded/overlapped) fit is BITWISE-identical to a
+    forced-synchronous fit — the pipeline moves when host builds and
+    device uploads happen, never what they compute;
+  * `fit_timing` carries the per-stage prepare breakdown
+    {re_build, projector, stats, pack, upload, compile} (+ `other`) and,
+    in a synchronous run, the stages tile `prepare_s`;
+  * the chunk-canonicalization compile cache shares random-effect solver
+    programs across coordinates (jit cache entries do not grow when the
+    second coordinate trains);
+  * `begin_pack_async` defers to synchronous packing on a 1-effective-core
+    host (the r05 e2e-vs-micro ingest gap);
+  * ShardDict async prefetch materializes the same device arrays as the
+    synchronous fault path.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.data import pipeline as pl
+from photon_ml_tpu.data.containers import SparseFeatures
+from photon_ml_tpu.data.game_dataset import (
+    FixedEffectDataConfig,
+    GameDataset,
+    HostCSR,
+    RandomEffectDataConfig,
+    ShardDict,
+)
+from photon_ml_tpu.estimators.game_estimator import (
+    PREPARE_STAGES,
+    GameEstimator,
+)
+from photon_ml_tpu.optimize.config import (
+    L2,
+    CoordinateOptimizationConfig,
+    OptimizerConfig,
+)
+from photon_ml_tpu.types import TaskType
+from photon_ml_tpu.utils.observability import (
+    TimingRegistry,
+    record_stage,
+    stage_scope,
+    stage_timer,
+)
+
+
+def _glmix_dataset(seed=0, n=512, n_entities=16, d=6):
+    """Small GLMix fixture: one dense shard feeding a fixed effect and two
+    random effects whose entities all have IDENTICAL row counts — so the
+    two coordinates produce identical canonical bucket shapes and must
+    share compiled solver programs."""
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    # Exactly n / n_entities rows per entity for BOTH tags (a permutation
+    # of a balanced assignment), so bucket capacities coincide.
+    users = rng.permutation(np.repeat(np.arange(n_entities), n // n_entities))
+    movies = rng.permutation(np.repeat(np.arange(n_entities), n // n_entities))
+    w = rng.normal(size=d) * 0.5
+    b_u = rng.normal(size=n_entities) * 0.7
+    margins = X @ w + b_u[users]
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-margins))).astype(np.float32)
+    return GameDataset.build(
+        {"g": jnp.asarray(X)},
+        y,
+        id_tags={"userId": users, "movieId": movies},
+    )
+
+
+DATA_CONFIGS = {
+    "global": FixedEffectDataConfig("g"),
+    "per-user": RandomEffectDataConfig("userId", "g", min_bucket=8),
+    "per-movie": RandomEffectDataConfig("movieId", "g", min_bucket=8),
+}
+
+
+def _opt_configs():
+    cfg = CoordinateOptimizationConfig(
+        optimizer=OptimizerConfig(max_iterations=15, tolerance=1e-7),
+        regularization=L2,
+        reg_weight=1.0,
+    )
+    return {cid: cfg for cid in DATA_CONFIGS}
+
+
+def _fit(pipeline):
+    est = GameEstimator(
+        TaskType.LOGISTIC_REGRESSION,
+        dict(DATA_CONFIGS),
+        coordinate_descent_iterations=2,
+        pipeline=pipeline,
+    )
+    results = est.fit(_glmix_dataset(), None, [_opt_configs()])
+    return est, results[0].model
+
+
+def _coeff_arrays(model):
+    out = {}
+    for cid in model.coordinate_ids:
+        m = model[cid]
+        if hasattr(m, "coefficients_matrix"):
+            out[cid] = np.asarray(m.coefficients_matrix)
+        else:
+            out[cid] = np.asarray(m.coefficients.means)
+    return out
+
+
+class TestPipelineParity:
+    def test_overlapped_fit_bitwise_identical_to_synchronous(self, monkeypatch):
+        # Force the worker pool on even on a 1-core CI host: parity must
+        # hold for the ACTUALLY-threaded path.
+        monkeypatch.setenv("PHOTON_HOST_THREADS", "4")
+        _, model_sync = _fit(pipeline=False)
+        _, model_pipe = _fit(pipeline=True)
+        sync, pipe = _coeff_arrays(model_sync), _coeff_arrays(model_pipe)
+        assert set(sync) == set(pipe)
+        for cid in sync:
+            assert np.array_equal(sync[cid], pipe[cid]), (
+                f"coordinate {cid}: pipelined fit diverged from synchronous"
+            )
+
+    def test_fit_timing_breakdown_tiles_prepare(self):
+        est, _ = _fit(pipeline=False)
+        for key in (*PREPARE_STAGES, "other", "prepare_s", "solve_s"):
+            assert key in est.fit_timing, f"fit_timing missing {key!r}"
+        total = sum(est.fit_timing[k] for k in (*PREPARE_STAGES, "other"))
+        prepare_s = est.fit_timing["prepare_s"]
+        assert abs(total - prepare_s) <= 0.05 * max(prepare_s, 1e-9), (
+            f"stage keys sum to {total:.4f}s but prepare_s={prepare_s:.4f}s"
+        )
+        # The dominant prepare stages must be non-trivially attributed.
+        assert est.fit_timing["re_build"] > 0.0
+        assert est.fit_timing["compile"] > 0.0
+
+
+class TestCompileCacheSharing:
+    def test_re_solver_programs_shared_across_coordinates(self):
+        """Satellite: the power-of-two bucket canonicalization exists so the
+        two RE coordinates share jitted solver programs — count jit cache
+        entries before/after the second coordinate trains."""
+        ds = _glmix_dataset(seed=3)
+        est = GameEstimator(
+            TaskType.LOGISTIC_REGRESSION,
+            dict(DATA_CONFIGS),
+            coordinate_descent_iterations=1,
+        )
+        prepared = est.prepare(ds)
+        cfg = _opt_configs()["per-user"]
+        c_user = est._coordinate_for(ds, "per-user", prepared["per-user"], cfg)
+        c_movie = est._coordinate_for(ds, "per-movie", prepared["per-movie"], cfg)
+        # Same static recipe + no normalization => the process-wide RE jit
+        # cache must hand both coordinates the SAME jitted callables.
+        assert c_user._train_bucket is c_movie._train_bucket
+        c_user.train(ds.offsets)
+        counter = getattr(c_user._train_bucket, "_cache_size", None)
+        if counter is None:
+            pytest.skip("jax version exposes no jit cache counter")
+        entries_after_first = counter()
+        assert entries_after_first >= 1
+        c_movie.train(ds.offsets)
+        assert counter() == entries_after_first, (
+            "second RE coordinate compiled new solver programs — the "
+            "canonical bucket shapes are not being shared"
+        )
+
+
+class TestPackDeferral:
+    def _csr(self, n=64, k=4, dim=32):
+        rng = np.random.default_rng(5)
+        return HostCSR(
+            np.arange(n + 1, dtype=np.int64) * k,
+            rng.integers(0, dim, size=n * k).astype(np.int64),
+            rng.normal(size=n * k).astype(np.float32),
+            dim,
+        )
+
+    def test_defers_on_single_core(self, monkeypatch):
+        from photon_ml_tpu.ops import pallas_sparse
+
+        monkeypatch.setattr(
+            pallas_sparse, "pack_worth_considering", lambda n: True
+        )
+        monkeypatch.setenv("PHOTON_HOST_THREADS", "1")
+        csr = self._csr()
+        pallas_sparse.begin_pack_async(csr, 64)
+        assert csr.pack_future is None, (
+            "1-core host must defer the background pack"
+        )
+
+    def test_defers_when_pipeline_forced_off(self, monkeypatch):
+        from photon_ml_tpu.ops import pallas_sparse
+
+        monkeypatch.setattr(
+            pallas_sparse, "pack_worth_considering", lambda n: True
+        )
+        monkeypatch.setenv("PHOTON_HOST_THREADS", "8")
+        monkeypatch.setenv("PHOTON_PIPELINE", "0")
+        csr = self._csr()
+        pallas_sparse.begin_pack_async(csr, 64)
+        assert csr.pack_future is None, (
+            "PHOTON_PIPELINE=0 must keep ingest thread-free"
+        )
+
+    def test_starts_thread_with_parallelism(self, monkeypatch):
+        from photon_ml_tpu.ops import pallas_sparse
+
+        monkeypatch.setattr(
+            pallas_sparse, "pack_worth_considering", lambda n: True
+        )
+        monkeypatch.setenv("PHOTON_HOST_THREADS", "4")
+        csr = self._csr()
+        pallas_sparse.begin_pack_async(csr, 64)
+        assert csr.pack_future is not None
+        csr.pack_future.result(timeout=30)  # pack completes off-thread
+
+
+class TestShardPrefetch:
+    def _host_sparse(self):
+        rng = np.random.default_rng(9)
+        return SparseFeatures(
+            rng.integers(0, 50, size=(40, 4)).astype(np.int32),
+            rng.normal(size=(40, 4)).astype(np.float32),
+            50,
+        )
+
+    def test_prefetch_matches_synchronous_fault(self):
+        sp = self._host_sparse()
+        d_pre = ShardDict({"s": sp})
+        d_pre.prefetch("s")
+        got_pre = d_pre["s"]
+        d_sync = ShardDict({"s": dataclasses.replace(sp)})
+        got_sync = d_sync["s"]
+        assert isinstance(got_pre.indices, jax.Array)
+        assert np.array_equal(np.asarray(got_pre.indices), np.asarray(got_sync.indices))
+        assert np.array_equal(np.asarray(got_pre.values), np.asarray(got_sync.values))
+        # The device copy is cached back: a second access returns it as-is.
+        assert d_pre["s"] is got_pre
+
+    def test_prefetch_noop_on_dense_and_device(self):
+        dense = jnp.ones((4, 2))
+        d = ShardDict({"x": dense})
+        d.prefetch("x")  # no-op, no error
+        assert d["x"] is dense
+        d.prefetch("missing")  # absent key: silently ignored
+
+
+class TestParallelismGates:
+    def test_env_override(self, monkeypatch):
+        monkeypatch.setenv("PHOTON_HOST_THREADS", "7")
+        assert pl.effective_host_parallelism() == 7
+        monkeypatch.setenv("PHOTON_HOST_THREADS", "1")
+        assert not pl.pipeline_enabled(None)
+        # Explicit override beats the 1-core auto-gate; env beats auto.
+        assert pl.pipeline_enabled(True)
+        monkeypatch.setenv("PHOTON_PIPELINE", "0")
+        monkeypatch.setenv("PHOTON_HOST_THREADS", "8")
+        assert not pl.pipeline_enabled(None)
+        monkeypatch.setenv("PHOTON_PIPELINE", "1")
+        monkeypatch.setenv("PHOTON_HOST_THREADS", "1")
+        assert pl.pipeline_enabled(None)
+
+    def test_stage_scopes_are_thread_local_with_explicit_handoff(self):
+        import threading
+
+        reg = TimingRegistry()
+        other = TimingRegistry()
+        with stage_scope(reg):
+            with stage_timer("stats"):
+                pass
+            # A bare worker thread does NOT inherit the scope (no silent
+            # cross-fit attribution) ...
+            t = threading.Thread(target=lambda: record_stage("upload", 0.25))
+            t.start()
+            t.join()
+            assert "upload" not in reg.sections
+
+            # ... the spawner hands its registry over explicitly instead.
+            def _worker():
+                with stage_scope(reg):
+                    record_stage("upload", 0.25)
+
+            t = threading.Thread(target=_worker)
+            t.start()
+            t.join()
+            # A scope opened on another thread never leaks into this one.
+            with stage_scope(other):
+                pass
+        record_stage("upload", 99.0)  # scope closed: no-op
+        assert reg.get("upload") == 0.25
+        assert "stats" in reg.sections
+        assert other.sections == {}
+
+    def test_uploader_records_into_submitters_registry(self):
+        import time as _t
+
+        reg = TimingRegistry()
+        with stage_scope(reg):
+            up = pl.AsyncUploader(stage="upload")
+            fut = up.submit("k", lambda: 42)
+        assert fut.result(timeout=30) == 42
+        for _ in range(200):  # the stage record lands just after the result
+            if "upload" in reg.sections:
+                break
+            _t.sleep(0.01)
+        assert "upload" in reg.sections
