@@ -543,6 +543,13 @@ def _child() -> None:
         from photon_ml_tpu.data.game_dataset import FixedEffectDataConfig
         from photon_ml_tpu.estimators.game_estimator import GameEstimator
         from photon_ml_tpu.evaluation.suite import EvaluationSuite, EvaluatorType
+        from photon_ml_tpu.utils import faults
+
+        # Robustness counters cover ONLY the e2e pipeline: a clean run
+        # emits zeros; a nonzero retries/diverged_steps/
+        # fallback_sync_uploads in a bench artifact is a loud robustness
+        # regression signal (a data plane or solver quietly limping).
+        faults.reset_counters()
 
         n_users = max(200, e2e_rows // 145)
         n_movies = max(50, e2e_rows // 740)
@@ -690,6 +697,7 @@ def _child() -> None:
             )
             eval_res = suite_e.evaluate(scores_e.scores)
             eval_s = time.perf_counter() - t0
+            fault_counts = faults.counters()
             e2e = dict(
                 rows=e2e_rows,
                 n_users=n_users,
@@ -706,6 +714,11 @@ def _child() -> None:
                 eval_s=round(eval_s, 1),
                 auc=round(float(eval_res.primary_value), 4),
                 total_excl_gen_s=round(ingest_s + train_s + eval_s, 1),
+                retries=int(fault_counts.get("retries", 0)),
+                diverged_steps=int(fit_timing.get("diverged_steps", 0)),
+                fallback_sync_uploads=int(
+                    fault_counts.get("fallback_sync_uploads", 0)
+                ),
             )
             _mark(f"e2e done: {e2e}")
     except Exception as exc:  # noqa: BLE001 - bench must still print a line

@@ -35,6 +35,7 @@ Structural translation (the central TPU design decision of this framework):
 from __future__ import annotations
 
 import dataclasses
+import logging
 import threading
 from typing import Dict, List, Mapping, Optional, Sequence
 
@@ -44,8 +45,11 @@ import numpy as np
 
 from photon_ml_tpu.data.containers import Features, LabeledData, SparseFeatures
 from photon_ml_tpu.types import ProjectorType
+from photon_ml_tpu.utils import faults
 
 Array = jax.Array
+
+logger = logging.getLogger(__name__)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -120,6 +124,7 @@ class ShardDict(dict):
     _uploader_init_lock = threading.Lock()
 
     def _materialize(self, v: SparseFeatures) -> SparseFeatures:
+        faults.fault_point("upload")
         return dataclasses.replace(
             v,
             indices=jnp.asarray(v.indices),
@@ -147,6 +152,9 @@ class ShardDict(dict):
     def __getitem__(self, key):
         v = super().__getitem__(key)
         if isinstance(v, SparseFeatures) and not isinstance(v.indices, jax.Array):
+            from photon_ml_tpu.utils.observability import stage_timer
+
+            host = v
             fut = (
                 self._uploader.pop(key) if self._uploader is not None else None
             )
@@ -154,12 +162,26 @@ class ShardDict(dict):
                 # Prefetched: the uploader thread already recorded the
                 # upload wall where it ran; the join wait here is the
                 # (hopefully ~zero) non-overlapped remainder.
-                v = fut.result()
-            else:
-                from photon_ml_tpu.utils.observability import stage_timer
-
+                try:
+                    v = fut.result()
+                except Exception:
+                    # The async path (with its own retries) gave up; the
+                    # shard is still needed, so degrade to the synchronous
+                    # in-thread path below before surfacing anything.
+                    logger.warning(
+                        "async upload of shard %r failed; degrading to a "
+                        "synchronous upload",
+                        key,
+                        exc_info=True,
+                    )
+                    faults.COUNTERS.increment("fallback_sync_uploads")
+                    fut = None
+            if fut is None:
                 with stage_timer("upload"):
-                    v = self._materialize(v)
+                    v = faults.retry(
+                        lambda: self._materialize(host),
+                        label=f"upload of shard {key!r}",
+                    )
             super().__setitem__(key, v)
         return v
 

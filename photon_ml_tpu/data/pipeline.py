@@ -29,14 +29,18 @@ one (there is no reduction reordering anywhere in the pipeline).
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
 from concurrent.futures import Future
 from typing import Callable, Dict, Optional
 
+from photon_ml_tpu.utils import faults
 from photon_ml_tpu.utils.observability import current_stage_registry
 
 import time
+
+logger = logging.getLogger(__name__)
 
 
 def effective_host_parallelism() -> int:
@@ -88,19 +92,42 @@ class AsyncUploader:
     scopes are thread-local, so the worker thread cannot see it
     ambiently) — overlapped uploads thus show up in the spawning fit's
     breakdown even though its main thread never waited on them.
+
+    Failure domain (utils/faults.py): each job retries transient failures
+    under the bounded-backoff retry policy before its future fails, and a
+    FAILED job is evicted from `_jobs` at the accessors — a dead future
+    must not be pinned under its key forever, where every later `submit`
+    or `peek` would return the same corpse and no retry could ever
+    succeed. `submit` on a dead key starts a fresh job; `peek` reports a
+    dead key as absent; `pop` hands the dead future to the consumer
+    exactly once (so the ONE owner sees the failure and can degrade to the
+    synchronous in-thread path, ShardDict.__getitem__) — a transient
+    upload failure costs a retry or a sync upload, never the fit.
     """
 
-    def __init__(self, max_in_flight: int = 2, stage: str = "upload"):
+    def __init__(
+        self,
+        max_in_flight: int = 2,
+        stage: str = "upload",
+        retry_policy: Optional["faults.RetryPolicy"] = None,
+    ):
         self._sem = threading.Semaphore(max_in_flight)
         self._stage = stage
+        self._policy = retry_policy
         self._lock = threading.Lock()
         self._jobs: Dict[object, Future] = {}
+
+    @staticmethod
+    def _is_dead(fut: Future) -> bool:
+        return fut.done() and (fut.cancelled() or fut.exception() is not None)
 
     def submit(self, key: object, fn: Callable[[], object]) -> Future:
         with self._lock:
             fut = self._jobs.get(key)
             if fut is not None:
-                return fut
+                if not self._is_dead(fut):
+                    return fut
+                del self._jobs[key]  # failed job: make room for the retry
             fut = Future()
             self._jobs[key] = fut
         registry = current_stage_registry()
@@ -111,7 +138,11 @@ class AsyncUploader:
                 return
             t0 = time.perf_counter()
             try:
-                fut.set_result(fn())
+                fut.set_result(
+                    faults.retry(
+                        fn, self._policy, label=f"async {self._stage} {key!r}"
+                    )
+                )
             except BaseException as exc:  # noqa: BLE001 - surfaced at result()
                 fut.set_exception(exc)
             finally:
@@ -126,10 +157,18 @@ class AsyncUploader:
         return fut
 
     def pop(self, key: object) -> Optional[Future]:
-        """Take ownership of a submitted job (the consumer joins it)."""
+        """Take ownership of a submitted job (the consumer joins it). A
+        FAILED job is still handed over — its one owner must observe the
+        failure (and degrade) — but it leaves the registry either way."""
         with self._lock:
             return self._jobs.pop(key, None)
 
     def peek(self, key: object) -> Optional[Future]:
+        """A live in-flight/completed job, or None. Failed jobs read as
+        absent (and are reaped) so observers treat the key as retryable."""
         with self._lock:
-            return self._jobs.get(key)
+            fut = self._jobs.get(key)
+            if fut is not None and self._is_dead(fut):
+                del self._jobs[key]
+                return None
+            return fut

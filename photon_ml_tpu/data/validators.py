@@ -13,7 +13,7 @@ the boolean mask for error reporting.
 
 from __future__ import annotations
 
-from typing import List, Tuple
+from typing import List, Optional, Tuple
 
 import numpy as np
 
@@ -25,16 +25,43 @@ SAMPLE_FRACTION = 0.1
 
 
 class DataValidationError(ValueError):
-    """Raised when training data fails sanity checks; `failures` lists
-    (check name, number of offending rows, example row indices)."""
+    """Raised when training data fails sanity checks.
 
-    def __init__(self, failures: List[Tuple[str, int, List[int]]]):
+    Report-everything semantics (DataValidators.scala accumulates every
+    failed predicate before erroring): `failures` lists EVERY failed check
+    as (check name, number of offending rows, example row indices), never
+    just the first — one error names every problem in the data, so a bad
+    ingest is fixed in one round trip instead of one-check-per-rerun.
+    `rows_checked` is the number of rows the mode actually examined, so the
+    per-check counts read as fractions of the right denominator.
+    """
+
+    def __init__(
+        self,
+        failures: List[Tuple[str, int, List[int]]],
+        rows_checked: Optional[int] = None,
+        mode: Optional[str] = None,
+    ):
         self.failures = failures
-        lines = [
-            f"{name}: {count} rows (e.g. rows {examples})"
-            for name, count, examples in failures
-        ]
-        super().__init__("Training data failed validation:\n  " + "\n  ".join(lines))
+        self.rows_checked = rows_checked
+        lines = []
+        for name, count, examples in failures:
+            frac = (
+                f" ({100.0 * count / rows_checked:.1f}%)"
+                if rows_checked
+                else ""
+            )
+            lines.append(f"{name}: {count} rows{frac} (e.g. rows {examples})")
+        scope = (
+            f" ({len(failures)} failed check(s) over {rows_checked} rows"
+            + (f", mode {mode}" if mode else "")
+            + ")"
+            if rows_checked
+            else ""
+        )
+        super().__init__(
+            f"Training data failed validation{scope}:\n  " + "\n  ".join(lines)
+        )
 
 
 def _sample_rows(n: int, mode: DataValidationType) -> np.ndarray:
@@ -48,9 +75,20 @@ def _sample_rows(n: int, mode: DataValidationType) -> np.ndarray:
 
 
 def validate_game_dataset(
-    dataset: GameDataset, task: TaskType, mode: DataValidationType
+    dataset: GameDataset,
+    task: TaskType,
+    mode: DataValidationType,
+    *,
+    max_examples: int = 5,
 ) -> None:
-    """sanityCheckDataFrameForTraining (DataValidators.scala:300+)."""
+    """sanityCheckDataFrameForTraining (DataValidators.scala:300+).
+
+    Runs EVERY check (labels, offsets, weights, per-task label rules, every
+    feature shard) and aggregates all failures — offending-row counts plus
+    the first `max_examples` row indices per check — into one
+    DataValidationError, mirroring the reference's report-everything
+    behavior instead of stopping at the first failed predicate.
+    """
     if mode == DataValidationType.VALIDATE_DISABLED:
         return
     n = dataset.num_samples
@@ -64,7 +102,7 @@ def validate_game_dataset(
     def check(name: str, ok: np.ndarray) -> None:
         if not ok.all():
             bad = rows[~ok]
-            failures.append((name, int(len(bad)), bad[:5].tolist()))
+            failures.append((name, int(len(bad)), bad[:max_examples].tolist()))
 
     check("finite label", np.isfinite(labels))
     check("finite offset", np.isfinite(offsets))
@@ -88,4 +126,4 @@ def validate_game_dataset(
             check(f"finite features in shard {shard!r}", np.isfinite(vals).all(axis=-1))
 
     if failures:
-        raise DataValidationError(failures)
+        raise DataValidationError(failures, rows_checked=len(rows), mode=mode.name)

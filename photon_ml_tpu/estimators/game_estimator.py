@@ -337,11 +337,28 @@ class GameEstimator:
                     cfg = self.data_configs[cid]
                     if isinstance(cfg, RandomEffectDataConfig):
                         fut = re_futures.pop(cid, None)
-                        red = (
-                            fut.result()
-                            if fut is not None
-                            else build_random_effect_dataset(dataset, cfg)
-                        )
+                        if fut is not None:
+                            try:
+                                red = fut.result()
+                            except Exception:
+                                # A failed producer thread must not kill the
+                                # fit: rebuild synchronously on this thread
+                                # (the pipeline moves only WHEN work runs, so
+                                # the fallback result is identical).
+                                from photon_ml_tpu.utils import faults
+
+                                logger.warning(
+                                    "background build of coordinate %r "
+                                    "failed; rebuilding synchronously",
+                                    cid,
+                                    exc_info=True,
+                                )
+                                faults.COUNTERS.increment(
+                                    "fallback_sync_builds"
+                                )
+                                red = build_random_effect_dataset(dataset, cfg)
+                        else:
+                            red = build_random_effect_dataset(dataset, cfg)
                         if pending_re:
                             _submit_re()
                         original_shard = cfg.feature_shard
@@ -561,6 +578,7 @@ class GameEstimator:
 
         results: List[GameResult] = []
         prev_model: Optional[GameModel] = initial_model
+        diverged_steps = 0
         default_cfg = CoordinateOptimizationConfig()
         for ci, cfgs in enumerate(opt_configs):
             t_coord = time.perf_counter()
@@ -642,6 +660,7 @@ class GameEstimator:
                 )
             )
             prev_model = cd.model
+            diverged_steps += cd.diverged_steps
             self.fit_timing["solve_s"] += time.perf_counter() - t_solve
             logger.info(
                 "configuration %d/%d trained%s",
@@ -662,6 +681,10 @@ class GameEstimator:
             0.0, self.fit_timing["prepare_s"] - sum(stages.values())
         )
         self.fit_timing.update(stages)
+        # Robustness counter: coordinate updates rejected by the divergence
+        # guard across every configuration of this fit (0 on a clean fit —
+        # nonzero in a bench artifact is a loud regression signal).
+        self.fit_timing["diverged_steps"] = diverged_steps
         return results
 
 

@@ -31,6 +31,18 @@ Write protocol — crash-exact by construction:
     by definition the current models), so it costs no extra writes;
   * step directories no longer referenced by state.json are pruned after
     the commit.
+
+Integrity — trust nothing you read back:
+  * every model npz's content checksum (crc32) is recorded in state.json at
+    the commit; `load()` re-hashes each file and refuses a mismatch with a
+    "corrupt/torn checkpoint file" `CheckpointIntegrityError` instead of
+    silently loading garbage (a torn write that survived the atomic-rename
+    protocol — e.g. a copied/rsynced checkpoint — is caught here);
+  * a state.json-referenced file that is missing or unreadable raises the
+    same actionable error, never a bare FileNotFoundError/BadZipFile;
+  * writes retry transient I/O failures under the bounded backoff policy
+    (utils/faults.py) before surfacing, and the `checkpoint_write` fault
+    site injects ahead of any byte hitting disk.
 """
 
 from __future__ import annotations
@@ -40,6 +52,7 @@ import json
 import os
 import shutil
 import tempfile
+import zlib
 from typing import Dict, List, Optional, Tuple
 
 import jax.numpy as jnp
@@ -51,12 +64,23 @@ from photon_ml_tpu.game.model import (
     GameModel,
     RandomEffectModel,
 )
+from photon_ml_tpu.utils import faults
 
 STATE_FILE = "state.json"
 STEPS_DIR = "steps"
 
 
+class CheckpointIntegrityError(ValueError):
+    """A state.json-referenced checkpoint file is missing, torn, or does
+    not match its recorded checksum."""
+
+
+def _checksum(data: bytes) -> str:
+    return f"crc32:{zlib.crc32(data) & 0xFFFFFFFF:08x}"
+
+
 def _atomic_write(path: str, data: bytes) -> None:
+    faults.fault_point("checkpoint_write")
     d = os.path.dirname(path)
     os.makedirs(d, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=d)
@@ -79,7 +103,9 @@ def _atomic_write(path: str, data: bytes) -> None:
         raise
 
 
-def _save_model_npz(path: str, model) -> None:
+def _save_model_npz(path: str, model) -> str:
+    """Write the model npz atomically (with transient-failure retries) and
+    return its content checksum for the state.json integrity record."""
     import io as _io
 
     buf = _io.BytesIO()
@@ -96,24 +122,71 @@ def _save_model_npz(path: str, model) -> None:
     else:
         raise TypeError(f"unknown model type {type(model)}")
     np.savez(buf, **arrays)
-    _atomic_write(path, buf.getvalue())
+    data = buf.getvalue()
+    faults.retry(
+        lambda: _atomic_write(path, data), label=f"checkpoint write {path}"
+    )
+    return _checksum(data)
 
 
-def _load_model_npz(path: str, task):
-    with np.load(path, allow_pickle=False) as z:
-        kind = str(z["kind"])
-        var = jnp.asarray(z["variances"]) if "variances" in z else None
-        if kind == "fixed":
-            return FixedEffectModel(Coefficients(jnp.asarray(z["means"]), var), task)
-        if kind == "random":
-            n_ent = int(z["n_entities"]) if "n_entities" in z else None
-            return RandomEffectModel(
-                jnp.asarray(z["matrix"]), var, task, n_entities=n_ent
-            )
-        raise ValueError(
-            f"{path}: unknown model kind {kind!r} (corrupted or foreign "
-            "checkpoint file)"
+def _load_model_npz(path: str, task, expected_checksum: Optional[str] = None):
+    """Load one model npz, verifying existence, readability, and — when
+    state.json recorded one — the content checksum. Every failure mode is a
+    CheckpointIntegrityError with a delete-to-start-fresh instruction."""
+    import io as _io
+
+    directory = os.path.dirname(os.path.dirname(os.path.dirname(path)))
+    remedy = (
+        f"— delete the checkpoint directory {directory or '.'} to start fresh"
+    )
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except FileNotFoundError:
+        raise CheckpointIntegrityError(
+            f"checkpoint is missing model file {path} (state.json references "
+            f"it, so the checkpoint is incomplete) {remedy}"
+        ) from None
+    except OSError as exc:
+        raise CheckpointIntegrityError(
+            f"checkpoint model file {path} is unreadable ({exc}) {remedy}"
+        ) from exc
+    if expected_checksum is not None and _checksum(data) != expected_checksum:
+        raise CheckpointIntegrityError(
+            f"corrupt/torn checkpoint file {path}: content checksum "
+            f"{_checksum(data)} does not match the recorded "
+            f"{expected_checksum} {remedy}"
         )
+    # Guard ONLY the npz parse: a device-placement failure (XlaRuntimeError,
+    # OOM) during model construction below is NOT corruption and must never
+    # be reported with a delete-the-checkpoint instruction.
+    try:
+        with np.load(_io.BytesIO(data), allow_pickle=False) as z:
+            arrays = {name: np.asarray(z[name]) for name in z.files}
+    except Exception as exc:  # BadZipFile, KeyError, truncated npz, ...
+        raise CheckpointIntegrityError(
+            f"corrupt/torn checkpoint file {path} ({type(exc).__name__}: "
+            f"{exc}) {remedy}"
+        ) from exc
+    kind = str(arrays.get("kind"))
+    var = (
+        jnp.asarray(arrays["variances"]) if "variances" in arrays else None
+    )
+    if kind == "fixed" and "means" in arrays:
+        return FixedEffectModel(
+            Coefficients(jnp.asarray(arrays["means"]), var), task
+        )
+    if kind == "random" and "matrix" in arrays:
+        n_ent = (
+            int(arrays["n_entities"]) if "n_entities" in arrays else None
+        )
+        return RandomEffectModel(
+            jnp.asarray(arrays["matrix"]), var, task, n_entities=n_ent
+        )
+    raise CheckpointIntegrityError(
+        f"{path}: unknown model kind {kind!r} (corrupted or foreign "
+        f"checkpoint file) {remedy}"
+    )
 
 
 def _results_to_json(res) -> dict:
@@ -150,6 +223,8 @@ class CoordinateDescentCheckpoint:
         # cid -> relative npz path currently representing the coordinate.
         self._model_files: Dict[str, str] = {}
         self._best_files: Dict[str, str] = {}
+        # relative npz path -> content checksum, committed with state.json.
+        self._checksums: Dict[str, str] = {}
 
     def exists(self) -> bool:
         return os.path.isfile(os.path.join(self.directory, STATE_FILE))
@@ -178,16 +253,23 @@ class CoordinateDescentCheckpoint:
         for cid, model in models.items():
             if cid == trained_cid or cid not in self._model_files:
                 rel = os.path.join(step_rel, f"{cid}.npz")
-                _save_model_npz(os.path.join(self.directory, rel), model)
+                self._checksums[rel] = _save_model_npz(
+                    os.path.join(self.directory, rel), model
+                )
                 self._model_files[cid] = rel
         if best_is_current and best_results is not None:
             self._best_files = dict(self._model_files)
+        live = set(self._model_files.values()) | set(self._best_files.values())
+        self._checksums = {
+            rel: c for rel, c in self._checksums.items() if rel in live
+        }
         state = {
             "completed_steps": completed_steps,
             "seed": seed,
             "config_key": config_key,
             "model_files": dict(self._model_files),
             "best_files": dict(self._best_files) if best_results is not None else {},
+            "checksums": dict(self._checksums),
             "best_results": (
                 None if best_results is None else _results_to_json(best_results)
             ),
@@ -196,9 +278,11 @@ class CoordinateDescentCheckpoint:
             ],
         }
         # state.json LAST: it is the commit point for the whole step.
-        _atomic_write(
-            os.path.join(self.directory, STATE_FILE),
-            json.dumps(state, indent=2).encode(),
+        state_bytes = json.dumps(state, indent=2).encode()
+        state_path = os.path.join(self.directory, STATE_FILE)
+        faults.retry(
+            lambda: _atomic_write(state_path, state_bytes),
+            label=f"checkpoint commit {state_path}",
         )
         self._prune(state)
 
@@ -228,12 +312,19 @@ class CoordinateDescentCheckpoint:
             )
         self._model_files = dict(state["model_files"])
         self._best_files = dict(state.get("best_files", {}))
+        # Pre-checksum checkpoints (older state.json) load unverified; files
+        # written from now on gain checksums at the next commit.
+        self._checksums = dict(state.get("checksums", {}))
         models = {
-            cid: _load_model_npz(os.path.join(self.directory, rel), task)
+            cid: _load_model_npz(
+                os.path.join(self.directory, rel), task, self._checksums.get(rel)
+            )
             for cid, rel in self._model_files.items()
         }
         best = {
-            cid: _load_model_npz(os.path.join(self.directory, rel), task)
+            cid: _load_model_npz(
+                os.path.join(self.directory, rel), task, self._checksums.get(rel)
+            )
             for cid, rel in self._best_files.items()
         }
         return CheckpointState(

@@ -30,8 +30,31 @@ import jax.numpy as jnp
 
 from photon_ml_tpu.evaluation.suite import EvaluationResults, EvaluationSuite
 from photon_ml_tpu.game.model import GameModel
+from photon_ml_tpu.utils import faults
+from photon_ml_tpu.utils.observability import record_stage
 
 logger = logging.getLogger(__name__)
+
+
+def _update_all_finite(model, scores) -> bool:
+    """ONE scalar all-finite check over a coordinate update (new model +
+    new scores): the and-reduction builds device-side, so the guard costs a
+    single boolean fetch per coordinate update, not one per array."""
+    arrays = [scores]
+    coeffs = getattr(model, "coefficients", None)
+    if coeffs is not None:
+        arrays.append(coeffs.means)
+        if coeffs.variances is not None:
+            arrays.append(coeffs.variances)
+    matrix = getattr(model, "coefficients_matrix", None)
+    if matrix is not None:
+        arrays.append(matrix)
+        if getattr(model, "variances_matrix", None) is not None:
+            arrays.append(model.variances_matrix)
+    ok = jnp.bool_(True)
+    for a in arrays:
+        ok = ok & jnp.all(jnp.isfinite(a))
+    return bool(ok)
 
 
 @dataclasses.dataclass
@@ -40,6 +63,10 @@ class CoordinateDescentResult:
     best_model: GameModel
     validation_history: List[Tuple[int, str, EvaluationResults]]
     timing: Dict[str, float]
+    # Coordinate updates rejected by the divergence guard (a COUNT, kept
+    # out of the seconds-valued `timing` dict so per-coordinate timing
+    # artifacts stay pure wall clock). 0 on a clean run.
+    diverged_steps: int = 0
 
 
 def run_coordinate_descent(
@@ -94,6 +121,7 @@ def run_coordinate_descent(
 
     models: Dict[str, object] = dict(initial_models.models) if initial_models else {}
     timing: Dict[str, float] = {}
+    diverged_steps = 0
     validation_history: List[Tuple[int, str, EvaluationResults]] = []
     best_results: Optional[EvaluationResults] = None
     best_models: Dict[str, object] = dict(models)
@@ -207,7 +235,15 @@ def run_coordinate_descent(
             return
 
     root_key = jax.random.PRNGKey(seed)
-    pass_results: Optional[EvaluationResults] = None
+    # Most recent validation results (best-pass selection compares against
+    # these at each pass-final coordinate). On resume, reconstruct from the
+    # persisted history: a replayed step whose update is REJECTED skips
+    # validation, so without this the resumed run would compare against
+    # None where the uninterrupted run compared against the previous
+    # step's results — a kill-resume best-model divergence.
+    pass_results: Optional[EvaluationResults] = (
+        validation_history[-1][2] if validation_history else None
+    )
     last_unlocked = unlocked[-1]
     for it in range(num_iterations):
         for ci, cid in enumerate(ids):
@@ -228,15 +264,62 @@ def run_coordinate_descent(
                 # Fresh subsample per optimize call, as in the reference's
                 # runWithSampling (DistributedOptimizationProblem.scala:144).
                 kwargs["key"] = jax.random.fold_in(root_key, step)
-            model, _stats = coord.train(offsets, models.get(cid), **kwargs)
-            new_scores = coord.score(model)
-            summed = residual + new_scores
-            scores[cid] = new_scores
-            models[cid] = model
+
+            # Divergence guard: an update whose new model or scores carry a
+            # non-finite value is REJECTED — committing it would poison every
+            # later coordinate's residual this run AND, via the checkpoint,
+            # every resumed run. A rejected solve gets a bounded number of
+            # retries (PHOTON_SOLVE_RETRIES, default 1): a transient cause
+            # (injected fault, flaky accelerator) re-solves to the exact
+            # fault-free result; a deterministic divergence reproduces and
+            # the coordinate keeps its last-good model.
+            model = None
+            new_scores = None
+            for attempt in range(1 + faults.solve_retry_attempts()):
+                try:
+                    faults.fault_point("solve")
+                except faults.InjectedFault:
+                    # Only the solve site's OWN injection reads as a
+                    # divergence; faults raised inside train/score (e.g. an
+                    # upload whose retries exhausted) keep their surface
+                    # semantics — swallowing them here would ship an
+                    # untrained model as a "diverged" counter.
+                    finite = False
+                else:
+                    cand_model, _stats = coord.train(
+                        offsets, models.get(cid), **kwargs
+                    )
+                    cand_scores = coord.score(cand_model)
+                    finite = _update_all_finite(cand_model, cand_scores)
+                if finite:
+                    model, new_scores = cand_model, cand_scores
+                    break
+                diverged_steps += 1
+                record_stage("diverged", 1.0)
+                logger.warning(
+                    "iteration %d coordinate %s: non-finite update rejected "
+                    "(attempt %d)",
+                    it,
+                    cid,
+                    attempt + 1,
+                )
+            accepted = model is not None
+            if accepted:
+                summed = residual + new_scores
+                scores[cid] = new_scores
+                models[cid] = model
+            else:
+                logger.error(
+                    "iteration %d coordinate %s diverged on every attempt — "
+                    "keeping its last-good model; the rejected update is not "
+                    "checkpointed",
+                    it,
+                    cid,
+                )
             timing[f"{cid}/iter{it}"] = time.perf_counter() - t0
             logger.info("iteration %d coordinate %s trained in %.3fs", it, cid, timing[f"{cid}/iter{it}"])
 
-            if validation_scorer is not None and validation_suite is not None:
+            if accepted and validation_scorer is not None and validation_suite is not None:
                 val_scores[cid] = validation_scorer(cid, model)
                 # Seed with the validation offsets so selection uses the same
                 # score definition as the final reported evaluation.
@@ -259,12 +342,16 @@ def run_coordinate_descent(
                 best_updated = True
 
             if ckpt is not None:
+                # trained_cid=None on a rejected update: the step cursor
+                # still advances (resume replays from the same (seed, step)
+                # keys), but the non-finite model is NEVER written — the
+                # durable state keeps the last-good model.
                 ckpt.save(
                     completed_steps=step + 1,
                     seed=seed,
                     config_key=ckpt_config_key,
                     models=models,
-                    trained_cid=cid,
+                    trained_cid=cid if accepted else None,
                     best_is_current=best_updated,
                     best_results=best_results,
                     validation_history=validation_history,
@@ -279,4 +366,5 @@ def run_coordinate_descent(
         best_model=best,
         validation_history=validation_history,
         timing=timing,
+        diverged_steps=diverged_steps,
     )

@@ -12,16 +12,21 @@ reference fixtures).
 
 from __future__ import annotations
 
+import logging
 import os
 from typing import Dict, List, Mapping, Optional, Sequence, Tuple
 
 import numpy as np
+
+from photon_ml_tpu.utils import faults
 
 from photon_ml_tpu.data.containers import pack_csr_to_ell
 from photon_ml_tpu.data.game_dataset import GameDataset, HostCSR
 from photon_ml_tpu.data.index_map import DELIMITER, IndexMap
 from photon_ml_tpu.io import avro as avro_io
 from photon_ml_tpu.native import avro_reader
+
+logger = logging.getLogger(__name__)
 
 
 def _stash_worthwhile(n_samples: int) -> bool:
@@ -122,11 +127,30 @@ def try_read_native(
 
     def _decode_one(c, n_threads):
         path, body, codec, sync, program = c
-        with open(path, "rb") as f:
-            data = f.read()
-        return avro_reader.decode_file_native(
-            data, body, codec, sync, program, DELIMITER, n_threads=n_threads
-        )
+
+        def _attempt():
+            # `decode` fault site + transient-I/O retries: the whole file is
+            # re-read per attempt, so a torn read never leaks into a retry.
+            faults.fault_point("decode")
+            with open(path, "rb") as f:
+                data = f.read()
+            return avro_reader.decode_file_native(
+                data, body, codec, sync, program, DELIMITER, n_threads=n_threads
+            )
+
+        try:
+            return faults.retry(_attempt, label=f"avro decode {path}")
+        except Exception:
+            # Retries exhausted (or non-transient): degrade to the
+            # synchronous pure-Python codec instead of killing the read —
+            # the caller treats None as "native path unavailable".
+            logger.warning(
+                "native decode of %s failed; falling back to the Python "
+                "codec",
+                path,
+                exc_info=True,
+            )
+            return None
 
     # One failed file means a full fallback to the Python codec, so stop
     # decoding as soon as a failure surfaces instead of paying for the

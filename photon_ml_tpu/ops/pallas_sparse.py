@@ -497,6 +497,9 @@ def begin_pack_async(csr, n_samples: int) -> None:
         if not fut.set_running_or_notify_cancel():
             return  # cancelled before start: skip the O(nnz) pack entirely
         try:
+            from photon_ml_tpu.utils import faults
+
+            faults.fault_point("pack")
             rows, cols, vals, dim = csr.to_coo()
             fut.set_result(host_pack_coo(rows, cols, vals, n_samples, dim))
         except BaseException as exc:  # noqa: BLE001 - surfaced at result()
@@ -517,9 +520,27 @@ def finish_pack(csr, n_samples: int) -> Optional[BucketedSparseFeatures]:
 
     fut = getattr(csr, "pack_future", None)
     if fut is not None and not fut.cancelled():
-        with stage_timer("pack"):
-            bf = fut.result()
-        return None if bf is None else bucketed.upload(bf)
+        try:
+            with stage_timer("pack"):
+                bf = fut.result()
+        except Exception:
+            # A failed background pack must not kill the fit: fall through
+            # to the synchronous pack below (identical result — the thread
+            # only moved WHEN the pack ran). Only the join is guarded: an
+            # upload failure after a GOOD pack must surface as what it is,
+            # not trigger a pointless O(nnz) repack.
+            import logging
+
+            from photon_ml_tpu.utils import faults
+
+            logging.getLogger(__name__).warning(
+                "background bucketed pack failed; repacking synchronously",
+                exc_info=True,
+            )
+            faults.COUNTERS.increment("fallback_sync_packs")
+            csr.pack_future = None
+        else:
+            return None if bf is None else bucketed.upload(bf)
     with stage_timer("pack"):
         rows, cols, vals, dim = csr.to_coo()
         bf = host_pack_coo(rows, cols, vals, n_samples, dim)

@@ -1,0 +1,364 @@
+"""Deterministic fault injection + bounded retry for the failure domain.
+
+The reference inherits mid-job failure recovery from its substrate: Spark
+lineage re-computes lost partitions and the driver re-tries failed stages,
+with DISK_ONLY persists bounding the recompute (CoordinateDescent.scala:
+325-341). The TPU port replaced that substrate with an explicit checkpoint
+(game/checkpoint.py) and a threaded host data plane (data/pipeline.py) —
+which means every failure path is now OURS to exercise and recover. This
+module is the shared machinery:
+
+* `FaultPlan` / `install` / `fault_point(site)` — a seeded, deterministic
+  fault-injection registry. Sites are the data-plane and solver boundaries
+  (`decode`, `pack`, `upload`, `solve`, `checkpoint_write`); a plan arms a
+  site for its first N invocations, explicit invocation indices, or a
+  seeded probability — all reproducible, so a chaos test can replay the
+  exact same failure schedule. Configured programmatically (tests) or via
+  `PHOTON_FAULTS` / `PHOTON_FAULTS_SEED` env (subprocess chaos runs):
+
+      PHOTON_FAULTS="decode:1,upload:2,solve@3,pack:p0.25"
+
+  `site:N` fails the first N invocations, `site@i+j` fails exactly the
+  1-based invocations i and j, `site:pX` fails each invocation with
+  probability X keyed on (seed, site, invocation) — deterministic per
+  seed. An armed `fault_point` raises `InjectedFault` (always classified
+  transient by the retry policy below).
+
+* `retry(fn, policy)` — bounded exponential backoff around transient
+  failures. Default policy: 3 attempts, 50 ms base delay doubling to a
+  2 s cap, retrying `InjectedFault`, `OSError`/`ConnectionError`/
+  `TimeoutError`, and XLA runtime errors (a remote-device tunnel surfaces
+  transient transport failures as `XlaRuntimeError`). Knobs:
+  `PHOTON_RETRY_MAX_ATTEMPTS`, `PHOTON_RETRY_BASE_DELAY_S`,
+  `PHOTON_RETRY_MAX_DELAY_S`.
+
+* `COUNTERS` — process-wide robustness event counters (`retries`,
+  `fallback_sync_uploads`, `fallback_sync_builds`, `fallback_sync_packs`,
+  `injected_faults`). Zero on a clean run by construction, so a nonzero
+  value in a bench artifact (bench.py e2e_from_disk) is a loud robustness
+  regression signal, and tests assert exact counts.
+
+Everything here changes only WHETHER work is retried/degraded, never what
+it computes: a run under injected transient faults must produce the same
+model, bit for bit, as a fault-free run (tests/test_faults.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import threading
+import time
+import zlib
+from contextlib import contextmanager
+from typing import Callable, Dict, FrozenSet, Mapping, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+# The injection sites wired into the framework. fault_point accepts any
+# string (the registry is open for future subsystems), but plans naming an
+# unknown site fail fast at parse time — a typo'd PHOTON_FAULTS that
+# silently injects nothing would be a chaos test that tests nothing.
+KNOWN_SITES = ("decode", "pack", "upload", "solve", "checkpoint_write")
+
+
+class InjectedFault(RuntimeError):
+    """Raised by an armed `fault_point`. Always classified transient."""
+
+
+# --------------------------------------------------------------- fault plans
+
+
+def _mix64(*parts: int) -> int:
+    """splitmix64-style avalanche over the parts — the same deterministic
+    keyed-hash idiom as the data layer's reservoir priorities
+    (data/game_dataset._row_priorities)."""
+    x = 0x9E3779B97F4A7C15
+    for p in parts:
+        x = (x ^ (p & 0xFFFFFFFFFFFFFFFF)) * 0xBF58476D1CE4E5B9 & 0xFFFFFFFFFFFFFFFF
+        x ^= x >> 30
+        x = x * 0x94D049BB133111EB & 0xFFFFFFFFFFFFFFFF
+        x ^= x >> 31
+    return x
+
+
+@dataclasses.dataclass(frozen=True)
+class SiteSpec:
+    """When one site fires: first-N invocations, explicit 1-based
+    invocation indices, and/or a seeded per-invocation probability."""
+
+    first_n: int = 0
+    indices: FrozenSet[int] = frozenset()
+    probability: float = 0.0
+
+    def should_fail(self, site: str, invocation: int, seed: int) -> bool:
+        if invocation <= self.first_n or invocation in self.indices:
+            return True
+        if self.probability > 0.0:
+            h = _mix64(seed, zlib.crc32(site.encode()), invocation)
+            return (h >> 11) / float(1 << 53) < self.probability
+        return False
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """Immutable site -> SiteSpec schedule plus the probability seed."""
+
+    sites: Mapping[str, SiteSpec]
+    seed: int = 0
+
+    @classmethod
+    def parse(cls, spec: str, seed: int = 0) -> "FaultPlan":
+        """`"decode:1,upload:2,solve@3+5,pack:p0.25"` — see module doc."""
+        sites: Dict[str, SiteSpec] = {}
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if "@" in part:
+                site, _, idx = part.partition("@")
+                entry = SiteSpec(
+                    indices=frozenset(int(i) for i in idx.split("+"))
+                )
+            elif ":" in part:
+                site, _, val = part.partition(":")
+                val = val.strip()
+                if val.startswith("p"):
+                    entry = SiteSpec(probability=float(val[1:]))
+                else:
+                    entry = SiteSpec(first_n=int(val))
+            else:
+                site, entry = part, SiteSpec(first_n=1)
+            site = site.strip()
+            if site not in KNOWN_SITES:
+                raise ValueError(
+                    f"unknown fault site {site!r} in {spec!r} "
+                    f"(known: {', '.join(KNOWN_SITES)})"
+                )
+            prev = sites.get(site, SiteSpec())
+            sites[site] = SiteSpec(
+                first_n=max(prev.first_n, entry.first_n),
+                indices=prev.indices | entry.indices,
+                probability=max(prev.probability, entry.probability),
+            )
+        return cls(sites=sites, seed=seed)
+
+
+class FaultInjector:
+    """A plan plus thread-safe per-site invocation/injection counters."""
+
+    def __init__(self, plan: FaultPlan):
+        self.plan = plan
+        self.invocations: Dict[str, int] = {}
+        self.injected: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def fire(self, site: str) -> None:
+        with self._lock:
+            n = self.invocations.get(site, 0) + 1
+            self.invocations[site] = n
+            spec = self.plan.sites.get(site)
+            fail = spec is not None and spec.should_fail(site, n, self.plan.seed)
+            if fail:
+                self.injected[site] = self.injected.get(site, 0) + 1
+        if fail:
+            COUNTERS.increment("injected_faults")
+            logger.warning("injected fault at site %r (invocation %d)", site, n)
+            raise InjectedFault(f"injected fault at site {site!r} (invocation {n})")
+
+
+_LOCK = threading.Lock()
+_INJECTOR: Optional[FaultInjector] = None
+_ENV_CHECKED = False
+
+
+def install(plan, seed: int = 0) -> FaultInjector:
+    """Arm a plan process-wide. `plan` is a FaultPlan or a spec string."""
+    global _INJECTOR, _ENV_CHECKED
+    if isinstance(plan, str):
+        plan = FaultPlan.parse(plan, seed=seed)
+    with _LOCK:
+        _INJECTOR = FaultInjector(plan)
+        _ENV_CHECKED = True
+    return _INJECTOR
+
+
+def clear() -> None:
+    """Disarm fault injection (env re-read on next fault_point)."""
+    global _INJECTOR, _ENV_CHECKED
+    with _LOCK:
+        _INJECTOR = None
+        _ENV_CHECKED = False
+
+
+def active_injector() -> Optional[FaultInjector]:
+    """The armed injector, arming from PHOTON_FAULTS on first call."""
+    global _INJECTOR, _ENV_CHECKED
+    if _INJECTOR is not None:
+        return _INJECTOR
+    if _ENV_CHECKED:
+        return None
+    with _LOCK:
+        if not _ENV_CHECKED:
+            _ENV_CHECKED = True
+            spec = os.environ.get("PHOTON_FAULTS", "").strip()
+            if spec:
+                seed = int(os.environ.get("PHOTON_FAULTS_SEED", "0"))
+                _INJECTOR = FaultInjector(FaultPlan.parse(spec, seed=seed))
+    return _INJECTOR
+
+
+def fault_point(site: str) -> None:
+    """Raise InjectedFault when `site` is armed; free no-op otherwise."""
+    inj = active_injector()
+    if inj is not None:
+        inj.fire(site)
+
+
+@contextmanager
+def inject(spec: str, seed: int = 0):
+    """Test scope: arm `spec`, yield the injector, disarm on exit."""
+    inj = install(spec, seed=seed)
+    try:
+        yield inj
+    finally:
+        clear()
+
+
+# ------------------------------------------------------------------ counters
+
+
+class _Counters:
+    """Process-wide robustness event counters (thread-safe)."""
+
+    def __init__(self) -> None:
+        self._counts: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def increment(self, name: str, by: int = 1) -> None:
+        with self._lock:
+            self._counts[name] = self._counts.get(name, 0) + by
+
+    def get(self, name: str) -> int:
+        with self._lock:
+            return self._counts.get(name, 0)
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counts)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counts.clear()
+
+
+COUNTERS = _Counters()
+
+
+def counters() -> Dict[str, int]:
+    return COUNTERS.snapshot()
+
+
+def reset_counters() -> None:
+    COUNTERS.reset()
+
+
+# --------------------------------------------------------------------- retry
+
+
+def _default_transient(exc: BaseException) -> bool:
+    """Transient by default: injected faults, host I/O failures, and the
+    XLA runtime errors a remote-device tunnel surfaces transport blips as.
+    Deliberately NOT retried: programming errors (TypeError/ValueError/
+    KeyError...), which would re-fail identically and mask the bug."""
+    if isinstance(exc, (InjectedFault, OSError, ConnectionError, TimeoutError)):
+        return True
+    return type(exc).__name__ == "XlaRuntimeError"
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Bounded exponential backoff: attempt k sleeps
+    min(base * backoff**(k-1), max_delay) before retrying."""
+
+    max_attempts: int = 3
+    base_delay_s: float = 0.05
+    max_delay_s: float = 2.0
+    backoff: float = 2.0
+    is_transient: Callable[[BaseException], bool] = _default_transient
+
+    def delay(self, attempt: int) -> float:
+        return min(
+            self.base_delay_s * self.backoff ** max(0, attempt - 1),
+            self.max_delay_s,
+        )
+
+
+def default_policy() -> RetryPolicy:
+    """The env-tunable default (PHOTON_RETRY_* knobs, see module doc)."""
+
+    def _num(name: str, cast, fallback):
+        raw = os.environ.get(name, "").strip()
+        if not raw:
+            return fallback
+        try:
+            return cast(raw)
+        except ValueError:
+            logger.warning("ignoring malformed %s=%r", name, raw)
+            return fallback
+
+    return RetryPolicy(
+        max_attempts=max(1, _num("PHOTON_RETRY_MAX_ATTEMPTS", int, 3)),
+        base_delay_s=_num("PHOTON_RETRY_BASE_DELAY_S", float, 0.05),
+        max_delay_s=_num("PHOTON_RETRY_MAX_DELAY_S", float, 2.0),
+    )
+
+
+def retry(
+    fn: Callable[[], object],
+    policy: Optional[RetryPolicy] = None,
+    *,
+    label: str = "operation",
+    counter: str = "retries",
+    sleep: Callable[[float], None] = time.sleep,
+):
+    """Run `fn`, retrying transient failures under `policy`. Every retry
+    increments COUNTERS[counter]; the final failure (attempts exhausted or
+    a non-transient error) propagates unchanged."""
+    policy = policy or default_policy()
+    attempt = 1
+    while True:
+        try:
+            return fn()
+        except BaseException as exc:  # noqa: BLE001 - re-raised when final
+            if attempt >= policy.max_attempts or not policy.is_transient(exc):
+                raise
+            delay = policy.delay(attempt)
+            COUNTERS.increment(counter)
+            logger.warning(
+                "transient failure in %s (attempt %d/%d): %s — retrying in %.2fs",
+                label,
+                attempt,
+                policy.max_attempts,
+                exc,
+                delay,
+            )
+            sleep(delay)
+            attempt += 1
+
+
+def solve_retry_attempts() -> int:
+    """Extra solve attempts the divergence guard grants a rejected
+    (non-finite) coordinate update before keeping the last-good model
+    (PHOTON_SOLVE_RETRIES, default 1). One retry is what makes a TRANSIENT
+    non-finite solve — an injected fault, a flaky accelerator — converge
+    back to the fault-free result bitwise; a deterministic divergence
+    reproduces on retry and falls through to last-good after one extra
+    solve."""
+    raw = os.environ.get("PHOTON_SOLVE_RETRIES", "").strip()
+    try:
+        return max(0, int(raw)) if raw else 1
+    except ValueError:
+        logger.warning("ignoring malformed PHOTON_SOLVE_RETRIES=%r", raw)
+        return 1

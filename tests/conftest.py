@@ -26,10 +26,67 @@ import jax
 
 jax.config.update("jax_platforms", _PLATFORM)
 
+import threading
+import time
+
 import numpy as np
 import pytest
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running tests excluded from the tier-1 run"
+    )
+    config.addinivalue_line(
+        "markers",
+        "chaos: failure-domain tests (fault injection, kill-resume parity)",
+    )
 
 
 @pytest.fixture
 def rng():
     return np.random.default_rng(20260729)
+
+
+@pytest.fixture(autouse=True)
+def _failure_domain_hygiene(monkeypatch):
+    """Per-test failure-domain invariants:
+
+    * fault injection armed by one test never leaks into the next (the
+      registry is process-global by design — production arms it once via
+      env), and an ambient PHOTON_FAULTS/PHOTON_RETRY_* exported in the
+      developer's shell never arms injection inside unrelated tests
+      (faults.clear() forces an env re-read, so the env must be scrubbed);
+    * robustness counters start at zero so tests can assert exact counts;
+    * no `photon-async-upload` thread outlives the test that spawned it —
+      AsyncUploader workers are per-job and must drain once their job
+      completes; a lingering one means a job wedged (or a future leaked)
+      and would make later tests' upload behavior order-dependent.
+    """
+    from photon_ml_tpu.utils import faults
+
+    for var in (
+        "PHOTON_FAULTS",
+        "PHOTON_FAULTS_SEED",
+        "PHOTON_RETRY_MAX_ATTEMPTS",
+        "PHOTON_RETRY_BASE_DELAY_S",
+        "PHOTON_RETRY_MAX_DELAY_S",
+        "PHOTON_SOLVE_RETRIES",
+    ):
+        monkeypatch.delenv(var, raising=False)
+    faults.clear()
+    faults.reset_counters()
+    yield
+    faults.clear()
+    faults.reset_counters()
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        leaked = [
+            t
+            for t in threading.enumerate()
+            if t.name.startswith("photon-async-upload") and t.is_alive()
+        ]
+        if not leaked:
+            break
+        time.sleep(0.02)
+    assert not leaked, f"leaked async-upload threads: {leaked}"
