@@ -418,6 +418,140 @@ def _child() -> None:
         **_bw_metrics(score_bytes, score_wall, platform),
     )
 
+    # ---- online serving (pinned bundle + deadline micro-batcher) ----------
+    # The north star serves live traffic; this measures the online path the
+    # offline scoring number cannot show: per-request latency through the
+    # micro-batcher against a >=100k-entity bundle pinned in device memory,
+    # with the bounded-compile-set contract checked (zero recompiles after
+    # warmup) and the clean-run robustness contract (zero injected faults
+    # => zero degraded batches), same loud-failure protocol as
+    # prepare_breakdown.
+    try:
+        from photon_ml_tpu.game.model import (
+            Coefficients as _SCoefs,
+            FixedEffectModel as _SFE,
+            GameModel as _SGM,
+            RandomEffectModel as _SRE,
+        )
+        from photon_ml_tpu.serving import (
+            ScoreRequest as _SReq,
+            ServingBundle as _SBundle,
+            ServingEngine as _SEngine,
+        )
+        from photon_ml_tpu.transformers.game_transformer import (
+            CoordinateScoringSpec as _SSpec,
+        )
+        from photon_ml_tpu.utils import faults as _sfaults
+
+        _sfaults.reset_counters()
+        e_srv, d_srv_fe, d_srv_re = 120_000, 64, 16
+        n_req, srv_batch = 16384, 256
+        rng_s = np.random.default_rng(31)
+        w_srv = rng_s.normal(size=d_srv_fe).astype(np.float32)
+        m_srv = np.zeros((e_srv + 1, d_srv_re), np.float32)
+        m_srv[:e_srv] = rng_s.normal(size=(e_srv, d_srv_re)).astype(np.float32) * 0.3
+        task_srv = TaskType.LOGISTIC_REGRESSION
+        bundle_srv = _SBundle.from_model(
+            _SGM(
+                {
+                    "fixed": _SFE(_SCoefs(jnp.asarray(w_srv)), task_srv),
+                    "per-entity": _SRE(jnp.asarray(m_srv), None, task_srv),
+                }
+            ),
+            {
+                "fixed": _SSpec(shard="g"),
+                "per-entity": _SSpec(
+                    shard="re",
+                    random_effect_type="entityId",
+                    entity_index={str(i): i for i in range(e_srv)},
+                ),
+            },
+            task_srv,
+        )
+        _mark(
+            f"serving bundle pinned ({e_srv} entities, "
+            f"{bundle_srv.upload_bytes/1e6:.1f} MB in {bundle_srv.upload_s:.3f}s)"
+        )
+        Xs_fe = rng_s.normal(size=(n_req, d_srv_fe)).astype(np.float32)
+        Xs_re = rng_s.normal(size=(n_req, d_srv_re)).astype(np.float32)
+        # 1 in 64 requests carries an id outside the bundle -> cold start; the
+        # measured fraction must match this stream exactly.
+        ent_srv = rng_s.integers(0, e_srv, size=n_req)
+        cold_mask = rng_s.uniform(size=n_req) < (1 / 64)
+        reqs_srv = [
+            _SReq(
+                features={"g": Xs_fe[i], "re": Xs_re[i]},
+                entity_ids={
+                    "entityId": f"unknown-{i}" if cold_mask[i] else str(ent_srv[i])
+                },
+                uid=str(i),
+            )
+            for i in range(n_req)
+        ]
+        engine_srv = _SEngine(bundle_srv, max_batch=srv_batch)
+        t0 = time.perf_counter()
+        engine_srv.warmup()
+        _mark(
+            f"serving engine warm ({engine_srv.compiles} bucket programs, "
+            f"{time.perf_counter() - t0:.1f}s)"
+        )
+        with engine_srv, engine_srv.batcher(max_wait_ms=1.0) as batcher_srv:
+            batcher_srv.score_all(reqs_srv)
+            m_srv_metrics = batcher_srv.metrics()
+        required_srv = (
+            "p50_ms",
+            "p99_ms",
+            "qps",
+            "cold_start_fraction",
+            "recompiles_after_warmup",
+        )
+        missing_srv = [
+            k for k in required_srv if m_srv_metrics.get(k) is None
+        ]
+        if missing_srv:
+            raise RuntimeError(
+                f"serving_online is missing metric keys {missing_srv} "
+                f"(got {sorted(k for k, v in m_srv_metrics.items() if v is not None)}) "
+                "— the serving metrics contract is broken"
+            )
+        expected_cold = float(cold_mask.sum()) / n_req
+        if abs(m_srv_metrics["cold_start_fraction"] - expected_cold) > 1e-9:
+            raise RuntimeError(
+                f"cold_start_fraction {m_srv_metrics['cold_start_fraction']} does "
+                f"not match the replayed stream's {expected_cold}"
+            )
+        if (
+            _sfaults.COUNTERS.get("injected_faults") == 0
+            and m_srv_metrics["degraded_batches"] != 0
+        ):
+            raise RuntimeError(
+                "clean serving run reported degraded batches "
+                f"({m_srv_metrics['degraded_batches']}) — robustness regression"
+            )
+        variants["serving_online"] = dict(
+            n_entities=e_srv,
+            requests=n_req,
+            max_batch=srv_batch,
+            p50_ms=m_srv_metrics["p50_ms"],
+            p95_ms=m_srv_metrics["p95_ms"],
+            p99_ms=m_srv_metrics["p99_ms"],
+            qps=m_srv_metrics["qps"],
+            cold_start_fraction=round(m_srv_metrics["cold_start_fraction"], 5),
+            padding_waste=round(m_srv_metrics["padding_waste"], 4),
+            recompiles_after_warmup=m_srv_metrics["recompiles_after_warmup"],
+            degraded_batches=m_srv_metrics["degraded_batches"],
+            bundle_upload_mb=round(bundle_srv.upload_bytes / 1e6, 1),
+            bundle_upload_s=round(bundle_srv.upload_s, 3),
+        )
+        _mark(f"serving_online measured ({m_srv_metrics['qps']} qps)")
+    except Exception as exc:  # noqa: BLE001 - bench must still print a line
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        variants["serving_online"] = dict(
+            failed=True, reason=f"{type(exc).__name__}: {exc}"
+        )
+
     # ---- Avro ingest (native block decoder vs pure-Python codec) ----------
     # File generated by the native columnar writer (null codec — the
     # reference's fixture codec) at ~150 MB so decode throughput is

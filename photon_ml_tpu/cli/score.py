@@ -125,19 +125,24 @@ def run(args) -> dict:
 
     out_root = args.root_output_directory
     os.makedirs(out_root, exist_ok=True)
+    # Columns go to the writer as-is (device score array, host uid column):
+    # save_scores streams them in fixed-size chunks, so a large scoring job
+    # never holds a full host copy of any column (the former uids.tolist()
+    # materialized an n-element Python string list, and scores/labels/
+    # weights were each np.asarray'd whole).
     uids = (
-        dataset.id_tags[UID].tolist()
+        dataset.id_tags[UID]
         if UID in dataset.id_tags
-        else [str(i) for i in range(dataset.num_samples)]
+        else np.arange(dataset.num_samples)
     )
     scores_dir = os.path.join(out_root, "scores")
     score_store.save_scores(
         scores_dir,
-        np.asarray(result.scores),
+        result.scores,
         args.model_id or "game-model",
         uids=uids,
-        labels=np.asarray(dataset.labels),
-        weights=np.asarray(dataset.weights),
+        labels=dataset.labels,
+        weights=dataset.weights,
     )
     logger.info("scores written to %s", scores_dir)
 

@@ -150,7 +150,9 @@ def _random_effect_margins_sharded_impl(
             g = jnp.take_along_axis(w_rows, features.indices, axis=1)
             out = jnp.sum(g * features.values, axis=-1)
     else:
-        out = jnp.einsum("nd,nd->n", features, w_rows)
+        # Batch-invariant per-row reduce, mirroring `random_effect_margins`
+        # (see the note there) — keep both dense branches in sync.
+        out = jnp.sum(features * w_rows, axis=-1)
     if shift is not None:
         out = out + shift
     return out
@@ -187,7 +189,14 @@ def random_effect_margins(features, entity_rows: Array, matrix: Array, norm) -> 
             rows = matrix[entity_rows[:, None], features.indices]
             out = jnp.sum(rows * features.values, axis=-1)
     else:
-        out = jnp.einsum("nd,nd->n", features, matrix[entity_rows])
+        # Multiply-broadcast + per-row reduce, NOT einsum("nd,nd->n"): the
+        # einsum lowers to a dot_general whose reduction order varies with
+        # the batch dimension (a 1-row batch measurably diverges from the
+        # same row inside a 9-row batch on CPU), while the per-row reduce
+        # is batch-size invariant — required for the serving engine's
+        # padded-bucket scoring to match this offline path bitwise (see
+        # transformers.game_transformer.dense_margins).
+        out = jnp.sum(features * matrix[entity_rows], axis=-1)
     if shift is not None:
         out = out + shift[entity_rows]
     return out

@@ -389,6 +389,23 @@ def list_container_files(path: str) -> List[str]:
 
 def read_container(path: str) -> tuple[Schema, List[Any]]:
     """Read every record from an Avro object container file."""
+    records: List[Any] = []
+    schema = None
+    for schema, rec in iter_container(path):
+        records.append(rec)
+    if schema is None:  # empty container: still surface the schema
+        with open(path, "rb") as f:
+            data = f.read()
+        schema, _, _, _ = read_header(data, path)
+    return schema, records
+
+
+def iter_container(path: str):
+    """Stream (schema, record) pairs from an Avro container, decoding one
+    block at a time — only a single block's decoded records are ever live
+    (the file BYTES are read whole, but those are compact; the decoded
+    Python dicts are the memory cost). The streaming path for consumers
+    that must stay O(block), e.g. the online request-replay driver."""
     with open(path, "rb") as f:
         data = f.read()
     schema, codec, sync, pos = read_header(data, path)
@@ -396,7 +413,6 @@ def read_container(path: str) -> tuple[Schema, List[Any]]:
     names = _Names()
     _collect_names(schema, names)
 
-    records: List[Any] = []
     while dec.remaining > 0:
         count = dec.read_long()
         size = dec.read_long()
@@ -407,10 +423,9 @@ def read_container(path: str) -> tuple[Schema, List[Any]]:
             raise ValueError(f"unsupported codec {codec!r}")
         bdec = BinaryDecoder(block)
         for _ in range(count):
-            records.append(read_datum(bdec, schema, names))
+            yield schema, read_datum(bdec, schema, names)
         if dec.read_fixed(SYNC_SIZE) != sync:
             raise ValueError(f"{path}: sync marker mismatch (corrupt block)")
-    return schema, records
 
 
 def write_part_files(

@@ -29,40 +29,78 @@ class ScoredItem:
     ids: Dict[str, str] = dataclasses.field(default_factory=dict)
 
 
+def score_records(
+    scores,
+    model_id: str,
+    *,
+    uids: Optional[Sequence] = None,
+    labels=None,
+    weights=None,
+    id_tags: Optional[Dict[str, Sequence]] = None,
+    chunk_size: int = 65536,
+) -> Iterator[dict]:
+    """ScoringResultAvro record stream in fixed-size chunks.
+
+    Column inputs may be numpy arrays, plain sequences, OR device (jax)
+    arrays: each chunk is sliced and converted independently, so a
+    large scoring job never materializes a full host copy of any column
+    (the former `uids.tolist()` built an n-element Python string list up
+    front) and device columns transfer chunk by chunk. Shared by the
+    offline scoring driver (cli/score.py) and the online replay driver
+    (cli/serve.py)."""
+    n = len(scores)
+    step = max(1, chunk_size)
+    for lo in range(0, n, step):
+        hi = min(lo + step, n)
+        sc = np.asarray(scores[lo:hi], np.float64)
+        uc = None if uids is None else uids[lo:hi]
+        lc = None if labels is None else np.asarray(labels[lo:hi], np.float64)
+        wc = None if weights is None else np.asarray(weights[lo:hi], np.float64)
+        tc = (
+            {k: v[lo:hi] for k, v in id_tags.items()} if id_tags else None
+        )
+        for i in range(hi - lo):
+            yield {
+                "uid": None if uc is None else str(uc[i]),
+                "label": None if lc is None else float(lc[i]),
+                "modelId": model_id,
+                "predictionScore": float(sc[i]),
+                "weight": None if wc is None else float(wc[i]),
+                "metadataMap": (
+                    {k: str(v[i]) for k, v in tc.items()} if tc else None
+                ),
+            }
+
+
 def save_scores(
     output_dir: str,
-    scores: np.ndarray,
+    scores,
     model_id: str,
     *,
     uids: Optional[Sequence[str]] = None,
-    labels: Optional[np.ndarray] = None,
-    weights: Optional[np.ndarray] = None,
+    labels=None,
+    weights=None,
     id_tags: Optional[Dict[str, Sequence]] = None,
     records_per_file: int = 500_000,
+    chunk_size: int = 65536,
 ) -> int:
-    """Write scores as ScoringResultAvro part files; returns record count."""
+    """Write scores as ScoringResultAvro part files; returns record count.
+    Streams through `score_records` — columns are converted chunk-wise,
+    never materialized whole."""
     os.makedirs(output_dir, exist_ok=True)
-    n = len(scores)
-
-    def records() -> Iterator[dict]:
-        for i in range(n):
-            meta = None
-            if id_tags:
-                meta = {k: str(v[i]) for k, v in id_tags.items()}
-            yield {
-                "uid": None if uids is None else str(uids[i]),
-                "label": None if labels is None else float(labels[i]),
-                "modelId": model_id,
-                "predictionScore": float(scores[i]),
-                "weight": None if weights is None else float(weights[i]),
-                "metadataMap": meta,
-            }
-
     return avro_io.write_part_files(
         output_dir,
         schemas.SCORING_RESULT,
-        records(),
-        n,
+        score_records(
+            scores,
+            model_id,
+            uids=uids,
+            labels=labels,
+            weights=weights,
+            id_tags=id_tags,
+            chunk_size=chunk_size,
+        ),
+        len(scores),
         records_per_file=records_per_file,
     )
 

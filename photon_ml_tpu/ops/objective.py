@@ -52,6 +52,15 @@ def _eff(w: Array, norm: Optional[NormalizationContext]) -> Tuple[Array, Array]:
     return norm.effective_coefficients(w), norm.margin_shift(w)
 
 
+def margin_params(
+    w: Array, norm: Optional[NormalizationContext]
+) -> Tuple[Array, Array]:
+    """Public view of `_eff` for scoring-side consumers (the transformer's
+    row-stable dense margin path and the serving engine): the effective
+    coefficient vector plus the scalar margin shift normalization folds in."""
+    return _eff(w, norm)
+
+
 def _matvec(features, w_eff: Array) -> Array:
     if isinstance(features, BucketedSparseFeatures):
         if pallas_sparse.should_use(features):

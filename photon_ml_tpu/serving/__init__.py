@@ -1,0 +1,27 @@
+"""Online serving: device-resident GAME model bundles + low-latency scoring.
+
+A deliberate extension beyond the reference (which only scores offline via
+GameScoringDriver): `bundle.py` pins a trained model's weight planes in
+device memory once, `engine.py` answers scoring requests through a bounded
+set of jit-compiled padded-bucket programs, and `batcher.py` coalesces
+single requests into deadline micro-batches. See PARITY.md "Online serving".
+"""
+
+from photon_ml_tpu.serving.batcher import MicroBatcher
+from photon_ml_tpu.serving.bundle import (
+    ScoreRequest,
+    ServingBundle,
+    ServingCoordinate,
+    load_bundle,
+)
+from photon_ml_tpu.serving.engine import ScoreResult, ServingEngine
+
+__all__ = [
+    "MicroBatcher",
+    "ScoreRequest",
+    "ScoreResult",
+    "ServingBundle",
+    "ServingCoordinate",
+    "ServingEngine",
+    "load_bundle",
+]

@@ -1,0 +1,256 @@
+"""Deadline micro-batching: coalesce single requests into engine batches.
+
+Latency/throughput tradeoff of every online scorer: dispatching each
+request alone wastes the accelerator (a bucket-1 program per request);
+waiting for a full batch starves low-traffic periods. The batcher flushes
+the pending queue when EITHER `max_batch` requests are waiting (throughput
+bound) or the OLDEST pending request has waited `max_wait_ms`
+(tail-latency bound) — the standard deadline policy.
+
+Failure domain (utils/faults.py): the engine's `lookup`/`score` fault
+points surface transient failures mid-batch. The batcher DEGRADES instead
+of dying: ANY failed batch re-dispatches per request — transient failures
+get the bounded retry policy; a non-transient error (one malformed
+request poisoning the pack) fails only the offending request's future,
+never its co-batched neighbors. One poisoned buffer or transient device
+error costs latency, not availability — and because the engine's kernels
+are batch-size invariant, the degraded answers are bitwise-identical to
+the batched ones (tests/test_serving.py asserts this under injected
+faults). Each degradation increments the per-batcher `degraded_batches`
+metric and the process-wide COUNTERS["serving_degraded_batches"], zero on
+clean runs by construction.
+
+Observability: per-request wall latency is recorded at completion;
+`metrics()` reports p50/p95/p99, qps, and the engine's counters (cold-start
+fraction, padding waste, recompiles) in one snapshot — the serving
+counterpart of PR 1's fit_timing stage breakdown.
+
+The flush thread is named `photon-serving-flush` and MUST be joined via
+`close()` (or the engine's close, or context-manager exit) — the test
+suite's thread-leak fixture asserts no such thread survives a test.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+import time
+from concurrent.futures import Future
+from typing import Deque, Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+from photon_ml_tpu.serving.bundle import ScoreRequest
+from photon_ml_tpu.serving.engine import ScoreResult, ServingEngine
+from photon_ml_tpu.utils import faults
+
+logger = logging.getLogger(__name__)
+
+
+class MicroBatcher:
+    """Queue + flush thread in front of a ServingEngine.
+
+    `submit()` returns a Future[ScoreResult]; `score()` is the blocking
+    convenience. Use as a context manager or call `close()` — close drains
+    the queue (pending requests are still answered) and joins the flush
+    thread.
+    """
+
+    def __init__(
+        self,
+        engine: ServingEngine,
+        *,
+        max_batch: Optional[int] = None,
+        max_wait_ms: float = 2.0,
+        latency_window: int = 1 << 20,
+    ):
+        self.engine = engine
+        self.max_batch = int(
+            engine.max_batch if max_batch is None else max_batch
+        )
+        if self.max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {self.max_batch}")
+        if self.max_batch > engine.max_batch:
+            raise ValueError(
+                f"max_batch {self.max_batch} exceeds the engine's declared "
+                f"bucket ceiling {engine.max_batch} (would recompile)"
+            )
+        self.max_wait_s = float(max_wait_ms) / 1e3
+        self._pending: Deque[Tuple[ScoreRequest, Future, float]] = (
+            collections.deque()
+        )
+        self._cv = threading.Condition()
+        self._stop = False
+        self._latencies_ms: Deque[float] = collections.deque(maxlen=latency_window)
+        self._completed = 0
+        self._failed = 0
+        self._degraded = 0  # THIS batcher's degraded batches (the global
+        # faults counter aggregates process-wide and would cross-contaminate
+        # metrics when several engines serve in one process)
+        self._t_first_submit: Optional[float] = None
+        self._t_last_done: Optional[float] = None
+        self._thread = threading.Thread(
+            target=self._flush_loop, name="photon-serving-flush", daemon=True
+        )
+        self._thread.start()
+
+    # ------------------------------------------------------------ lifecycle
+
+    @property
+    def closed(self) -> bool:
+        return self._stop
+
+    def close(self) -> None:
+        """Drain pending requests, stop and JOIN the flush thread."""
+        with self._cv:
+            if self._stop:
+                return
+            self._stop = True
+            self._cv.notify_all()
+        self._thread.join()
+
+    def __enter__(self) -> "MicroBatcher":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
+
+    # -------------------------------------------------------------- scoring
+
+    def submit(self, request: ScoreRequest) -> "Future[ScoreResult]":
+        fut: "Future[ScoreResult]" = Future()
+        now = time.monotonic()
+        with self._cv:
+            if self._stop:
+                raise RuntimeError("MicroBatcher is closed")
+            if self._t_first_submit is None:
+                self._t_first_submit = now
+            self._pending.append((request, fut, now))
+            self._cv.notify_all()
+        return fut
+
+    def score(self, request: ScoreRequest) -> ScoreResult:
+        return self.submit(request).result()
+
+    def score_all(self, requests: Iterable[ScoreRequest]) -> List[ScoreResult]:
+        """Replay helper: submit a stream, wait for every result in order."""
+        futures = [self.submit(r) for r in requests]
+        return [f.result() for f in futures]
+
+    # ----------------------------------------------------------- flush loop
+
+    def _flush_loop(self) -> None:
+        while True:
+            with self._cv:
+                while not self._stop and not self._ripe_locked():
+                    self._cv.wait(timeout=self._wait_timeout_locked())
+                if self._stop and not self._pending:
+                    return
+                # Transition each future to RUNNING as it is claimed; a
+                # client-cancelled future is dropped HERE — once running it
+                # can no longer be cancelled, so the completion paths'
+                # set_result/set_exception cannot race a cancel and blow
+                # InvalidStateError through the flush thread.
+                batch = []
+                while len(batch) < self.max_batch and self._pending:
+                    item = self._pending.popleft()
+                    if item[1].set_running_or_notify_cancel():
+                        batch.append(item)
+            if batch:
+                self._dispatch(batch)
+
+    def _ripe_locked(self) -> bool:
+        if not self._pending:
+            return False
+        if len(self._pending) >= self.max_batch:
+            return True
+        oldest = self._pending[0][2]
+        return (time.monotonic() - oldest) >= self.max_wait_s
+
+    def _wait_timeout_locked(self) -> Optional[float]:
+        if not self._pending:
+            return None  # sleep until a submit/close notifies
+        oldest = self._pending[0][2]
+        return max(0.0, oldest + self.max_wait_s - time.monotonic())
+
+    def _dispatch(self, batch: List[Tuple[ScoreRequest, Future, float]]) -> None:
+        requests = [r for r, _, _ in batch]
+        try:
+            results = self.engine.score_batch(requests)
+        except BaseException as exc:  # noqa: BLE001 - isolated below
+            # ANY mid-batch failure degrades to per-request dispatch:
+            # transient faults (injected, device blip) get the bounded
+            # retry policy inside the fallback, while a non-transient error
+            # (one malformed request poisoning the pack) re-raises
+            # immediately there and fails ONLY the offending request's
+            # future — co-batched healthy requests still get answers.
+            # Batch-size-invariant kernels keep the degraded scores
+            # bitwise-identical to what the batch would have produced.
+            faults.COUNTERS.increment("serving_degraded_batches")
+            with self._cv:
+                self._degraded += 1
+            logger.warning(
+                "batch of %d degraded to per-request dispatch: %s",
+                len(requests),
+                exc,
+            )
+            self._dispatch_degraded(batch)
+            return
+        now = time.monotonic()
+        for (_, fut, t0), res in zip(batch, results):
+            self._complete(fut, res, now - t0)
+
+    def _dispatch_degraded(
+        self, batch: List[Tuple[ScoreRequest, Future, float]]
+    ) -> None:
+        for req, fut, t0 in batch:
+            try:
+                res = faults.retry(
+                    lambda req=req: self.engine.score_batch([req])[0],
+                    label="serving per-request fallback",
+                )
+            except BaseException as exc:  # noqa: BLE001 - surfaced via future
+                with self._cv:
+                    self._failed += 1
+                fut.set_exception(exc)
+                continue
+            self._complete(fut, res, time.monotonic() - t0)
+
+    def _complete(self, fut: Future, res: ScoreResult, wall_s: float) -> None:
+        with self._cv:
+            self._latencies_ms.append(wall_s * 1e3)
+            self._completed += 1
+            self._t_last_done = time.monotonic()
+        fut.set_result(res)
+
+    # -------------------------------------------------------------- metrics
+
+    def metrics(self) -> Dict[str, object]:
+        """One snapshot: request latency percentiles + qps + the engine's
+        counters. Keys are the serving_online bench contract."""
+        with self._cv:
+            lat = np.asarray(self._latencies_ms, np.float64)
+            completed = self._completed
+            failed = self._failed
+            degraded = self._degraded
+            t0, t1 = self._t_first_submit, self._t_last_done
+        out: Dict[str, object] = {
+            "completed": completed,
+            "failed": failed,
+            "degraded_batches": degraded,
+        }
+        if lat.size:
+            p50, p95, p99 = np.percentile(lat, [50.0, 95.0, 99.0])
+            out.update(
+                p50_ms=round(float(p50), 4),
+                p95_ms=round(float(p95), 4),
+                p99_ms=round(float(p99), 4),
+            )
+        else:
+            out.update(p50_ms=None, p95_ms=None, p99_ms=None)
+        wall = (t1 - t0) if (t0 is not None and t1 is not None and t1 > t0) else 0.0
+        out["qps"] = round(completed / wall, 1) if wall > 0 else None
+        out.update(self.engine.metrics())
+        return out

@@ -1,0 +1,338 @@
+"""Serving bundles: a GAME model staged into device memory exactly once.
+
+The offline scoring path (cli/score.py) re-stages the model per job: load
+the Avro artifact, build host matrices, upload shards, score, exit. An
+online engine cannot pay that per request — Snap ML's serving result
+(PAPERS.md) is precisely that keeping model state pinned in accelerator
+memory across requests is where the latency win lives. A `ServingBundle`
+is that pinned state:
+
+  * per fixed-effect coordinate: the effective weight vector, one device
+    array, uploaded at load;
+  * per random-effect coordinate: the dense `(n_entities + 1, dim)`
+    coefficient matrix (row `n_entities` is the pinned zero row — GLMix
+    cold-start semantics: an unknown entity scores with the fixed effects
+    only) plus a host-side entity-id -> row hash index;
+  * optionally the feature index maps, so requests can arrive as
+    (name, term) -> value dicts and be resolved to column indices host-side.
+
+Bundles are built from a persisted model artifact (`from_artifact` /
+`load_bundle` — the production path, original feature space, no
+projector/normalization needed) or directly from an in-memory trained
+model (`from_model` — tests and co-located train+serve; normalization
+passes through to the same margin algebra the transformer uses, but
+projected random-effect coordinates are rejected: serving scores in
+original space, so export through `model_bridge.artifact_from_game_model`
+first, which back-projects).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import os
+import time
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.data.index_map import IndexMap, feature_key
+from photon_ml_tpu.game.model import FixedEffectModel, GameModel, RandomEffectModel
+from photon_ml_tpu.io.model_store import GameModelArtifact
+from photon_ml_tpu.transformers.game_transformer import CoordinateScoringSpec
+from photon_ml_tpu.types import TaskType
+
+Array = jax.Array
+
+# Request feature payload for one shard: a dense (dim,) row, or a sparse
+# (indices, values) pair, or a {feature_key: value} mapping resolved through
+# the bundle's index maps at encode time.
+ShardFeatures = Union[np.ndarray, Tuple[np.ndarray, np.ndarray]]
+
+
+@dataclasses.dataclass
+class ScoreRequest:
+    """One scoring request: per-shard features + per-RE-type entity ids.
+
+    `features[shard]` is a dense (dim,) float row or an (indices, values)
+    sparse pair (duplicate indices accumulate, matching `pack_csr_to_ell`).
+    A shard absent from the mapping scores as an all-zero row. Entity ids
+    missing for a random-effect type are cold starts by definition.
+    """
+
+    features: Dict[str, ShardFeatures] = dataclasses.field(default_factory=dict)
+    entity_ids: Dict[str, object] = dataclasses.field(default_factory=dict)
+    offset: float = 0.0
+    uid: Optional[str] = None
+
+
+@dataclasses.dataclass
+class ServingCoordinate:
+    """One coordinate's device-resident serving state."""
+
+    cid: str
+    shard: str
+    params: Array  # (dim,) fixed-effect weights or (E + 1, dim) RE matrix
+    norm: Optional[object] = None
+    random_effect_type: Optional[str] = None
+    entity_index: Optional[Mapping[object, int]] = None
+
+    @property
+    def is_random_effect(self) -> bool:
+        return self.random_effect_type is not None
+
+    @property
+    def dim(self) -> int:
+        return int(self.params.shape[-1])
+
+    @property
+    def unseen_row(self) -> int:
+        """The pinned zero row unknown entities gather (cold start)."""
+        return int(self.params.shape[0]) - 1
+
+    def lookup_rows(self, entity_ids: Sequence[object]) -> Tuple[np.ndarray, int]:
+        """Resolve entity ids to coefficient rows; id None or unknown ->
+        the pinned zero row. Returns (rows, cold_start_count). Same key
+        coercion as the offline `entity_rows_for_dataset`: persisted
+        artifacts key entities by string, in-memory models may key by int."""
+        index = self.entity_index or {}
+        unseen = self.unseen_row
+        coerce = bool(index) and isinstance(next(iter(index)), str)
+        rows = np.empty(len(entity_ids), np.int32)
+        cold = 0
+        for i, eid in enumerate(entity_ids):
+            if eid is None:
+                rows[i] = unseen
+                cold += 1
+                continue
+            if coerce and not isinstance(eid, str):
+                eid = str(eid)
+            row = index.get(eid, unseen)
+            rows[i] = row
+            cold += row == unseen
+        return rows, cold
+
+
+@dataclasses.dataclass
+class ServingBundle:
+    """Device-pinned GAME model + the host indexes serving needs."""
+
+    task: TaskType
+    coordinates: Dict[str, ServingCoordinate]
+    index_maps: Optional[Mapping[str, IndexMap]] = None
+    # Load-time accounting: bytes shipped to the device and the wall it took
+    # (exactly once — the engine never re-uploads model state per request).
+    upload_bytes: int = 0
+    upload_s: float = 0.0
+
+    @property
+    def coordinate_ids(self) -> List[str]:
+        return list(self.coordinates.keys())
+
+    def shard_dims(self) -> Dict[str, int]:
+        """Feature width per shard consumed by any coordinate."""
+        dims: Dict[str, int] = {}
+        for c in self.coordinates.values():
+            dims[c.shard] = c.dim
+        return dims
+
+    def encode_request(
+        self,
+        features: Mapping[str, Union[ShardFeatures, Mapping[str, float]]],
+        *,
+        entity_ids: Optional[Mapping[str, object]] = None,
+        offset: float = 0.0,
+        uid: Optional[str] = None,
+    ) -> ScoreRequest:
+        """Build a ScoreRequest, resolving {feature_key: value} mappings
+        through the bundle's index maps (unknown features are dropped, as
+        the offline ingest drops features outside the training index)."""
+        enc: Dict[str, ShardFeatures] = {}
+        for shard, payload in features.items():
+            if isinstance(payload, Mapping):
+                if self.index_maps is None or shard not in self.index_maps:
+                    raise ValueError(
+                        f"no index map for shard {shard!r}: named-feature "
+                        "requests need a bundle loaded with index maps"
+                    )
+                imap = self.index_maps[shard]
+                idx: List[int] = []
+                vals: List[float] = []
+                for key, v in payload.items():
+                    j = imap.get_index(key)
+                    if j >= 0:
+                        idx.append(j)
+                        vals.append(float(v))
+                enc[shard] = (
+                    np.asarray(idx, np.int32),
+                    np.asarray(vals, np.float32),
+                )
+            else:
+                enc[shard] = payload
+        return ScoreRequest(
+            features=enc,
+            entity_ids=dict(entity_ids or {}),
+            offset=float(offset),
+            uid=uid,
+        )
+
+    # ------------------------------------------------------------- builders
+
+    @classmethod
+    def from_model(
+        cls,
+        model: GameModel,
+        specs: Mapping[str, CoordinateScoringSpec],
+        task: TaskType,
+        *,
+        index_maps: Optional[Mapping[str, IndexMap]] = None,
+    ) -> "ServingBundle":
+        """Stage an in-memory (model, specs) pair. Projected random-effect
+        coordinates are rejected — serving scores in original feature space
+        (export via model_bridge.artifact_from_game_model, which
+        back-projects, then `from_artifact`)."""
+        t0 = time.perf_counter()
+        coords: Dict[str, ServingCoordinate] = {}
+        nbytes = 0
+        for cid in model.coordinate_ids:
+            spec = specs[cid]
+            m = model[cid]
+            if isinstance(m, FixedEffectModel):
+                params = jnp.asarray(m.coefficients.means, jnp.float32)
+                coords[cid] = ServingCoordinate(
+                    cid, spec.shard, params, norm=spec.norm
+                )
+            elif isinstance(m, RandomEffectModel):
+                if spec.projector is not None:
+                    raise ValueError(
+                        f"coordinate {cid!r} is trained in projected space; "
+                        "serving bundles score in original space — export "
+                        "the artifact (model_bridge.artifact_from_game_model) "
+                        "and build the bundle from it"
+                    )
+                matrix = m.coefficients_matrix
+                # Mesh-padded matrices carry inert all-zero rows past the
+                # logical E + 1; slice them off so unseen_row is the pinned
+                # zero row and the replicated gather is exact.
+                logical = m.num_entities + 1
+                if matrix.shape[0] > logical:
+                    matrix = matrix[:logical]
+                params = jnp.asarray(matrix, jnp.float32)
+                coords[cid] = ServingCoordinate(
+                    cid,
+                    spec.shard,
+                    params,
+                    norm=spec.norm,
+                    random_effect_type=spec.random_effect_type,
+                    entity_index=dict(spec.entity_index or {}),
+                )
+            else:
+                raise TypeError(f"unknown model type {type(m)} for {cid!r}")
+            nbytes += coords[cid].params.size * coords[cid].params.dtype.itemsize
+        # One blocking upload at load: everything after this is pinned.
+        jax.block_until_ready([c.params for c in coords.values()])
+        return cls(
+            task=task,
+            coordinates=coords,
+            index_maps=index_maps,
+            upload_bytes=int(nbytes),
+            upload_s=time.perf_counter() - t0,
+        )
+
+    @classmethod
+    def from_artifact(
+        cls,
+        artifact: GameModelArtifact,
+        *,
+        index_maps: Optional[Mapping[str, IndexMap]] = None,
+    ) -> "ServingBundle":
+        """The production path: persisted artifact (original feature space,
+        string entity ids) -> pinned bundle."""
+        from photon_ml_tpu.io.model_bridge import game_model_from_artifact
+
+        model, specs = game_model_from_artifact(artifact)
+        return cls.from_model(model, specs, artifact.task, index_maps=index_maps)
+
+
+def load_bundle(
+    model_dir: str,
+    *,
+    index_maps: Optional[Mapping[str, IndexMap]] = None,
+) -> ServingBundle:
+    """Load a model directory (the training driver's layout) into a serving
+    bundle. Index maps default to the JSON maps saved beside the model
+    (`<model_dir>/feature-indexes/<shard>.json`), mirroring cli/score.py."""
+    from photon_ml_tpu.io import model_store
+
+    if index_maps is None:
+        index_dir = os.path.join(model_dir, "feature-indexes")
+        index_maps = {
+            os.path.splitext(os.path.basename(p))[0]: IndexMap.load(p)
+            for p in sorted(glob.glob(os.path.join(index_dir, "*.json")))
+        }
+        if not index_maps:
+            raise FileNotFoundError(
+                f"no feature index maps under {index_dir}; pass index_maps "
+                "explicitly (e.g. resolved from an off-heap store)"
+            )
+    artifact = model_store.load_game_model(model_dir, index_maps)
+    return ServingBundle.from_artifact(artifact, index_maps=index_maps)
+
+
+def request_from_record(
+    bundle: ServingBundle,
+    record: Mapping[str, object],
+    shard_configs: Mapping[str, object],
+    *,
+    uid_field: str = "uid",
+    offset_field: str = "offset",
+) -> ScoreRequest:
+    """Reference-shaped Avro record (name/term/value feature bags + id
+    fields) -> ScoreRequest. `shard_configs` maps each shard to its
+    FeatureShardConfig (bag list + intercept), as parsed from the
+    feature-shard DSL — the same config offline ingest applies, so a
+    replayed record builds the same feature row."""
+    features: Dict[str, Dict[str, float]] = {}
+    for shard, cfg in shard_configs.items():
+        fmap: Dict[str, float] = {}
+        for bag in cfg.feature_bags:
+            for ntv in record.get(bag) or ():
+                key = feature_key(ntv.get("name", ""), ntv.get("term", "") or "")
+                # Duplicate (name, term) entries accumulate, as ingest does.
+                fmap[key] = fmap.get(key, 0.0) + float(ntv["value"])
+        if getattr(cfg, "has_intercept", False):
+            from photon_ml_tpu.data.index_map import INTERCEPT_KEY
+
+            fmap[INTERCEPT_KEY] = fmap.get(INTERCEPT_KEY, 0.0) + 1.0
+        features[shard] = fmap
+    # Id-tag resolution mirrors offline ingest EXACTLY (io/avro_data.py:
+    # direct record field, "map.key" dotted path, metadataMap fallback,
+    # and a missing id resolving to the string "" — which ingest treats as
+    # a trainable entity key, NOT a cold start). A replayed record must
+    # gather the same coefficient row the dataset reader would have.
+    def _tag(tag: str) -> str:
+        v = record.get(tag)
+        field, _, map_key = tag.partition(".")
+        if v is None and map_key:
+            inner = record.get(field)
+            if isinstance(inner, Mapping):
+                v = inner.get(map_key)
+        if v is None:
+            meta = record.get("metadataMap")
+            v = meta.get(tag, "") if isinstance(meta, Mapping) else ""
+        return str(v)
+
+    entity_ids = {
+        c.random_effect_type: _tag(c.random_effect_type)
+        for c in bundle.coordinates.values()
+        if c.is_random_effect
+    }
+    uid = record.get(uid_field)
+    return bundle.encode_request(
+        features,
+        entity_ids=entity_ids,
+        offset=float(record.get(offset_field) or 0.0),
+        uid=None if uid is None else str(uid),
+    )

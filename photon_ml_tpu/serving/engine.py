@@ -1,0 +1,396 @@
+"""Online scoring engine: jitted padded-bucket programs over a pinned bundle.
+
+Design constraints (the DrJAX lesson from PAPERS.md — fixed, jit-stable
+program shapes — applied to a serving hot path):
+
+  * The compile set is BOUNDED and declared up front: one XLA program per
+    power-of-two bucket size up to `max_batch`. A batch of n requests pads
+    to the smallest bucket >= n; after `warmup()` has compiled every
+    bucket, a request stream of arbitrary batch sizes triggers ZERO new
+    compiles (`recompiles_after_warmup` in metrics, asserted in tests).
+  * One device round trip per batch: pack host-side, upload the request
+    buffers, dispatch one fused program (all coordinates + link function),
+    fetch (scores, means) together.
+  * Bitwise offline parity: the fused program reuses the transformer's own
+    margin kernels (`dense_margins`, `random_effect_margins`) and sums
+    coordinates in the same order, and those kernels are batch-size
+    invariant (see dense_margins' docstring) — so a request scores
+    bitwise-identically to `GameTransformer.transform` on the same row,
+    whatever bucket it pads into. That also makes scores independent of
+    micro-batch composition, which is what lets the batcher degrade to
+    per-request dispatch under faults without changing any answer.
+  * Cold start: entities absent from the bundle's hash index gather the
+    pinned zero row, i.e. score with the fixed effects (+ offset) only —
+    GLMix's prior-model semantics for unseen entities. Counted per lookup
+    and surfaced per request.
+  * Request buffers are donated to the program on accelerator backends
+    (they are per-batch scratch; donation lets XLA reuse the HBM). Model
+    planes are never donated — they are the bundle's pinned state.
+
+Fault sites: `lookup` (entity-row resolution) and `score` (device
+dispatch), via utils/faults.py. The engine itself raises; degradation
+policy (retry, per-request fallback) lives in the batcher so direct
+callers keep raw failure semantics.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.game.model import random_effect_margins
+from photon_ml_tpu.ops.losses import mean_for_task
+from photon_ml_tpu.serving.bundle import ScoreRequest, ServingBundle
+from photon_ml_tpu.transformers.game_transformer import dense_margins
+from photon_ml_tpu.types import TaskType
+from photon_ml_tpu.utils import faults
+from photon_ml_tpu.utils.observability import TimingRegistry, stage_scope, stage_timer
+
+Array = jax.Array
+
+
+@dataclasses.dataclass
+class ScoreResult:
+    """One answered request: raw summed margin + link-function mean
+    (ScoredGameDatum fields), plus cold-start accounting."""
+
+    score: float
+    mean: float
+    uid: Optional[str] = None
+    cold_start: bool = False  # any random-effect lookup fell back
+    n_cold: int = 0  # how many of the request's RE lookups fell back
+
+
+def _score_program(offsets, shard_feats, rows, params, norms, *, kinds, shards, task):
+    """The fused per-bucket program: offsets + per-coordinate margins (same
+    kernels and summation order as GameTransformer.transform) + link mean.
+
+    Request features arrive as ONE buffer per shard (`shard_feats`), with
+    coordinates resolving their shard by the static `shards` tuple — never
+    as a per-coordinate tuple, which would pass the same device array
+    twice when two coordinates share a shard and make buffer donation
+    alias one buffer to two parameters (undefined on accelerators)."""
+    total = offsets
+    for k, kind in enumerate(kinds):
+        feats = shard_feats[shards[k]]
+        if kind == "fe":
+            total = total + dense_margins(feats, params[k], norms[k])
+        else:
+            total = total + random_effect_margins(
+                feats, rows[k], params[k], norms[k]
+            )
+    return total, mean_for_task(task, total)
+
+
+def _bucket_sizes(max_batch: int) -> Tuple[int, ...]:
+    sizes = []
+    b = 1
+    while b < max_batch:
+        sizes.append(b)
+        b <<= 1
+    sizes.append(max_batch)
+    return tuple(sizes)
+
+
+class ServingEngine:
+    """Scores request batches against a pinned `ServingBundle`.
+
+    Thread-safety: `score_batch` may be called from any thread (the
+    batcher's flush thread, a caller's worker pool); metrics updates are
+    lock-protected. One engine owns one private jit cache, so `compiles`
+    counts exactly this engine's XLA programs.
+    """
+
+    def __init__(
+        self,
+        bundle: ServingBundle,
+        *,
+        max_batch: int = 256,
+        task: Optional[TaskType] = None,
+    ):
+        if max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        self.bundle = bundle
+        self.task = task or bundle.task
+        self.max_batch = int(max_batch)
+        self.buckets = _bucket_sizes(self.max_batch)
+        self._kinds = tuple(
+            "re" if bundle.coordinates[cid].is_random_effect else "fe"
+            for cid in bundle.coordinate_ids
+        )
+        self._coords = [bundle.coordinates[cid] for cid in bundle.coordinate_ids]
+        self._coord_shards = tuple(c.shard for c in self._coords)
+        self._shard_dims = bundle.shard_dims()
+        # Per-engine jit instance = private compile cache, so _cache_size()
+        # is an honest XLA-compile counter for THIS engine. jit caches key
+        # on the underlying callable, and wrappers over the same module
+        # function SHARE entries — a fresh per-engine trampoline keeps this
+        # engine's count isolated from every other engine in the process.
+        def _engine_score_program(*args, **kwargs):
+            return _score_program(*args, **kwargs)
+
+        donate = () if jax.default_backend() == "cpu" else (0, 1, 2)
+        self._jit = jax.jit(
+            _engine_score_program,
+            static_argnames=("kinds", "shards", "task"),
+            donate_argnums=donate,
+        )
+        self.stages = TimingRegistry()
+        self._lock = threading.Lock()
+        self._requests = 0
+        self._batches = 0
+        self._lookups = 0
+        self._cold_lookups = 0
+        self._slots_total = 0
+        self._slots_padded = 0
+        self._warmup_compiles: Optional[int] = None
+        self._dispatched_buckets: set = set()
+        self._t_first: Optional[float] = None
+        self._t_last: Optional[float] = None
+        self._batchers: List[object] = []
+        self._closed = False
+
+    # ----------------------------------------------------------- lifecycle
+
+    def batcher(self, **kwargs) -> "MicroBatcher":  # noqa: F821
+        """Create a MicroBatcher bound to this engine; `close()` joins it."""
+        if self._closed:
+            # close() already ran and will never revisit _batchers — a
+            # batcher created now would leak its flush thread.
+            raise RuntimeError("ServingEngine is closed")
+        from photon_ml_tpu.serving.batcher import MicroBatcher
+
+        b = MicroBatcher(self, **kwargs)
+        self._batchers.append(b)
+        return b
+
+    def close(self) -> None:
+        """Shut down every batcher created via `batcher()` (joining their
+        flush threads). Idempotent. The bundle stays usable — model planes
+        are plain device arrays owned by the bundle, not the engine."""
+        if self._closed:
+            return
+        self._closed = True
+        for b in self._batchers:
+            b.close()
+
+    def __enter__(self) -> "ServingEngine":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
+
+    # ------------------------------------------------------------- scoring
+
+    def bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if b >= n:
+                return b
+        return self.max_batch
+
+    def warmup(self) -> int:
+        """Compile every declared bucket (inert all-cold zero batches that
+        do not count toward request metrics). Returns the compile count;
+        afterwards `recompiles_after_warmup` tracks cache misses — zero for
+        any request stream whose batches fit max_batch."""
+        t0 = time.perf_counter()
+        for b in self.buckets:
+            # inject=False: warmup is not the request path — an armed
+            # lookup/score fault must fire on (and be counted against)
+            # real traffic, not kill engine bring-up.
+            self._dispatch(self._pack([], b, inject=False), inject=False)
+        # Warmup wall (mostly XLA compiles) is recorded under its own stage
+        # key; no ambient scope is open here, so the inner serve_pack/
+        # serve_score timers stay warmup-free.
+        self.stages.record("serve_warmup", time.perf_counter() - t0)
+        compiles = self.compiles
+        with self._lock:
+            self._warmup_compiles = compiles
+        return compiles
+
+    def score_batch(self, requests: Sequence[ScoreRequest]) -> List[ScoreResult]:
+        """Score one micro-batch: pad to the bucket, one device round trip.
+        Batches larger than max_batch split internally."""
+        if not requests:
+            return []
+        if len(requests) > self.max_batch:
+            out: List[ScoreResult] = []
+            for lo in range(0, len(requests), self.max_batch):
+                out.extend(self.score_batch(requests[lo : lo + self.max_batch]))
+            return out
+        n = len(requests)
+        bucket = self.bucket_for(n)
+        with stage_scope(self.stages):
+            packed = self._pack(requests, bucket)
+            scores, means = self._dispatch(packed)
+        flags = packed["cold_flags"]
+        results = [
+            ScoreResult(
+                score=float(scores[i]),
+                mean=float(means[i]),
+                uid=requests[i].uid,
+                cold_start=bool(flags[i].any()),
+                n_cold=int(flags[i].sum()),
+            )
+            for i in range(n)
+        ]
+        now = time.monotonic()
+        with self._lock:
+            self._requests += n
+            self._batches += 1
+            self._lookups += int(flags.size)
+            self._cold_lookups += int(flags.sum())
+            self._slots_total += bucket
+            self._slots_padded += bucket - n
+            if self._t_first is None:
+                self._t_first = now
+            self._t_last = now
+        return results
+
+    # ------------------------------------------------------------ internals
+
+    def _pack(
+        self, requests: Sequence[ScoreRequest], bucket: int, *, inject: bool = True
+    ) -> dict:
+        """Host-side batch assembly: per-shard dense buffers, per-RE-coordinate
+        entity rows (padding slots gather the pinned zero row), offsets."""
+        n = len(requests)
+        with stage_timer("serve_pack"):
+            buffers = {
+                s: np.zeros((bucket, d), np.float32)
+                for s, d in self._shard_dims.items()
+            }
+            offsets = np.zeros(bucket, np.float32)
+            for i, r in enumerate(requests):
+                offsets[i] = r.offset
+                for s, payload in r.features.items():
+                    buf = buffers.get(s)
+                    if buf is None:
+                        continue
+                    if isinstance(payload, tuple):
+                        idx, vals = payload
+                        np.add.at(buf[i], np.asarray(idx, np.int64), vals)
+                    else:
+                        buf[i, :] = payload
+        with stage_timer("serve_lookup"):
+            if inject:
+                faults.fault_point("lookup")
+            re_coords = [c for c in self._coords if c.is_random_effect]
+            cold_flags = np.zeros((n, len(re_coords)), bool)
+            rows_by_cid: Dict[str, np.ndarray] = {}
+            for k, c in enumerate(re_coords):
+                ids = [r.entity_ids.get(c.random_effect_type) for r in requests]
+                rows, _ = c.lookup_rows(ids)
+                cold_flags[:, k] = rows == c.unseen_row
+                padded = np.full(bucket, c.unseen_row, np.int32)
+                padded[:n] = rows
+                rows_by_cid[c.cid] = padded
+        return {
+            "bucket": bucket,
+            "buffers": buffers,
+            "offsets": offsets,
+            "rows_by_cid": rows_by_cid,
+            "cold_flags": cold_flags,
+        }
+
+    def _dispatch(
+        self, packed: dict, *, inject: bool = True
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Upload request buffers, run the fused program, fetch both outputs
+        in one transfer."""
+        with stage_timer("serve_score"):
+            if inject:
+                faults.fault_point("score")
+            dev_buffers = {
+                s: jnp.asarray(b) for s, b in packed["buffers"].items()
+            }
+            rows = tuple(
+                jnp.asarray(packed["rows_by_cid"][c.cid])
+                if c.is_random_effect
+                else None
+                for c in self._coords
+            )
+            params = tuple(c.params for c in self._coords)
+            norms = tuple(c.norm for c in self._coords)
+            total, means = self._jit(
+                jnp.asarray(packed["offsets"]),
+                dev_buffers,
+                rows,
+                params,
+                norms,
+                kinds=self._kinds,
+                shards=self._coord_shards,
+                task=self.task,
+            )
+            host_total, host_means = jax.device_get((total, means))
+        with self._lock:
+            self._dispatched_buckets.add(packed["bucket"])
+        return np.asarray(host_total), np.asarray(host_means)
+
+    # -------------------------------------------------------------- metrics
+
+    @property
+    def compiles(self) -> int:
+        """XLA programs compiled by THIS engine: the jit wrapper's cache
+        size (an honest compile count), falling back to the number of
+        distinct bucket shapes dispatched if the private cache API ever
+        goes away (same value whenever each bucket is one program)."""
+        try:
+            return int(self._jit._cache_size())
+        except AttributeError:
+            with self._lock:
+                return len(self._dispatched_buckets)
+
+    @property
+    def recompiles_after_warmup(self) -> Optional[int]:
+        """Compiles since warmup(), or None when warmup never ran — a 0
+        here must MEAN zero hot-path compiles, not 'nobody measured'; an
+        un-warmed engine compiling on live traffic has no baseline to
+        count from, and None trips the bench's missing-key contract."""
+        with self._lock:
+            base = self._warmup_compiles
+        return None if base is None else max(0, self.compiles - base)
+
+    def metrics(self) -> Dict[str, object]:
+        """Engine-side counters; the batcher's metrics() merges these with
+        request latency percentiles."""
+        compiles = self.compiles  # before the lock: the fallback path locks
+        with self._lock:
+            lookups = self._lookups
+            cold = self._cold_lookups
+            slots = self._slots_total
+            padded = self._slots_padded
+            elapsed = (
+                (self._t_last - self._t_first)
+                if self._t_first is not None and self._t_last > self._t_first
+                else 0.0
+            )
+            out = {
+                "requests": self._requests,
+                "batches": self._batches,
+                "cold_start_lookups": cold,
+                "cold_start_fraction": (cold / lookups) if lookups else 0.0,
+                "padding_waste": (padded / slots) if slots else 0.0,
+                "compiles": compiles,
+                "recompiles_after_warmup": (
+                    None
+                    if self._warmup_compiles is None
+                    else max(0, compiles - self._warmup_compiles)
+                ),
+                "upload_bytes": self.bundle.upload_bytes,
+                "upload_s": round(self.bundle.upload_s, 4),
+                "engine_qps": (
+                    round(self._requests / elapsed, 1) if elapsed > 0 else None
+                ),
+            }
+        out["stage_walls_s"] = {
+            k: round(v, 4) for k, v in sorted(self.stages.sections.items())
+        }
+        return out

@@ -200,12 +200,33 @@ def _entity_sharded_mesh(matrix):
     return leading_axis_mesh(matrix, require_divisible=True)
 
 
+def dense_margins(features: Array, w: Array, norm) -> Array:
+    """Row-stable dense margins: multiply-broadcast + per-row reduction
+    instead of the matvec `features @ w`. The matvec's CPU/TPU lowering picks
+    blocking by the BATCH dimension, so the same row can score differently at
+    different batch sizes (observed 2e-6 drift on CPU between a 7-row and a
+    padded 16-row call); the per-row reduction's within-row order is fixed
+    regardless of how many rows ride along. That batch-size invariance is
+    what lets the online serving engine score padded power-of-two buckets
+    bitwise-identically to this offline path (serving/engine.py), and makes
+    a request's score independent of which micro-batch it lands in. Margins
+    are bandwidth-bound (one multiply-add per X element), so giving up the
+    matvec costs little. jit-traceable; shared by `_fe_margins` and the
+    serving engine's fused program — keep both on this one code path."""
+    w_eff, shift = objective.margin_params(w, norm)
+    return jnp.sum(features * w_eff, axis=-1) + shift
+
+
 @jax.jit
 def _fe_margins(features: Features, w: Array, norm) -> Array:
     # `features` may be an ELL SparseFeatures (either layout), a dense
     # matrix, or the trained coordinate's BucketedSparseFeatures
     # (training_prepared's preference) — all three expose the logical
-    # (n_rows, dim) via .shape, and compute_margins handles each.
+    # (n_rows, dim) via .shape, and compute_margins handles each. Dense
+    # matrices take the row-stable path (see `dense_margins`); the sparse
+    # layouts' gather + per-row-K reductions are already batch-invariant.
+    if isinstance(features, (jax.Array, np.ndarray)):
+        return dense_margins(features, w, norm)
     n = features.shape[0]
     zeros = jnp.zeros((n,), w.dtype)
     return objective.compute_margins(w, LabeledData(features, zeros, zeros, zeros), norm)

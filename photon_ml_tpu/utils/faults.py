@@ -34,7 +34,8 @@ module is the shared machinery:
 
 * `COUNTERS` — process-wide robustness event counters (`retries`,
   `fallback_sync_uploads`, `fallback_sync_builds`, `fallback_sync_packs`,
-  `injected_faults`). Zero on a clean run by construction, so a nonzero
+  `injected_faults`, `serving_degraded_batches`). Zero on a clean run by
+  construction, so a nonzero
   value in a bench artifact (bench.py e2e_from_disk) is a loud robustness
   regression signal, and tests assert exact counts.
 
@@ -60,7 +61,18 @@ logger = logging.getLogger(__name__)
 # string (the registry is open for future subsystems), but plans naming an
 # unknown site fail fast at parse time — a typo'd PHOTON_FAULTS that
 # silently injects nothing would be a chaos test that tests nothing.
-KNOWN_SITES = ("decode", "pack", "upload", "solve", "checkpoint_write")
+KNOWN_SITES = (
+    "decode",
+    "pack",
+    "upload",
+    "solve",
+    "checkpoint_write",
+    # Online serving (serving/engine.py): entity-row resolution and the
+    # batched device dispatch. The micro-batcher degrades a faulted batch
+    # to per-request dispatch (serving/batcher.py) instead of dying.
+    "lookup",
+    "score",
+)
 
 
 class InjectedFault(RuntimeError):

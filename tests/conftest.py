@@ -41,6 +41,10 @@ def pytest_configure(config):
         "markers",
         "chaos: failure-domain tests (fault injection, kill-resume parity)",
     )
+    config.addinivalue_line(
+        "markers",
+        "serving: online serving engine tests (bundle/engine/batcher)",
+    )
 
 
 @pytest.fixture
@@ -61,7 +65,10 @@ def _failure_domain_hygiene(monkeypatch):
     * no `photon-async-upload` thread outlives the test that spawned it —
       AsyncUploader workers are per-job and must drain once their job
       completes; a lingering one means a job wedged (or a future leaked)
-      and would make later tests' upload behavior order-dependent.
+      and would make later tests' upload behavior order-dependent;
+    * no `photon-serving-flush` thread outlives the test — a MicroBatcher's
+      flush thread must be joined by engine/batcher close(); a survivor
+      means serving work kept running against a torn-down fixture.
     """
     from photon_ml_tpu.utils import faults
 
@@ -84,9 +91,10 @@ def _failure_domain_hygiene(monkeypatch):
         leaked = [
             t
             for t in threading.enumerate()
-            if t.name.startswith("photon-async-upload") and t.is_alive()
+            if t.name.startswith(("photon-async-upload", "photon-serving-flush"))
+            and t.is_alive()
         ]
         if not leaked:
             break
         time.sleep(0.02)
-    assert not leaked, f"leaked async-upload threads: {leaked}"
+    assert not leaked, f"leaked async-upload/serving-flush threads: {leaked}"

@@ -195,6 +195,66 @@ class TestDriversEndToEnd:
         items = load_scores(os.path.join(score_out, "scores"))
         assert len(items) == 200 and items[0].uid.startswith("uid")
 
+        # Replay the same records through the ONLINE serving driver: same
+        # model, same feature DSL — per-uid scores must agree with the
+        # offline driver (approx, not bitwise: offline ingest scores the
+        # ELL sparse layout, the engine densifies request rows, so the
+        # per-row reduction ranges differ).
+        from photon_ml_tpu.cli import serve as serve_cli
+        serve_out = str(tmp_path / "served")
+        serve_cli.main([
+            "--model-input-directory", best,
+            "--requests", val_avro,
+            "--root-output-directory", serve_out,
+            "--feature-shard-configurations",
+            "name=globalShard,feature.bags=features,intercept=true",
+            "--max-batch", "32",
+            "--max-wait-ms", "1",
+        ])
+        served = {
+            it.uid: it.prediction_score
+            for it in load_scores(os.path.join(serve_out, "scores"))
+        }
+        offline = {it.uid: it.prediction_score for it in items}
+        assert set(served) == set(offline)
+        for uid, s in served.items():
+            assert s == pytest.approx(offline[uid], rel=1e-4, abs=1e-5)
+        ssummary = json.load(
+            open(os.path.join(serve_out, "serving-summary.json"))
+        )
+        assert ssummary["num_requests"] == 200
+        m = ssummary["serving"]
+        assert m["completed"] == 200
+        assert m["recompiles_after_warmup"] == 0
+        assert m["degraded_batches"] == 0
+        # Validation entities were all seen at training time: no cold starts.
+        assert m["cold_start_fraction"] == 0.0
+
+        # JSON-lines replay: named features resolved through the model's
+        # index maps.
+        jsonl = str(tmp_path / "requests.jsonl")
+        with open(jsonl, "w") as f:
+            f.write(json.dumps({
+                "uid": "j0",
+                "ids": {"memberId": "m1"},
+                "features": {"globalShard": {"f0": 1.0, "(INTERCEPT)": 1.0}},
+            }) + "\n")
+            f.write(json.dumps({
+                "uid": "j1",
+                "ids": {"memberId": "never-seen"},
+                "features": {"globalShard": {"f1": -1.0, "(INTERCEPT)": 1.0}},
+            }) + "\n")
+        serve_out2 = str(tmp_path / "served-jsonl")
+        serve_cli.main([
+            "--model-input-directory", best,
+            "--requests", jsonl,
+            "--root-output-directory", serve_out2,
+            "--max-batch", "4",
+        ])
+        jm = json.load(open(os.path.join(serve_out2, "serving-summary.json")))
+        assert jm["num_requests"] == 2
+        assert jm["serving"]["cold_start_lookups"] == 1
+
     def test_warm_start_and_partial_retrain(self, tmp_path):
         train_avro = str(tmp_path / "train.avro")
         _write_glmix_avro(train_avro, 0, 300)

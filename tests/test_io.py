@@ -254,6 +254,51 @@ def test_score_store_roundtrip(tmp_path, rng):
     assert by_uid["u3"].ids["userId"] == "user3"
 
 
+def test_score_store_roundtrip_missing_fields(tmp_path, rng):
+    """ScoredItems with every optional field absent (uid/label/weight/ids)
+    must round-trip — the schema's nullable unions, not just the fully
+    populated shape the test above exercises."""
+    n = 17
+    scores = rng.normal(size=n)
+    out = str(tmp_path / "scores")
+    count = save_scores(out, scores, "bare-model", chunk_size=5)
+    assert count == n
+    items = load_scores(out)
+    assert len(items) == n
+    for i, it in enumerate(items):
+        np.testing.assert_allclose(it.prediction_score, scores[i])
+        assert it.uid is None
+        assert it.label is None
+        assert it.weight is None
+        assert it.ids == {}
+
+
+def test_score_store_chunked_matches_whole(tmp_path, rng):
+    """The fixed-size-chunk record stream is a pure refactor: chunked and
+    chunk-size-1 writes produce identical records, device (jax) columns
+    included."""
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.io.score_store import score_records
+
+    n = 23
+    scores = jnp.asarray(rng.normal(size=n).astype(np.float32))
+    labels = rng.uniform(size=n)
+    uids = np.asarray([f"u{i}" for i in range(n)])
+    a = list(
+        score_records(scores, "m", uids=uids, labels=labels, chunk_size=7)
+    )
+    b = list(
+        score_records(scores, "m", uids=uids, labels=labels, chunk_size=1)
+    )
+    assert a == b
+    assert len(a) == n
+    assert a[0]["uid"] == "u0" and a[0]["label"] == labels[0]
+    # Degenerate chunk sizes clamp to 1 instead of silently yielding nothing.
+    assert len(list(score_records(scores, "m", chunk_size=0))) == n
+    assert len(list(score_records(scores, "m", chunk_size=-3))) == n
+
+
 # ---------------------------------------------------------------------------
 # Training data reader
 

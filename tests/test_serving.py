@@ -1,0 +1,564 @@
+"""Online serving engine: bundle staging, bucketed scoring, micro-batching.
+
+The load-bearing contract is OFFLINE/ONLINE PARITY: every score the engine
+(or the batcher, or the fault-degraded per-request fallback) produces must
+be bitwise-identical to `GameTransformer.transform` on the same rows. The
+engine's kernels are batch-size invariant by construction (see
+`dense_margins`), so the tests exercise the shapes that would break a
+naive port: odd batch sizes padding into different buckets, duplicate
+entities in one batch, all-cold-start batches, and injected faults at the
+lookup/score sites.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.data.game_dataset import GameDataset
+from photon_ml_tpu.game.model import (
+    Coefficients,
+    FixedEffectModel,
+    GameModel,
+    RandomEffectModel,
+)
+from photon_ml_tpu.serving import (
+    MicroBatcher,
+    ScoreRequest,
+    ServingBundle,
+    ServingEngine,
+    load_bundle,
+)
+from photon_ml_tpu.transformers.game_transformer import (
+    CoordinateScoringSpec,
+    GameTransformer,
+)
+from photon_ml_tpu.types import TaskType
+from photon_ml_tpu.utils import faults
+
+pytestmark = pytest.mark.serving
+
+TASK = TaskType.LOGISTIC_REGRESSION
+D_FE, D_RE, N_ENTITIES = 12, 5, 6
+
+
+def _fixture(rng, n=13, entity_ids=None):
+    """(model, specs, dataset, requests): one FE + one RE coordinate over
+    dense shards, some entities unseen (cold starts)."""
+    X = rng.normal(size=(n, D_FE)).astype(np.float32)
+    Xe = rng.normal(size=(n, D_RE)).astype(np.float32)
+    if entity_ids is None:
+        entity_ids = rng.integers(0, N_ENTITIES + 3, size=n)  # some >= E: cold
+    entity_ids = np.asarray(entity_ids)
+    offsets = rng.normal(size=n).astype(np.float32)
+    w = rng.normal(size=D_FE).astype(np.float32)
+    matrix = np.zeros((N_ENTITIES + 1, D_RE), np.float32)
+    matrix[:N_ENTITIES] = rng.normal(size=(N_ENTITIES, D_RE))
+    model = GameModel(
+        {
+            "fixed": FixedEffectModel(Coefficients(jnp.asarray(w)), TASK),
+            "per-e": RandomEffectModel(jnp.asarray(matrix), None, TASK),
+        }
+    )
+    specs = {
+        "fixed": CoordinateScoringSpec(shard="g"),
+        "per-e": CoordinateScoringSpec(
+            shard="re",
+            random_effect_type="eid",
+            entity_index={str(i): i for i in range(N_ENTITIES)},
+        ),
+    }
+    ds = GameDataset.build(
+        {"g": X, "re": Xe},
+        np.zeros(n, np.float32),
+        offsets=offsets,
+        id_tags={"eid": entity_ids.astype(str)},
+    )
+    requests = [
+        ScoreRequest(
+            features={"g": X[i], "re": Xe[i]},
+            entity_ids={"eid": str(entity_ids[i])},
+            offset=float(offsets[i]),
+            uid=str(i),
+        )
+        for i in range(n)
+    ]
+    return model, specs, ds, requests
+
+
+def _scores(results):
+    return np.asarray([r.score for r in results], np.float32)
+
+
+def _means(results):
+    return np.asarray([r.mean for r in results], np.float32)
+
+
+class TestEngineParity:
+    def test_engine_matches_transformer_bitwise(self, rng):
+        model, specs, ds, reqs = _fixture(rng)
+        ref = GameTransformer(model, specs, TASK).transform(ds)
+        with ServingEngine(
+            ServingBundle.from_model(model, specs, TASK), max_batch=16
+        ) as eng:
+            res = eng.score_batch(reqs)
+        assert (_scores(res) == np.asarray(ref.scores)).all()
+        assert (_means(res) == np.asarray(ref.means)).all()
+
+    def test_every_bucket_size_matches(self, rng):
+        """The same rows must score identically from ANY bucket — the
+        batch-invariance that makes micro-batch composition irrelevant."""
+        model, specs, ds, reqs = _fixture(rng, n=8)
+        ref = np.asarray(GameTransformer(model, specs, TASK).transform(ds).scores)
+        with ServingEngine(
+            ServingBundle.from_model(model, specs, TASK), max_batch=32
+        ) as eng:
+            # One per batch (bucket 1), pairs (bucket 2), odd triple
+            # (bucket 4), all 8 (bucket 8).
+            singles = np.concatenate(
+                [_scores(eng.score_batch([r])) for r in reqs]
+            )
+            pairs = np.concatenate(
+                [_scores(eng.score_batch(reqs[i : i + 2])) for i in range(0, 8, 2)]
+            )
+            triple = _scores(eng.score_batch(reqs[:3]))
+            full = _scores(eng.score_batch(reqs))
+        assert (singles == ref).all()
+        assert (pairs == ref).all()
+        assert (triple == ref[:3]).all()
+        assert (full == ref).all()
+
+    def test_duplicate_entities_in_one_batch(self, rng):
+        ids = np.asarray([2, 2, 2, 0, 2, 1, 1])
+        model, specs, ds, reqs = _fixture(rng, n=7, entity_ids=ids)
+        ref = np.asarray(GameTransformer(model, specs, TASK).transform(ds).scores)
+        with ServingEngine(
+            ServingBundle.from_model(model, specs, TASK), max_batch=8
+        ) as eng:
+            assert (_scores(eng.score_batch(reqs)) == ref).all()
+
+    def test_all_cold_start_batch_is_fixed_effect_only(self, rng):
+        """Unknown entities score with the fixed effects + offset only —
+        GLMix's prior-model semantics (the pinned zero row)."""
+        ids = np.asarray([99, 100, 101, 102])
+        model, specs, ds, reqs = _fixture(rng, n=4, entity_ids=ids)
+        ref = np.asarray(GameTransformer(model, specs, TASK).transform(ds).scores)
+        fe_only = GameModel({"fixed": model["fixed"]})
+        ds_fe = GameDataset.build(
+            {"g": np.asarray(ds.shards["g"])},
+            np.zeros(4, np.float32),
+            offsets=np.asarray(ds.offsets),
+        )
+        fe_ref = np.asarray(
+            GameTransformer(fe_only, {"fixed": specs["fixed"]}, TASK)
+            .transform(ds_fe)
+            .scores
+        )
+        with ServingEngine(
+            ServingBundle.from_model(model, specs, TASK), max_batch=8
+        ) as eng:
+            res = eng.score_batch(reqs)
+        assert all(r.cold_start for r in res)
+        assert all(r.n_cold == 1 for r in res)
+        assert (_scores(res) == ref).all()
+        assert (_scores(res) == fe_ref).all()
+
+    def test_missing_entity_id_is_cold(self, rng):
+        model, specs, _, _ = _fixture(rng, n=2)
+        req = ScoreRequest(
+            features={
+                "g": np.zeros(D_FE, np.float32),
+                "re": np.ones(D_RE, np.float32),
+            }
+        )
+        with ServingEngine(
+            ServingBundle.from_model(model, specs, TASK), max_batch=4
+        ) as eng:
+            res = eng.score_batch([req])[0]
+        assert res.cold_start
+
+    def test_shared_shard_coordinates_match(self, rng):
+        """Two coordinates reading the SAME feature shard (the train-CLI's
+        default GLMix config): the engine ships one buffer per shard, and
+        scores still match the transformer bitwise."""
+        n = 7
+        X = rng.normal(size=(n, D_RE)).astype(np.float32)
+        ids = rng.integers(0, N_ENTITIES, size=n)
+        w = rng.normal(size=D_RE).astype(np.float32)
+        matrix = np.zeros((N_ENTITIES + 1, D_RE), np.float32)
+        matrix[:N_ENTITIES] = rng.normal(size=(N_ENTITIES, D_RE))
+        model = GameModel(
+            {
+                "fixed": FixedEffectModel(Coefficients(jnp.asarray(w)), TASK),
+                "per-e": RandomEffectModel(jnp.asarray(matrix), None, TASK),
+            }
+        )
+        specs = {
+            "fixed": CoordinateScoringSpec(shard="g"),
+            "per-e": CoordinateScoringSpec(
+                shard="g",
+                random_effect_type="eid",
+                entity_index={str(i): i for i in range(N_ENTITIES)},
+            ),
+        }
+        ds = GameDataset.build(
+            {"g": X}, np.zeros(n, np.float32), id_tags={"eid": ids.astype(str)}
+        )
+        ref = np.asarray(GameTransformer(model, specs, TASK).transform(ds).scores)
+        reqs = [
+            ScoreRequest(features={"g": X[i]}, entity_ids={"eid": str(ids[i])})
+            for i in range(n)
+        ]
+        with ServingEngine(
+            ServingBundle.from_model(model, specs, TASK), max_batch=8
+        ) as eng:
+            assert (_scores(eng.score_batch(reqs)) == ref).all()
+
+    def test_oversized_batch_splits(self, rng):
+        model, specs, ds, reqs = _fixture(rng, n=13)
+        ref = np.asarray(GameTransformer(model, specs, TASK).transform(ds).scores)
+        with ServingEngine(
+            ServingBundle.from_model(model, specs, TASK), max_batch=4
+        ) as eng:
+            assert (_scores(eng.score_batch(reqs)) == ref).all()
+
+
+class TestCompileSet:
+    def test_zero_recompiles_after_warmup(self, rng):
+        model, specs, _, reqs = _fixture(rng, n=13)
+        with ServingEngine(
+            ServingBundle.from_model(model, specs, TASK), max_batch=16
+        ) as eng:
+            assert eng.buckets == (1, 2, 4, 8, 16)
+            n_programs = eng.warmup()
+            assert n_programs == len(eng.buckets)
+            # Varying batch sizes, including ones that pad: no new programs.
+            for size in (1, 3, 13, 7, 2, 16, 5, 11):
+                eng.score_batch(reqs[:size])
+            assert eng.recompiles_after_warmup == 0
+            assert eng.metrics()["recompiles_after_warmup"] == 0
+
+    def test_padding_waste_accounted(self, rng):
+        model, specs, _, reqs = _fixture(rng, n=13)
+        with ServingEngine(
+            ServingBundle.from_model(model, specs, TASK), max_batch=16
+        ) as eng:
+            eng.score_batch(reqs[:3])  # bucket 4: 1 padded slot
+            m = eng.metrics()
+        assert m["padding_waste"] == pytest.approx(0.25)
+
+
+class TestBatcher:
+    def test_batcher_matches_transformer_bitwise(self, rng):
+        model, specs, ds, reqs = _fixture(rng, n=13)
+        ref = np.asarray(GameTransformer(model, specs, TASK).transform(ds).scores)
+        with ServingEngine(
+            ServingBundle.from_model(model, specs, TASK), max_batch=4
+        ) as eng:
+            with eng.batcher(max_wait_ms=1.0) as b:
+                assert (_scores(b.score_all(reqs)) == ref).all()
+                m = b.metrics()
+        assert m["completed"] == 13
+        assert m["p50_ms"] is not None and m["p99_ms"] is not None
+        assert m["degraded_batches"] == 0
+
+    def test_deadline_flushes_partial_batch(self, rng):
+        """A lone request must not wait for max_batch peers — the deadline
+        bound flushes it."""
+        model, specs, _, reqs = _fixture(rng, n=2)
+        with ServingEngine(
+            ServingBundle.from_model(model, specs, TASK), max_batch=64
+        ) as eng:
+            with eng.batcher(max_wait_ms=5.0) as b:
+                t0 = time.monotonic()
+                res = b.score(reqs[0])
+                wall = time.monotonic() - t0
+        assert isinstance(res.score, float)
+        assert wall < 5.0  # flushed by deadline, not wedged forever
+
+    def test_flush_thread_joined_on_engine_close(self, rng):
+        model, specs, _, _ = _fixture(rng, n=2)
+        eng = ServingEngine(ServingBundle.from_model(model, specs, TASK), max_batch=4)
+        b = eng.batcher(max_wait_ms=1.0)
+        assert any(
+            t.name == "photon-serving-flush" for t in threading.enumerate()
+        )
+        eng.close()
+        assert b.closed
+        assert not any(
+            t.name == "photon-serving-flush" and t.is_alive()
+            for t in threading.enumerate()
+        )
+
+    def test_close_drains_pending(self, rng):
+        model, specs, _, reqs = _fixture(rng, n=13)
+        eng = ServingEngine(ServingBundle.from_model(model, specs, TASK), max_batch=4)
+        b = eng.batcher(max_wait_ms=10_000.0)  # deadline never fires
+        futures = [b.submit(r) for r in reqs[:3]]  # below max_batch
+        eng.close()  # must answer the stragglers, then join
+        assert all(isinstance(f.result(timeout=5).score, float) for f in futures)
+
+    def test_submit_after_close_raises(self, rng):
+        model, specs, _, reqs = _fixture(rng, n=2)
+        eng = ServingEngine(ServingBundle.from_model(model, specs, TASK), max_batch=4)
+        b = eng.batcher()
+        eng.close()
+        with pytest.raises(RuntimeError):
+            b.submit(reqs[0])
+        # A batcher created after close would leak its flush thread (the
+        # idempotent close() never revisits _batchers) — refused.
+        with pytest.raises(RuntimeError):
+            eng.batcher()
+
+    def test_cancelled_future_does_not_kill_flush_thread(self, rng):
+        """A client cancelling a queued request must not blow
+        InvalidStateError through the flush thread — later requests still
+        get answers."""
+        model, specs, _, reqs = _fixture(rng, n=13)
+        with ServingEngine(
+            ServingBundle.from_model(model, specs, TASK), max_batch=4
+        ) as eng:
+            with eng.batcher(max_wait_ms=60_000.0, max_batch=4) as b:
+                doomed = b.submit(reqs[0])  # deadline far away: still queued
+                assert doomed.cancel()
+                later = [b.submit(r) for r in reqs[1:5]]  # fills max_batch
+                results = [f.result(timeout=5) for f in later]
+        assert all(isinstance(r.score, float) for r in results)
+        assert doomed.cancelled()
+
+    def test_batcher_rejects_oversized_max_batch(self, rng):
+        model, specs, _, _ = _fixture(rng, n=2)
+        with ServingEngine(
+            ServingBundle.from_model(model, specs, TASK), max_batch=4
+        ) as eng:
+            with pytest.raises(ValueError):
+                eng.batcher(max_batch=8)
+            # A zero/negative batch bound would busy-spin the flush loop
+            # forming empty batches and deadlock close(); rejected up front.
+            with pytest.raises(ValueError):
+                eng.batcher(max_batch=0)
+            with pytest.raises(ValueError):
+                eng.batcher(max_batch=-1)
+
+
+@pytest.mark.chaos
+class TestServingFaultDomain:
+    def test_score_fault_degrades_bitwise(self, rng):
+        """An injected device-dispatch fault degrades the batch to
+        per-request dispatch; answers stay bitwise-identical and the
+        degradation is counted."""
+        model, specs, ds, reqs = _fixture(rng, n=9)
+        ref = np.asarray(GameTransformer(model, specs, TASK).transform(ds).scores)
+        with ServingEngine(
+            ServingBundle.from_model(model, specs, TASK), max_batch=16
+        ) as eng:
+            eng.warmup()
+            with faults.inject("score:1"):
+                with eng.batcher(max_wait_ms=1.0) as b:
+                    res = b.score_all(reqs)
+        assert (_scores(res) == ref).all()
+        assert faults.COUNTERS.get("serving_degraded_batches") == 1
+        assert faults.COUNTERS.get("injected_faults") >= 1
+
+    def test_lookup_fault_degrades_bitwise(self, rng):
+        model, specs, ds, reqs = _fixture(rng, n=9)
+        ref = np.asarray(GameTransformer(model, specs, TASK).transform(ds).scores)
+        with ServingEngine(
+            ServingBundle.from_model(model, specs, TASK), max_batch=16
+        ) as eng:
+            with faults.inject("lookup:1"):
+                with eng.batcher(max_wait_ms=1.0) as b:
+                    res = b.score_all(reqs)
+        assert (_scores(res) == ref).all()
+        assert faults.COUNTERS.get("serving_degraded_batches") == 1
+
+    def test_odd_sizes_and_cold_under_probability_faults(self, rng):
+        """Sustained seeded fault pressure at both sites: every answer
+        still bitwise-matches the offline transformer."""
+        ids = np.asarray([0, 99, 1, 1, 99, 2, 3])  # duplicates + cold mixed
+        model, specs, ds, reqs = _fixture(rng, n=7, entity_ids=ids)
+        ref = np.asarray(GameTransformer(model, specs, TASK).transform(ds).scores)
+        with ServingEngine(
+            ServingBundle.from_model(model, specs, TASK), max_batch=4
+        ) as eng:
+            with faults.inject("score:p0.3,lookup:p0.2", seed=7):
+                with eng.batcher(max_wait_ms=1.0) as b:
+                    res = b.score_all(reqs)
+        assert (_scores(res) == ref).all()
+
+    def test_warmup_immune_to_armed_faults(self, rng):
+        """Warmup is bring-up, not traffic: an armed lookup/score fault must
+        neither kill it nor be consumed by it — the scheduled fault fires on
+        the first REAL batch (which then degrades, bitwise-unchanged)."""
+        model, specs, ds, reqs = _fixture(rng, n=5)
+        ref = np.asarray(GameTransformer(model, specs, TASK).transform(ds).scores)
+        with faults.inject("score:1,lookup:1"):
+            with ServingEngine(
+                ServingBundle.from_model(model, specs, TASK), max_batch=8
+            ) as eng:
+                eng.warmup()  # would raise if warmup consumed the fault
+                with eng.batcher(max_wait_ms=1.0) as b:
+                    res = b.score_all(reqs)
+        assert (_scores(res) == ref).all()
+        assert faults.COUNTERS.get("serving_degraded_batches") >= 1
+
+    def test_non_transient_error_fails_futures_not_thread(self, rng):
+        model, specs, _, reqs = _fixture(rng, n=3)
+        eng = ServingEngine(ServingBundle.from_model(model, specs, TASK), max_batch=4)
+        boom = ValueError("programming error")
+
+        def broken(requests):
+            raise boom
+
+        eng.score_batch = broken  # type: ignore[assignment]
+        with eng.batcher(max_wait_ms=1.0) as b:
+            fut = b.submit(reqs[0])
+            with pytest.raises(ValueError):
+                fut.result(timeout=5)
+            assert b.metrics()["failed"] == 1
+        eng.close()
+
+
+class TestBundle:
+    def test_projected_coordinate_rejected(self, rng):
+        model, specs, _, _ = _fixture(rng, n=2)
+        specs = dict(specs)
+        specs["per-e"] = CoordinateScoringSpec(
+            shard="re",
+            random_effect_type="eid",
+            entity_index=specs["per-e"].entity_index,
+            projector=object(),
+        )
+        with pytest.raises(ValueError, match="projected space"):
+            ServingBundle.from_model(model, specs, TASK)
+
+    def test_artifact_save_load_serve_parity(self, rng, tmp_path):
+        """The production path: save the artifact (model store layout +
+        feature-index JSONs, as the training driver does), `load_bundle`,
+        and serve — bitwise-identical to a transformer built from the same
+        reloaded artifact."""
+        import os
+
+        from photon_ml_tpu.data.index_map import IndexMap
+        from photon_ml_tpu.io import model_bridge, model_store
+
+        model, specs, ds, reqs = _fixture(rng, n=9)
+        index_maps = {
+            "g": IndexMap.from_feature_names([f"f{i}" for i in range(D_FE)]),
+            "re": IndexMap.from_feature_names([f"r{i}" for i in range(D_RE)]),
+        }
+        art = model_bridge.artifact_from_game_model(model, specs, TASK)
+        mdir = tmp_path / "model"
+        model_store.save_game_model(str(mdir), art, index_maps)
+        idx_dir = mdir / "feature-indexes"
+        os.makedirs(idx_dir)
+        for shard, imap in index_maps.items():
+            imap.save(str(idx_dir / f"{shard}.json"))
+
+        bundle = load_bundle(str(mdir))
+        art2 = model_store.load_game_model(str(mdir), index_maps)
+        model2, specs2 = model_bridge.game_model_from_artifact(art2)
+        ref = np.asarray(GameTransformer(model2, specs2, art2.task).transform(ds).scores)
+        with ServingEngine(bundle, max_batch=16) as eng:
+            assert (_scores(eng.score_batch(reqs)) == ref).all()
+        assert bundle.upload_bytes > 0
+
+    def test_encode_request_named_features(self, rng):
+        from photon_ml_tpu.data.index_map import IndexMap
+
+        model, specs, _, _ = _fixture(rng, n=2)
+        imap = IndexMap.from_feature_names([f"f{i}" for i in range(D_FE)])
+        bundle = ServingBundle.from_model(
+            model, specs, TASK, index_maps={"g": imap}
+        )
+        req = bundle.encode_request(
+            {"g": {"f0": 1.5, "f3": -2.0, "nope": 9.0}}, uid="x"
+        )
+        idx, vals = req.features["g"]
+        expected = sorted([imap.get_index("f0"), imap.get_index("f3")])
+        assert sorted(idx.tolist()) == expected  # unknown feature dropped
+        assert set(vals.tolist()) == {1.5, -2.0}
+
+    def test_sparse_duplicate_indices_accumulate(self, rng):
+        model, specs, _, _ = _fixture(rng, n=2)
+        w = np.asarray(model["fixed"].coefficients.means)
+        req = ScoreRequest(
+            features={
+                "g": (
+                    np.asarray([1, 1, 2], np.int32),
+                    np.asarray([0.5, 0.25, 1.0], np.float32),
+                )
+            },
+            entity_ids={"eid": "0"},
+        )
+        dense = np.zeros(D_FE, np.float32)
+        dense[1], dense[2] = 0.75, 1.0
+        req_dense = ScoreRequest(features={"g": dense}, entity_ids={"eid": "0"})
+        with ServingEngine(
+            ServingBundle.from_model(model, specs, TASK), max_batch=4
+        ) as eng:
+            sparse_score = eng.score_batch([req])[0].score
+            dense_score = eng.score_batch([req_dense])[0].score
+        assert sparse_score == dense_score
+
+    def test_request_from_record_applies_intercept(self, rng):
+        from photon_ml_tpu.data.index_map import INTERCEPT_KEY, IndexMap
+        from photon_ml_tpu.io.avro_data import FeatureShardConfig
+        from photon_ml_tpu.serving.bundle import request_from_record
+
+        model, specs, _, _ = _fixture(rng, n=2)
+        imap = IndexMap.from_feature_names(
+            [f"f{i}" for i in range(D_FE - 1)], add_intercept=True
+        )
+        bundle = ServingBundle.from_model(
+            model, specs, TASK, index_maps={"g": imap}
+        )
+        rec = {
+            "uid": "u1",
+            "features": [{"name": "f0", "term": "", "value": 2.0}],
+            "eid": "3",
+        }
+        req = request_from_record(
+            bundle, rec, {"g": FeatureShardConfig(("features",), True)}
+        )
+        idx, vals = req.features["g"]
+        icpt = imap.get_index(INTERCEPT_KEY)
+        assert icpt in idx.tolist()
+        assert req.entity_ids["eid"] == "3"
+        assert req.uid == "u1"
+
+    def test_request_from_record_missing_id_resolves_like_ingest(self, rng):
+        """Offline ingest tags a record with NO id field as entity "" (a
+        trainable key) — replay must resolve the same coefficient row, not
+        invent a cold start the offline path wouldn't have."""
+        from photon_ml_tpu.data.index_map import IndexMap
+        from photon_ml_tpu.io.avro_data import FeatureShardConfig
+        from photon_ml_tpu.serving.bundle import request_from_record
+
+        model, specs, _, _ = _fixture(rng, n=2)
+        specs = dict(specs)
+        specs["per-e"] = CoordinateScoringSpec(
+            shard="re",
+            random_effect_type="eid",
+            entity_index={"": 0, "m1": 1},  # "" trained, as ingest produces
+        )
+        bundle = ServingBundle.from_model(
+            model,
+            specs,
+            TASK,
+            index_maps={
+                "g": IndexMap.from_feature_names([f"f{i}" for i in range(D_FE)])
+            },
+        )
+        req = request_from_record(
+            bundle,
+            {"features": [], "metadataMap": None},
+            {"g": FeatureShardConfig(("features",), False)},
+        )
+        assert req.entity_ids["eid"] == ""
+        rows, cold = bundle.coordinates["per-e"].lookup_rows([req.entity_ids["eid"]])
+        assert rows[0] == 0 and cold == 0  # the trained "" row, not unseen
