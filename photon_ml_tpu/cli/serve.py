@@ -72,42 +72,64 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-batch", type=int, default=256,
                    help="largest micro-batch / compiled bucket size")
     p.add_argument("--max-wait-ms", type=float, default=2.0,
-                   help="deadline: flush a partial batch once its oldest "
-                        "request has waited this long")
+                   help="flush a partial batch once its oldest request has "
+                        "waited this long")
+    p.add_argument("--max-pending", type=int, default=None,
+                   help="admission-control bound on the pending queue "
+                        "(default: 4x max-batch); replay submits are "
+                        "backpressured, live submits past the bound shed "
+                        "with a typed Overloaded rejection")
+    p.add_argument("--deadline-ms", type=float, default=None,
+                   help="per-request deadline budget; a request queued past "
+                        "it fails with DeadlineExceeded instead of wasting "
+                        "a device slot (default: no deadline)")
     p.add_argument("--model-id", default=None,
                    help="model id tag written into every score record")
     p.add_argument("--logging-level", default="INFO")
     return p
 
 
-def _iter_json_requests(path: str, bundle: ServingBundle) -> Iterator[ScoreRequest]:
+def _iter_json_requests(
+    path: str, bundle: ServingBundle, malformed: List[int]
+) -> Iterator[ScoreRequest]:
     with open(path) as f:
-        for line in f:
+        for lineno, line in enumerate(f, 1):
             line = line.strip()
             if not line:
                 continue
-            doc = json.loads(line)
-            features = {}
-            for shard, payload in (doc.get("features") or {}).items():
-                if isinstance(payload, dict) and "indices" in payload:
-                    features[shard] = (
-                        np.asarray(payload["indices"], np.int32),
-                        np.asarray(payload.get("values", []), np.float32),
-                    )
-                elif isinstance(payload, dict):
-                    features[shard] = payload  # named features -> index maps
-                else:
-                    features[shard] = np.asarray(payload, np.float32)
-            yield bundle.encode_request(
-                features,
-                entity_ids=doc.get("ids") or {},
-                offset=float(doc.get("offset") or 0.0),
-                uid=None if doc.get("uid") is None else str(doc["uid"]),
-            )
+            # One malformed line costs ONE record (counted), never the
+            # rest of the stream — same isolation the per-future harvest
+            # gives requests that fail at scoring time.
+            try:
+                doc = json.loads(line)
+                features = {}
+                for shard, payload in (doc.get("features") or {}).items():
+                    if isinstance(payload, dict) and "indices" in payload:
+                        features[shard] = (
+                            np.asarray(payload["indices"], np.int32),
+                            np.asarray(payload.get("values", []), np.float32),
+                        )
+                    elif isinstance(payload, dict):
+                        features[shard] = payload  # named features -> index maps
+                    else:
+                        features[shard] = np.asarray(payload, np.float32)
+                req = bundle.encode_request(
+                    features,
+                    entity_ids=doc.get("ids") or {},
+                    offset=float(doc.get("offset") or 0.0),
+                    uid=None if doc.get("uid") is None else str(doc["uid"]),
+                )
+            except Exception as exc:  # noqa: BLE001 - per-record isolation
+                malformed[0] += 1
+                logger.warning(
+                    "skipping malformed request at %s:%d: %s", path, lineno, exc
+                )
+                continue
+            yield req
 
 
 def _iter_avro_requests(
-    path: str, bundle: ServingBundle, shard_configs
+    path: str, bundle: ServingBundle, shard_configs, malformed: List[int]
 ) -> Iterator[ScoreRequest]:
     from photon_ml_tpu.io import avro as avro_io
 
@@ -117,8 +139,20 @@ def _iter_avro_requests(
     for p in paths:
         # Block-streaming read: only one Avro block's decoded records are
         # live at a time, keeping replay memory O(window), not O(file).
-        for _, rec in avro_io.iter_container(p):
-            yield request_from_record(bundle, rec, shard_configs)
+        # quarantine=True: one corrupt block costs its requests (counted),
+        # never the rest of the replay file. A decodable record that fails
+        # request conversion (missing/garbage field) likewise costs one
+        # record, not the stream.
+        for _, rec in avro_io.iter_container(p, quarantine=True):
+            try:
+                req = request_from_record(bundle, rec, shard_configs)
+            except Exception as exc:  # noqa: BLE001 - per-record isolation
+                malformed[0] += 1
+                logger.warning(
+                    "skipping malformed replay record in %s: %s", p, exc
+                )
+                continue
+            yield req
 
 
 def run(args) -> dict:
@@ -162,10 +196,13 @@ def run(args) -> dict:
             for s in args.feature_shard_configurations
         )
 
+    malformed = [0]  # records dropped at parse time, before submission
     if is_json:
-        stream = _iter_json_requests(args.requests, bundle)
+        stream = _iter_json_requests(args.requests, bundle, malformed)
     else:
-        stream = _iter_avro_requests(args.requests, bundle, shard_configs)
+        stream = _iter_avro_requests(
+            args.requests, bundle, shard_configs, malformed
+        )
 
     out_root = args.root_output_directory
     os.makedirs(out_root, exist_ok=True)
@@ -185,15 +222,21 @@ def run(args) -> dict:
     model_id = args.model_id or "game-model"
     n_requests = 0
     n_failed = 0
-    with engine, engine.batcher(max_wait_ms=args.max_wait_ms) as batcher:
+    with engine, engine.batcher(
+        max_wait_ms=args.max_wait_ms,
+        max_pending=args.max_pending,
+        default_deadline_ms=args.deadline_ms,
+    ) as batcher:
         for k in itertools.count():
             window = list(itertools.islice(stream, REPLAY_WINDOW))
             if not window:
                 break
             # Per-future harvesting, not score_all: one malformed request
             # must cost ONE failed record (logged, counted), never the
-            # window's healthy co-batched answers or the summary.
-            futures = [batcher.submit(r) for r in window]
+            # window's healthy co-batched answers or the summary. Replay is
+            # a closed-loop client: block=True backpressures against the
+            # bounded queue instead of shedding its own offline traffic.
+            futures = [batcher.submit(r, block=True) for r in window]
             results = []  # (stream position, ScoreResult) of the successes
             for i, fut in enumerate(futures):
                 try:
@@ -207,8 +250,14 @@ def run(args) -> dict:
                         exc,
                     )
             if results:
+                # Crash-safe part files: write to a dot-prefixed temp name
+                # (invisible to list_container_files) and os.replace into
+                # place — a SIGKILL mid-write tears the temp file, never a
+                # part a reader would pick up.
+                part = os.path.join(scores_dir, f"part-{k:05d}.avro")
+                tmp = os.path.join(scores_dir, f".part-{k:05d}.avro.tmp")
                 avro_io.write_container(
-                    os.path.join(scores_dir, f"part-{k:05d}.avro"),
+                    tmp,
                     schemas.SCORING_RESULT,
                     score_store.score_records(
                         np.asarray([r.score for _, r in results], np.float64),
@@ -219,19 +268,29 @@ def run(args) -> dict:
                         ],
                     ),
                 )
+                os.replace(tmp, part)
             n_requests += len(window)
         metrics = batcher.metrics()
     logger.info(
-        "replayed %d request(s), %d failed; scores written to %s",
+        "replayed %d request(s), %d failed, %d malformed record(s) skipped; "
+        "scores written to %s",
         n_requests,
         n_failed,
+        malformed[0],
         scores_dir,
     )
+
+    # Drain-on-shutdown already ran (the context exits answered every
+    # pending future); the health machine must have landed CLOSED.
+    from photon_ml_tpu.utils import faults
 
     summary = {
         "num_requests": n_requests,
         "failed_requests": n_failed,
+        "malformed_records": malformed[0],
         "serving": metrics,
+        "health": engine.health.snapshot(),
+        "robustness_counters": faults.counters(),
     }
     with open(os.path.join(out_root, "serving-summary.json"), "w") as f:
         json.dump(summary, f, indent=2, default=str)

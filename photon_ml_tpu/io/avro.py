@@ -17,10 +17,13 @@ from __future__ import annotations
 
 import io
 import json
+import logging
 import os
 import struct
 import zlib
 from typing import Any, BinaryIO, Dict, Iterable, Iterator, List, Optional, Union
+
+logger = logging.getLogger(__name__)
 
 Schema = Union[str, dict, list]
 
@@ -387,11 +390,13 @@ def list_container_files(path: str) -> List[str]:
     ]
 
 
-def read_container(path: str) -> tuple[Schema, List[Any]]:
+def read_container(
+    path: str, *, quarantine: bool = False
+) -> tuple[Schema, List[Any]]:
     """Read every record from an Avro object container file."""
     records: List[Any] = []
     schema = None
-    for schema, rec in iter_container(path):
+    for schema, rec in iter_container(path, quarantine=quarantine):
         records.append(rec)
     if schema is None:  # empty container: still surface the schema
         with open(path, "rb") as f:
@@ -400,32 +405,92 @@ def read_container(path: str) -> tuple[Schema, List[Any]]:
     return schema, records
 
 
-def iter_container(path: str):
+def iter_container(path: str, *, quarantine: bool = False):
     """Stream (schema, record) pairs from an Avro container, decoding one
     block at a time — only a single block's decoded records are ever live
     (the file BYTES are read whole, but those are compact; the decoded
     Python dicts are the memory cost). The streaming path for consumers
-    that must stay O(block), e.g. the online request-replay driver."""
+    that must stay O(block), e.g. the online request-replay driver.
+
+    Corrupt-block QUARANTINE (`quarantine=True`): a block that fails its
+    sync-marker check, inflate, or datum decode is skipped — the reader
+    re-synchronizes at the next sync marker, counts the block in
+    COUNTERS["quarantined_blocks"], and keeps streaming (one flipped bit
+    must not abort a whole replay/ingest file). The error is loud only
+    when EVERY block in the file is bad — then there is nothing to salvage
+    and silence would hide a truncated or garbage file. A torn tail block
+    (crash mid-write) quarantines the same way.
+
+    Quarantine is OPT-IN, for row-shaped data where a lost block costs
+    rows (request replay, training-data ingest). Completeness-critical
+    reads — model artifacts, checkpoints, scores — keep the default: any
+    corrupt block raises, because a model silently missing a block of
+    coefficients would serve wrong answers, not degraded ones."""
+    from photon_ml_tpu.utils.faults import COUNTERS
+
     with open(path, "rb") as f:
         data = f.read()
     schema, codec, sync, pos = read_header(data, path)
+    if codec not in ("null", "deflate"):
+        # A codec this reader does not speak is a file-level contract
+        # violation, not block corruption — never quarantined.
+        raise ValueError(f"unsupported codec {codec!r}")
     dec = BinaryDecoder(data, pos)
     names = _Names()
     _collect_names(schema, names)
 
+    total_blocks = good_blocks = 0
+    first_error: Optional[Exception] = None
     while dec.remaining > 0:
-        count = dec.read_long()
-        size = dec.read_long()
-        block = dec.read_fixed(size)
-        if codec == "deflate":
-            block = zlib.decompress(block, -15)
-        elif codec != "null":
-            raise ValueError(f"unsupported codec {codec!r}")
-        bdec = BinaryDecoder(block)
-        for _ in range(count):
-            yield schema, read_datum(bdec, schema, names)
-        if dec.read_fixed(SYNC_SIZE) != sync:
-            raise ValueError(f"{path}: sync marker mismatch (corrupt block)")
+        block_start = dec.pos
+        try:
+            count = dec.read_long()
+            size = dec.read_long()
+            if count < 0 or size < 0 or size > dec.remaining:
+                raise ValueError(
+                    f"implausible block framing (count={count}, size={size})"
+                )
+            block = dec.read_fixed(size)
+            if dec.read_fixed(SYNC_SIZE) != sync:
+                raise ValueError("sync marker mismatch")
+            if codec == "deflate":
+                block = zlib.decompress(block, -15)
+            bdec = BinaryDecoder(block)
+            # Decode the whole block BEFORE yielding: a datum error halfway
+            # through must quarantine the block, not hand a consumer half
+            # its records first.
+            records = [read_datum(bdec, schema, names) for _ in range(count)]
+        except Exception as exc:  # noqa: BLE001 - quarantined, counted below
+            if not quarantine:
+                raise ValueError(
+                    f"{path}: corrupt block at byte {block_start} ({exc})"
+                ) from exc
+            total_blocks += 1
+            first_error = first_error or exc
+            COUNTERS.increment("quarantined_blocks")
+            logger.warning(
+                "%s: quarantined corrupt block at byte %d (%s)",
+                path,
+                block_start,
+                exc,
+            )
+            # Re-synchronize: the 16-byte sync marker delimits blocks, so
+            # the next occurrence past the corrupt region is the next
+            # block boundary. No marker left -> the tail is unreadable.
+            nxt = data.find(sync, block_start + 1)
+            if nxt < 0:
+                break
+            dec.pos = nxt + SYNC_SIZE
+            continue
+        total_blocks += 1
+        good_blocks += 1
+        for rec in records:
+            yield schema, rec
+    if total_blocks and good_blocks == 0:
+        raise ValueError(
+            f"{path}: all {total_blocks} block(s) are corrupt "
+            f"(first error: {first_error})"
+        )
 
 
 def write_part_files(
@@ -459,17 +524,19 @@ def write_part_files(
     return total
 
 
-def read_directory(path: str) -> tuple[Optional[Schema], List[Any]]:
+def read_directory(
+    path: str, *, quarantine: bool = False
+) -> tuple[Optional[Schema], List[Any]]:
     """Read all .avro part-files under a directory (HDFS-dir convention the
     reference uses: AvroUtils.readAvroFiles globs part files)."""
     if os.path.isfile(path):
-        return read_container(path)
+        return read_container(path, quarantine=quarantine)
     schema = None
     records: List[Any] = []
     for name in sorted(os.listdir(path)):
         if name.startswith((".", "_")) or not name.endswith(".avro"):
             continue
-        s, recs = read_container(os.path.join(path, name))
+        s, recs = read_container(os.path.join(path, name), quarantine=quarantine)
         schema = schema or s
         records.extend(recs)
     return schema, records

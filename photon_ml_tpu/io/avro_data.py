@@ -227,7 +227,10 @@ def read_game_dataset(
 
     records: List[dict] = []
     for p in paths:
-        _, recs = avro_io.read_directory(p)
+        # quarantine=True: training ingest is row-shaped — one corrupt
+        # block costs its rows (counted in quarantined_blocks), not the
+        # whole file. Model/score reads keep the loud default.
+        _, recs = avro_io.read_directory(p, quarantine=True)
         records.extend(recs)
     n = len(records)
     if n == 0:

@@ -4,7 +4,14 @@ A deliberate extension beyond the reference (which only scores offline via
 GameScoringDriver): `bundle.py` pins a trained model's weight planes in
 device memory once, `engine.py` answers scoring requests through a bounded
 set of jit-compiled padded-bucket programs, and `batcher.py` coalesces
-single requests into deadline micro-batches. See PARITY.md "Online serving".
+single requests into deadline micro-batches. `lifecycle.py` is the
+management tier that keeps it serving under fire: admission control
+(typed `Overloaded` shedding), per-request deadline budgets
+(`DeadlineExceeded`), a circuit breaker that degrades a persistently
+faulting device to fixed-effect-only answers, versioned atomic bundle
+hot-swap (`BundleManager`), and the STARTING → READY ⇄ DEGRADED →
+DRAINING → CLOSED health machine. See PARITY.md "Online serving" and
+"Serving failure semantics".
 """
 
 from photon_ml_tpu.serving.batcher import MicroBatcher
@@ -15,13 +22,35 @@ from photon_ml_tpu.serving.bundle import (
     load_bundle,
 )
 from photon_ml_tpu.serving.engine import ScoreResult, ServingEngine
+from photon_ml_tpu.serving.lifecycle import (
+    BatcherUnhealthy,
+    BundleManager,
+    CircuitBreaker,
+    CircuitState,
+    DeadlineExceeded,
+    HbmBudgetExceeded,
+    HealthStateMachine,
+    Overloaded,
+    ServingState,
+    SwapIncompatible,
+)
 
 __all__ = [
+    "BatcherUnhealthy",
+    "BundleManager",
+    "CircuitBreaker",
+    "CircuitState",
+    "DeadlineExceeded",
+    "HbmBudgetExceeded",
+    "HealthStateMachine",
     "MicroBatcher",
+    "Overloaded",
     "ScoreRequest",
     "ScoreResult",
     "ServingBundle",
     "ServingCoordinate",
     "ServingEngine",
+    "ServingState",
+    "SwapIncompatible",
     "load_bundle",
 ]

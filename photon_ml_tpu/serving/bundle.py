@@ -60,12 +60,19 @@ class ScoreRequest:
     sparse pair (duplicate indices accumulate, matching `pack_csr_to_ell`).
     A shard absent from the mapping scores as an all-zero row. Entity ids
     missing for a random-effect type are cold starts by definition.
+
+    `deadline_ms` is the request's latency budget, counted from submission
+    to the micro-batcher: a request still queued past its budget is failed
+    with `DeadlineExceeded` before wasting a device slot, and batch
+    assembly never co-batches an expired request. None defers to the
+    batcher's `default_deadline_ms` (which may also be None: no deadline).
     """
 
     features: Dict[str, ShardFeatures] = dataclasses.field(default_factory=dict)
     entity_ids: Dict[str, object] = dataclasses.field(default_factory=dict)
     offset: float = 0.0
     uid: Optional[str] = None
+    deadline_ms: Optional[float] = None
 
 
 @dataclasses.dataclass
@@ -126,10 +133,27 @@ class ServingBundle:
     # (exactly once — the engine never re-uploads model state per request).
     upload_bytes: int = 0
     upload_s: float = 0.0
+    # Set by release(): the hot-swap drain freed this bundle's device state.
+    released: bool = False
 
     @property
     def coordinate_ids(self) -> List[str]:
         return list(self.coordinates.keys())
+
+    def release(self) -> None:
+        """Drop this bundle's device-resident state (hot-swap retirement).
+
+        Drops the coordinate references rather than calling .delete() on
+        the arrays: `from_model` stages without copying when the trained
+        model's arrays are already device-resident f32, so a hard delete
+        here could free buffers a live GameModel still reads. CPython
+        refcounting frees the device memory the moment the last reference
+        dies — for the production artifact path (host-built matrices owned
+        solely by the bundle) that is immediately. Scoring a released
+        bundle raises; release is idempotent."""
+        self.coordinates = {}
+        self.index_maps = None
+        self.released = True
 
     def shard_dims(self) -> Dict[str, int]:
         """Feature width per shard consumed by any coordinate."""

@@ -27,10 +27,26 @@ program shapes — applied to a serving hot path):
     (they are per-batch scratch; donation lets XLA reuse the HBM). Model
     planes are never donated — they are the bundle's pinned state.
 
+Lifecycle tier (serving/lifecycle.py) additions on top of PR 4:
+
+  * The bundle is no longer construction-pinned: every batch snapshots an
+    immutable `_EngineState` (bundle + derived coordinate metadata), and a
+    `BundleManager.swap()` flips that snapshot atomically between batches
+    — in-flight batches finish on the generation they started on, which
+    the per-state in-flight counter drains before the old bundle is
+    released.
+  * `score_batch_fe_only` is the circuit-open degradation tier: every
+    random-effect lookup is forced to the pinned zero row and no fault
+    site fires in the path, so it keeps answering (bitwise-equal to
+    FE-only `GameTransformer` output) while the full path is broken.
+  * `health` (STARTING/READY/DEGRADED/DRAINING/CLOSED) and `breaker` (the
+    circuit over the lookup/score fault sites) surface through
+    `metrics()`.
+
 Fault sites: `lookup` (entity-row resolution) and `score` (device
 dispatch), via utils/faults.py. The engine itself raises; degradation
-policy (retry, per-request fallback) lives in the batcher so direct
-callers keep raw failure semantics.
+policy (retry, per-request fallback, circuit routing) lives in the batcher
+so direct callers keep raw failure semantics.
 """
 
 from __future__ import annotations
@@ -46,7 +62,13 @@ import numpy as np
 
 from photon_ml_tpu.game.model import random_effect_margins
 from photon_ml_tpu.ops.losses import mean_for_task
-from photon_ml_tpu.serving.bundle import ScoreRequest, ServingBundle
+from photon_ml_tpu.serving.bundle import ScoreRequest, ServingBundle, ServingCoordinate
+from photon_ml_tpu.serving.lifecycle import (
+    BundleManager,
+    CircuitBreaker,
+    HealthStateMachine,
+    ServingState,
+)
 from photon_ml_tpu.transformers.game_transformer import dense_margins
 from photon_ml_tpu.types import TaskType
 from photon_ml_tpu.utils import faults
@@ -58,13 +80,31 @@ Array = jax.Array
 @dataclasses.dataclass
 class ScoreResult:
     """One answered request: raw summed margin + link-function mean
-    (ScoredGameDatum fields), plus cold-start accounting."""
+    (ScoredGameDatum fields), plus cold-start accounting. `fe_only` marks
+    an answer produced by the circuit-open fixed-effect-only tier (the
+    score is the FE-only score, NOT the full-model one)."""
 
     score: float
     mean: float
     uid: Optional[str] = None
     cold_start: bool = False  # any random-effect lookup fell back
     n_cold: int = 0  # how many of the request's RE lookups fell back
+    fe_only: bool = False
+
+
+@dataclasses.dataclass
+class _EngineState:
+    """One bundle generation's scoring state. Immutable after build except
+    `active` (in-flight batch count, guarded by the engine lock) — the
+    swap drain waits on it before releasing the generation's bundle."""
+
+    bundle: ServingBundle
+    coords: List[ServingCoordinate]
+    kinds: Tuple[str, ...]
+    coord_shards: Tuple[str, ...]
+    shard_dims: Dict[str, int]
+    version: int = 0
+    active: int = 0
 
 
 def _score_program(offsets, shard_feats, rows, params, norms, *, kinds, shards, task):
@@ -99,12 +139,13 @@ def _bucket_sizes(max_batch: int) -> Tuple[int, ...]:
 
 
 class ServingEngine:
-    """Scores request batches against a pinned `ServingBundle`.
+    """Scores request batches against a swappable pinned `ServingBundle`.
 
     Thread-safety: `score_batch` may be called from any thread (the
     batcher's flush thread, a caller's worker pool); metrics updates are
-    lock-protected. One engine owns one private jit cache, so `compiles`
-    counts exactly this engine's XLA programs.
+    lock-protected, and each batch runs against one atomic state snapshot.
+    One engine owns one private jit cache, so `compiles` counts exactly
+    this engine's XLA programs.
     """
 
     def __init__(
@@ -113,20 +154,14 @@ class ServingEngine:
         *,
         max_batch: int = 256,
         task: Optional[TaskType] = None,
+        circuit_threshold: int = 5,
+        circuit_probe_interval_s: float = 1.0,
     ):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
-        self.bundle = bundle
         self.task = task or bundle.task
         self.max_batch = int(max_batch)
         self.buckets = _bucket_sizes(self.max_batch)
-        self._kinds = tuple(
-            "re" if bundle.coordinates[cid].is_random_effect else "fe"
-            for cid in bundle.coordinate_ids
-        )
-        self._coords = [bundle.coordinates[cid] for cid in bundle.coordinate_ids]
-        self._coord_shards = tuple(c.shard for c in self._coords)
-        self._shard_dims = bundle.shard_dims()
         # Per-engine jit instance = private compile cache, so _cache_size()
         # is an honest XLA-compile counter for THIS engine. jit caches key
         # on the underlying callable, and wrappers over the same module
@@ -142,13 +177,25 @@ class ServingEngine:
             donate_argnums=donate,
         )
         self.stages = TimingRegistry()
-        self._lock = threading.Lock()
+        # Condition, not Lock: the hot-swap drain waits on per-state
+        # in-flight counts reaching zero (notified by score_batch exits).
+        self._lock = threading.Condition()
+        self._state = self._build_state(bundle, version=0)
+        self.health = HealthStateMachine()
+        self.breaker = CircuitBreaker(
+            threshold=circuit_threshold,
+            probe_interval_s=circuit_probe_interval_s,
+            on_open=lambda: self.health.add_degraded("circuit_open"),
+            on_close=lambda: self.health.clear_degraded("circuit_open"),
+        )
+        self._bundle_manager: Optional[BundleManager] = None
         self._requests = 0
         self._batches = 0
         self._lookups = 0
         self._cold_lookups = 0
         self._slots_total = 0
         self._slots_padded = 0
+        self._fe_only_requests = 0
         self._warmup_compiles: Optional[int] = None
         self._dispatched_buckets: set = set()
         self._t_first: Optional[float] = None
@@ -157,6 +204,23 @@ class ServingEngine:
         self._closed = False
 
     # ----------------------------------------------------------- lifecycle
+
+    @property
+    def bundle(self) -> ServingBundle:
+        """The ACTIVE bundle generation (swappable; snapshot per batch)."""
+        return self._state.bundle
+
+    @property
+    def bundle_version(self) -> int:
+        return self._state.version
+
+    @property
+    def bundle_manager(self) -> BundleManager:
+        """The engine's hot-swap manager (created on first use)."""
+        with self._lock:
+            if self._bundle_manager is None:
+                self._bundle_manager = BundleManager(self)
+            return self._bundle_manager
 
     def batcher(self, **kwargs) -> "MicroBatcher":  # noqa: F821
         """Create a MicroBatcher bound to this engine; `close()` joins it."""
@@ -171,14 +235,24 @@ class ServingEngine:
         return b
 
     def close(self) -> None:
-        """Shut down every batcher created via `batcher()` (joining their
-        flush threads). Idempotent. The bundle stays usable — model planes
-        are plain device arrays owned by the bundle, not the engine."""
+        """Graceful drain-on-shutdown: DRAINING while every batcher created
+        via `batcher()` answers its pending futures and joins its flush
+        thread, then CLOSED. Idempotent. The bundle stays usable — model
+        planes are plain device arrays owned by the bundle, not the
+        engine."""
         if self._closed:
             return
         self._closed = True
+        self.health.begin_drain()
         for b in self._batchers:
             b.close()
+        self.health.close()
+
+    def _on_batcher_unhealthy(self, exc: BaseException) -> None:
+        """A batcher's flush thread died (serving/batcher.py failed all its
+        pending futures); the engine is degraded until operators replace
+        the batcher — this reason never self-clears."""
+        self.health.add_degraded(f"batcher_unhealthy: {exc!r}")
 
     def __enter__(self) -> "ServingEngine":
         return self
@@ -186,6 +260,59 @@ class ServingEngine:
     def __exit__(self, *exc) -> bool:
         self.close()
         return False
+
+    # ------------------------------------------------------- state plumbing
+
+    def _build_state(self, bundle: ServingBundle, *, version: int) -> _EngineState:
+        if bundle.released:
+            raise RuntimeError("cannot serve a released bundle")
+        coords = [bundle.coordinates[cid] for cid in bundle.coordinate_ids]
+        return _EngineState(
+            bundle=bundle,
+            coords=coords,
+            kinds=tuple(
+                "re" if c.is_random_effect else "fe" for c in coords
+            ),
+            coord_shards=tuple(c.shard for c in coords),
+            shard_dims=bundle.shard_dims(),
+            version=version,
+        )
+
+    def _warm_state(self, state: _EngineState) -> None:
+        """Compile every bucket program for `state`'s parameter shapes
+        (inert all-cold zero batches; no fault sites, no request metrics).
+        Used by warmup() on the live state and by the hot-swap staging on
+        the NEXT state — so the atomic flip compiles nothing."""
+        for b in self.buckets:
+            self._dispatch(
+                self._pack([], b, state, inject=False), state, inject=False
+            )
+
+    def _commit_state(
+        self, new_state: _EngineState, *, baseline_bump: int = 0
+    ) -> _EngineState:
+        """The hot-swap flip: one assignment under the lock. The warmup
+        baseline grows by exactly the programs STAGING compiled
+        (`baseline_bump`) — never reset to the current total, which would
+        silently absorb any pre-swap hot-path recompiles and wipe the
+        regression signal recompiles_after_warmup exists to carry."""
+        with self._lock:
+            old = self._state
+            self._state = new_state
+            if self._warmup_compiles is not None:
+                self._warmup_compiles += max(0, baseline_bump)
+        return old
+
+    def _drain_state(self, state: _EngineState, *, timeout_s: float) -> bool:
+        """Wait until no in-flight batch still scores on `state`."""
+        deadline = time.monotonic() + timeout_s
+        with self._lock:
+            while state.active > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._lock.wait(timeout=remaining)
+        return True
 
     # ------------------------------------------------------------- scoring
 
@@ -199,13 +326,13 @@ class ServingEngine:
         """Compile every declared bucket (inert all-cold zero batches that
         do not count toward request metrics). Returns the compile count;
         afterwards `recompiles_after_warmup` tracks cache misses — zero for
-        any request stream whose batches fit max_batch."""
+        any request stream whose batches fit max_batch. Transitions the
+        health machine STARTING -> READY."""
         t0 = time.perf_counter()
-        for b in self.buckets:
-            # inject=False: warmup is not the request path — an armed
-            # lookup/score fault must fire on (and be counted against)
-            # real traffic, not kill engine bring-up.
-            self._dispatch(self._pack([], b, inject=False), inject=False)
+        # inject=False inside _warm_state: warmup is not the request path —
+        # an armed lookup/score fault must fire on (and be counted against)
+        # real traffic, not kill engine bring-up.
+        self._warm_state(self._state)
         # Warmup wall (mostly XLA compiles) is recorded under its own stage
         # key; no ambient scope is open here, so the inner serve_pack/
         # serve_score timers stay warmup-free.
@@ -213,23 +340,42 @@ class ServingEngine:
         compiles = self.compiles
         with self._lock:
             self._warmup_compiles = compiles
+        self.health.mark_ready()
         return compiles
 
-    def score_batch(self, requests: Sequence[ScoreRequest]) -> List[ScoreResult]:
+    def score_batch(
+        self, requests: Sequence[ScoreRequest], *, fe_only: bool = False
+    ) -> List[ScoreResult]:
         """Score one micro-batch: pad to the bucket, one device round trip.
-        Batches larger than max_batch split internally."""
+        Batches larger than max_batch split internally. `fe_only=True` is
+        the circuit-open tier: every RE lookup forced to the pinned zero
+        row, no fault sites in the path."""
         if not requests:
             return []
         if len(requests) > self.max_batch:
             out: List[ScoreResult] = []
             for lo in range(0, len(requests), self.max_batch):
-                out.extend(self.score_batch(requests[lo : lo + self.max_batch]))
+                out.extend(
+                    self.score_batch(
+                        requests[lo : lo + self.max_batch], fe_only=fe_only
+                    )
+                )
             return out
         n = len(requests)
         bucket = self.bucket_for(n)
-        with stage_scope(self.stages):
-            packed = self._pack(requests, bucket)
-            scores, means = self._dispatch(packed)
+        with self._lock:
+            st = self._state
+            st.active += 1
+        try:
+            with stage_scope(self.stages):
+                packed = self._pack(
+                    requests, bucket, st, inject=not fe_only, fe_only=fe_only
+                )
+                scores, means = self._dispatch(packed, st, inject=not fe_only)
+        finally:
+            with self._lock:
+                st.active -= 1
+                self._lock.notify_all()
         flags = packed["cold_flags"]
         results = [
             ScoreResult(
@@ -238,6 +384,7 @@ class ServingEngine:
                 uid=requests[i].uid,
                 cold_start=bool(flags[i].any()),
                 n_cold=int(flags[i].sum()),
+                fe_only=fe_only,
             )
             for i in range(n)
         ]
@@ -245,19 +392,43 @@ class ServingEngine:
         with self._lock:
             self._requests += n
             self._batches += 1
-            self._lookups += int(flags.size)
-            self._cold_lookups += int(flags.sum())
+            if fe_only:
+                # FE-only answers are forced cold by construction; keeping
+                # them out of the lookup counters preserves
+                # cold_start_fraction's meaning (unknown entities on the
+                # HEALTHY path).
+                self._fe_only_requests += n
+            else:
+                self._lookups += int(flags.size)
+                self._cold_lookups += int(flags.sum())
             self._slots_total += bucket
             self._slots_padded += bucket - n
             if self._t_first is None:
                 self._t_first = now
             self._t_last = now
+        if self.health.state is ServingState.STARTING:
+            self.health.mark_ready()  # serving without explicit warmup()
         return results
+
+    def score_batch_fe_only(
+        self, requests: Sequence[ScoreRequest]
+    ) -> List[ScoreResult]:
+        """The circuit-open degradation tier: score with fixed effects (+
+        offset) only, bitwise-equal to FE-only GameTransformer output via
+        the pinned zero-row path. No fault site fires here — this tier
+        must keep answering precisely when the full path is broken."""
+        return self.score_batch(requests, fe_only=True)
 
     # ------------------------------------------------------------ internals
 
     def _pack(
-        self, requests: Sequence[ScoreRequest], bucket: int, *, inject: bool = True
+        self,
+        requests: Sequence[ScoreRequest],
+        bucket: int,
+        state: _EngineState,
+        *,
+        inject: bool = True,
+        fe_only: bool = False,
     ) -> dict:
         """Host-side batch assembly: per-shard dense buffers, per-RE-coordinate
         entity rows (padding slots gather the pinned zero row), offsets."""
@@ -265,7 +436,7 @@ class ServingEngine:
         with stage_timer("serve_pack"):
             buffers = {
                 s: np.zeros((bucket, d), np.float32)
-                for s, d in self._shard_dims.items()
+                for s, d in state.shard_dims.items()
             }
             offsets = np.zeros(bucket, np.float32)
             for i, r in enumerate(requests):
@@ -282,10 +453,16 @@ class ServingEngine:
         with stage_timer("serve_lookup"):
             if inject:
                 faults.fault_point("lookup")
-            re_coords = [c for c in self._coords if c.is_random_effect]
+            re_coords = [c for c in state.coords if c.is_random_effect]
             cold_flags = np.zeros((n, len(re_coords)), bool)
             rows_by_cid: Dict[str, np.ndarray] = {}
             for k, c in enumerate(re_coords):
+                if fe_only:
+                    # Every slot gathers the pinned zero row: the margin
+                    # contribution is exactly +0.0, i.e. FE-only scoring
+                    # without touching the (possibly failing) index path.
+                    rows_by_cid[c.cid] = np.full(bucket, c.unseen_row, np.int32)
+                    continue
                 ids = [r.entity_ids.get(c.random_effect_type) for r in requests]
                 rows, _ = c.lookup_rows(ids)
                 cold_flags[:, k] = rows == c.unseen_row
@@ -301,7 +478,7 @@ class ServingEngine:
         }
 
     def _dispatch(
-        self, packed: dict, *, inject: bool = True
+        self, packed: dict, state: _EngineState, *, inject: bool = True
     ) -> Tuple[np.ndarray, np.ndarray]:
         """Upload request buffers, run the fused program, fetch both outputs
         in one transfer."""
@@ -315,18 +492,18 @@ class ServingEngine:
                 jnp.asarray(packed["rows_by_cid"][c.cid])
                 if c.is_random_effect
                 else None
-                for c in self._coords
+                for c in state.coords
             )
-            params = tuple(c.params for c in self._coords)
-            norms = tuple(c.norm for c in self._coords)
+            params = tuple(c.params for c in state.coords)
+            norms = tuple(c.norm for c in state.coords)
             total, means = self._jit(
                 jnp.asarray(packed["offsets"]),
                 dev_buffers,
                 rows,
                 params,
                 norms,
-                kinds=self._kinds,
-                shards=self._coord_shards,
+                kinds=state.kinds,
+                shards=state.coord_shards,
                 task=self.task,
             )
             host_total, host_means = jax.device_get((total, means))
@@ -360,9 +537,13 @@ class ServingEngine:
 
     def metrics(self) -> Dict[str, object]:
         """Engine-side counters; the batcher's metrics() merges these with
-        request latency percentiles."""
+        request latency percentiles. Includes the lifecycle tier: health
+        state (+ degraded reasons), circuit snapshot, bundle version and
+        swap counters."""
         compiles = self.compiles  # before the lock: the fallback path locks
+        manager = self._bundle_manager
         with self._lock:
+            st = self._state
             lookups = self._lookups
             cold = self._cold_lookups
             slots = self._slots_total
@@ -384,12 +565,22 @@ class ServingEngine:
                     if self._warmup_compiles is None
                     else max(0, compiles - self._warmup_compiles)
                 ),
-                "upload_bytes": self.bundle.upload_bytes,
-                "upload_s": round(self.bundle.upload_s, 4),
+                "fe_only_requests": self._fe_only_requests,
+                "bundle_version": st.version,
+                "upload_bytes": st.bundle.upload_bytes,
+                "upload_s": round(st.bundle.upload_s, 4),
                 "engine_qps": (
                     round(self._requests / elapsed, 1) if elapsed > 0 else None
                 ),
             }
+        health = self.health.snapshot()
+        out["state"] = health["state"]
+        out["degraded_reasons"] = health["degraded_reasons"]
+        out.update(self.breaker.snapshot())
+        out["bundle_swaps"] = manager.swaps if manager is not None else 0
+        out["bundle_swap_rollbacks"] = (
+            manager.rollbacks if manager is not None else 0
+        )
         out["stage_walls_s"] = {
             k: round(v, 4) for k, v in sorted(self.stages.sections.items())
         }

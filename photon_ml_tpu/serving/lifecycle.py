@@ -1,0 +1,576 @@
+"""Serving lifecycle: health states, circuit breaking, atomic bundle swap.
+
+PR 4's engine scores requests; this module is the management tier that
+keeps it scoring under fire — the Snap ML hierarchy lesson (PAPERS.md,
+arxiv 1803.06333) applied to serving: the accelerator runs fixed fused
+programs, and everything that can go wrong around them (overload, a
+persistently faulting device, a model push) is handled by explicit host
+machinery with typed outcomes, never a hang or a silent wrong answer.
+
+Pieces, all consumed by serving/engine.py and serving/batcher.py:
+
+* Typed failures — `Overloaded` (admission control shed the request),
+  `DeadlineExceeded` (the request expired in queue; standard library
+  TimeoutError subclass so generic timeout handling catches it),
+  `BatcherUnhealthy` (the flush thread died; every pending future got the
+  error), `HbmBudgetExceeded` (a bundle swap would not fit device memory),
+  `SwapIncompatible` (the next bundle's coordinate structure does not
+  match the compiled programs).
+
+* `ServingState` + `HealthStateMachine` — STARTING → READY ⇄ DEGRADED →
+  DRAINING → CLOSED. DEGRADED is reason-tracked: the circuit opening and a
+  flush-thread death each add a reason; READY returns only when every
+  reason clears (a recovered circuit must not mask a dead batcher).
+  Transitions are timestamped for the metrics snapshot.
+
+* `CircuitBreaker` — counts CONSECUTIVE device-class failures that
+  survived the bounded retry policy (utils/faults.is_device_error; a
+  malformed request never counts). At `threshold` the circuit OPENs:
+  traffic is routed to the engine's fixed-effect-only tier (bitwise-equal
+  to FE-only GameTransformer output — the pinned zero-row cold-start
+  path) instead of failing. After `probe_interval_s` one probe request is
+  allowed through the full path (HALF_OPEN); success re-CLOSEs, failure
+  re-arms the interval. The permit protocol is explicit: every
+  `acquire() == True` must be resolved by exactly one of `on_success` /
+  `on_failure` / `on_abandon` (abandon = the attempt failed for a
+  non-device reason and proves nothing about the device).
+
+* `BundleManager` — versioned atomic hot-swap. `swap()` double-buffers
+  the next `ServingBundle` into device memory (HBM-budget check BEFORE
+  staging), warms the engine's bucket programs against the new parameters
+  (so the flip compiles nothing on live traffic), flips scoring atomically
+  between batches, drains in-flight batches off the old bundle, and
+  releases it. Staging or warmup faulting (fault sites `swap_stage`,
+  `swap_commit`) rolls back: the old bundle keeps serving, the new one is
+  released, `serving_swap_rollbacks` counts it, and the error propagates
+  to the caller. Live traffic never observes a half-swapped engine.
+"""
+
+from __future__ import annotations
+
+import collections
+import enum
+import logging
+import os
+import threading
+import time
+from typing import Callable, Deque, Dict, List, Optional, Tuple
+
+from photon_ml_tpu.utils import faults
+
+logger = logging.getLogger(__name__)
+
+
+# ------------------------------------------------------------ typed failures
+
+
+class Overloaded(RuntimeError):
+    """Admission control rejected the request: the pending queue is full
+    (or an armed `admit` fault shed it). The client should back off —
+    never retry in a tight loop."""
+
+
+class DeadlineExceeded(TimeoutError):
+    """The request's deadline budget expired while it waited in queue; it
+    was failed BEFORE wasting a device slot."""
+
+
+class BatcherUnhealthy(RuntimeError):
+    """The micro-batcher's flush thread died. Every pending future was
+    failed with the original error; new submits are refused."""
+
+
+class HbmBudgetExceeded(RuntimeError):
+    """Double-buffering the next bundle would exceed the device-memory
+    budget; nothing was staged."""
+
+
+class SwapIncompatible(ValueError):
+    """The next bundle's coordinate structure (ids, kinds, shards, dims)
+    does not match the serving engine's compiled program family."""
+
+
+# -------------------------------------------------------------- health state
+
+
+class ServingState(enum.Enum):
+    STARTING = "STARTING"
+    READY = "READY"
+    DEGRADED = "DEGRADED"
+    DRAINING = "DRAINING"
+    CLOSED = "CLOSED"
+
+
+# The legal edges. DEGRADED<->READY flips with the degraded-reason set;
+# DRAINING only completes to CLOSED; CLOSED is terminal.
+_TRANSITIONS = {
+    ServingState.STARTING: {
+        ServingState.READY,
+        ServingState.DEGRADED,
+        ServingState.DRAINING,
+        ServingState.CLOSED,
+    },
+    ServingState.READY: {
+        ServingState.DEGRADED,
+        ServingState.DRAINING,
+        ServingState.CLOSED,
+    },
+    ServingState.DEGRADED: {
+        ServingState.READY,
+        ServingState.DRAINING,
+        ServingState.CLOSED,
+    },
+    ServingState.DRAINING: {ServingState.CLOSED},
+    ServingState.CLOSED: set(),
+}
+
+
+class HealthStateMachine:
+    """Thread-safe serving health with reason-tracked degradation.
+
+    `add_degraded(reason)` / `clear_degraded(reason)` manage a set of
+    active degradation reasons; the READY <-> DEGRADED edge follows that
+    set, so two independent degradations (open circuit + dead batcher)
+    must BOTH clear before the engine reports READY again.
+    """
+
+    # Bounded transition history: a flapping degradation (intermittent
+    # device, 1s probe interval) appends two entries per flap forever; a
+    # metrics scrape must not pay O(uptime). The total count is kept
+    # separately so truncation is visible.
+    HISTORY_LIMIT = 64
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic):
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = ServingState.STARTING
+        self._reasons: List[str] = []
+        self._history: Deque[Tuple[float, str, str]] = collections.deque(
+            [(clock(), "", ServingState.STARTING.value)],
+            maxlen=self.HISTORY_LIMIT,
+        )
+        self._transitions_total = 0
+
+    @property
+    def state(self) -> ServingState:
+        with self._lock:
+            return self._state
+
+    @property
+    def degraded_reasons(self) -> List[str]:
+        with self._lock:
+            return list(self._reasons)
+
+    def _to_locked(self, new: ServingState) -> None:
+        if new is self._state:
+            return
+        if new not in _TRANSITIONS[self._state]:
+            raise RuntimeError(
+                f"illegal serving-state transition {self._state.value} -> "
+                f"{new.value}"
+            )
+        self._history.append((self._clock(), self._state.value, new.value))
+        self._transitions_total += 1
+        logger.info("serving state %s -> %s", self._state.value, new.value)
+        self._state = new
+
+    def mark_ready(self) -> None:
+        """STARTING -> READY (or DEGRADED, if reasons accrued during
+        bring-up). No-op once past STARTING."""
+        with self._lock:
+            if self._state is ServingState.STARTING:
+                self._to_locked(
+                    ServingState.DEGRADED if self._reasons else ServingState.READY
+                )
+
+    def add_degraded(self, reason: str) -> None:
+        with self._lock:
+            if reason not in self._reasons:
+                self._reasons.append(reason)
+            if self._state is ServingState.READY:
+                self._to_locked(ServingState.DEGRADED)
+
+    def clear_degraded(self, reason: str) -> None:
+        with self._lock:
+            if reason in self._reasons:
+                self._reasons.remove(reason)
+            if self._state is ServingState.DEGRADED and not self._reasons:
+                self._to_locked(ServingState.READY)
+
+    def begin_drain(self) -> None:
+        with self._lock:
+            if self._state not in (ServingState.DRAINING, ServingState.CLOSED):
+                self._to_locked(ServingState.DRAINING)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._state is not ServingState.CLOSED:
+                if self._state is not ServingState.DRAINING:
+                    self._to_locked(ServingState.DRAINING)
+                self._to_locked(ServingState.CLOSED)
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            hist = list(self._history)
+            return {
+                "state": self._state.value,
+                "degraded_reasons": list(self._reasons),
+                "transitions_total": self._transitions_total,
+                "transitions": [
+                    {"t": round(t, 4), "from": a, "to": b}
+                    for t, a, b in hist
+                    if a  # drop the synthetic initial STARTING entry
+                ],
+            }
+
+
+# ------------------------------------------------------------ circuit breaker
+
+
+class CircuitState(enum.Enum):
+    CLOSED = "CLOSED"
+    OPEN = "OPEN"
+    HALF_OPEN = "HALF_OPEN"
+
+
+class CircuitPermit:
+    """One full-path attempt's token. `probe=True` marks THE half-open
+    probe permit; permits handed out while CLOSED are free. Resolution
+    methods key off the token, so a stale CLOSED-era permit resolving
+    late can never clobber another batcher's in-flight probe."""
+
+    __slots__ = ("probe",)
+
+    def __init__(self, probe: bool):
+        self.probe = probe
+
+
+class CircuitBreaker:
+    """Consecutive-failure breaker with single-probe half-open recovery.
+
+    Permit protocol (the batcher is the only caller): `acquire()` asks
+    whether THIS attempt may use the full scoring path, returning a
+    `CircuitPermit` or None. While CLOSED permits are free (no
+    bookkeeping). While OPEN it returns None — route to the FE-only tier
+    — until `probe_interval_s` has elapsed, when exactly one caller gets
+    THE probe permit (HALF_OPEN). Every permit must be resolved with
+    exactly one of `on_success(permit)` (re-closes), `on_failure(permit)`
+    (re-opens and re-arms the interval), or `on_abandon(permit)` (returns
+    the permit without judging the device — the attempt failed for a
+    request-shaped reason). An unresolved probe would wedge the breaker
+    in HALF_OPEN forever — the protocol makes that a local bug, not a
+    distributed one; the permit token keeps concurrent batchers honest.
+    """
+
+    def __init__(
+        self,
+        *,
+        threshold: int = 5,
+        probe_interval_s: float = 1.0,
+        clock: Callable[[], float] = time.monotonic,
+        on_open: Optional[Callable[[], None]] = None,
+        on_close: Optional[Callable[[], None]] = None,
+    ):
+        if threshold < 1:
+            raise ValueError("threshold must be >= 1")
+        self.threshold = int(threshold)
+        self.probe_interval_s = float(probe_interval_s)
+        self._clock = clock
+        self._on_open = on_open
+        self._on_close = on_close
+        self._lock = threading.Lock()
+        self._state = CircuitState.CLOSED
+        self._consecutive = 0
+        self._probing = False
+        self._next_probe_t = 0.0
+        self._opens = 0
+        self._probes = 0
+
+    @property
+    def state(self) -> CircuitState:
+        with self._lock:
+            return self._state
+
+    @property
+    def is_open(self) -> bool:
+        return self.state is not CircuitState.CLOSED
+
+    def acquire(self) -> Optional[CircuitPermit]:
+        with self._lock:
+            if self._state is CircuitState.CLOSED:
+                return CircuitPermit(probe=False)
+            if (
+                self._state is CircuitState.OPEN
+                and self._clock() >= self._next_probe_t
+            ):
+                self._state = CircuitState.HALF_OPEN
+                self._probing = True
+                self._probes += 1
+                return CircuitPermit(probe=True)
+            if self._state is CircuitState.HALF_OPEN and not self._probing:
+                self._probing = True
+                self._probes += 1
+                return CircuitPermit(probe=True)
+            return None
+
+    def on_success(self, permit: CircuitPermit) -> None:
+        notify = False
+        with self._lock:
+            if permit.probe:
+                self._probing = False
+            self._consecutive = 0
+            # Only THE probe may re-close an open circuit: a stale
+            # CLOSED-era permit succeeding late (acquired before the
+            # failures that opened it) is evidence about the PAST, and
+            # letting it close the breaker would route traffic back to a
+            # dead device without any probe.
+            if permit.probe and self._state is not CircuitState.CLOSED:
+                self._state = CircuitState.CLOSED
+                notify = True
+                logger.info("serving circuit re-closed (probe succeeded)")
+        if notify and self._on_close is not None:
+            self._on_close()
+
+    def on_failure(self, permit: CircuitPermit) -> None:
+        notify = False
+        with self._lock:
+            if permit.probe:
+                self._probing = False
+            self._consecutive += 1
+            # A failed PROBE re-opens unconditionally; a free (CLOSED-era)
+            # permit failing while another batcher's probe is in flight
+            # only counts toward the consecutive threshold — it must not
+            # decide the probe's outcome.
+            should_open = (
+                permit.probe and self._state is CircuitState.HALF_OPEN
+            ) or self._consecutive >= self.threshold
+            if should_open and self._state is not CircuitState.OPEN:
+                self._state = CircuitState.OPEN
+                self._opens += 1
+                notify = True
+                logger.warning(
+                    "serving circuit OPEN after %d consecutive device "
+                    "failure(s); probing in %.2fs",
+                    self._consecutive,
+                    self.probe_interval_s,
+                )
+            if self._state is CircuitState.OPEN:
+                self._next_probe_t = self._clock() + self.probe_interval_s
+        if notify:
+            faults.COUNTERS.increment("serving_circuit_opens")
+            if self._on_open is not None:
+                self._on_open()
+
+    def on_abandon(self, permit: CircuitPermit) -> None:
+        """Return an unused permit: the attempt failed, but not in a way
+        that says anything about the device (e.g. a malformed request)."""
+        if permit.probe:
+            with self._lock:
+                self._probing = False
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            return {
+                "circuit_state": self._state.value,
+                "circuit_opens": self._opens,
+                "circuit_probes": self._probes,
+                "consecutive_device_failures": self._consecutive,
+            }
+
+
+# --------------------------------------------------------------- bundle swap
+
+
+def device_memory_budget_bytes() -> Optional[int]:
+    """The HBM budget a swap must fit in: PHOTON_SERVING_HBM_BUDGET_BYTES
+    when set, else the device's reported bytes_limit (TPU/GPU runtimes
+    expose memory_stats; CPU does not — None means 'unknown, skip the
+    check' there, matching the virtual-mesh test platform)."""
+    raw = os.environ.get("PHOTON_SERVING_HBM_BUDGET_BYTES", "").strip()
+    if raw:
+        try:
+            return int(raw)
+        except ValueError:
+            logger.warning(
+                "ignoring malformed PHOTON_SERVING_HBM_BUDGET_BYTES=%r", raw
+            )
+    try:
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats()
+        if stats and "bytes_limit" in stats:
+            return int(stats["bytes_limit"])
+    except Exception:  # noqa: BLE001 - absent API means unknown budget
+        pass
+    return None
+
+
+class BundleManager:
+    """Versioned, atomic, rollback-safe hot-swap of a ServingEngine's
+    bundle. One manager per engine; `swap()` is serialized (a second
+    concurrent swap waits its turn — model pushes are rare and ordering
+    them is the correct semantics)."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        self._swap_lock = threading.Lock()
+        self._swaps = 0
+        self._rollbacks = 0
+
+    # Public counters (read by engine.metrics()).
+    @property
+    def swaps(self) -> int:
+        return self._swaps
+
+    @property
+    def rollbacks(self) -> int:
+        return self._rollbacks
+
+    @property
+    def version(self) -> int:
+        return self.engine._state.version
+
+    def swap(
+        self,
+        next_bundle,
+        *,
+        expected_bytes: Optional[int] = None,
+        hbm_budget_bytes: Optional[int] = None,
+        release_old: bool = True,
+        drain_timeout_s: float = 30.0,
+    ) -> Dict[str, object]:
+        """Replace the engine's bundle with `next_bundle` under live
+        traffic. `next_bundle` is a ServingBundle or a zero-arg builder
+        returning one (the builder form is the production path: the HBM
+        check runs BEFORE any device allocation, using `expected_bytes`).
+
+        Sequence: budget check -> `swap_stage` fault point + build (staged
+        double-buffered; transient staging faults get the bounded retry
+        policy) -> compatibility check -> warm every bucket program against
+        the new parameters -> `swap_commit` fault point -> atomic flip ->
+        drain in-flight batches off the old state -> release the old
+        bundle. Any failure before the flip rolls back: the old bundle
+        never stopped serving, the new one is released, and the error
+        propagates (counted in `serving_swap_rollbacks`).
+        """
+        with self._swap_lock:
+            engine = self.engine
+            old_state = engine._state
+            builder = next_bundle if callable(next_bundle) else None
+
+            # HBM budget: both generations are resident during the swap.
+            budget = (
+                hbm_budget_bytes
+                if hbm_budget_bytes is not None
+                else device_memory_budget_bytes()
+            )
+            need = expected_bytes
+            if need is None and builder is None:
+                need = int(getattr(next_bundle, "upload_bytes", 0)) or None
+            have = int(old_state.bundle.upload_bytes)
+            if budget is not None and need is not None and have + need > budget:
+                raise HbmBudgetExceeded(
+                    f"staging {need} bytes beside the active bundle's {have} "
+                    f"bytes exceeds the {budget}-byte HBM budget; swap refused "
+                    "before staging"
+                )
+
+            staged = None
+            try:
+                t0 = time.perf_counter()
+
+                def _stage():
+                    faults.fault_point("swap_stage")
+                    return builder() if builder is not None else next_bundle
+
+                staged = faults.retry(_stage, label="bundle swap staging")
+                if getattr(staged, "released", False):
+                    raise SwapIncompatible("next bundle is already released")
+                # Post-build budget re-check for prebuilt/unknown sizes.
+                got = int(getattr(staged, "upload_bytes", 0))
+                if budget is not None and need is None and have + got > budget:
+                    raise HbmBudgetExceeded(
+                        f"staged bundle is {got} bytes; with the active "
+                        f"bundle's {have} bytes that exceeds the {budget}-byte "
+                        "HBM budget"
+                    )
+                new_state = engine._build_state(
+                    staged, version=old_state.version + 1
+                )
+                self._check_compatible(old_state, new_state)
+                # Pre-compile the new parameter shapes for every bucket so
+                # the flip pays zero compile latency on live traffic. The
+                # compile delta bumps the engine's warmup baseline at
+                # commit — staging compiles are warmup, not hot-path.
+                compiles_before_warm = engine.compiles
+                engine._warm_state(new_state)
+                staging_compiles = engine.compiles - compiles_before_warm
+                faults.fault_point("swap_commit")
+                stage_s = time.perf_counter() - t0
+            except BaseException:
+                self._rollbacks += 1
+                faults.COUNTERS.increment("serving_swap_rollbacks")
+                logger.warning(
+                    "bundle swap to version %d rolled back; version %d "
+                    "keeps serving",
+                    old_state.version + 1,
+                    old_state.version,
+                )
+                if staged is not None and staged is not old_state.bundle:
+                    try:
+                        staged.release()
+                    except Exception:  # noqa: BLE001 - rollback best-effort
+                        pass
+                raise
+
+            # The flip itself: one attribute assignment under the engine
+            # lock — in-flight batches finish on the old state, every batch
+            # claimed after this scores on the new one.
+            engine._commit_state(new_state, baseline_bump=staging_compiles)
+            self._swaps += 1
+            faults.COUNTERS.increment("serving_swaps")
+            drained = engine._drain_state(old_state, timeout_s=drain_timeout_s)
+            if not drained:
+                logger.warning(
+                    "old bundle version %d still has in-flight batches after "
+                    "%.1fs; leaving it allocated",
+                    old_state.version,
+                    drain_timeout_s,
+                )
+            if release_old and drained:
+                old_state.bundle.release()
+            logger.info(
+                "bundle hot-swap committed: version %d -> %d (staged in %.3fs)",
+                old_state.version,
+                new_state.version,
+                stage_s,
+            )
+            return {
+                "version": new_state.version,
+                "previous_version": old_state.version,
+                "stage_s": round(stage_s, 4),
+                "old_released": bool(release_old and drained),
+                "staged_bytes": int(getattr(staged, "upload_bytes", 0)),
+            }
+
+    @staticmethod
+    def _check_compatible(old_state, new_state) -> None:
+        """The compiled program family keys on (coordinate order, kinds,
+        shards, feature dims); entity counts may differ (those are traced
+        argument shapes, re-warmed during staging)."""
+        if old_state.kinds != new_state.kinds or [
+            c.cid for c in old_state.coords
+        ] != [c.cid for c in new_state.coords]:
+            raise SwapIncompatible(
+                "next bundle's coordinate ids/kinds differ from the serving "
+                "engine's"
+            )
+        if old_state.coord_shards != new_state.coord_shards:
+            raise SwapIncompatible(
+                "next bundle maps coordinates to different feature shards"
+            )
+        if old_state.shard_dims != new_state.shard_dims:
+            raise SwapIncompatible(
+                f"next bundle's shard dims {new_state.shard_dims} differ "
+                f"from the engine's {old_state.shard_dims}"
+            )

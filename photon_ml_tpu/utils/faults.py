@@ -34,8 +34,11 @@ module is the shared machinery:
 
 * `COUNTERS` — process-wide robustness event counters (`retries`,
   `fallback_sync_uploads`, `fallback_sync_builds`, `fallback_sync_packs`,
-  `injected_faults`, `serving_degraded_batches`). Zero on a clean run by
-  construction, so a nonzero
+  `injected_faults`, `serving_degraded_batches`, `serving_shed_requests`,
+  `serving_deadline_misses`, `serving_circuit_opens`,
+  `serving_fe_only_requests`, `serving_swaps`, `serving_swap_rollbacks`,
+  `serving_flush_thread_failures`, `quarantined_blocks`). Zero on a clean
+  run by construction, so a nonzero
   value in a bench artifact (bench.py e2e_from_disk) is a loud robustness
   regression signal, and tests assert exact counts.
 
@@ -61,18 +64,27 @@ logger = logging.getLogger(__name__)
 # string (the registry is open for future subsystems), but plans naming an
 # unknown site fail fast at parse time — a typo'd PHOTON_FAULTS that
 # silently injects nothing would be a chaos test that tests nothing.
-KNOWN_SITES = (
-    "decode",
-    "pack",
-    "upload",
-    "solve",
-    "checkpoint_write",
+# `python -m photon_ml_tpu.utils.faults --list-sites` prints this table,
+# and tests/conftest.py fails the run if any fault_point() call in the
+# tree names a site missing from it.
+SITE_DESCRIPTIONS = {
+    "decode": "Avro block decode in the ingest data plane",
+    "pack": "host-side CSR->ELL pack (background pack pool)",
+    "upload": "host->device shard upload (AsyncUploader jobs)",
+    "solve": "per-coordinate device solve in coordinate descent",
+    "checkpoint_write": "durable checkpoint writes (state.json + model npz)",
     # Online serving (serving/engine.py): entity-row resolution and the
     # batched device dispatch. The micro-batcher degrades a faulted batch
     # to per-request dispatch (serving/batcher.py) instead of dying.
-    "lookup",
-    "score",
-)
+    "lookup": "serving entity-id -> coefficient-row resolution",
+    "score": "serving batched device dispatch (upload + fused program)",
+    # Serving lifecycle (serving/lifecycle.py): admission into the
+    # micro-batcher queue and the two phases of a bundle hot-swap.
+    "admit": "serving admission control (an armed fault sheds the request)",
+    "swap_stage": "bundle hot-swap staging (build + upload + warm the next bundle)",
+    "swap_commit": "bundle hot-swap commit (the atomic flip between batches)",
+}
+KNOWN_SITES = tuple(SITE_DESCRIPTIONS)
 
 
 class InjectedFault(RuntimeError):
@@ -360,6 +372,15 @@ def retry(
             attempt += 1
 
 
+def is_device_error(exc: BaseException) -> bool:
+    """True for failures attributable to the device/transport layer — the
+    class the serving circuit breaker counts toward opening (a malformed
+    request raising TypeError/ValueError is the REQUEST's fault and must
+    never trip the breaker). Same classification as the retry policy's
+    transient set: what retry could not fix but was device-shaped."""
+    return _default_transient(exc)
+
+
 def solve_retry_attempts() -> int:
     """Extra solve attempts the divergence guard grants a rejected
     (non-finite) coordinate update before keeping the last-good model
@@ -374,3 +395,55 @@ def solve_retry_attempts() -> int:
     except ValueError:
         logger.warning("ignoring malformed PHOTON_SOLVE_RETRIES=%r", raw)
         return 1
+
+
+# ------------------------------------------------------------------ CLI
+
+
+def main(argv=None) -> int:
+    """`python -m photon_ml_tpu.utils.faults --list-sites`: print the
+    registered fault-site table (site, description, and what the ambient
+    PHOTON_FAULTS plan arms at it) so operators can see what a chaos spec
+    can target without reading the source."""
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="python -m photon_ml_tpu.utils.faults",
+        description="Inspect the deterministic fault-injection registry.",
+    )
+    p.add_argument(
+        "--list-sites",
+        action="store_true",
+        help="print every registered fault site and any armed plan",
+    )
+    args = p.parse_args(argv)
+    if not args.list_sites:
+        p.print_help()
+        return 2
+    inj = active_injector()
+    armed = dict(inj.plan.sites) if inj is not None else {}
+    width = max(len(s) for s in KNOWN_SITES)
+    print(f"{'site'.ljust(width)}  armed  description")
+    for site in KNOWN_SITES:
+        spec = armed.get(site)
+        if spec is None:
+            tag = "-"
+        else:
+            bits = []
+            if spec.first_n:
+                bits.append(f"first {spec.first_n}")
+            if spec.indices:
+                bits.append("@" + "+".join(str(i) for i in sorted(spec.indices)))
+            if spec.probability:
+                bits.append(f"p={spec.probability}")
+            tag = ",".join(bits) or "-"
+        print(f"{site.ljust(width)}  {tag:5s}  {SITE_DESCRIPTIONS[site]}")
+    if inj is not None:
+        unknown = sorted(set(armed) - set(KNOWN_SITES))
+        if unknown:  # unreachable via parse(), but be honest if it happens
+            print(f"WARNING: armed plan names unregistered sites: {unknown}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

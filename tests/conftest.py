@@ -39,12 +39,52 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
-        "chaos: failure-domain tests (fault injection, kill-resume parity)",
+        "chaos: failure-domain tests (fault injection, kill-resume parity); "
+        "the serving subset (-m 'chaos and serving') runs inside tier-1",
     )
     config.addinivalue_line(
         "markers",
-        "serving: online serving engine tests (bundle/engine/batcher)",
+        "serving: online serving engine tests (bundle/engine/batcher/"
+        "lifecycle)",
     )
+    _assert_fault_sites_registered()
+
+
+def _assert_fault_sites_registered():
+    """Guard: every `fault_point("<site>")` call in the tree must name a
+    site registered in utils.faults.KNOWN_SITES — an unregistered site is
+    unreachable from PHOTON_FAULTS (plans naming it fail to parse), i.e. a
+    fault point no chaos test can ever arm."""
+    import re
+
+    from photon_ml_tpu.utils import faults
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    pat = re.compile(r"fault_point\(\s*[\"']([A-Za-z0-9_]+)[\"']")
+    offenders = []
+    roots = [os.path.join(repo, "photon_ml_tpu"), os.path.join(repo, "bench.py")]
+    for root in roots:
+        files = [root] if os.path.isfile(root) else [
+            os.path.join(dirpath, fn)
+            for dirpath, _, fns in os.walk(root)
+            for fn in fns
+            if fn.endswith(".py")
+        ]
+        for path in files:
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+            for m in pat.finditer(text):
+                if m.group(1) not in faults.KNOWN_SITES:
+                    line = text.count("\n", 0, m.start()) + 1
+                    offenders.append(f"{path}:{line}: {m.group(1)!r}")
+    if offenders:
+        import pytest as _pytest
+
+        raise _pytest.UsageError(
+            "fault_point() calls with unregistered sites (add them to "
+            "photon_ml_tpu.utils.faults.KNOWN_SITES):\n  "
+            + "\n  ".join(offenders)
+        )
 
 
 @pytest.fixture

@@ -111,6 +111,91 @@ def test_avro_multiblock(tmp_path):
     assert [r["v"] for r in out] == list(range(10_000))
 
 
+class TestCorruptBlockQuarantine:
+    """With quarantine=True (replay/ingest: row-shaped data),
+    iter_container skips-and-counts a corrupt block (resyncing at the next
+    sync marker) instead of aborting the file; loud only when EVERY block
+    is bad. The DEFAULT stays loud — a model artifact silently missing a
+    block of coefficients would serve wrong answers, not degraded ones."""
+
+    SCHEMA = {
+        "name": "R",
+        "type": "record",
+        "fields": [{"name": "v", "type": "long"}],
+    }
+
+    def _three_block_file(self, tmp_path):
+        p = str(tmp_path / "q.avro")
+        avro_io.write_container(
+            p, self.SCHEMA, [{"v": i} for i in range(6)], block_records=2
+        )
+        data = bytearray(open(p, "rb").read())
+        _, _, sync, _ = avro_io.read_header(bytes(data), p)
+        # Sync occurrences: end-of-header, then one per block.
+        marks = []
+        at = bytes(data).find(sync)
+        while at >= 0:
+            marks.append(at)
+            at = bytes(data).find(sync, at + 1)
+        assert len(marks) == 4  # header + 3 blocks
+        return p, data, sync, marks
+
+    def _smash(self, data, lo, hi):
+        # 0xFF floods the varint reader (continuation bit always set), so
+        # the block fails framing deterministically, whatever the codec.
+        data[lo:hi] = b"\xff" * (hi - lo)
+
+    def test_middle_block_quarantined(self, tmp_path):
+        from photon_ml_tpu.utils import faults
+
+        p, data, sync, marks = self._three_block_file(tmp_path)
+        self._smash(data, marks[1] + len(sync), marks[2])
+        open(p, "wb").write(bytes(data))
+        recs = [r for _, r in avro_io.iter_container(p, quarantine=True)]
+        assert [r["v"] for r in recs] == [0, 1, 4, 5]  # block 2 skipped
+        assert faults.COUNTERS.get("quarantined_blocks") == 1
+
+    def test_all_blocks_bad_is_loud(self, tmp_path):
+        p, data, sync, marks = self._three_block_file(tmp_path)
+        for k in range(3):
+            self._smash(data, marks[k] + len(sync), marks[k + 1])
+        open(p, "wb").write(bytes(data))
+        with pytest.raises(ValueError, match="all 3 block"):
+            list(avro_io.iter_container(p, quarantine=True))
+
+    def test_torn_tail_block_quarantined(self, tmp_path):
+        from photon_ml_tpu.utils import faults
+
+        p, data, sync, marks = self._three_block_file(tmp_path)
+        open(p, "wb").write(bytes(data[: marks[3] - 4]))  # crash mid-block 3
+        recs = [r for _, r in avro_io.iter_container(p, quarantine=True)]
+        assert [r["v"] for r in recs] == [0, 1, 2, 3]
+        assert faults.COUNTERS.get("quarantined_blocks") == 1
+
+    def test_clean_file_counts_nothing(self, tmp_path):
+        from photon_ml_tpu.utils import faults
+
+        p, _, _, _ = self._three_block_file(tmp_path)
+        recs = [r for _, r in avro_io.iter_container(p, quarantine=True)]
+        assert [r["v"] for r in recs] == list(range(6))
+        assert faults.COUNTERS.get("quarantined_blocks") == 0
+
+    def test_default_read_stays_loud(self, tmp_path):
+        """Completeness-critical consumers (model stores, checkpoints,
+        scores) must still get a hard error on the FIRST corrupt block —
+        quarantine is opt-in for row-shaped reads only."""
+        from photon_ml_tpu.utils import faults
+
+        p, data, sync, marks = self._three_block_file(tmp_path)
+        self._smash(data, marks[1] + len(sync), marks[2])
+        open(p, "wb").write(bytes(data))
+        with pytest.raises(ValueError, match="corrupt block"):
+            list(avro_io.iter_container(p))
+        with pytest.raises(ValueError, match="corrupt block"):
+            avro_io.read_container(p)
+        assert faults.COUNTERS.get("quarantined_blocks") == 0
+
+
 def test_bayesian_model_record_roundtrip(tmp_path):
     rec = {
         "modelId": "fixed-effect",

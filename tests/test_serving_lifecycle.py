@@ -1,0 +1,965 @@
+"""Serving-under-fire tests: admission control, deadlines, circuit-broken
+degradation, atomic bundle hot-swap, health states, crash-safe replay.
+
+The load-bearing contracts, mirroring ISSUE 5:
+
+* overload sheds with TYPED `Overloaded` rejections — never an unbounded
+  backlog, never a hang; admitted requests still complete;
+* a request that expires in queue fails with `DeadlineExceeded` BEFORE
+  wasting a device slot, and is never co-batched past its budget;
+* after K consecutive device-class failures the circuit OPENs and traffic
+  degrades to fixed-effect-only answers BITWISE-equal to FE-only
+  `GameTransformer` output (the pinned zero-row path), with half-open
+  probing to recover;
+* a bundle hot-swap under live traffic fails/drops ZERO requests, and
+  post-swap answers are bitwise-equal to a cold-started engine on the new
+  bundle; staging/commit faults roll back with the old bundle still
+  serving;
+* a flush-thread death fails every pending future with the error instead
+  of hanging them, and close() stays joinable;
+* a SIGKILLed replay leaves only readable score parts behind, and a
+  re-run completes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.data.game_dataset import GameDataset
+from photon_ml_tpu.game.model import (
+    Coefficients,
+    FixedEffectModel,
+    GameModel,
+    RandomEffectModel,
+)
+from photon_ml_tpu.serving import (
+    BatcherUnhealthy,
+    CircuitBreaker,
+    CircuitState,
+    DeadlineExceeded,
+    HbmBudgetExceeded,
+    HealthStateMachine,
+    Overloaded,
+    ScoreRequest,
+    ServingBundle,
+    ServingEngine,
+    ServingState,
+    SwapIncompatible,
+)
+from photon_ml_tpu.transformers.game_transformer import (
+    CoordinateScoringSpec,
+    GameTransformer,
+)
+from photon_ml_tpu.types import TaskType
+from photon_ml_tpu.utils import faults
+
+pytestmark = pytest.mark.serving
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TASK = TaskType.LOGISTIC_REGRESSION
+D_FE, D_RE, N_ENTITIES = 10, 4, 6
+
+
+def _fixture(rng, n=9, seed_shift=0):
+    """(model, specs, dataset, requests) — one FE + one RE coordinate."""
+    X = rng.normal(size=(n, D_FE)).astype(np.float32)
+    Xe = rng.normal(size=(n, D_RE)).astype(np.float32)
+    entity_ids = rng.integers(0, N_ENTITIES + 2, size=n)
+    offsets = rng.normal(size=n).astype(np.float32)
+    w = rng.normal(size=D_FE).astype(np.float32)
+    matrix = np.zeros((N_ENTITIES + 1, D_RE), np.float32)
+    matrix[:N_ENTITIES] = rng.normal(size=(N_ENTITIES, D_RE))
+    model = GameModel(
+        {
+            "fixed": FixedEffectModel(Coefficients(jnp.asarray(w)), TASK),
+            "per-e": RandomEffectModel(jnp.asarray(matrix), None, TASK),
+        }
+    )
+    specs = {
+        "fixed": CoordinateScoringSpec(shard="g"),
+        "per-e": CoordinateScoringSpec(
+            shard="re",
+            random_effect_type="eid",
+            entity_index={str(i): i for i in range(N_ENTITIES)},
+        ),
+    }
+    ds = GameDataset.build(
+        {"g": X, "re": Xe},
+        np.zeros(n, np.float32),
+        offsets=offsets,
+        id_tags={"eid": entity_ids.astype(str)},
+    )
+    reqs = [
+        ScoreRequest(
+            features={"g": X[i], "re": Xe[i]},
+            entity_ids={"eid": str(entity_ids[i])},
+            offset=float(offsets[i]),
+            uid=str(i),
+        )
+        for i in range(n)
+    ]
+    return model, specs, ds, reqs
+
+
+def _fe_only_ref(model, specs, ds):
+    """FE-only GameTransformer scores (offset + fixed effects)."""
+    fe_model = GameModel({"fixed": model["fixed"]})
+    n = int(np.asarray(ds.offsets).shape[0])
+    ds_fe = GameDataset.build(
+        {"g": np.asarray(ds.shards["g"])},
+        np.zeros(n, np.float32),
+        offsets=np.asarray(ds.offsets),
+    )
+    return np.asarray(
+        GameTransformer(fe_model, {"fixed": specs["fixed"]}, TASK)
+        .transform(ds_fe)
+        .scores
+    )
+
+
+def _scores(results):
+    return np.asarray([r.score for r in results], np.float32)
+
+
+def _slow_engine(eng, delay_s):
+    """Wrap score_batch with a stall so the flush thread stays busy and the
+    pending queue can actually fill (timing-only, math untouched)."""
+    inner = eng.score_batch
+
+    def slow(requests, **kw):
+        time.sleep(delay_s)
+        return inner(requests, **kw)
+
+    eng.score_batch = slow  # type: ignore[method-assign]
+    return eng
+
+
+# ------------------------------------------------------------- admission
+
+
+class TestAdmissionControl:
+    def test_overload_sheds_typed_and_admitted_complete(self, rng):
+        model, specs, _, reqs = _fixture(rng, n=4)
+        eng = _slow_engine(
+            ServingEngine(ServingBundle.from_model(model, specs, TASK), max_batch=4),
+            0.03,
+        )
+        with eng, eng.batcher(max_wait_ms=1.0, max_pending=2) as b:
+            futures, shed = [], 0
+            for _ in range(40):
+                try:
+                    futures.append(b.submit(reqs[0]))
+                except Overloaded:
+                    shed += 1
+            # Typed shedding, no unbounded backlog, and NO hangs: every
+            # admitted future resolves within the timeout.
+            assert shed > 0
+            assert all(
+                isinstance(f.result(timeout=20).score, float) for f in futures
+            )
+            m = b.metrics()
+        assert m["shed"] == shed
+        assert m["completed"] == len(futures)
+        assert faults.COUNTERS.get("serving_shed_requests") == shed
+
+    def test_blocking_submit_backpressures_instead_of_shedding(self, rng):
+        model, specs, ds, reqs = _fixture(rng, n=9)
+        ref = np.asarray(GameTransformer(model, specs, TASK).transform(ds).scores)
+        eng = _slow_engine(
+            ServingEngine(ServingBundle.from_model(model, specs, TASK), max_batch=4),
+            0.01,
+        )
+        with eng, eng.batcher(max_wait_ms=1.0, max_pending=2) as b:
+            res = b.score_all(reqs)  # closed-loop: block=True inside
+            m = b.metrics()
+        assert (_scores(res) == ref).all()
+        assert m["shed"] == 0
+
+    def test_admit_fault_site_sheds_via_photon_faults(self, rng, monkeypatch):
+        """Chaos path for the new `admit` site, armed through the SAME env
+        knob production uses."""
+        model, specs, _, reqs = _fixture(rng, n=3)
+        monkeypatch.setenv("PHOTON_FAULTS", "admit:2")
+        faults.clear()  # force env re-read at the next fault_point
+        with ServingEngine(
+            ServingBundle.from_model(model, specs, TASK), max_batch=4
+        ) as eng:
+            with eng.batcher(max_wait_ms=1.0) as b:
+                with pytest.raises(Overloaded):
+                    b.submit(reqs[0])
+                with pytest.raises(Overloaded):
+                    b.submit(reqs[1])
+                res = b.score(reqs[2])  # third admit passes
+        assert isinstance(res.score, float)
+        assert faults.COUNTERS.get("serving_shed_requests") == 2
+        assert faults.COUNTERS.get("injected_faults") == 2
+
+    def test_closed_batcher_beats_armed_admit_fault(self, rng):
+        """A closed batcher must report its typed state, not consume the
+        armed admit fault as a phantom shed."""
+        model, specs, _, reqs = _fixture(rng, n=2)
+        eng = ServingEngine(ServingBundle.from_model(model, specs, TASK), max_batch=4)
+        b = eng.batcher()
+        eng.close()
+        with faults.inject("admit:1"):
+            with pytest.raises(RuntimeError, match="closed"):
+                b.submit(reqs[0])
+        assert faults.COUNTERS.get("serving_shed_requests") == 0
+        assert faults.COUNTERS.get("injected_faults") == 0
+
+
+# -------------------------------------------------------------- deadlines
+
+
+class TestDeadlineEnforcement:
+    def test_expired_in_queue_fails_typed(self, rng):
+        model, specs, _, reqs = _fixture(rng, n=2)
+        eng = _slow_engine(
+            ServingEngine(ServingBundle.from_model(model, specs, TASK), max_batch=4),
+            0.15,
+        )
+        with eng, eng.batcher(max_wait_ms=1.0) as b:
+            blocker = b.submit(reqs[0])  # occupies the device for 150ms
+            time.sleep(0.02)  # let the flush thread claim it
+            doomed = b.submit(reqs[1], deadline_ms=5.0)
+            with pytest.raises(DeadlineExceeded):
+                doomed.result(timeout=20)
+            assert isinstance(blocker.result(timeout=20).score, float)
+            m = b.metrics()
+        assert m["deadline_missed"] == 1
+        assert faults.COUNTERS.get("serving_deadline_misses") == 1
+
+    def test_request_carried_budget_honored(self, rng):
+        model, specs, _, reqs = _fixture(rng, n=2)
+        eng = _slow_engine(
+            ServingEngine(ServingBundle.from_model(model, specs, TASK), max_batch=4),
+            0.15,
+        )
+        req = ScoreRequest(
+            features=dict(reqs[1].features),
+            entity_ids=dict(reqs[1].entity_ids),
+            deadline_ms=5.0,
+        )
+        with eng, eng.batcher(max_wait_ms=1.0) as b:
+            b.submit(reqs[0])
+            time.sleep(0.02)
+            with pytest.raises(DeadlineExceeded):
+                b.submit(req).result(timeout=20)
+
+    def test_unexpired_neighbors_still_answered(self, rng):
+        """Batch assembly drops ONLY the expired request; queued neighbors
+        with headroom are co-batched and answered normally."""
+        model, specs, ds, reqs = _fixture(rng, n=3)
+        ref = np.asarray(GameTransformer(model, specs, TASK).transform(ds).scores)
+        eng = _slow_engine(
+            ServingEngine(ServingBundle.from_model(model, specs, TASK), max_batch=4),
+            0.1,
+        )
+        with eng, eng.batcher(max_wait_ms=1.0) as b:
+            f0 = b.submit(reqs[0])  # claimed; stalls the flush thread
+            time.sleep(0.02)
+            f1 = b.submit(reqs[1], deadline_ms=5.0)  # expires in queue
+            f2 = b.submit(reqs[2])  # no deadline: must survive the purge
+            with pytest.raises(DeadlineExceeded):
+                f1.result(timeout=20)
+            assert f0.result(timeout=20).score == ref[0]
+            assert f2.result(timeout=20).score == ref[2]
+
+    def test_stale_service_ewma_decays_instead_of_wedging(self, rng):
+        """A service-time spike (one slow batch) must not pre-fail every
+        short-budget request forever: dispatch-less expiry rounds decay the
+        EWMA until traffic flows again."""
+        model, specs, _, reqs = _fixture(rng, n=2)
+        with ServingEngine(
+            ServingBundle.from_model(model, specs, TASK), max_batch=4
+        ) as eng:
+            eng.warmup()
+            with eng.batcher(max_wait_ms=1.0) as b:
+                with b._cv:
+                    b._service_ewma_s = 30.0  # absurd spike
+                got_answer = False
+                for _ in range(20):
+                    try:
+                        b.submit(reqs[0], deadline_ms=100.0).result(timeout=20)
+                        got_answer = True
+                        break
+                    except DeadlineExceeded:
+                        continue
+                assert got_answer, "EWMA margin wedged the batcher"
+
+    def test_no_deadline_means_no_misses(self, rng):
+        model, specs, _, reqs = _fixture(rng, n=9)
+        with ServingEngine(
+            ServingBundle.from_model(model, specs, TASK), max_batch=4
+        ) as eng:
+            with eng.batcher(max_wait_ms=1.0) as b:
+                b.score_all(reqs)
+                m = b.metrics()
+        assert m["deadline_missed"] == 0
+        assert faults.COUNTERS.get("serving_deadline_misses") == 0
+
+
+# ----------------------------------------------------- flush-thread death
+
+
+class TestFlushThreadDeath:
+    def test_pending_futures_failed_not_hung(self, rng):
+        model, specs, _, reqs = _fixture(rng, n=3)
+        eng = ServingEngine(ServingBundle.from_model(model, specs, TASK), max_batch=4)
+        eng.warmup()  # READY, so the death shows up as a DEGRADED reason
+        b = eng.batcher(max_wait_ms=60_000.0, max_batch=4)  # holds the queue
+        boom = RuntimeError("flush bookkeeping bug")
+
+        def broken(batch):
+            raise boom
+
+        b._dispatch = broken  # type: ignore[method-assign]
+        futures = [b.submit(r) for r in reqs]
+        with b._cv:
+            b._cv.notify_all()
+        # Force a flush by filling max_batch (4th submit may race the dying
+        # thread — both Overloaded-free acceptance and unhealthy rejection
+        # are legal for IT; the three queued futures must fail, not hang).
+        try:
+            futures.append(b.submit(reqs[0]))
+        except BatcherUnhealthy:
+            pass
+        for f in futures:
+            with pytest.raises(RuntimeError, match="flush bookkeeping bug"):
+                f.result(timeout=20)
+        # The batcher is typed-unhealthy for new work, close() stays
+        # joinable, and the engine is DEGRADED with the recorded reason.
+        with pytest.raises(BatcherUnhealthy):
+            b.submit(reqs[0])
+        assert not b.healthy
+        assert faults.COUNTERS.get("serving_flush_thread_failures") == 1
+        assert eng.health.state is ServingState.DEGRADED
+        assert any(
+            "batcher_unhealthy" in r for r in eng.health.degraded_reasons
+        )
+        eng.close()  # joins the (dead) thread without wedging
+        assert b.closed
+
+
+# --------------------------------------------------------- circuit breaker
+
+
+class TestCircuitBreakerUnit:
+    def test_opens_after_threshold_and_probes_single_file(self):
+        t = [0.0]
+        br = CircuitBreaker(threshold=3, probe_interval_s=10.0, clock=lambda: t[0])
+        for _ in range(2):
+            br.on_failure(br.acquire())
+        assert br.state is CircuitState.CLOSED
+        br.on_failure(br.acquire())  # third consecutive: OPEN
+        assert br.state is CircuitState.OPEN
+        assert br.acquire() is None  # interval not elapsed
+        t[0] = 11.0
+        probe = br.acquire()  # the single probe permit
+        assert probe is not None and probe.probe
+        assert br.acquire() is None  # second caller: still degraded
+        br.on_success(probe)
+        assert br.state is CircuitState.CLOSED
+        assert faults.COUNTERS.get("serving_circuit_opens") == 1
+
+    def test_failed_probe_rearms_interval(self):
+        t = [0.0]
+        br = CircuitBreaker(threshold=1, probe_interval_s=5.0, clock=lambda: t[0])
+        br.on_failure(br.acquire())
+        t[0] = 6.0
+        probe = br.acquire()
+        assert probe is not None
+        br.on_failure(probe)  # probe failed: OPEN again, interval restarts
+        assert br.state is CircuitState.OPEN
+        t[0] = 10.0
+        assert br.acquire() is None  # 6.0 + 5.0 not reached
+        t[0] = 11.5
+        assert br.acquire() is not None
+
+    def test_abandon_returns_probe_permit(self):
+        """A probe that failed for a non-device reason must not wedge the
+        breaker in HALF_OPEN forever."""
+        t = [0.0]
+        br = CircuitBreaker(threshold=1, probe_interval_s=1.0, clock=lambda: t[0])
+        br.on_failure(br.acquire())
+        t[0] = 2.0
+        probe = br.acquire()
+        br.on_abandon(probe)
+        assert br.acquire() is not None  # permit is available again
+
+    def test_success_resets_consecutive_count(self):
+        br = CircuitBreaker(threshold=2)
+        br.on_failure(br.acquire())
+        br.on_success(br.acquire())
+        br.on_failure(br.acquire())  # 1 consecutive, not 2
+        assert br.state is CircuitState.CLOSED
+
+    def test_stale_free_permit_cannot_clobber_inflight_probe(self):
+        """A CLOSED-era permit resolving late must neither release nor
+        decide another batcher's half-open probe."""
+        t = [0.0]
+        br = CircuitBreaker(threshold=3, probe_interval_s=1.0, clock=lambda: t[0])
+        stale = br.acquire()  # free permit, acquired while CLOSED
+        assert stale is not None and not stale.probe
+        for _ in range(3):
+            br.on_failure(br.acquire())  # circuit opens
+        t[0] = 2.0
+        probe = br.acquire()
+        assert probe is not None and probe.probe
+        br.on_abandon(stale)  # stale resolution: probe still in flight
+        assert br.acquire() is None  # no second probe handed out
+        br.on_failure(stale)  # stale device failure: counts, doesn't probe
+        assert br.acquire() is None
+        br.on_success(probe)  # the REAL probe decides the outcome
+        assert br.state is CircuitState.CLOSED
+
+    def test_stale_free_permit_success_cannot_close_open_circuit(self):
+        """A CLOSED-era permit succeeding LATE (acquired before the device
+        died) must not re-close an open circuit — only the probe may route
+        traffic back."""
+        t = [0.0]
+        br = CircuitBreaker(threshold=2, probe_interval_s=1.0, clock=lambda: t[0])
+        stale = br.acquire()
+        assert stale is not None and not stale.probe
+        for _ in range(2):
+            br.on_failure(br.acquire())
+        assert br.state is CircuitState.OPEN
+        br.on_success(stale)  # pre-outage evidence arriving late
+        assert br.state is CircuitState.OPEN  # still open, probe decides
+        t[0] = 2.0
+        probe = br.acquire()
+        assert probe is not None and probe.probe
+        br.on_success(probe)
+        assert br.state is CircuitState.CLOSED
+
+
+@pytest.mark.chaos
+class TestCircuitBreakerServing:
+    def test_open_circuit_serves_fe_only_bitwise(self, rng, monkeypatch):
+        """Persistent device faults open the circuit after K failures;
+        subsequent traffic gets ANSWERS (not errors) bitwise-equal to
+        fixed-effect-only GameTransformer output."""
+        monkeypatch.setenv("PHOTON_RETRY_MAX_ATTEMPTS", "1")
+        monkeypatch.setenv("PHOTON_RETRY_BASE_DELAY_S", "0.001")
+        model, specs, ds, reqs = _fixture(rng, n=9)
+        fe_ref = _fe_only_ref(model, specs, ds)
+        with ServingEngine(
+            ServingBundle.from_model(model, specs, TASK),
+            max_batch=4,
+            circuit_threshold=2,
+            circuit_probe_interval_s=60.0,  # no probe inside this test
+        ) as eng:
+            eng.warmup()
+            with faults.inject("score:1000"):  # device persistently down
+                with eng.batcher(max_wait_ms=1.0) as b:
+                    failed, fe_answers = 0, {}
+                    for i, r in enumerate(reqs):
+                        try:
+                            fe_answers[i] = b.score(r)
+                        except faults.InjectedFault:
+                            failed += 1
+                    m = b.metrics()
+            # The pre-open failures surfaced as errors (the evidence), the
+            # rest as FE-only answers.
+            assert failed == 2
+            assert m["circuit_state"] == "OPEN"
+            assert m["circuit_opens"] == 1
+            assert faults.COUNTERS.get("serving_circuit_opens") == 1
+            assert eng.health.state is ServingState.DEGRADED
+            assert "circuit_open" in eng.health.degraded_reasons
+            for i, res in fe_answers.items():
+                assert res.fe_only
+                assert res.score == fe_ref[i]
+            assert m["fe_only_answers"] == len(fe_answers)
+
+    def test_half_open_probe_recovers_full_path(self, rng, monkeypatch):
+        monkeypatch.setenv("PHOTON_RETRY_MAX_ATTEMPTS", "1")
+        monkeypatch.setenv("PHOTON_RETRY_BASE_DELAY_S", "0.001")
+        model, specs, ds, reqs = _fixture(rng, n=6)
+        ref = np.asarray(GameTransformer(model, specs, TASK).transform(ds).scores)
+        fe_ref = _fe_only_ref(model, specs, ds)
+        with ServingEngine(
+            ServingBundle.from_model(model, specs, TASK),
+            max_batch=4,
+            circuit_threshold=1,
+            circuit_probe_interval_s=0.05,
+        ) as eng:
+            eng.warmup()
+            # Exactly 2 faulted invocations: the batch attempt + the
+            # per-request retry — the ONE device failure that opens the
+            # K=1 circuit. Everything after scores clean.
+            with faults.inject("score:2"):
+                with eng.batcher(max_wait_ms=1.0) as b:
+                    with pytest.raises(faults.InjectedFault):
+                        b.score(reqs[0])
+                    assert eng.breaker.state is CircuitState.OPEN
+                    r1 = b.score(reqs[1])  # inside the interval: FE-only
+                    assert r1.fe_only and r1.score == fe_ref[1]
+                    time.sleep(0.06)  # probe due
+                    r2 = b.score(reqs[2])  # the probe: full path, succeeds
+                    assert not r2.fe_only and r2.score == ref[2]
+                    assert eng.breaker.state is CircuitState.CLOSED
+                    rest = b.score_all(reqs[3:])
+            assert (_scores(rest) == ref[3:]).all()
+            assert eng.health.state is ServingState.READY
+
+    def test_malformed_request_never_trips_breaker(self, rng):
+        """A poisoned request fails ITS future; the device is innocent —
+        the circuit stays closed and neighbors keep full-path answers."""
+        model, specs, ds, reqs = _fixture(rng, n=4)
+        ref = np.asarray(GameTransformer(model, specs, TASK).transform(ds).scores)
+        poison = ScoreRequest(
+            features={"g": np.zeros((3, 3), np.float32)},  # wrong shape
+            entity_ids={"eid": "0"},
+        )
+        with ServingEngine(
+            ServingBundle.from_model(model, specs, TASK),
+            max_batch=4,
+            circuit_threshold=1,  # a single device failure WOULD open it
+        ) as eng:
+            with eng.batcher(max_wait_ms=1.0) as b:
+                futs = [b.submit(r) for r in reqs[:3]] + [b.submit(poison)]
+                good = [f.result(timeout=20) for f in futs[:3]]
+                with pytest.raises(Exception) as ei:
+                    futs[3].result(timeout=20)
+                assert not isinstance(ei.value, faults.InjectedFault)
+            assert eng.breaker.state is CircuitState.CLOSED
+        assert (_scores(good) == ref[:3]).all()
+        assert faults.COUNTERS.get("serving_circuit_opens") == 0
+
+
+# ------------------------------------------------------------ bundle swap
+
+
+def _second_model(rng, model):
+    """A same-shape successor (new weights, same E / dims / shards)."""
+    w2 = rng.normal(size=D_FE).astype(np.float32)
+    matrix2 = np.zeros((N_ENTITIES + 1, D_RE), np.float32)
+    matrix2[:N_ENTITIES] = rng.normal(size=(N_ENTITIES, D_RE))
+    return GameModel(
+        {
+            "fixed": FixedEffectModel(Coefficients(jnp.asarray(w2)), TASK),
+            "per-e": RandomEffectModel(jnp.asarray(matrix2), None, TASK),
+        }
+    )
+
+
+class TestBundleHotSwap:
+    def test_swap_under_live_traffic_zero_failures_bitwise(self, rng):
+        model, specs, ds, reqs = _fixture(rng, n=9)
+        model2 = _second_model(rng, model)
+        with ServingEngine(
+            ServingBundle.from_model(model, specs, TASK), max_batch=8
+        ) as eng:
+            eng.warmup()
+            stop = threading.Event()
+            failures: list = []
+            answered = [0]
+
+            def traffic(b):
+                i = 0
+                while not stop.is_set():
+                    try:
+                        b.score(reqs[i % len(reqs)])
+                        answered[0] += 1
+                    except Exception as exc:  # noqa: BLE001 - recorded
+                        failures.append(exc)
+                    i += 1
+
+            with eng.batcher(max_wait_ms=0.5) as b:
+                t = threading.Thread(target=lambda: traffic(b))
+                t.start()
+                time.sleep(0.05)  # traffic flowing against version 0
+                info = eng.bundle_manager.swap(
+                    lambda: ServingBundle.from_model(model2, specs, TASK)
+                )
+                time.sleep(0.05)  # traffic flowing against version 1
+                stop.set()
+                t.join(timeout=20)
+            assert not t.is_alive()
+            assert failures == []
+            assert answered[0] > 0
+            assert info["version"] == 1 and info["old_released"]
+            assert eng.bundle_version == 1
+            # Post-swap answers == a cold-started engine on the new bundle.
+            with ServingEngine(
+                ServingBundle.from_model(model2, specs, TASK), max_batch=8
+            ) as cold:
+                ref2 = _scores(cold.score_batch(reqs))
+            assert (_scores(eng.score_batch(reqs)) == ref2).all()
+            # Staging pre-warmed the new parameters: the flip compiled
+            # nothing on the hot path.
+            assert eng.recompiles_after_warmup == 0
+            assert eng.metrics()["bundle_swaps"] == 1
+        assert faults.COUNTERS.get("serving_swaps") == 1
+        assert faults.COUNTERS.get("serving_swap_rollbacks") == 0
+
+    def test_stage_fault_rolls_back_old_keeps_serving(self, rng, monkeypatch):
+        monkeypatch.setenv("PHOTON_RETRY_MAX_ATTEMPTS", "2")
+        monkeypatch.setenv("PHOTON_RETRY_BASE_DELAY_S", "0.001")
+        model, specs, ds, reqs = _fixture(rng, n=5)
+        ref = np.asarray(GameTransformer(model, specs, TASK).transform(ds).scores)
+        model2 = _second_model(rng, model)
+        with ServingEngine(
+            ServingBundle.from_model(model, specs, TASK), max_batch=8
+        ) as eng:
+            with faults.inject("swap_stage:1000"):  # beyond the retry budget
+                with pytest.raises(faults.InjectedFault):
+                    eng.bundle_manager.swap(
+                        lambda: ServingBundle.from_model(model2, specs, TASK)
+                    )
+            assert eng.bundle_version == 0
+            assert (_scores(eng.score_batch(reqs)) == ref).all()
+            assert eng.metrics()["bundle_swap_rollbacks"] == 1
+        assert faults.COUNTERS.get("serving_swap_rollbacks") == 1
+        assert faults.COUNTERS.get("serving_swaps") == 0
+
+    def test_transient_stage_fault_is_retried_through(self, rng):
+        model, specs, _, reqs = _fixture(rng, n=3)
+        model2 = _second_model(rng, model)
+        with ServingEngine(
+            ServingBundle.from_model(model, specs, TASK), max_batch=4
+        ) as eng:
+            with faults.inject("swap_stage:1"):  # one blip: retry absorbs it
+                info = eng.bundle_manager.swap(
+                    lambda: ServingBundle.from_model(model2, specs, TASK)
+                )
+            assert info["version"] == 1
+        assert faults.COUNTERS.get("serving_swaps") == 1
+        assert faults.COUNTERS.get("serving_swap_rollbacks") == 0
+
+    def test_commit_fault_rolls_back(self, rng):
+        model, specs, ds, reqs = _fixture(rng, n=5)
+        ref = np.asarray(GameTransformer(model, specs, TASK).transform(ds).scores)
+        model2 = _second_model(rng, model)
+        with ServingEngine(
+            ServingBundle.from_model(model, specs, TASK), max_batch=8
+        ) as eng:
+            with faults.inject("swap_commit:1"):
+                with pytest.raises(faults.InjectedFault):
+                    eng.bundle_manager.swap(
+                        lambda: ServingBundle.from_model(model2, specs, TASK)
+                    )
+            assert eng.bundle_version == 0
+            assert (_scores(eng.score_batch(reqs)) == ref).all()
+        assert faults.COUNTERS.get("serving_swap_rollbacks") == 1
+
+    def test_hbm_budget_refused_before_staging(self, rng):
+        model, specs, _, _ = _fixture(rng, n=2)
+        built = [0]
+
+        def builder():
+            built[0] += 1
+            return ServingBundle.from_model(model, specs, TASK)
+
+        with ServingEngine(
+            ServingBundle.from_model(model, specs, TASK), max_batch=4
+        ) as eng:
+            with pytest.raises(HbmBudgetExceeded):
+                eng.bundle_manager.swap(
+                    builder, expected_bytes=1 << 40, hbm_budget_bytes=1 << 20
+                )
+        assert built[0] == 0  # refused BEFORE any device allocation
+        assert faults.COUNTERS.get("serving_swaps") == 0
+
+    def test_incompatible_bundle_rejected(self, rng):
+        model, specs, _, _ = _fixture(rng, n=2)
+        rng2 = np.random.default_rng(99)
+        w = rng2.normal(size=D_FE + 3).astype(np.float32)  # wrong FE dim
+        bad = GameModel(
+            {"fixed": FixedEffectModel(Coefficients(jnp.asarray(w)), TASK)}
+        )
+        bad_specs = {"fixed": CoordinateScoringSpec(shard="g")}
+        with ServingEngine(
+            ServingBundle.from_model(model, specs, TASK), max_batch=4
+        ) as eng:
+            with pytest.raises(SwapIncompatible):
+                eng.bundle_manager.swap(
+                    ServingBundle.from_model(bad, bad_specs, TASK)
+                )
+            assert eng.bundle_version == 0
+        assert faults.COUNTERS.get("serving_swap_rollbacks") == 1
+
+    def test_released_bundle_refused(self, rng):
+        model, specs, _, _ = _fixture(rng, n=2)
+        bundle = ServingBundle.from_model(model, specs, TASK)
+        bundle.release()
+        assert bundle.released
+        with pytest.raises(RuntimeError, match="released"):
+            ServingEngine(bundle, max_batch=4)
+
+
+# ----------------------------------------------------------- health states
+
+
+class TestHealthStateMachine:
+    def test_engine_lifecycle_states(self, rng):
+        model, specs, _, reqs = _fixture(rng, n=2)
+        eng = ServingEngine(ServingBundle.from_model(model, specs, TASK), max_batch=4)
+        assert eng.health.state is ServingState.STARTING
+        assert eng.metrics()["state"] == "STARTING"
+        eng.warmup()
+        assert eng.health.state is ServingState.READY
+        eng.close()
+        assert eng.health.state is ServingState.CLOSED
+        snap = eng.health.snapshot()
+        path = [t["to"] for t in snap["transitions"]]
+        assert path == ["READY", "DRAINING", "CLOSED"]
+
+    def test_close_drains_pending_then_closes(self, rng):
+        model, specs, _, reqs = _fixture(rng, n=5)
+        eng = ServingEngine(ServingBundle.from_model(model, specs, TASK), max_batch=4)
+        eng.warmup()
+        b = eng.batcher(max_wait_ms=10_000.0)  # flush deadline never fires
+        futures = [b.submit(r) for r in reqs[:3]]
+        eng.close()  # graceful drain: stragglers answered, nothing dropped
+        assert all(isinstance(f.result(timeout=5).score, float) for f in futures)
+        assert eng.health.state is ServingState.CLOSED
+
+    def test_reason_tracked_degradation(self):
+        h = HealthStateMachine()
+        h.mark_ready()
+        h.add_degraded("circuit_open")
+        h.add_degraded("batcher_unhealthy: boom")
+        assert h.state is ServingState.DEGRADED
+        h.clear_degraded("circuit_open")
+        assert h.state is ServingState.DEGRADED  # dead batcher still pins it
+        h.clear_degraded("batcher_unhealthy: boom")
+        assert h.state is ServingState.READY
+
+    def test_closed_is_terminal(self):
+        """CLOSED is terminal: late degradation reports and ready marks
+        (shutdown races) are absorbed, never resurrect the state."""
+        h = HealthStateMachine()
+        h.begin_drain()
+        h.close()
+        h.add_degraded("too late")
+        h.mark_ready()
+        assert h.state is ServingState.CLOSED
+        # The DRAINING -> READY edge does not exist: draining only closes.
+        h2 = HealthStateMachine()
+        h2.mark_ready()
+        h2.begin_drain()
+        h2.clear_degraded("nothing")
+        assert h2.state is ServingState.DRAINING
+        h2.close()
+        assert h2.state is ServingState.CLOSED
+
+
+# ------------------------------------------------------------ site tooling
+
+
+class TestFaultSiteTooling:
+    def test_list_sites_prints_registered_table(self, capsys):
+        assert faults.main(["--list-sites"]) == 0
+        out = capsys.readouterr().out
+        for site in faults.KNOWN_SITES:
+            assert site in out
+        for new_site in ("admit", "swap_stage", "swap_commit"):
+            assert new_site in out
+
+    def test_list_sites_shows_armed_plan(self, capsys):
+        with faults.inject("admit:2,score:p0.5"):
+            faults.main(["--list-sites"])
+        out = capsys.readouterr().out
+        assert "first 2" in out
+        assert "p=0.5" in out
+
+    def test_every_new_site_is_parseable_from_env_spec(self):
+        # The conftest guard keeps fault_point() calls inside KNOWN_SITES;
+        # this keeps the inverse true — every registered site is armable.
+        plan = faults.FaultPlan.parse(
+            ",".join(f"{s}:1" for s in faults.KNOWN_SITES)
+        )
+        assert set(plan.sites) == set(faults.KNOWN_SITES)
+
+
+# ------------------------------------------------------- crash-safe replay
+
+
+_SERVE_CHILD = """
+import os, sys
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, {repo!r})
+from photon_ml_tpu.cli import serve
+serve.REPLAY_WINDOW = 8  # many small windows: a mid-replay kill lands between parts
+serve.main([
+    "--model-input-directory", sys.argv[1],
+    "--requests", sys.argv[2],
+    "--root-output-directory", sys.argv[3],
+    "--max-batch", "8",
+    "--max-wait-ms", "0.5",
+])
+print("CHILD_DONE", flush=True)
+"""
+
+
+@pytest.mark.chaos
+class TestCrashSafeReplay:
+    def _model_dir(self, rng, tmp_path):
+        from photon_ml_tpu.data.index_map import IndexMap
+        from photon_ml_tpu.io import model_bridge, model_store
+
+        model, specs, _, _ = _fixture(rng, n=2)
+        index_maps = {
+            "g": IndexMap.from_feature_names([f"f{i}" for i in range(D_FE)]),
+            "re": IndexMap.from_feature_names([f"r{i}" for i in range(D_RE)]),
+        }
+        art = model_bridge.artifact_from_game_model(model, specs, TASK)
+        mdir = tmp_path / "model"
+        model_store.save_game_model(str(mdir), art, index_maps)
+        idx_dir = mdir / "feature-indexes"
+        os.makedirs(idx_dir)
+        for shard, imap in index_maps.items():
+            imap.save(str(idx_dir / f"{shard}.json"))
+        return str(mdir)
+
+    def _requests_file(self, rng, tmp_path, n):
+        path = tmp_path / "requests.jsonl"
+        with open(path, "w") as f:
+            for i in range(n):
+                doc = {
+                    "uid": f"r{i}",
+                    "ids": {"eid": str(int(rng.integers(0, N_ENTITIES + 2)))},
+                    "features": {
+                        "g": {f"f{j}": float(rng.normal()) for j in range(3)},
+                        "re": {f"r{j}": float(rng.normal()) for j in range(2)},
+                    },
+                }
+                f.write(json.dumps(doc) + "\n")
+        return str(path)
+
+    def test_sigkill_mid_replay_leaves_only_readable_parts(self, rng, tmp_path):
+        from photon_ml_tpu.io import avro as avro_io
+
+        n_req = 160  # REPLAY_WINDOW=8 in the child -> 20 part files
+        mdir = self._model_dir(rng, tmp_path)
+        reqfile = self._requests_file(rng, tmp_path, n_req)
+        outdir = str(tmp_path / "out")
+        script = tmp_path / "child.py"
+        script.write_text(_SERVE_CHILD.format(repo=REPO))
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.Popen(
+            [sys.executable, str(script), mdir, reqfile, outdir],
+            cwd=REPO,
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+        )
+        scores_dir = os.path.join(outdir, "scores")
+        try:
+            # Kill -9 once at least two parts are durably in place (parts
+            # are written to a dot-tmp name and os.replace'd, so anything
+            # named part-*.avro must already be complete).
+            deadline = time.monotonic() + 180
+            killed = False
+            while time.monotonic() < deadline and proc.poll() is None:
+                try:
+                    parts = [
+                        p
+                        for p in os.listdir(scores_dir)
+                        if p.startswith("part-") and p.endswith(".avro")
+                    ]
+                except OSError:
+                    parts = []
+                if len(parts) >= 2:
+                    proc.send_signal(signal.SIGKILL)
+                    killed = True
+                    break
+                time.sleep(0.01)
+            proc.wait(timeout=120)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+        if killed:
+            assert proc.returncode == -signal.SIGKILL
+        parts = sorted(
+            p
+            for p in os.listdir(scores_dir)
+            if p.startswith("part-") and p.endswith(".avro")
+        )
+        assert parts, "no part committed before the child finished"
+        # EVERY committed part is fully readable — no torn Avro container.
+        n_read = 0
+        for p in parts:
+            _, recs = avro_io.read_container(os.path.join(scores_dir, p))
+            assert recs, f"{p} is empty"
+            n_read += len(recs)
+        assert faults.COUNTERS.get("quarantined_blocks") == 0
+        # A re-run over the same stream completes end to end and scores
+        # every request (same outdir: parts are replaced atomically).
+        from photon_ml_tpu.cli import serve
+
+        old_window = serve.REPLAY_WINDOW
+        serve.REPLAY_WINDOW = 8
+        try:
+            summary = serve.run(
+                serve.build_parser().parse_args(
+                    [
+                        "--model-input-directory", mdir,
+                        "--requests", reqfile,
+                        "--root-output-directory", outdir,
+                        "--max-batch", "8",
+                        "--max-wait-ms", "0.5",
+                    ]
+                )
+            )
+        finally:
+            serve.REPLAY_WINDOW = old_window
+        assert summary["num_requests"] == n_req
+        assert summary["failed_requests"] == 0
+        assert summary["malformed_records"] == 0
+        assert summary["health"]["state"] == "CLOSED"
+        total = 0
+        for p in sorted(os.listdir(scores_dir)):
+            if p.startswith("part-") and p.endswith(".avro"):
+                _, recs = avro_io.read_container(os.path.join(scores_dir, p))
+                total += len(recs)
+        assert total == n_req
+
+    def test_malformed_replay_records_cost_one_record_each(self, rng, tmp_path):
+        """A bad line mid-stream (broken JSON, garbage feature value) is
+        skipped and counted — the replay completes and scores everything
+        else."""
+        from photon_ml_tpu.cli import serve
+
+        mdir = self._model_dir(rng, tmp_path)
+        good = 20
+        path = tmp_path / "requests.jsonl"
+        with open(path, "w") as f:
+            for i in range(good // 2):
+                f.write(json.dumps({"uid": f"a{i}", "ids": {"eid": "0"},
+                                    "features": {"g": {"f0": 1.0}}}) + "\n")
+            f.write("{not json at all\n")
+            f.write(json.dumps({"uid": "bad", "ids": {"eid": "0"},
+                                "features": {"g": {"f0": "garbage"}}}) + "\n")
+            for i in range(good // 2):
+                f.write(json.dumps({"uid": f"b{i}", "ids": {"eid": "1"},
+                                    "features": {"g": {"f1": -1.0}}}) + "\n")
+        outdir = str(tmp_path / "out")
+        summary = serve.run(
+            serve.build_parser().parse_args(
+                [
+                    "--model-input-directory", mdir,
+                    "--requests", str(path),
+                    "--root-output-directory", outdir,
+                    "--max-batch", "8",
+                    "--max-wait-ms", "0.5",
+                ]
+            )
+        )
+        assert summary["num_requests"] == good
+        assert summary["failed_requests"] == 0
+        assert summary["malformed_records"] == 2
+        assert summary["health"]["state"] == "CLOSED"
