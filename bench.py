@@ -64,6 +64,27 @@ def _bw_metrics(nbytes: int, wall: float, platform: str) -> dict:
     return out
 
 
+def _dispatch_json(mode):
+    """Kernel dispatch decision as machine-comparable JSON (satellite fix:
+    r05 serialized repr() strings, so dense_lbfgs carried "dispatch":
+    "True" — a string — and the trajectory tooling could not compare it).
+    True/False/None stay JSON booleans/null; a ShardedDispatch becomes an
+    object naming the mesh axis and device count."""
+    if mode is None or isinstance(mode, bool):
+        return mode
+    out = {"sharded": True}
+    axis = getattr(mode, "axis", None)
+    if axis is not None:
+        out["axis"] = str(axis)
+    mesh = getattr(mode, "mesh", None)
+    if mesh is not None:
+        try:
+            out["devices"] = int(mesh.devices.size)
+        except Exception:  # noqa: BLE001 - annotation only
+            pass
+    return out
+
+
 def _measure_baseline_surrogate(n: int, d: int, fn_evals: int) -> dict:
     """Measured single-process float64 BLAS value+gradient passes — the
     reference's per-partition hot loop without Spark overhead (a strict
@@ -255,7 +276,7 @@ def _child() -> None:
         stats,
         wall_s=round(dense_wall, 3),
         kernel_engaged=kernel_mode is not False,
-        dispatch=repr(kernel_mode),
+        dispatch=_dispatch_json(kernel_mode),
         **_bw_metrics(dense_bytes, dense_wall, platform),
     )
 
@@ -306,22 +327,33 @@ def _child() -> None:
     # overlaps it (production ingest overlaps the remaining assembly), so
     # join it under the ingest-side accounting. Coordinate construction
     # below then pays only the device upload (pack_s).
+    from photon_ml_tpu.data import device_pack as device_pack_mod
     from photon_ml_tpu.ops import pallas_sparse as pallas_sparse_mod
+    from photon_ml_tpu.utils.observability import (
+        TimingRegistry as _TReg,
+        stage_scope as _sscope,
+    )
 
+    pack_reg = _TReg()
     t_pack = time.perf_counter()
-    pallas_sparse_mod.begin_pack_async(ds_sp.host_csr["s"], n)
+    with _sscope(pack_reg):
+        pallas_sparse_mod.begin_pack_async(ds_sp.host_csr["s"], n)
     fut = getattr(ds_sp.host_csr["s"], "pack_future", None)
     # No future has more than one cause — distinguish them in the artifact
     # (a deferral and a declined pack are different stories):
-    # "background" = bg thread ran and was joined here; "deferred_*" = the
-    # pack runs synchronously inside coordinate construction below and
-    # lands in pack_s; "not_engaged" = the size/backend gates declined
-    # before the pipeline gate.
+    # "background" = bg thread ran and was joined here; "device" = the
+    # device pack runs inside coordinate construction below (no host
+    # thread exists to hide); "deferred_*" = the host pack runs
+    # synchronously inside coordinate construction below and lands in
+    # pack_s; "not_engaged" = the size/backend gates declined before the
+    # pipeline gate.
     if fut is not None:
         fut.result()
         pack_mode = "background"
     elif not pallas_sparse_mod.pack_worth_considering(n):
         pack_mode = "not_engaged"
+    elif device_pack_mod.enabled():
+        pack_mode = "device"
     else:
         from photon_ml_tpu.data.pipeline import effective_host_parallelism
 
@@ -330,23 +362,33 @@ def _child() -> None:
             if effective_host_parallelism() <= 1
             else "deferred_pipeline_off"
         )
-    pack_host_s = time.perf_counter() - t_pack
-    _mark(f"ingest-side host pack {pack_host_s:.2f}s ({pack_mode})")
+    pack_ingest_s = time.perf_counter() - t_pack
+    _mark(f"ingest-side pack {pack_ingest_s:.2f}s ({pack_mode})")
 
     t_pack = time.perf_counter()
-    sp_coord = FixedEffectCoordinate(
-        ds_sp,
-        "s",
-        CoordinateOptimizationConfig(
-            optimizer=OptimizerConfig(max_iterations=20, tolerance=1e-7),
-            regularization=L2,
-            reg_weight=1.0,
-        ),
-        TaskType.LOGISTIC_REGRESSION,
-    )
+    with _sscope(pack_reg):
+        sp_coord = FixedEffectCoordinate(
+            ds_sp,
+            "s",
+            CoordinateOptimizationConfig(
+                optimizer=OptimizerConfig(max_iterations=20, tolerance=1e-7),
+                regularization=L2,
+                reg_weight=1.0,
+            ),
+            TaskType.LOGISTIC_REGRESSION,
+        )
     pack_s = time.perf_counter() - t_pack
     sparse_kernel = isinstance(sp_coord._features, BucketedSparseFeatures)
-    _mark(f"sparse coordinate built (bucketed={sparse_kernel}, {pack_s:.1f}s)")
+    # Placement split + path: pack_device/pack_host stage walls recorded by
+    # the pack itself (data/bucketed._pack_level); the ingest-side join wall
+    # counts as host placement when a background host thread ran (the
+    # worker thread records into no scope).
+    pack_path = pack_reg.get_note("pack_path") or "none"
+    pack_device_s = pack_reg.get("pack_device")
+    pack_host_s = pack_reg.get("pack_host")
+    if pack_mode == "background":
+        pack_host_s = max(pack_host_s, pack_ingest_s)
+    _mark(f"sparse coordinate built (bucketed={sparse_kernel}, {pack_s:.1f}s, path={pack_path})")
     sp_wall, res_sp = timed(lambda: sp_coord.train(ds_sp.offsets)[1], "sparse_ell", warm=lambda: sp_coord.train(offsets_warm)[1])
     sstats = _solve_stats(res_sp)
     # Work-normalized bytes per objective evaluation: the ELL entry bytes
@@ -357,6 +399,41 @@ def _child() -> None:
     pack_report = (
         sp_coord._features.density_report() if sparse_kernel else None
     )
+    # Per-path roofline annotations: which objective kernel actually runs
+    # (fused single-stream / composed matvec+rmatvec / XLA gather-scatter),
+    # which layout each level carries, and — when the device pack ran — the
+    # pack's own achieved bandwidth against the same HBM roofline (the
+    # device pack streams ~12 B/entry of COO planes + the packed writes).
+    objective_path = "xla"
+    layout = None
+    if sparse_kernel:
+        bf = sp_coord._features
+        if pallas_sparse_mod.should_use(bf):
+            objective_path = (
+                "fused"
+                if pallas_sparse_mod.fused_feasible(bf)
+                else "composed"
+            )
+        layout = dict(
+            level1="row_aligned" if bf.level1.row_aligned else "grouped",
+            level2=(
+                None
+                if bf.level2 is None
+                else ("row_aligned" if bf.level2.row_aligned else "grouped")
+            ),
+        )
+    pack_metrics = dict(
+        pack_s=round(pack_s, 1),
+        pack_ingest_s=round(pack_ingest_s, 2),
+        pack_device_s=round(pack_device_s, 3),
+        pack_host_s=round(pack_host_s, 2),
+        pack_path=pack_path,
+        pack_mode=pack_mode,
+    )
+    if pack_device_s > 0:
+        pack_metrics["device_pack_bw"] = _bw_metrics(
+            n * k_nnz * 12, max(pack_device_s, 1e-9), platform
+        )
     sp_bytes = sstats["fn_evals"] * n * k_nnz * 8 * 2
     variants["sparse_ell_lbfgs"] = dict(
         sstats,
@@ -364,10 +441,10 @@ def _child() -> None:
         dim=d_sparse,
         wall_s=round(sp_wall, 3),
         kernel_engaged=sparse_kernel,
-        pack_s=round(pack_s, 1),
-        pack_host_s=round(pack_host_s, 2),
-        pack_mode=pack_mode,
+        objective_path=objective_path,
+        layout=layout,
         pack_report=pack_report,
+        **pack_metrics,
         **_bw_metrics(sp_bytes, sp_wall, platform),
     )
 
@@ -1059,7 +1136,17 @@ def _child() -> None:
             from photon_ml_tpu.estimators.game_estimator import PREPARE_STAGES
 
             missing_stages = [
-                k for k in (*PREPARE_STAGES, "other") if k not in fit_timing
+                k
+                for k in (
+                    *PREPARE_STAGES,
+                    "other",
+                    # Pack placement split (r06): device-vs-host walls and
+                    # the chosen implementation path, same loud contract.
+                    "pack_device_s",
+                    "pack_host_s",
+                    "pack_path",
+                )
+                if k not in fit_timing
             ]
             if missing_stages:
                 raise RuntimeError(
@@ -1101,6 +1188,9 @@ def _child() -> None:
                 train_s=round(train_s, 1),
                 prepare_s=round(fit_timing["prepare_s"], 1),
                 prepare_breakdown=prepare_breakdown,
+                pack_device_s=round(fit_timing["pack_device_s"], 3),
+                pack_host_s=round(fit_timing["pack_host_s"], 2),
+                pack_path=fit_timing["pack_path"],
                 solve_s=round(fit_timing["solve_s"], 1),
                 train_rows_per_s=round(e2e_rows / train_s, 0),
                 eval_s=round(eval_s, 1),

@@ -34,7 +34,20 @@ list evaluated by XLA scatter/gather. Uniform data: level 1 carries ~99%,
 blowup ~1.0-1.2x. Skewed data trades kernel speed for correctness
 gracefully. The pack runs once per dataset (the sparsity pattern is static
 across every optimizer iteration, reg-weight sweep and coordinate-descent
-pass) as a vectorized counting sort — O(nnz) numpy, no argsort.
+pass).
+
+**Placement paths (r06).** The placement itself — histogram, rank, scatter
+— has one semantics and four interchangeable implementations, tried in
+order by `_pack_level`: the DEVICE pack (data/device_pack.py: stable sort
++ scatter as one XLA program, auto-on with an accelerator — the 12 s
+host pass of BENCH_r05 becomes milliseconds where the planes live
+anyway), the core-SHARDED native counting sort (bucketed_pack.cc, row-tile
+cuts over sorted rows), the serial native sort, and the numpy oracle. All
+four are bitwise identical (rank within a segment = input order
+everywhere), so tests can pin any against any. Level-1's slot layout is
+planned per workload by `choose_layout` (PHOTON_SPARSE_LAYOUT, Poisson
+collision economics); the chosen path and its device/host walls land in
+the ambient stage scope (`pack_path`, `pack_device`/`pack_host`).
 """
 
 from __future__ import annotations
@@ -53,6 +66,16 @@ Array = jax.Array
 
 BUCKET = 128  # feature ids per bucket == the dynamic_gather table width
 _ROW_SHIFT = 7  # packed = row_local << 7 | lane
+
+# Level-1 layout planner (see choose_layout): row-aligned wins the forward
+# scatter and the backward u-select but pays per-lane collision padding that
+# scales the whole entry stream; above this estimated blowup the grouped
+# (feature-lane) layout streams fewer bytes than alignment saves. The r06
+# wide-operand kernels (ops/pallas_sparse.py) amortize the surviving
+# feature-side one-hot, which is what makes the aligned layout profitable
+# for the fused objective at all — r05's per-segment-row contractions lost
+# its forward win to dispatch and padding together.
+ROWALIGN_MAX_BLOWUP = 1.35
 
 L1_TILE_ROWS = 2048  # level-1 tile: row_local fits 11 bits, z-acc (16, 128)
 L2_TILE_ROWS = 16384  # level-2 tile: pools 8 L1 tiles' spill, z-acc (128, 128)
@@ -185,11 +208,19 @@ def _pack_level(
     dtype,
     host_only: bool = False,
     row_aligned: bool = False,
+    allow_device: bool = True,
 ) -> Tuple[BucketedLevel, np.ndarray]:
     """Pack entries that fit segment width `sp`; return (level, spill mask).
 
     `host_only=True` keeps the packed planes as host numpy arrays (no
-    device upload) — the benchmark's isolated host-cost measurement."""
+    device upload) — the benchmark's isolated host-cost measurement.
+
+    Returns (level, spill mask, path) where `path` names the placement
+    implementation that ran: "device" (XLA counting sort + scatter, planes
+    born device-resident), "native-sharded"/"native" (bucketed_pack.cc),
+    or "numpy" (the no-compiler oracle)."""
+    from photon_ml_tpu.utils.observability import stage_timer
+
     _dev = (lambda x: x) if host_only else jnp.asarray
     B = max(1, -(-dim // BUCKET))
     T = max(1, -(-n_rows // tile_rows))
@@ -198,17 +229,48 @@ def _pack_level(
     tile_shift = tile_rows.bit_length() - 1
     rows32 = rows.astype(np.int32, copy=False)
     cols32 = cols.astype(np.int32, copy=False)
+    spv = sp // 128
+
+    # Device pack (data/device_pack.py): the O(nnz) placement runs as one
+    # XLA program where the packed planes will live anyway; only the spill
+    # mask returns to host. host_only (the bench's isolated host-cost
+    # measurement) keeps the host implementations; allow_device=False is
+    # the level-2 call (the spill tail's nnz is data-dependent, so a
+    # device pack there would compile a fresh sort program per fit for ~1%
+    # of the entries — the host pass costs milliseconds instead).
+    if not host_only and allow_device:
+        from photon_ml_tpu.data import device_pack
+
+        if device_pack.enabled():
+            with stage_timer("pack_device"):
+                dev = device_pack.pack_level_device(
+                    rows32, cols32, vals, T, B, tile_shift, sp, row_aligned
+                )
+            if dev is not None:
+                packed_d, values_d, spill_idx = dev
+                level = BucketedLevel(
+                    packed=packed_d.reshape(-1, 128),
+                    values=values_d.reshape(-1, 128),
+                    tile_rows=tile_rows,
+                    spv=spv,
+                    row_aligned=row_aligned,
+                )
+                spill_mask = np.zeros(len(rows32), dtype=bool)
+                spill_mask[spill_idx] = True
+                return level, spill_mask, "device"
 
     # Native counting-sort packer (photon_ml_tpu/native/bucketed_pack.cc):
-    # one linear pass vs numpy's argsort + three gather/scatter passes.
+    # one linear pass vs numpy's argsort + three gather/scatter passes;
+    # core-sharded over row-tile ranges when the rows arrive sorted (the
+    # CSR-derived data plane always does).
     from photon_ml_tpu.native import bucketed_pack as native_pack
 
-    native = native_pack.pack_level_native(
-        rows32, cols32, vals, T, B, tile_shift, sp, row_aligned
-    )
+    with stage_timer("pack_host"):
+        native = native_pack.pack_level_native(
+            rows32, cols32, vals, T, B, tile_shift, sp, row_aligned
+        )
     if native is not None:
-        packed_n, values_n, spill_idx = native
-        spv = sp // 128
+        packed_n, values_n, spill_idx, native_path = native
         level = BucketedLevel(
             packed=_dev(packed_n.reshape(-1, 128)),
             values=_dev(values_n.reshape(-1, 128)),
@@ -218,24 +280,48 @@ def _pack_level(
         )
         spill_mask = np.zeros(len(rows32), dtype=bool)
         spill_mask[spill_idx] = True
-        return level, spill_mask
+        return level, spill_mask, native_path
 
-    seg = (rows32 >> tile_shift) * np.int32(B) + (cols32 >> 7)
-    n_seg = T * B
-    spv = sp // 128
-    if row_aligned:
-        rl = rows32 & np.int32(tile_rows - 1)
-        lane = rl & np.int32(127)
-        seg_lane = seg.astype(np.int64) * 128 + lane
-        payload = ((rl >> 7) << _ROW_SHIFT) | (cols32 & np.int32(BUCKET - 1))
-        order, pos, _ = _sort_by_segment(seg_lane, n_seg * 128)
-        fits = pos < spv
-        sel = order[fits]
-        dst = (
-            seg[sel].astype(np.int64) * sp
-            + pos[fits] * 128
-            + lane[sel].astype(np.int64)
+    with stage_timer("pack_host"):
+        seg = (rows32 >> tile_shift) * np.int32(B) + (cols32 >> 7)
+        n_seg = T * B
+        if row_aligned:
+            rl = rows32 & np.int32(tile_rows - 1)
+            lane = rl & np.int32(127)
+            seg_lane = seg.astype(np.int64) * 128 + lane
+            payload = ((rl >> 7) << _ROW_SHIFT) | (cols32 & np.int32(BUCKET - 1))
+            order, pos, _ = _sort_by_segment(seg_lane, n_seg * 128)
+            fits = pos < spv
+            sel = order[fits]
+            dst = (
+                seg[sel].astype(np.int64) * sp
+                + pos[fits] * 128
+                + lane[sel].astype(np.int64)
+            )
+            packed = np.zeros(n_seg * sp, np.int32)
+            values = np.zeros(n_seg * sp, dtype)
+            packed[dst] = payload[sel]
+            values[dst] = vals[sel]
+            level = BucketedLevel(
+                packed=_dev(packed.reshape(n_seg * spv, 128)),
+                values=_dev(values.reshape(n_seg * spv, 128)),
+                tile_rows=tile_rows,
+                spv=spv,
+                row_aligned=True,
+            )
+            spill_mask = np.zeros(len(seg), dtype=bool)
+            spill_mask[order[~fits]] = True
+            return level, spill_mask, "numpy"
+        # Pack the per-entry payload BEFORE sorting so only two arrays need
+        # the (random-access) reorder gather.
+        payload = ((rows32 & np.int32(tile_rows - 1)) << _ROW_SHIFT) | (
+            cols32 & np.int32(BUCKET - 1)
         )
+        order, pos, _ = _sort_by_segment(seg, n_seg)
+        fits = pos < sp
+        sel = order[fits]  # entry indices that fit, in segment order
+        # Destinations are monotone in the sorted order -> sequential writes.
+        dst = seg[sel].astype(np.int64) * sp + pos[fits]
         packed = np.zeros(n_seg * sp, np.int32)
         values = np.zeros(n_seg * sp, dtype)
         packed[dst] = payload[sel]
@@ -245,38 +331,101 @@ def _pack_level(
             values=_dev(values.reshape(n_seg * spv, 128)),
             tile_rows=tile_rows,
             spv=spv,
-            row_aligned=True,
         )
         spill_mask = np.zeros(len(seg), dtype=bool)
         spill_mask[order[~fits]] = True
-        return level, spill_mask
-    # Pack the per-entry payload BEFORE sorting so only two arrays need the
-    # (random-access) reorder gather.
-    payload = ((rows32 & np.int32(tile_rows - 1)) << _ROW_SHIFT) | (
-        cols32 & np.int32(BUCKET - 1)
-    )
-    order, pos, _ = _sort_by_segment(seg, n_seg)
-    fits = pos < sp
-    sel = order[fits]  # entry indices that fit, in segment order
-    # Destinations are monotone in the sorted order -> sequential flat writes.
-    dst = seg[sel].astype(np.int64) * sp + pos[fits]
-    packed = np.zeros(n_seg * sp, np.int32)
-    values = np.zeros(n_seg * sp, dtype)
-    packed[dst] = payload[sel]
-    values[dst] = vals[sel]
-    level = BucketedLevel(
-        packed=_dev(packed.reshape(n_seg * spv, 128)),
-        values=_dev(values.reshape(n_seg * spv, 128)),
-        tile_rows=tile_rows,
-        spv=spv,
-    )
-    spill_mask = np.zeros(len(seg), dtype=bool)
-    spill_mask[order[~fits]] = True
-    return level, spill_mask
+        return level, spill_mask, "numpy"
 
 
 def _round_up(x: int, m: int) -> int:
     return -(-x // m) * m
+
+
+def _poisson_excess_fraction(lam: float, cap: int) -> float:
+    """E[max(X - cap, 0)] / lam for X ~ Poisson(lam): the expected fraction
+    of entries a per-lane capacity `cap` spills under uniform placement.
+    Hot buckets violate the Poisson model, but their excess lands in the
+    level-2/COO tail either way — the estimate only has to rank layouts.
+
+    Each tail term is computed in log space (lgamma): the naive recurrence
+    seeds with exp(-lam), which underflows to exactly 0 for lam >~ 746 and
+    would report ZERO spill for precisely the dense shapes that spill
+    almost everything."""
+    import math
+
+    if lam <= 0.0:
+        return 0.0
+    hi = int(cap + lam + 10.0 * math.sqrt(lam) + 20.0)
+    log_lam = math.log(lam)
+    excess = 0.0
+    for j in range(cap + 1, hi + 1):
+        lp = j * log_lam - lam - math.lgamma(j + 1)
+        if lp > -745.0:  # below this exp() underflows; the term is 0
+            excess += (j - cap) * math.exp(lp)
+    return min(excess / lam, 1.0)
+
+
+def _aligned_sp(mean1: float) -> Tuple[int, float, float]:
+    """Poisson-adaptive row-aligned segment width: the smallest in-contract
+    SP whose expected per-lane collision spill stays under 5%, plus the
+    estimated (level-1 pad blowup, spill fraction) at that width. Replaces
+    r05's fixed 2x-mean sizing (measured pad_blowup 2.13 on the bench
+    shape) with a width derived from the collision distribution itself.
+    When even MAX_SP cannot hold the tail the returned frac stays high and
+    `choose_layout` declines; forced-rowalign callers get the best-effort
+    width and let level 2 carry the spill."""
+    lam = mean1 / 128.0
+    spv, frac = 8, 0.0
+    for spv in range(8, MAX_SP // 128 + 1, 8):
+        frac = _poisson_excess_fraction(lam, spv)
+        if frac <= 0.05:
+            break
+    sp = spv * 128
+    kept = max(mean1 * (1.0 - frac), 1e-9)
+    return sp, sp / kept, frac
+
+
+_LAYOUT_ENV = "PHOTON_SPARSE_LAYOUT"
+
+
+def choose_layout(
+    nnz: int, n_rows: int, dim: int, workload: str = "training"
+) -> Tuple[bool, Optional[int]]:
+    """Level-1 layout plan: (row_aligned, sp1 override or None).
+
+    PHOTON_SPARSE_LAYOUT=rowalign|grouped forces (legacy
+    PHOTON_SPARSE_ROWALIGN=1 == rowalign); auto picks per the measured
+    economics (ops/pallas_sparse.py r05/r06 notes): the aligned layout
+    removes the forward z-scatter one-hot AND the backward u-select
+    gather, but its per-lane collision padding scales the whole entry
+    stream, so it engages only when the Poisson-estimated blowup stays
+    under ROWALIGN_MAX_BLOWUP (training: fused fwd+bwd both stream) or
+    2.25 for matvec-dominated scoring workloads (aligned matvec measured
+    2.01x even at blowup 2.13). Level 2 always stays grouped: its rt=128
+    coarse tiles would pay the very 128-row one-hot alignment avoids.
+    """
+    env = os.environ.get(_LAYOUT_ENV, "").strip().lower()
+    if not env and os.environ.get("PHOTON_SPARSE_ROWALIGN", "0").lower() in (
+        "1",
+        "true",
+    ):
+        env = "rowalign"
+    if env in ("rowalign", "row_aligned", "aligned"):
+        return True, None
+    if env in ("grouped", "feature", "legacy"):
+        return False, None
+    B = max(1, -(-dim // BUCKET))
+    T1 = max(1, -(-n_rows // L1_TILE_ROWS))
+    mean1 = nnz / max(T1 * B, 1)
+    sp_ra, blowup_ra, frac_ra = _aligned_sp(mean1)
+    limit = ROWALIGN_MAX_BLOWUP if workload == "training" else 2.25
+    # Both gates must pass: low padding AND a realized spill within the
+    # sizing target — dense shapes whose lane load exceeds MAX_SP would
+    # otherwise show a deceptively low blowup on the sliver that fits
+    # while >90% of entries fall through to level 2.
+    if blowup_ra <= limit and frac_ra <= 0.05:
+        return True, sp_ra
+    return False, None
 
 
 def pack_bucketed(
@@ -289,22 +438,23 @@ def pack_bucketed(
     dtype=np.float32,
     host_only: bool = False,
     row_aligned: Optional[bool] = None,
+    workload: str = "training",
 ) -> BucketedSparseFeatures:
     """Pack COO triplets into the two-level bucketed layout.
 
-    `row_aligned` (default from PHOTON_SPARSE_ROWALIGN, off — the measured
-    training-optimal choice; see the r05 note in ops/pallas_sparse.py)
-    selects the row-lane-aligned level-1 slot layout, see
-    BucketedLevel.row_aligned.
+    `row_aligned=None` defers the level-1 layout to `choose_layout` (env
+    override + Poisson collision economics, per `workload`); True/False
+    forces. See BucketedLevel.row_aligned and the r05/r06 notes in
+    ops/pallas_sparse.py.
 
     `host_only=True` skips every device upload (planes stay numpy) — used
     by the benchmark to time the host pack cost in isolation without
-    monkeypatching this module's array namespace."""
+    monkeypatching this module's array namespace. The chosen placement
+    implementation lands in the ambient stage scope as the `pack_path`
+    note plus `pack_device`/`pack_host` stage walls."""
+    from photon_ml_tpu.utils.observability import set_stage_note
+
     _dev = (lambda x: x) if host_only else jnp.asarray
-    if row_aligned is None:
-        row_aligned = os.environ.get(
-            "PHOTON_SPARSE_ROWALIGN", "0"
-        ).lower() in ("1", "true")
     rows = np.asarray(rows, np.int64)
     cols = np.asarray(cols, np.int64)
     vals = np.asarray(vals, dtype)
@@ -317,16 +467,24 @@ def pack_bucketed(
     # Level-1 SP near the mean segment size (1024-granular): padding stays
     # ~1x and the spill tail (mean-crossing segments) goes to level 2.
     mean1 = nnz / max(T1 * B, 1)
-    # Row-aligned level 1 needs collision headroom: per-lane capacity is
-    # sp/128 and lane loads are ~Poisson(mean/128), so sizing at the mean
-    # spills ~half the lanes' tails (measured 14% of entries). 2x mean
-    # keeps L1 residency comparable to the legacy layout's.
-    m1 = 2 * mean1 if row_aligned else mean1
-    sp1 = min(max(1024, _round_up(int(m1), 1024)), MAX_SP)
-    level1, spill = _pack_level(
+    sp1_hint = None
+    if row_aligned is None:
+        row_aligned, sp1_hint = choose_layout(nnz, n_rows, dim, workload)
+    if row_aligned and sp1_hint is None:
+        # Forced-aligned callers get the same Poisson-adaptive width the
+        # planner would have chosen (r05's fixed 2x-mean sizing measured
+        # pad_blowup 2.13; the adaptive width sizes to the collision tail).
+        sp1_hint, _, _ = _aligned_sp(mean1)
+    sp1 = (
+        sp1_hint
+        if sp1_hint is not None
+        else min(max(1024, _round_up(int(mean1), 1024)), MAX_SP)
+    )
+    level1, spill, pack_path = _pack_level(
         rows, cols, vals, n_rows, dim, L1_TILE_ROWS, sp1, dtype, host_only,
         row_aligned,
     )
+    set_stage_note("pack_path", pack_path)
 
     level2 = None
     o_rows = rows[spill]
@@ -341,9 +499,13 @@ def pack_bucketed(
         # Level 2 stays on the feature-lane layout regardless: its coarse
         # tiles have rt = 128, so a row-aligned sublane-block select would
         # cost exactly the 128-row one-hot the alignment exists to avoid.
-        level2, spill2 = _pack_level(
+        # It also stays on the HOST paths (allow_device=False): the spill
+        # tail is ~1% of entries and its nnz varies per dataset, so the
+        # host pass costs milliseconds where a device pack would compile a
+        # fresh sort program per fit.
+        level2, spill2, _ = _pack_level(
             o_rows, o_cols, o_vals, n_rows, dim, L2_TILE_ROWS, sp2, dtype,
-            host_only, False,
+            host_only, False, allow_device=False,
         )
         o_rows, o_cols, o_vals = o_rows[spill2], o_cols[spill2], o_vals[spill2]
 

@@ -1,0 +1,172 @@
+"""Device-side bucketed pack: counting sort + scatter as one XLA program.
+
+Why: the host pack — even the native counting sort — is a serial O(nnz)
+CPU pass over the entry arrays, measured at 12.36 s of the 13.6 s sparse
+pack wall on the 1M x 64nnz bench shape (BENCH_r05). The accelerator
+streams the same arrays at HBM rate, and every step of the pack is a
+primitive XLA is good at: segment ids are shifts/masks, placement ranks
+come from a stable radix argsort + exclusive-cumsum histogram, and the
+final placement is one scatter. So the layout build moves where the data
+is going anyway: upload the raw COO planes once (12 bytes/entry — the
+same order of bytes the packed planes would have cost to upload), run the
+pack as ONE jitted program, and keep the packed planes device-resident.
+The host's remaining work is the level-2 spill tail (~1% of entries on
+uniform data), packed by the existing host path from the spill mask.
+
+Placement parity: the device rank assignment (stable sort by segment key,
+rank = index - segment start) is definitionally the same computation as
+the host counting sort — entries keep input order within a segment, so
+the packed planes are BITWISE identical to the host pack's
+(tests/test_pallas_sparse.py::TestDevicePack proves it, including
+duplicate-column and empty-row edges).
+
+Backend gate: `enabled()` is auto-on when an accelerator backend is
+attached (the pack is a bandwidth problem; a CPU "device" is the host by
+another name, and the native sharded pack beats jitted-CPU XLA there).
+PHOTON_DEVICE_PACK=1 forces it on any backend (tests run the CPU jit
+path), =0 disables.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+def enabled() -> bool:
+    env = os.environ.get("PHOTON_DEVICE_PACK", "").strip().lower()
+    if env in ("0", "false", "off", "no"):
+        return False
+    if env in ("1", "true", "on", "yes"):
+        return True
+    return jax.default_backend() in ("tpu", "gpu")
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "n_seg", "sp", "tile_shift", "n_buckets", "row_aligned"
+    ),
+)
+def _pack_level_device(
+    rows: Array,
+    cols: Array,
+    vals: Array,
+    *,
+    n_seg: int,
+    sp: int,
+    tile_shift: int,
+    n_buckets: int,
+    row_aligned: bool,
+) -> Tuple[Array, Array, Array]:
+    """One level's placement on device. Returns (packed (n_seg*sp,),
+    values (n_seg*sp,), spill_mask (nnz,) bool in ORIGINAL entry order).
+
+    Rank-within-segment comes from the stable argsort: entries keep input
+    order inside a segment, exactly like the host counting sort, so the
+    scattered planes match the host pack bit for bit.
+    """
+    nnz = rows.shape[0]
+    row_mask = jnp.int32((1 << tile_shift) - 1)
+    # int32 address arithmetic throughout (x64 is off by default on every
+    # backend this runs on); pack_level_device guards n_seg * sp < 2^31.
+    seg = jax.lax.shift_right_logical(rows, tile_shift) * jnp.int32(
+        n_buckets
+    ) + jax.lax.shift_right_logical(cols, 7)
+    rl = jax.lax.bitwise_and(rows, row_mask)
+    if row_aligned:
+        # Rank is per (segment, lane): the slot lane IS row_local & 127.
+        lane = jax.lax.bitwise_and(rl, jnp.int32(127))
+        key = seg * jnp.int32(128) + lane
+        n_keys = n_seg * 128
+        cap = sp // 128
+        payload = jax.lax.bitwise_or(
+            jax.lax.shift_left(jax.lax.shift_right_logical(rl, 7), 7),
+            jax.lax.bitwise_and(cols, jnp.int32(127)),
+        )
+    else:
+        key = seg
+        n_keys = n_seg
+        cap = sp
+        payload = jax.lax.bitwise_or(
+            jax.lax.shift_left(rl, 7),
+            jax.lax.bitwise_and(cols, jnp.int32(127)),
+        )
+    order = jnp.argsort(key, stable=True)
+    key_s = key[order]
+    counts = jnp.zeros((n_keys,), jnp.int32).at[key].add(1)
+    starts = jnp.cumsum(counts) - counts  # exclusive prefix sum
+    pos = jnp.arange(nnz, dtype=jnp.int32) - starts[key_s]
+    fits = pos < cap
+    if row_aligned:
+        dst = seg[order] * jnp.int32(sp) + pos * 128 + jax.lax.bitwise_and(
+            rl[order], jnp.int32(127)
+        )
+    else:
+        dst = key_s * jnp.int32(sp) + pos
+    # Non-fitting entries target one-past-the-end; mode="drop" discards them.
+    dst = jnp.where(fits, dst, n_seg * sp)
+    packed = jnp.zeros((n_seg * sp,), jnp.int32).at[dst].set(
+        payload[order], mode="drop"
+    )
+    values = jnp.zeros((n_seg * sp,), vals.dtype).at[dst].set(
+        vals[order], mode="drop"
+    )
+    # Spill mask back in ORIGINAL entry order (the host packs level 2 /
+    # overflow from its own COO copies, so only this small mask crosses).
+    spill_mask = jnp.zeros((nnz,), bool).at[order].set(~fits)
+    return packed, values, spill_mask
+
+
+def pack_level_device(
+    rows: np.ndarray,
+    cols: np.ndarray,
+    vals: np.ndarray,
+    n_tiles: int,
+    n_buckets: int,
+    tile_shift: int,
+    sp: int,
+    row_aligned: bool = False,
+) -> Optional[Tuple[Array, Array, np.ndarray]]:
+    """Device counterpart of `native.bucketed_pack.pack_level_native`:
+    returns (packed (n_seg*sp,) i32 DEVICE, values (n_seg*sp,) DEVICE,
+    spill entry indices HOST), or None when the device path is off.
+
+    The COO upload happens here (recorded by the caller's ambient `upload`
+    stage via data.bucketed); only the boolean spill mask returns to host —
+    1 byte/entry against the 12 the pack no longer reads on host.
+    """
+    if not enabled():
+        return None
+    nnz = len(vals)
+    n_seg = n_tiles * n_buckets
+    if n_seg * sp >= 2**31 or n_seg * 128 >= 2**31:
+        return None  # int32 addressing bound; host paths have none
+    if nnz == 0:
+        return (
+            jnp.zeros((n_seg * sp,), jnp.int32),
+            jnp.zeros((n_seg * sp,), np.asarray(vals).dtype),
+            np.zeros((0,), np.int64),
+        )
+    rows32 = jnp.asarray(np.ascontiguousarray(rows, np.int32))
+    cols32 = jnp.asarray(np.ascontiguousarray(cols, np.int32))
+    vals_d = jnp.asarray(np.ascontiguousarray(vals))
+    packed, values, spill_mask = _pack_level_device(
+        rows32,
+        cols32,
+        vals_d,
+        n_seg=n_seg,
+        sp=sp,
+        tile_shift=tile_shift,
+        n_buckets=n_buckets,
+        row_aligned=row_aligned,
+    )
+    spill_idx = np.nonzero(np.asarray(spill_mask))[0].astype(np.int64)
+    return packed, values, spill_idx

@@ -692,6 +692,36 @@ def gather_block_features(features: Features, gather: Array) -> Features:
     return jnp.take(features, gather, axis=0)
 
 
+def gather_block_arrays(
+    features: Features,
+    labels: Array,
+    weights: Array,
+    offs: Array,
+    gather: Array,
+    mask: Array,
+    ent_rows: Array,
+    feature_mask: Optional[Array],
+) -> LabeledData:
+    """Array-level core of `gather_block_data`: build one bucket's
+    (E, S, ...) LabeledData from raw (possibly traced) arrays. Trace-safe —
+    the scan-dispatched sweep (game/coordinate.py) runs it INSIDE its scan
+    body, so both code paths share one definition and cannot drift."""
+    feats = gather_block_features(features, gather)
+    if feature_mask is not None:
+        block_mask = jnp.take(feature_mask, ent_rows, axis=0)  # (E, D)
+        if isinstance(feats, SparseFeatures):
+            mult = jax.vmap(lambda m, idx: m[idx])(block_mask, feats.indices)
+            feats = dataclasses.replace(feats, values=feats.values * mult)
+        else:
+            feats = feats * block_mask[:, None, :]
+    return LabeledData(
+        features=feats,
+        labels=jnp.take(labels, gather, axis=0),
+        offsets=jnp.take(offs, gather, axis=0),
+        weights=jnp.take(weights, gather, axis=0) * mask,
+    )
+
+
 def gather_block_data(
     dataset: GameDataset,
     shard: str,
@@ -707,20 +737,13 @@ def gather_block_data(
     Pearson-selection matrix; the bucket's rows are gathered and multiplied
     into the features so deselected columns carry no data signal.
     """
-    offs = dataset.offsets if offsets is None else offsets
-    features = gather_block_features(dataset.shards[shard], blocks.gather)
-    if feature_mask is not None:
-        block_mask = jnp.take(feature_mask, blocks.entity_rows, axis=0)  # (E, D)
-        if isinstance(features, SparseFeatures):
-            mult = jax.vmap(lambda m, idx: m[idx])(block_mask, features.indices)
-            features = dataclasses.replace(
-                features, values=features.values * mult
-            )
-        else:
-            features = features * block_mask[:, None, :]
-    return LabeledData(
-        features=features,
-        labels=jnp.take(dataset.labels, blocks.gather, axis=0),
-        offsets=jnp.take(offs, blocks.gather, axis=0),
-        weights=jnp.take(dataset.weights, blocks.gather, axis=0) * blocks.mask,
+    return gather_block_arrays(
+        dataset.shards[shard],
+        dataset.labels,
+        dataset.weights,
+        dataset.offsets if offsets is None else offsets,
+        blocks.gather,
+        blocks.mask,
+        blocks.entity_rows,
+        feature_mask,
     )

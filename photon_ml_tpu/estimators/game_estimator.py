@@ -681,6 +681,21 @@ class GameEstimator:
             0.0, self.fit_timing["prepare_s"] - sum(stages.values())
         )
         self.fit_timing.update(stages)
+        # Pack placement split (nested inside the `pack` stage, so NOT part
+        # of the tiling sum above): where the bucketed placement pass
+        # actually ran, plus which implementation ran it. The keys are
+        # always present — the bench e2e contract fails loudly on their
+        # absence like the stage keys — and `pack_path` is "none" when no
+        # pack engaged this fit.
+        self.fit_timing["pack_device_s"] = self.timing_registry.get(
+            "pack_device"
+        ) - stage_base.get("pack_device", 0.0)
+        self.fit_timing["pack_host_s"] = self.timing_registry.get(
+            "pack_host"
+        ) - stage_base.get("pack_host", 0.0)
+        self.fit_timing["pack_path"] = (
+            self.timing_registry.get_note("pack_path") or "none"
+        )
         # Robustness counter: coordinate updates rejected by the divergence
         # guard across every configuration of this fit (0 on a clean fit —
         # nonzero in a bench artifact is a loud regression signal).

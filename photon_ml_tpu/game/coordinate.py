@@ -18,7 +18,11 @@ Execution model:
     is one vmapped solver call over (E, S, ...) blocks — thousands of
     co-resident L-BFGS/TRON instances in one XLA program, each stopping via
     its own convergence mask. Per-entity warm start (:110-121) is a gather of
-    the previous coefficient matrix.
+    the previous coefficient matrix. Same-shape buckets additionally fuse
+    into ONE lax.scan program per sweep (sweep_scan_enabled, r06): block
+    gather, vmapped solve, coefficient scatter and variance all run inside
+    it, so a sweep costs O(distinct block shapes) dispatches instead of
+    3-4 per bucket — bitwise equal to the per-bucket loop.
 
 Each coordinate builds its jitted train/score callables ONCE (per bucket
 shape); repeated coordinate-descent iterations and regularization-weight
@@ -32,8 +36,9 @@ in the reference, Coordinate.scala); train/score take explicit offset vectors.
 from __future__ import annotations
 
 import dataclasses
+import os
 from functools import partial
-from typing import Optional, Tuple
+from typing import List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -81,6 +86,25 @@ def _config_with_traced_weight(
 ) -> CoordinateOptimizationConfig:
     """Swap the (static) reg weight for a traced scalar inside jit."""
     return dataclasses.replace(config, reg_weight=reg_weight)
+
+
+def sweep_scan_enabled() -> bool:
+    """Scan-dispatch the random-effect bucket sweep (PHOTON_SWEEP_SCAN,
+    default on): same-shape entity buckets run as ONE lax.scan program —
+    block gather, vmapped solve, coefficient scatter and (optional)
+    variance all inside it — instead of 3-4 XLA dispatches per bucket.
+    Flare's whole-pipeline-compilation thesis applied to the solver loop:
+    at bench scale the per-sweep program count drops from O(buckets) to
+    O(distinct block shapes), which is what dominates small-coordinate
+    fits on a dispatch-latency-bound (remote or contended) backend."""
+    return os.environ.get("PHOTON_SWEEP_SCAN", "").strip().lower() not in (
+        "0",
+        "false",
+        "off",
+        "no",
+    )
+
+
 
 
 class FixedEffectCoordinate:
@@ -459,6 +483,114 @@ class RandomEffectCoordinate:
         self._variance_bucket = variance_bucket
         self._score_fn = score_fn
 
+        # Scan-dispatched sweep (sweep_scan_enabled): all same-shape entity
+        # buckets run as ONE XLA program — block gather, vmapped solve,
+        # coefficient scatter, optional variance — with (matrix, variances)
+        # as the scan carry. Same update order and the same ops as the
+        # per-bucket loop, so results are bitwise identical
+        # (tests/test_game.py::test_sweep_scan_matches_bucket_loop); only
+        # the dispatch count changes: O(distinct shapes) programs per sweep
+        # instead of 3-4 dispatches per bucket.
+        scan_cache_key = None
+        if norm is None:
+            from photon_ml_tpu.optimize.config import static_config_key
+
+            scan_cache_key = ("re_scan", static_config_key(cfg), self.task)
+        cached_scan = (
+            _RE_JIT_CACHE.get(scan_cache_key) if scan_cache_key else None
+        )
+        if cached_scan is not None:
+            self._train_scan = cached_scan
+            return
+
+        @jax.jit
+        def train_scan(
+            features,
+            labels,
+            weights,
+            offsets,
+            matrix,
+            var_matrix,
+            gathers,
+            masks,
+            ents,
+            feature_mask,
+            norm_factors,
+            norm_shifts,
+            reg_weight,
+        ):
+            from photon_ml_tpu.data.game_dataset import gather_block_arrays
+
+            traced_cfg = _config_with_traced_weight(cfg, reg_weight)
+
+            def step(carry, xs):
+                m, v = carry
+                gather, mask, ent = xs
+                block = gather_block_arrays(
+                    features, labels, weights, offsets, gather, mask, ent,
+                    feature_mask,
+                )
+                w0 = m[ent]
+                if per_entity_norm:
+                    # Per-entity norm rows arrive as ARGUMENTS (closing
+                    # over norm.factors would bake the whole (E+1, D)
+                    # matrix into the program as a constant).
+                    f_blk = (
+                        None if norm_factors is None else norm_factors[ent]
+                    )
+                    s_blk = (
+                        None if norm_shifts is None else norm_shifts[ent]
+                    )
+
+                    def one(data_e, w0_e, f_e, s_e):
+                        return problem.solve(
+                            loss, data_e, traced_cfg, w0_e,
+                            norm.row_context(f_e, s_e), use_pallas=False,
+                        )
+
+                    res = jax.vmap(one)(block, w0, f_blk, s_blk)
+                else:
+
+                    def one(data_e, w0_e):
+                        return problem.solve(
+                            loss, data_e, traced_cfg, w0_e, norm,
+                            use_pallas=False,
+                        )
+
+                    res = jax.vmap(one)(block, w0)
+                m = m.at[ent].set(res.coefficients)
+                if v is not None:
+                    if per_entity_norm:
+
+                        def onev(data_e, w_e, f_e, s_e):
+                            return problem.compute_variances(
+                                loss, data_e, traced_cfg, w_e,
+                                norm.row_context(f_e, s_e),
+                            )
+
+                        vv = jax.vmap(onev)(
+                            block, res.coefficients, f_blk, s_blk
+                        )
+                    else:
+
+                        def onev(data_e, w_e):
+                            return problem.compute_variances(
+                                loss, data_e, traced_cfg, w_e, norm
+                            )
+
+                        vv = jax.vmap(onev)(block, res.coefficients)
+                    v = v.at[ent].set(vv)
+                return (m, v), res.iterations
+
+            (matrix, var_matrix), iters = jax.lax.scan(
+                step, (matrix, var_matrix), (gathers, masks, ents)
+            )
+            return matrix, var_matrix, iters
+
+        if scan_cache_key:
+            _RE_JIT_CACHE[scan_cache_key] = train_scan
+        self._train_scan = train_scan
+
     def train(
         self,
         offsets: Array,
@@ -511,8 +643,34 @@ class RandomEffectCoordinate:
 
         # No host syncs inside the loop: bucket programs dispatch back-to-back
         # and stats materialize once at the end.
-        bucket_iters = []
-        for blocks in red.buckets:
+        bucket_iters: List = [None] * len(red.buckets)
+        if mesh is None and red.buckets and sweep_scan_enabled():
+            # Scan-dispatched sweep: one program per distinct block shape.
+            # The entity-sharded mesh path keeps the per-bucket loop — its
+            # ring collectives are host-orchestrated.
+            norm_f = norm_s = None
+            if self._per_entity_norm:
+                norm_f, norm_s = self.norm.factors, self.norm.shifts
+            for idxs, gathers, masks, ents in self._scan_group_list():
+                matrix, var_matrix, iters = self._train_scan(
+                    ds.shards[red.feature_shard],
+                    ds.labels,
+                    ds.weights,
+                    offsets,
+                    matrix,
+                    var_matrix,
+                    gathers,
+                    masks,
+                    ents,
+                    red.feature_mask,
+                    norm_f,
+                    norm_s,
+                    rw,
+                )
+                for k, bi in enumerate(idxs):
+                    bucket_iters[bi] = iters[k]
+            return self._finish_train(matrix, var_matrix, bucket_iters)
+        for bi, blocks in enumerate(red.buckets):
             block_data = gather_block_data(
                 ds, red.feature_shard, blocks, offsets, feature_mask=red.feature_mask
             )
@@ -544,7 +702,35 @@ class RandomEffectCoordinate:
                     )
                 else:
                     var_matrix = var_matrix.at[blocks.entity_rows].set(v)
-            bucket_iters.append(res.iterations)
+            bucket_iters[bi] = res.iterations
+        return self._finish_train(matrix, var_matrix, bucket_iters)
+
+    def _scan_group_list(self):
+        """Buckets grouped by block shape, each stacked into (K, E, S)
+        scan operands. Built once per coordinate; every (capacity, E)
+        shape comes from the canonical discrete set, so the group count —
+        and hence the per-sweep program count — is small by construction."""
+        groups = getattr(self, "_scan_groups_cache", None)
+        if groups is None:
+            by_shape: dict = {}
+            bl = self.re_dataset.buckets
+            for i, b in enumerate(bl):
+                by_shape.setdefault((b.num_entities, b.capacity), []).append(i)
+            groups = [
+                (
+                    idxs,
+                    jnp.stack([bl[i].gather for i in idxs]),
+                    jnp.stack([bl[i].mask for i in idxs]),
+                    jnp.stack([bl[i].entity_rows for i in idxs]),
+                )
+                for idxs in by_shape.values()
+            ]
+            self._scan_groups_cache = groups
+        return groups
+
+    def _finish_train(self, matrix, var_matrix, bucket_iters):
+        red = self.re_dataset
+        e_total = red.num_entities
         stats = {
             "buckets": [
                 dict(

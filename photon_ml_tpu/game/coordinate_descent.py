@@ -26,6 +26,7 @@ import logging
 import time
 from typing import Dict, List, Mapping, Optional, Sequence, Set, Tuple
 
+import jax
 import jax.numpy as jnp
 
 from photon_ml_tpu.evaluation.suite import EvaluationResults, EvaluationSuite
@@ -36,10 +37,8 @@ from photon_ml_tpu.utils.observability import record_stage
 logger = logging.getLogger(__name__)
 
 
-def _update_all_finite(model, scores) -> bool:
-    """ONE scalar all-finite check over a coordinate update (new model +
-    new scores): the and-reduction builds device-side, so the guard costs a
-    single boolean fetch per coordinate update, not one per array."""
+def _model_arrays(model, scores) -> tuple:
+    """The arrays a coordinate update's divergence guard must vet."""
     arrays = [scores]
     coeffs = getattr(model, "coefficients", None)
     if coeffs is not None:
@@ -51,8 +50,38 @@ def _update_all_finite(model, scores) -> bool:
         arrays.append(matrix)
         if getattr(model, "variances_matrix", None) is not None:
             arrays.append(model.variances_matrix)
+    return tuple(arrays)
+
+
+# Per-coordinate sweep glue as TWO fused XLA programs (the scan-the-sweep
+# companion to the coordinate-level scan in game/coordinate.py): residual +
+# offset build is one dispatch, and the commit — new summed scores PLUS the
+# divergence guard's all-finite reduction over every updated array — is one
+# more, whose single boolean fetch is the sweep's only host sync. The ops
+# are identical to the previous unfused expressions, so residuals, summed
+# scores and the guard decision are bitwise unchanged.
+
+
+@jax.jit
+def _residual_offsets(summed, prev_scores, base_offsets):
+    residual = summed - prev_scores
+    return residual, base_offsets + residual
+
+
+@jax.jit
+def _commit_update(residual, new_scores, guarded_arrays):
     ok = jnp.bool_(True)
-    for a in arrays:
+    for a in guarded_arrays:
+        ok = ok & jnp.all(jnp.isfinite(a))
+    return residual + new_scores, ok
+
+
+def _update_all_finite(model, scores) -> bool:
+    """ONE scalar all-finite check over a coordinate update (new model +
+    new scores): the and-reduction builds device-side, so the guard costs a
+    single boolean fetch per coordinate update, not one per array."""
+    ok = jnp.bool_(True)
+    for a in _model_arrays(model, scores):
         ok = ok & jnp.all(jnp.isfinite(a))
     return bool(ok)
 
@@ -255,8 +284,9 @@ def run_coordinate_descent(
             coord = coordinates[cid]
             t0 = time.perf_counter()
             _prefetch_after(step)
-            residual = summed - scores.get(cid, jnp.zeros((n,), dtype))
-            offsets = base_offsets + residual
+            residual, offsets = _residual_offsets(
+                summed, scores.get(cid, jnp.zeros((n,), dtype)), base_offsets
+            )
             kwargs = {}
             if reg_weights and cid in reg_weights:
                 kwargs["reg_weight"] = reg_weights[cid]
@@ -275,6 +305,7 @@ def run_coordinate_descent(
             # the coordinate keeps its last-good model.
             model = None
             new_scores = None
+            new_summed = None
             for attempt in range(1 + faults.solve_retry_attempts()):
                 try:
                     faults.fault_point("solve")
@@ -290,9 +321,17 @@ def run_coordinate_descent(
                         offsets, models.get(cid), **kwargs
                     )
                     cand_scores = coord.score(cand_model)
-                    finite = _update_all_finite(cand_model, cand_scores)
+                    # One fused program: the next summed-scores vector and
+                    # the divergence guard's reduction; one bool fetch.
+                    cand_summed, ok = _commit_update(
+                        residual,
+                        cand_scores,
+                        _model_arrays(cand_model, cand_scores),
+                    )
+                    finite = bool(ok)
                 if finite:
                     model, new_scores = cand_model, cand_scores
+                    new_summed = cand_summed
                     break
                 diverged_steps += 1
                 record_stage("diverged", 1.0)
@@ -305,7 +344,7 @@ def run_coordinate_descent(
                 )
             accepted = model is not None
             if accepted:
-                summed = residual + new_scores
+                summed = new_summed
                 scores[cid] = new_scores
                 models[cid] = model
             else:

@@ -13,6 +13,7 @@
 
 #include <cstdint>
 #include <cstring>
+#include <thread>
 #include <vector>
 
 extern "C" {
@@ -80,6 +81,108 @@ int64_t photon_pack_level(const int32_t* rows, const int32_t* cols,
     } else {
       spill_out[n_spill++] = i;
     }
+  }
+  return n_spill;
+}
+
+// Core-sharded variant of photon_pack_level for row-SORTED input (the
+// CSR-derived data plane always hands rows in non-decreasing order): the
+// entry range is cut at row-tile boundaries, so no two threads ever touch
+// the same segment — each runs the identical serial placement over its
+// slice, preserving input order within every segment, and the per-thread
+// spill lists concatenate in thread order == global entry order. The
+// result is therefore BITWISE identical to the serial pack. Returns -2
+// when rows are not sorted (caller falls back to the serial symbol) and
+// -1 on invalid arguments.
+int64_t photon_pack_level_sharded(const int32_t* rows, const int32_t* cols,
+                                  const float* vals, int64_t nnz,
+                                  int64_t n_tiles, int64_t n_buckets,
+                                  int32_t tile_shift, int64_t sp,
+                                  int32_t row_aligned, int32_t n_threads,
+                                  int32_t* out_packed, float* out_values,
+                                  int64_t* spill_out) {
+  if (nnz < 0 || n_tiles <= 0 || n_buckets <= 0 || sp <= 0 || tile_shift < 0 ||
+      n_threads <= 0)
+    return -1;
+  if (row_aligned && sp % 128 != 0) return -1;
+  for (int64_t i = 1; i < nnz; ++i)
+    if (rows[i] < rows[i - 1]) return -2;
+  // Small-input threshold mirrored by the python binding (which labels
+  // the path): keep the two in sync.
+  if (n_threads == 1 || nnz < (int64_t)n_threads * 65536)
+    return photon_pack_level(rows, cols, vals, nnz, n_tiles, n_buckets,
+                             tile_shift, sp, row_aligned, out_packed,
+                             out_values, spill_out);
+
+  // Cut points: thread t starts at the first entry whose TILE differs from
+  // the previous thread's last tile (entries of one tile never split).
+  std::vector<int64_t> cuts(n_threads + 1, nnz);
+  cuts[0] = 0;
+  for (int32_t t = 1; t < n_threads; ++t) {
+    int64_t i = nnz * t / n_threads;
+    const int32_t tile = rows[i] >> tile_shift;
+    while (i < nnz && (rows[i] >> tile_shift) == tile) ++i;
+    cuts[t] = i;
+  }
+  for (int32_t t = 1; t <= n_threads; ++t)
+    if (cuts[t] < cuts[t - 1]) cuts[t] = cuts[t - 1];
+
+  std::vector<std::vector<int64_t>> spills((size_t)n_threads);
+  std::vector<std::thread> workers;
+  workers.reserve((size_t)n_threads);
+  const int32_t row_mask = (1 << tile_shift) - 1;
+  const int64_t spv = sp / 128;
+  for (int32_t t = 0; t < n_threads; ++t) {
+    workers.emplace_back([&, t]() {
+      const int64_t lo = cuts[t], hi = cuts[t + 1];
+      if (lo >= hi) return;
+      const int64_t tile_lo = rows[lo] >> tile_shift;
+      const int64_t tile_hi = (rows[hi - 1] >> tile_shift) + 1;
+      std::vector<int64_t>& spill = spills[(size_t)t];
+      if (row_aligned) {
+        std::vector<int32_t> cursor(
+            (size_t)((tile_hi - tile_lo) * n_buckets * 128), 0);
+        for (int64_t i = lo; i < hi; ++i) {
+          const int32_t r = rows[i];
+          const int32_t c = cols[i];
+          const int64_t seg = (int64_t)(r >> tile_shift) * n_buckets + (c >> 7);
+          const int32_t rl = r & row_mask;
+          const int32_t lane = rl & 127;
+          const int64_t cur =
+              (seg - tile_lo * n_buckets) * 128 + lane;
+          const int32_t rank = cursor[(size_t)cur]++;
+          if (rank < spv) {
+            const int64_t slot = seg * sp + (int64_t)rank * 128 + lane;
+            out_packed[slot] = ((rl >> 7) << 7) | (c & 127);
+            out_values[slot] = vals[i];
+          } else {
+            spill.push_back(i);
+          }
+        }
+      } else {
+        std::vector<int64_t> cursor((size_t)((tile_hi - tile_lo) * n_buckets),
+                                    0);
+        for (int64_t i = lo; i < hi; ++i) {
+          const int32_t r = rows[i];
+          const int32_t c = cols[i];
+          const int64_t seg = (int64_t)(r >> tile_shift) * n_buckets + (c >> 7);
+          const int64_t pos = cursor[(size_t)(seg - tile_lo * n_buckets)]++;
+          if (pos < sp) {
+            const int64_t slot = seg * sp + pos;
+            out_packed[slot] = ((r & row_mask) << 7) | (c & 127);
+            out_values[slot] = vals[i];
+          } else {
+            spill.push_back(i);
+          }
+        }
+      }
+    });
+  }
+  for (auto& w : workers) w.join();
+  int64_t n_spill = 0;
+  for (const auto& s : spills) {
+    std::memcpy(spill_out + n_spill, s.data(), s.size() * sizeof(int64_t));
+    n_spill += (int64_t)s.size();
   }
   return n_spill;
 }

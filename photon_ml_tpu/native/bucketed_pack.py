@@ -8,6 +8,7 @@ numpy path stays as the no-compiler fallback and as the semantics oracle
 from __future__ import annotations
 
 import ctypes
+import os
 from typing import Optional, Tuple
 
 import numpy as np
@@ -38,6 +39,22 @@ def _lib() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_float),
             ctypes.POINTER(ctypes.c_int64),
         ]
+        lib.photon_pack_level_sharded.restype = ctypes.c_int64
+        lib.photon_pack_level_sharded.argtypes = [
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.c_int32,
+            ctypes.c_int64,
+            ctypes.c_int32,  # row_aligned
+            ctypes.c_int32,  # n_threads
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_int64),
+        ]
         lib.photon_ell_fill.restype = ctypes.c_int32
         lib.photon_ell_fill.argtypes = [
             ctypes.POINTER(ctypes.c_int64),
@@ -59,6 +76,20 @@ def _ptr(a: np.ndarray, ctype):
     return a.ctypes.data_as(ctypes.POINTER(ctype))
 
 
+def pack_threads() -> int:
+    """Cores the pack may shard over: PHOTON_PACK_THREADS override, else
+    the host's effective parallelism (cgroup-aware)."""
+    env = os.environ.get("PHOTON_PACK_THREADS", "").strip()
+    if env:
+        try:
+            return max(1, int(env))
+        except ValueError:
+            pass
+    from photon_ml_tpu.data.pipeline import effective_host_parallelism
+
+    return effective_host_parallelism()
+
+
 def pack_level_native(
     rows: np.ndarray,
     cols: np.ndarray,
@@ -68,9 +99,15 @@ def pack_level_native(
     tile_shift: int,
     sp: int,
     row_aligned: bool = False,
-) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+    threads: Optional[int] = None,
+) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray, str]]:
     """Returns (packed (n_seg*sp,) i32, values (n_seg*sp,) f32,
-    spill entry indices) or None when the native library is unavailable."""
+    spill entry indices, path) or None when the native library is
+    unavailable. `path` is "native-sharded" when the core-parallel pass ran
+    (rows sorted, >1 thread available) else "native"; both placements are
+    bitwise identical (the sharded pass cuts at tile boundaries, so no two
+    threads share a segment and input order within segments is preserved —
+    tests assert equality against the numpy oracle)."""
     lib = _lib()
     if lib is None:
         return None
@@ -82,7 +119,7 @@ def pack_level_native(
     packed = np.zeros(n_seg * sp, np.int32)
     values = np.zeros(n_seg * sp, np.float32)
     spill = np.empty(nnz, np.int64)
-    n_spill = lib.photon_pack_level(
+    args = (
         _ptr(rows32, ctypes.c_int32),
         _ptr(cols32, ctypes.c_int32),
         _ptr(vals32, ctypes.c_float),
@@ -92,13 +129,28 @@ def pack_level_native(
         tile_shift,
         sp,
         1 if row_aligned else 0,
+    )
+    out = (
         _ptr(packed, ctypes.c_int32),
         _ptr(values, ctypes.c_float),
         _ptr(spill, ctypes.c_int64),
     )
+    n_threads = pack_threads() if threads is None else max(1, threads)
+    path = "native"
+    n_spill = -2
+    # Mirror the C++ small-input threshold (bucketed_pack.cc, kept in
+    # sync): below it the sharded entry point would internally delegate to
+    # the serial pass, and reporting "native-sharded" for a serial run is
+    # exactly the dispatch-decision mislabeling this PR's bench fix bans.
+    if n_threads > 1 and nnz >= n_threads * 65536:
+        n_spill = lib.photon_pack_level_sharded(*args, n_threads, *out)
+        if n_spill >= 0:
+            path = "native-sharded"
+    if n_spill == -2:  # unsorted rows, single-threaded, or small input
+        n_spill = lib.photon_pack_level(*args, *out)
     if n_spill < 0:
         return None
-    return packed, values, spill[:n_spill]
+    return packed, values, spill[:n_spill], path
 
 
 def ell_fill_native(

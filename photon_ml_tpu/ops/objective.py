@@ -156,7 +156,11 @@ def value_and_gradient(
     if fused_sparse:
         # Sparse fused path: one stream over the bucketed entries computes
         # value, u and the gradient together (pallas_sparse._fused_kernel) —
-        # same raw-sum contract as the dense fused kernel below.
+        # same raw-sum contract as the dense fused kernel below. The
+        # per-level layout rides in the features pytree (level1 may be
+        # row-aligned per data/bucketed.choose_layout, level2 is always
+        # grouped): the kernels branch per level, so no dispatch decision
+        # is needed here beyond feasibility.
         val, g, sum_u = pallas_sparse.fused_value_gradient_sums(
             loss, w_eff, shift, data.features, data.labels, data.offsets,
             data.weights, interpret=pallas_glm.FORCE_INTERPRET,

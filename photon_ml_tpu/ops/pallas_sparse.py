@@ -67,13 +67,35 @@ would cost the very one-hot alignment avoids): matvec 9.0 -> 4.5 ms/pass
 (2.01x); BUT rmatvec 17.5 -> 32.5 ms (0.54x) and the fused objective
 38.9 -> 43.3 ms (0.90x): the gradient's feature-side one-hot is
 alignment-INVARIANT, and per-lane collision padding (pad_blowup 1.13 ->
-2.13 at 2x-mean sizing) scales the whole backward stream. Conclusion: the
-sublane-alignment family cannot beat the fused kernel's ceiling — the
-backward one-hot survives any row-side layout and padding eats the
-forward win. The layout ships default-OFF (PHOTON_SPARSE_ROWALIGN=1
-enables it; it is the right choice for matvec-dominated/scoring-only
-workloads) and both layouts decode identically (to_coo/XLA fallbacks
-branch on the flag).
+2.13 at 2x-mean sizing) scales the whole backward stream.
+
+r06 — WIDE-OPERAND contraction batching, the profile's answer to what the
+fused kernel is actually bound by. A Mosaic profile of the fused objective
+(same bench shape) shows neither HBM nor the MXU saturated: ~71% of cycles
+sit in per-segment-row scalar/VPU overhead — spv separate (1, 128) x
+(128, 128) one-hot contractions per segment, each too small to fill an
+MXU pass, interleaved with the one-hot builds that feed them. The fix is
+operand SHAPE, not layout: concatenate the spv segment rows along lanes
+and issue ONE (rt, spv*128) x (128, spv*128) contraction per segment
+(forward) and one (1, spv*128) x (128, spv*128) per segment (backward) —
+identical FLOPs and one-hot element count, but spv-fold fewer MXU
+dispatches and a contraction long enough to stream. Lane-concatenation
+(not reshape) builds the wide operands, so Mosaic never relayouts across
+the lane/sublane split. MEASURED within-run on v5e at the bench shape:
+fused objective 38.9 -> 11.2 ms/eval (3.5x; matching the cycle
+accounting: the remaining wall is the wide one-hot builds + MXU), matvec
+9.0 -> 4.1 ms, rmatvec 17.5 -> 6.8 ms. With the batched backward
+amortized, the r05 verdict on alignment inverts in the low-collision
+regime: the aligned forward win (no z one-hot at all) is no longer
+drowned by backward padding WHEN padding stays near 1x, so the layout
+choice moved into a planner (data/bucketed.choose_layout): Poisson
+collision economics pick row-aligned level 1 only when its adaptive-width
+blowup stays under ROWALIGN_MAX_BLOWUP (bench shape: stays grouped at
+blowup 2.0 — correctly), level 2 is always grouped, and
+PHOTON_SPARSE_LAYOUT=rowalign|grouped forces either way (legacy
+PHOTON_SPARSE_ROWALIGN=1 == rowalign). Both layouts decode identically
+(to_coo/XLA fallbacks branch on the flag) and the fused kernel runs
+either end-to-end.
 """
 
 from __future__ import annotations
@@ -144,6 +166,35 @@ def _onehot_rows(idx_row: Array, rows: int) -> Array:
     ).astype(jnp.float32)
 
 
+def _wide_rows(a: Array) -> Array:
+    """(spv, 128) -> (1, spv*128) by lane-concatenating the sublane rows.
+
+    Concatenation, not reshape: a lane-splitting reshape would force a
+    Mosaic relayout across the sublane/lane tiling; per-row slices plus a
+    lane concat lower to plain vreg moves."""
+    spv = a.shape[0]
+    if spv == 1:
+        return a
+    return jnp.concatenate([a[s : s + 1, :] for s in range(spv)], axis=1)
+
+
+def _bcast_wide(a: Array, sublanes: int) -> Array:
+    """(spv, 128) -> (sublanes, spv*128): flatten rows, broadcast down."""
+    w = _wide_rows(a)
+    return jax.lax.broadcast_in_dim(w[0, :], (sublanes, w.shape[1]), (1,))
+
+
+def _onehot_wide(idx: Array, rows: int) -> Array:
+    """(spv, 128) indices -> (rows, spv*128) one-hot, f32 (iota-compare).
+
+    The wide build feeds ONE MXU contraction per segment instead of spv
+    narrow ones — the r06 restructure; element count is identical."""
+    wide = _bcast_wide(idx, rows)
+    return (
+        jax.lax.broadcasted_iota(jnp.int32, wide.shape, 0) == wide
+    ).astype(jnp.float32)
+
+
 def _onehot_contract(values_row: Array, onehot: Array) -> Array:
     """dot(values, onehot^T) with the configured value-operand precision."""
     dn = (((1,), (1,)), ((), ()))
@@ -191,14 +242,13 @@ def _matvec_kernel(
                     p[s : s + 1, :], rt
                 )
         else:
+            # Wide-operand batch (r06): one (rt, spv*128) x (128, spv*128)
+            # contraction per segment replaces spv narrow MXU passes.
             rl = jax.lax.shift_right_logical(pk, _ROW_SHIFT)
-            for s in range(spv):
-                rl_row = rl[s : s + 1, :]
-                rhi = jax.lax.shift_right_logical(rl_row, 7)
-                rlo = jax.lax.bitwise_and(rl_row, 127)
-                p1 = _onehot_rows(rhi, rt) * _bcast_row(p[s : s + 1, :], rt)
-                orlt = _onehot_rows(rlo, 128)
-                zc = zc + _onehot_contract(p1, orlt)
+            rhi = jax.lax.shift_right_logical(rl, 7)
+            rlo = jax.lax.bitwise_and(rl, 127)
+            p1 = _onehot_wide(rhi, rt) * _bcast_wide(p, rt)
+            zc = zc + _onehot_contract(p1, _onehot_wide(rlo, 128))
 
     @pl.when(bg == 0)
     def _():
@@ -223,25 +273,28 @@ def _rmatvec_kernel(
             vv = vv * vv
         rl = jax.lax.shift_right_logical(pk, _ROW_SHIFT)
         lane = jax.lax.bitwise_and(pk, BUCKET - 1)
-        gc = jnp.zeros((1, 128), jnp.float32)
-        for s in range(spv):
-            rl_row = rl[s : s + 1, :]
-            if row_aligned:
-                # Slot lane IS the u lane: select the sublane block with
-                # the rt-row one-hot; no u lane-gather needed.
-                u_sel = jnp.sum(
-                    _onehot_rows(rl_row, rt) * u2, axis=0, keepdims=True
-                )
-            else:
-                rhi = jax.lax.shift_right_logical(rl_row, 7)
-                rlo = jax.lax.bitwise_and(rl_row, 127)
-                tu = jnp.take_along_axis(u2, _bcast_row(rlo, rt), axis=1)
-                u_sel = jnp.sum(
-                    _onehot_rows(rhi, rt) * tu, axis=0, keepdims=True
-                )
-            a = u_sel * vv[s : s + 1, :]
-            olt = _onehot_rows(lane[s : s + 1, :], 128)
-            gc = gc + _onehot_contract(a, olt)
+        # Wide-operand batch (r06): u-select and feature scatter for all
+        # spv segment rows at once; ONE MXU contraction per segment.
+        if row_aligned:
+            # Slot lane IS the u lane: chunk s of the wide operand reads
+            # u2[:, lane], i.e. u2 tiled spv times along lanes.
+            u2w = (
+                u2
+                if spv == 1
+                else jnp.concatenate([u2] * spv, axis=1)
+            )
+            u_sel = jnp.sum(
+                _onehot_wide(rl, rt) * u2w, axis=0, keepdims=True
+            )
+        else:
+            rhi = jax.lax.shift_right_logical(rl, 7)
+            rlo = jax.lax.bitwise_and(rl, 127)
+            tu = jnp.take_along_axis(u2, _bcast_wide(rlo, rt), axis=1)
+            u_sel = jnp.sum(
+                _onehot_wide(rhi, rt) * tu, axis=0, keepdims=True
+            )
+        a = u_sel * _bcast_wide(vv, 1)
+        gc = _onehot_contract(a, _onehot_wide(lane, 128))
         bidx = bg * group + gi
 
         @pl.when(t == 0)
@@ -423,12 +476,13 @@ def maybe_pack(feats, n_samples: int) -> Optional[BucketedSparseFeatures]:
 
 
 def host_pack_coo(
-    rows, cols, vals, n_samples: int, dim: int
+    rows, cols, vals, n_samples: int, dim: int, *, host_only: bool = True
 ) -> Optional[BucketedSparseFeatures]:
-    """Host-only half of `maybe_pack_coo`: gates + counting-sort pack, NO
-    device upload (planes stay numpy; `data.bucketed.upload` moves them).
-    Split out so ingest can run the pack on a background thread while the
-    rest of ingest/prepare proceeds (begin_pack_async)."""
+    """Gates + counting-sort pack. `host_only=True` (the background-thread
+    ingest path) keeps the planes numpy; `data.bucketed.upload` moves them.
+    `host_only=False` lets the pack dispatch to the device path
+    (data/device_pack.py) when enabled — planes are then born
+    device-resident and `upload` is a no-op for them."""
     import numpy as np
 
     from photon_ml_tpu.data.bucketed import pack_bucketed
@@ -437,12 +491,27 @@ def host_pack_coo(
         return None
     if np.asarray(vals).dtype != np.float32:
         return None
-    bf = pack_bucketed(rows, cols, vals, n_samples, dim, host_only=True)
+    bf = pack_bucketed(rows, cols, vals, n_samples, dim, host_only=host_only)
     if not should_use(bf):
         return None
     if bf.density_report()["pad_blowup"] > MAX_PAD_BLOWUP:
         return None
     return bf
+
+
+def pack_coo_auto(
+    rows, cols, vals, n_samples: int, dim: int
+) -> Optional[BucketedSparseFeatures]:
+    """Gates + pack on the best available placement path: the device
+    counting-sort when enabled (12 s of host wall on the bench shape
+    becomes one XLA program where the planes live anyway), else the host
+    native/numpy pack with its planes left for `upload` to move."""
+    from photon_ml_tpu.data import bucketed, device_pack
+
+    bf = host_pack_coo(
+        rows, cols, vals, n_samples, dim, host_only=not device_pack.enabled()
+    )
+    return None if bf is None else bucketed.upload(bf)
 
 
 def maybe_pack_coo(
@@ -454,10 +523,7 @@ def maybe_pack_coo(
     dataset-construction placement (RandomEffectDataset.scala:229-264).
     Applies the same engagement gates; sharding cannot apply (host arrays).
     """
-    from photon_ml_tpu.data import bucketed
-
-    bf = host_pack_coo(rows, cols, vals, n_samples, dim)
-    return None if bf is None else bucketed.upload(bf)
+    return pack_coo_auto(rows, cols, vals, n_samples, dim)
 
 
 def begin_pack_async(csr, n_samples: int) -> None:
@@ -484,14 +550,31 @@ def begin_pack_async(csr, n_samples: int) -> None:
         return
     if not pack_worth_considering(n_samples):
         return
+    from photon_ml_tpu.data import device_pack
+
+    if device_pack.enabled():
+        # The device pack at first consumption costs milliseconds — a
+        # 12-second host thread to hide behind ingest no longer exists.
+        return
     from photon_ml_tpu.data.pipeline import pipeline_enabled
 
     if not pipeline_enabled():
         return
     import concurrent.futures
+    import contextlib
     import threading
 
+    from photon_ml_tpu.utils.observability import (
+        current_stage_registry,
+        stage_scope,
+    )
+
     fut: "concurrent.futures.Future" = concurrent.futures.Future()
+    # Capture the submitter's ambient stage registry (the AsyncUploader
+    # pattern): the worker thread's own stack is empty, and without this
+    # the pack_host wall + pack_path note of the DOMINANT host pack would
+    # silently vanish from the fit's breakdown.
+    submit_registry = current_stage_registry()
 
     def _run():
         if not fut.set_running_or_notify_cancel():
@@ -499,9 +582,17 @@ def begin_pack_async(csr, n_samples: int) -> None:
         try:
             from photon_ml_tpu.utils import faults
 
-            faults.fault_point("pack")
-            rows, cols, vals, dim = csr.to_coo()
-            fut.set_result(host_pack_coo(rows, cols, vals, n_samples, dim))
+            scope = (
+                stage_scope(submit_registry)
+                if submit_registry is not None
+                else contextlib.nullcontext()
+            )
+            with scope:
+                faults.fault_point("pack")
+                rows, cols, vals, dim = csr.to_coo()
+                fut.set_result(
+                    host_pack_coo(rows, cols, vals, n_samples, dim)
+                )
         except BaseException as exc:  # noqa: BLE001 - surfaced at result()
             fut.set_exception(exc)
 
@@ -541,9 +632,14 @@ def finish_pack(csr, n_samples: int) -> Optional[BucketedSparseFeatures]:
             csr.pack_future = None
         else:
             return None if bf is None else bucketed.upload(bf)
+    from photon_ml_tpu.data import device_pack
+
     with stage_timer("pack"):
         rows, cols, vals, dim = csr.to_coo()
-        bf = host_pack_coo(rows, cols, vals, n_samples, dim)
+        bf = host_pack_coo(
+            rows, cols, vals, n_samples, dim,
+            host_only=not device_pack.enabled(),
+        )
     return None if bf is None else bucketed.upload(bf)
 
 
@@ -630,14 +726,11 @@ def _fused_kernel(
                     p[s : s + 1, :], rt
                 )
             return zc
-        for s in range(spv):
-            rl_row = rl[s : s + 1, :]
-            rhi = jax.lax.shift_right_logical(rl_row, 7)
-            rlo = jax.lax.bitwise_and(rl_row, 127)
-            p1 = _onehot_rows(rhi, rt) * _bcast_row(p[s : s + 1, :], rt)
-            orlt = _onehot_rows(rlo, 128)
-            zc = zc + _onehot_contract(p1, orlt)
-        return zc
+        # Wide-operand batch (r06): one MXU contraction per segment.
+        rhi = jax.lax.shift_right_logical(rl, 7)
+        rlo = jax.lax.bitwise_and(rl, 127)
+        p1 = _onehot_wide(rhi, rt) * _bcast_wide(p, rt)
+        return zc + _onehot_contract(p1, _onehot_wide(rlo, 128))
 
     z = jax.lax.fori_loop(0, B, fwd_body, zx_ref[:]) + off_ref[:]
     y = y_ref[:]
@@ -663,25 +756,22 @@ def _fused_kernel(
         vv = val_ref[pl.ds(b * spv, spv), :]
         lane = jax.lax.bitwise_and(pk, BUCKET - 1)
         rl = jax.lax.shift_right_logical(pk, _ROW_SHIFT)
-        gc = jnp.zeros((1, 128), jnp.float32)
-        for s in range(spv):
-            rl_row = rl[s : s + 1, :]
-            if row_aligned:
-                # u lanes align with slot lanes: sublane-block select only.
-                u_sel = jnp.sum(
-                    _onehot_rows(rl_row, rt) * u2, axis=0, keepdims=True
-                )
-            else:
-                rhi = jax.lax.shift_right_logical(rl_row, 7)
-                rlo = jax.lax.bitwise_and(rl_row, 127)
-                tu = jnp.take_along_axis(u2, _bcast_row(rlo, rt), axis=1)
-                u_sel = jnp.sum(
-                    _onehot_rows(rhi, rt) * tu, axis=0, keepdims=True
-                )
-            a = u_sel * vv[s : s + 1, :]
-            olt = _onehot_rows(lane[s : s + 1, :], 128)
-            gc = gc + _onehot_contract(a, olt)
-        g_ref[pl.ds(b, 1), :] += gc
+        # Wide-operand batch (r06): ONE MXU contraction per segment.
+        if row_aligned:
+            # u lanes align with slot lanes: sublane-block select only.
+            u2w = u2 if spv == 1 else jnp.concatenate([u2] * spv, axis=1)
+            u_sel = jnp.sum(
+                _onehot_wide(rl, rt) * u2w, axis=0, keepdims=True
+            )
+        else:
+            rhi = jax.lax.shift_right_logical(rl, 7)
+            rlo = jax.lax.bitwise_and(rl, 127)
+            tu = jnp.take_along_axis(u2, _bcast_wide(rlo, rt), axis=1)
+            u_sel = jnp.sum(
+                _onehot_wide(rhi, rt) * tu, axis=0, keepdims=True
+            )
+        a = u_sel * _bcast_wide(vv, 1)
+        g_ref[pl.ds(b, 1), :] += _onehot_contract(a, _onehot_wide(lane, 128))
         return carry
 
     jax.lax.fori_loop(0, B, bwd_body, 0)

@@ -45,12 +45,22 @@ class TimingRegistry:
     def __init__(self) -> None:
         self.sections: Dict[str, float] = {}
         self.counts: Dict[str, int] = {}
+        # Non-time annotations (e.g. which pack path ran): last write wins,
+        # read back by the estimator into fit_timing.
+        self.notes: Dict[str, str] = {}
         self._lock = threading.Lock()
 
     def record(self, name: str, seconds: float) -> None:
         with self._lock:
             self.sections[name] = self.sections.get(name, 0.0) + seconds
             self.counts[name] = self.counts.get(name, 0) + 1
+
+    def set_note(self, name: str, value: str) -> None:
+        with self._lock:
+            self.notes[name] = value
+
+    def get_note(self, name: str, default: Optional[str] = None) -> Optional[str]:
+        return self.notes.get(name, default)
 
     def get(self, name: str, default: float = 0.0) -> float:
         return self.sections.get(name, default)
@@ -147,6 +157,14 @@ def record_stage(name: str, seconds: float) -> None:
     registry = current_stage_registry()
     if registry is not None:
         registry.record(name, seconds)
+
+
+def set_stage_note(name: str, value: str) -> None:
+    """Attach a non-time annotation (e.g. `pack_path`) to this thread's
+    innermost stage scope (no-op without one)."""
+    registry = current_stage_registry()
+    if registry is not None:
+        registry.set_note(name, value)
 
 
 @contextmanager

@@ -47,6 +47,11 @@ def pytest_configure(config):
         "serving: online serving engine tests (bundle/engine/batcher/"
         "lifecycle)",
     )
+    config.addinivalue_line(
+        "markers",
+        "perf: perf-regression guards (engagement + non-dominance contracts "
+        "on bench-like shapes); the heavy ones are also slow-marked",
+    )
     _assert_fault_sites_registered()
 
 

@@ -458,3 +458,88 @@ class TestPearsonFeatureSelection:
         assert mask[0] == 1.0  # offset-dominated informative column survives
         assert mask[3] == 1.0  # intercept survives
         assert mask[1] == 0.0
+
+
+class TestSweepScan:
+    """Scan-dispatched random-effect sweep (PHOTON_SWEEP_SCAN): the
+    same-shape bucket groups run as one lax.scan program; results must be
+    BITWISE equal to the per-bucket dispatch loop — the scan only changes
+    how many XLA programs a sweep costs, never what they compute."""
+
+    def _dataset(self, n=6000, d_re=8, n_entities=300, seed=3):
+        rng = np.random.default_rng(seed)
+        Xe = rng.normal(size=(n, d_re)).astype(np.float32)
+        entity = rng.integers(0, n_entities, size=n)
+        y = (rng.uniform(size=n) > 0.5).astype(np.float32)
+        ds = GameDataset.build(
+            {"pe": jnp.asarray(Xe)}, y, id_tags={"entityId": entity}
+        )
+        red = build_random_effect_dataset(
+            ds,
+            RandomEffectDataConfig(
+                "entityId", "pe", active_upper_bound=32, min_bucket=8
+            ),
+        )
+        return ds, red
+
+    def test_sweep_scan_matches_bucket_loop(self, monkeypatch):
+        from photon_ml_tpu.game.coordinate import sweep_scan_enabled
+
+        ds, red = self._dataset()
+        assert len(red.buckets) > 1  # the scan must have something to fuse
+        cfg = CoordinateOptimizationConfig(
+            optimizer=OptimizerConfig(max_iterations=10, tolerance=1e-7),
+            regularization=L2,
+            reg_weight=5.0,
+            variance_computation=VarianceComputationType.SIMPLE,
+        )
+        coord = RandomEffectCoordinate(ds, red, cfg, TaskType.LOGISTIC_REGRESSION)
+        assert sweep_scan_enabled()
+        m_scan, stats_scan = coord.train(ds.offsets)
+        monkeypatch.setenv("PHOTON_SWEEP_SCAN", "0")
+        assert not sweep_scan_enabled()
+        m_loop, stats_loop = coord.train(ds.offsets)
+        np.testing.assert_array_equal(
+            np.asarray(m_scan.coefficients_matrix),
+            np.asarray(m_loop.coefficients_matrix),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(m_scan.variances_matrix),
+            np.asarray(m_loop.variances_matrix),
+        )
+        assert stats_scan == stats_loop
+
+    def test_sweep_scan_warm_start_matches(self, monkeypatch):
+        """Warm start reads the coefficient matrix through the scan carry —
+        per-entity rows must round-trip exactly as in the loop."""
+        ds, red = self._dataset(seed=11)
+        cfg = CoordinateOptimizationConfig(
+            optimizer=OptimizerConfig(max_iterations=5, tolerance=1e-7),
+            regularization=L2,
+            reg_weight=2.0,
+        )
+        coord = RandomEffectCoordinate(ds, red, cfg, TaskType.LOGISTIC_REGRESSION)
+        warm, _ = coord.train(ds.offsets)
+        m_scan, _ = coord.train(ds.offsets, warm)
+        monkeypatch.setenv("PHOTON_SWEEP_SCAN", "0")
+        m_loop, _ = coord.train(ds.offsets, warm)
+        np.testing.assert_array_equal(
+            np.asarray(m_scan.coefficients_matrix),
+            np.asarray(m_loop.coefficients_matrix),
+        )
+
+    def test_scan_groups_cover_every_bucket_once(self):
+        ds, red = self._dataset(seed=7)
+        cfg = CoordinateOptimizationConfig(
+            optimizer=OptimizerConfig(max_iterations=2, tolerance=1e-6),
+            regularization=L2,
+            reg_weight=1.0,
+        )
+        coord = RandomEffectCoordinate(ds, red, cfg, TaskType.LOGISTIC_REGRESSION)
+        groups = coord._scan_group_list()
+        seen = sorted(i for idxs, *_ in groups for i in idxs)
+        assert seen == list(range(len(red.buckets)))
+        for idxs, gathers, masks, ents in groups:
+            assert gathers.shape[0] == len(idxs)
+            assert masks.shape == gathers.shape
+            assert ents.shape == gathers.shape[:2]

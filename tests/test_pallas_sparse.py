@@ -138,6 +138,223 @@ class TestKernelParity:
         )
 
 
+def _assert_same_layout(a, b):
+    """Bitwise equality of two BucketedSparseFeatures layouts."""
+    assert a.level1.row_aligned == b.level1.row_aligned
+    assert a.level1.spv == b.level1.spv
+    np.testing.assert_array_equal(
+        np.asarray(a.level1.packed), np.asarray(b.level1.packed)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(a.level1.values), np.asarray(b.level1.values)
+    )
+    assert (a.level2 is None) == (b.level2 is None)
+    if a.level2 is not None:
+        np.testing.assert_array_equal(
+            np.asarray(a.level2.packed), np.asarray(b.level2.packed)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(a.level2.values), np.asarray(b.level2.values)
+        )
+    np.testing.assert_array_equal(
+        np.asarray(a.overflow_rows), np.asarray(b.overflow_rows)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(a.overflow_cols), np.asarray(b.overflow_cols)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(a.overflow_vals), np.asarray(b.overflow_vals)
+    )
+
+
+class TestDevicePack:
+    """The XLA counting-sort pack must place every entry exactly where the
+    host counting sort does — the device path swaps WHERE the pack runs,
+    never what it produces (tentpole acceptance: bitwise layout parity)."""
+
+    def _both(self, rows, cols, vals, n, d, monkeypatch, **kw):
+        monkeypatch.setenv("PHOTON_DEVICE_PACK", "0")
+        host = pack_bucketed(rows, cols, vals, n, d, host_only=True, **kw)
+        monkeypatch.setenv("PHOTON_DEVICE_PACK", "1")
+        dev = pack_bucketed(rows, cols, vals, n, d, **kw)
+        return host, dev
+
+    @pytest.mark.parametrize("row_aligned", [True, False])
+    def test_device_pack_matches_host_pack_bitwise(self, row_aligned, monkeypatch):
+        rng = np.random.default_rng(12)
+        rows, cols, vals = _random_coo(rng, 5000, 300, 40000, hot_fraction=0.1)
+        host, dev = self._both(
+            rows, cols, vals, 5000, 300, monkeypatch, row_aligned=row_aligned
+        )
+        _assert_same_layout(host, dev)
+
+    def test_duplicate_columns_and_empty_rows(self, monkeypatch):
+        """The edge cases a rank-assignment bug would corrupt: repeated
+        (row, col) entries must keep their input order (both land, summing
+        on decode), and rows with no entries must stay empty."""
+        n, d = 4200, 260
+        rng = np.random.default_rng(13)
+        rows, cols, vals = _random_coo(rng, n, d, 20000)
+        # Duplicate-column block: the same (row, col) pair many times, with
+        # distinct values so placement order is observable.
+        dup_rows = np.full(500, 7, np.int64)
+        dup_cols = np.full(500, 33, np.int64)
+        dup_vals = (np.arange(500, dtype=np.float32) + 1.0) * 1e-3
+        rows = np.concatenate([rows, dup_rows])
+        cols = np.concatenate([cols, dup_cols])
+        vals = np.concatenate([vals, dup_vals])
+        # Empty rows: everything below row 2048 moved out of [100, 2048).
+        keep = ~((rows >= 100) & (rows < 2048))
+        rows, cols, vals = rows[keep], cols[keep], vals[keep]
+        host, dev = self._both(rows, cols, vals, n, d, monkeypatch)
+        _assert_same_layout(host, dev)
+        r2, c2, v2 = to_coo(dev)
+        assert not (((r2 >= 100) & (r2 < 2048)).any())
+        np.testing.assert_allclose(
+            _dense(r2, c2, v2, n, d), _dense(rows, cols, vals, n, d)
+        )
+
+    def test_empty_matrix_device(self, monkeypatch):
+        monkeypatch.setenv("PHOTON_DEVICE_PACK", "1")
+        bf = pack_bucketed(
+            np.zeros(0, np.int64),
+            np.zeros(0, np.int64),
+            np.zeros(0, np.float32),
+            10,
+            7,
+        )
+        z = pallas_sparse.matvec_xla(bf, jnp.ones(7))
+        assert z.shape == (10,) and float(jnp.abs(z).max()) == 0.0
+
+    def test_enabled_gate(self, monkeypatch):
+        from photon_ml_tpu.data import device_pack
+
+        monkeypatch.setenv("PHOTON_DEVICE_PACK", "0")
+        assert not device_pack.enabled()
+        monkeypatch.setenv("PHOTON_DEVICE_PACK", "1")
+        assert device_pack.enabled()
+        monkeypatch.delenv("PHOTON_DEVICE_PACK")
+        # auto: on only with an accelerator attached
+        assert device_pack.enabled() == (
+            jax.default_backend() in ("tpu", "gpu")
+        )
+
+
+class TestLayoutPlanner:
+    def test_env_forces_layout(self, monkeypatch):
+        from photon_ml_tpu.data.bucketed import choose_layout
+
+        monkeypatch.setenv("PHOTON_SPARSE_LAYOUT", "rowalign")
+        assert choose_layout(10**6, 10**5, 4096)[0] is True
+        monkeypatch.setenv("PHOTON_SPARSE_LAYOUT", "grouped")
+        assert choose_layout(10**6, 10**5, 4096)[0] is False
+        monkeypatch.delenv("PHOTON_SPARSE_LAYOUT")
+        monkeypatch.setenv("PHOTON_SPARSE_ROWALIGN", "1")  # legacy knob
+        assert choose_layout(10**6, 10**5, 4096)[0] is True
+
+    def test_auto_declines_bench_shape(self, monkeypatch):
+        """1M x 64 nnz into 16k dim: lane collisions force a ~2x aligned
+        blowup (r05's measured 2.13), above the training threshold — auto
+        must keep the grouped layout there."""
+        from photon_ml_tpu.data.bucketed import choose_layout
+
+        monkeypatch.delenv("PHOTON_SPARSE_LAYOUT", raising=False)
+        aligned, _ = choose_layout(64 * 10**6, 10**6, 16384)
+        assert aligned is False
+
+    def test_auto_declines_when_lane_load_exceeds_capacity(self, monkeypatch):
+        """Regression: lam >~ 746 underflowed exp(-lam) to 0 in the naive
+        Poisson recurrence, so the planner saw ZERO spill on dense shapes
+        whose per-lane load (~1562 here) dwarfs even MAX_SP capacity, and
+        picked an aligned layout that spilled ~99% of entries to level 2.
+        The log-space tail + the spill-fraction gate must decline."""
+        from photon_ml_tpu.data.bucketed import (
+            _poisson_excess_fraction,
+            choose_layout,
+        )
+
+        monkeypatch.delenv("PHOTON_SPARSE_LAYOUT", raising=False)
+        assert _poisson_excess_fraction(1562.5, 8) > 0.9
+        aligned, _ = choose_layout(200_000, 2048, 128)
+        assert aligned is False
+
+    def test_auto_accepts_low_collision_shape(self, monkeypatch):
+        """Dense-segment regime (high mean entries per lane): the adaptive
+        width amortizes the 1024-slot granularity and alignment engages."""
+        from photon_ml_tpu.data.bucketed import choose_layout
+
+        monkeypatch.delenv("PHOTON_SPARSE_LAYOUT", raising=False)
+        # mean1 = nnz / (T1 * B) = 64M / (16 * 1) = 4M>>MAX_SP; use a shape
+        # with mean segment size ~6800: sp granularity is ~15% there.
+        n_rows, dim = 32768, 128
+        nnz = 16 * 1 * 6800
+        aligned, sp1 = choose_layout(nnz, n_rows, dim)
+        assert aligned is True and sp1 is not None and sp1 % 1024 == 0
+
+
+class TestLayoutObjectiveParity:
+    """Satellite: the fused sparse objective must agree across layouts —
+    (value, gradient, sum_u) from the row-aligned pack vs the grouped pack
+    of the SAME matrix, across level-1-only / level-2 / overflow mixes.
+    (Exact bitwise equality across layouts is not defined — the two packs
+    accumulate in different orders — so the contract is f32-tight
+    agreement plus bitwise stability within each layout.)"""
+
+    @pytest.mark.parametrize("hot_fraction", [0.0, 0.25, 0.6])
+    def test_fused_objective_layout_parity(self, hot_fraction, interpret_kernels):
+        from photon_ml_tpu.ops.losses import LOGISTIC
+
+        rng = np.random.default_rng(21)
+        n, d, nnz = 6000, 260, 48000
+        rows, cols, vals = _random_coo(rng, n, d, nnz, hot_fraction=hot_fraction)
+        bf_g = pack_bucketed(rows, cols, vals, n, d, row_aligned=False)
+        bf_a = pack_bucketed(rows, cols, vals, n, d, row_aligned=True)
+        if hot_fraction:
+            # The hot bucket must actually exercise the spill levels.
+            rep = bf_g.density_report()
+            assert rep["level1_fraction"] < 1.0
+        assert pallas_sparse.fused_feasible(bf_g)
+        assert pallas_sparse.fused_feasible(bf_a)
+        y = (rng.uniform(size=n) > 0.5).astype(np.float32)
+        w = (rng.normal(size=d) * 0.1).astype(np.float32)
+        offs = rng.normal(size=n).astype(np.float32) * 0.01
+        wts = rng.uniform(0.5, 1.5, size=n).astype(np.float32)
+        out = {}
+        for name, bf in (("grouped", bf_g), ("aligned", bf_a)):
+            val, grad, sum_u = pallas_sparse.fused_value_gradient_sums(
+                LOGISTIC,
+                jnp.asarray(w),
+                jnp.zeros(()),
+                bf,
+                jnp.asarray(y),
+                jnp.asarray(offs),
+                jnp.asarray(wts),
+                interpret=True,
+            )
+            out[name] = (float(val), np.asarray(grad), float(sum_u))
+        np.testing.assert_allclose(out["grouped"][0], out["aligned"][0], rtol=1e-5)
+        np.testing.assert_allclose(
+            out["grouped"][1], out["aligned"][1], rtol=2e-4, atol=2e-4
+        )
+        np.testing.assert_allclose(out["grouped"][2], out["aligned"][2], rtol=1e-5)
+        # f64 reference from the raw COO: both layouts must be RIGHT, not
+        # merely mutually consistent.
+        M = _dense(rows, cols, vals, n, d)
+        z = M @ w.astype(np.float64) + offs
+        p = 1.0 / (1.0 + np.exp(-z))
+        val_ref = np.sum(
+            wts * (np.log1p(np.exp(-np.abs(z))) + np.maximum(z, 0) - y * z)
+        )
+        u_ref = wts * (p - y)
+        g_ref = M.T @ u_ref
+        for name in ("grouped", "aligned"):
+            np.testing.assert_allclose(out[name][0], val_ref, rtol=1e-4)
+            np.testing.assert_allclose(
+                out[name][1], g_ref, rtol=5e-4, atol=5e-4
+            )
+            np.testing.assert_allclose(out[name][2], u_ref.sum(), rtol=1e-4)
+
+
 class TestMaybePack:
     def _ell(self, n, d, k, dtype=np.float32, seed=0):
         rng = np.random.default_rng(seed)
@@ -292,10 +509,17 @@ class TestHostCooPack:
         assert isinstance(coord._features, BucketedSparseFeatures)
         assert coord._use_pallas is None
 
-    def test_async_ingest_pack_joins_at_coordinate(self, interpret_kernels):
+    def test_async_ingest_pack_joins_at_coordinate(
+        self, interpret_kernels, monkeypatch
+    ):
         """begin_pack_async at stash time -> the coordinate joins the
         background host pack (finish_pack) and the layout matches the
-        synchronous pack exactly."""
+        synchronous pack exactly. The pipeline is forced on: the test is
+        about join/pack parity, not the 1-core auto-off gate (which made
+        it fail on single-core CI hosts), and the device pack is forced
+        off so a background host thread exists to join at all."""
+        monkeypatch.setenv("PHOTON_PIPELINE", "1")
+        monkeypatch.setenv("PHOTON_DEVICE_PACK", "0")
         from photon_ml_tpu.data.game_dataset import GameDataset, HostCSR
         from photon_ml_tpu.game.coordinate import FixedEffectCoordinate
         from photon_ml_tpu.optimize.config import (

@@ -1,0 +1,130 @@
+"""Perf-regression guards for the sparse hot path (r06 raw-speed sprint).
+
+Tier-1 runs only the cheap structural checks; the `slow`+`perf` marked
+guards pack bench-like shapes and assert the two r06 contracts that keep
+the sprint's wins from silently regressing:
+
+  * the fused sparse objective ENGAGES on the bench shape (r03 shipped a
+    gate bug that silently kept it off for a whole round), and
+  * the pack no longer dominates the sparse wall: on the device path the
+    placement pass leaves the host CPU entirely (pack_host stage == 0),
+    and the host fallback's native counting sort beats the numpy argsort
+    oracle it replaced.
+"""
+
+import json
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from photon_ml_tpu.data import bucketed
+from photon_ml_tpu.ops import pallas_glm, pallas_sparse
+from photon_ml_tpu.utils.observability import TimingRegistry, stage_scope
+
+
+@pytest.fixture
+def interpret_kernels():
+    old = pallas_glm.FORCE_INTERPRET
+    pallas_glm.FORCE_INTERPRET = True
+    yield
+    pallas_glm.FORCE_INTERPRET = old
+
+
+def _bench_like_coo(n=131072, d=4096, k=32, seed=17):
+    rng = np.random.default_rng(seed)
+    rows = np.repeat(np.arange(n, dtype=np.int64), k)
+    cols = rng.integers(0, d, size=n * k).astype(np.int64)
+    vals = rng.normal(size=n * k).astype(np.float32)
+    return rows, cols, vals, n, d
+
+
+class TestDispatchJson:
+    def test_dispatch_decisions_are_machine_comparable(self):
+        """Satellite: bench artifacts must carry dispatch decisions as JSON
+        booleans/objects, never repr() strings (BENCH_r05 shipped
+        "dispatch": "True")."""
+        import bench
+
+        for mode, expect in ((True, True), (False, False), (None, None)):
+            assert bench._dispatch_json(mode) is expect
+
+        class _FakeMesh:
+            class devices:
+                size = 8
+
+        class _FakeSharded:
+            axis = "batch"
+            mesh = _FakeMesh()
+
+        out = bench._dispatch_json(_FakeSharded())
+        assert out["sharded"] is True and out["devices"] == 8
+        # Every shape must survive a JSON round trip unchanged.
+        for mode in (True, False, None, _FakeSharded()):
+            enc = bench._dispatch_json(mode)
+            assert json.loads(json.dumps(enc)) == enc
+
+
+@pytest.mark.slow
+@pytest.mark.perf
+class TestSparsePerfGuards:
+    def test_fused_path_engages_on_bench_shape(
+        self, interpret_kernels, monkeypatch
+    ):
+        """kernel_engaged on the (scaled) bench shape: the pack gates must
+        accept it AND the fused single-stream kernel must be the dispatch
+        (should_use + fused_feasible) — the r03 regression shape."""
+        monkeypatch.setenv("PHOTON_DEVICE_PACK", "1")
+        rows, cols, vals, n, d = _bench_like_coo()
+        bf = pallas_sparse.maybe_pack_coo(rows, cols, vals, n, d)
+        assert bf is not None, "pack gates declined the bench shape"
+        assert pallas_sparse.should_use(bf)
+        assert pallas_sparse.fused_feasible(bf), (
+            "bench shape fell off the fused kernel onto the composed path"
+        )
+        assert bf.density_report()["pad_blowup"] <= pallas_sparse.MAX_PAD_BLOWUP
+
+    def test_device_pack_leaves_host_cpu(self, interpret_kernels, monkeypatch):
+        """Pack non-dominance, device path: the placement pass must record
+        NO host-placement wall — everything lands under pack_device (plus
+        the small level-2 spill tail)."""
+        monkeypatch.setenv("PHOTON_DEVICE_PACK", "1")
+        rows, cols, vals, n, d = _bench_like_coo(n=65536, k=16)
+        reg = TimingRegistry()
+        with stage_scope(reg):
+            bf = pallas_sparse.maybe_pack_coo(rows, cols, vals, n, d)
+        assert bf is not None
+        assert reg.get_note("pack_path") == "device"
+        assert reg.get("pack_device") > 0.0
+        # Level 1 — ~99% of entries on this uniform shape — must not have
+        # paid a host placement pass; only the spill tail may.
+        assert reg.get("pack_host") <= 0.25 * reg.get("pack_device") + 0.05
+
+    def test_native_pack_beats_numpy_oracle(self, monkeypatch):
+        """Pack non-dominance, host fallback: the native counting sort must
+        beat the numpy argsort oracle it replaced (generous 1.5x slack —
+        this is a regression tripwire, not a benchmark)."""
+        import time
+
+        from photon_ml_tpu.native.bucketed_pack import pack_level_native
+
+        rows, cols, vals, n, d = _bench_like_coo(n=65536, k=32)
+        monkeypatch.setenv("PHOTON_DEVICE_PACK", "0")
+        monkeypatch.setenv("PHOTON_DISABLE_NATIVE", "1")
+        t0 = time.perf_counter()
+        bucketed.pack_bucketed(rows, cols, vals, n, d, host_only=True)
+        numpy_wall = time.perf_counter() - t0
+        monkeypatch.delenv("PHOTON_DISABLE_NATIVE")
+        probe = pack_level_native(
+            np.zeros(0, np.int32), np.zeros(0, np.int32),
+            np.zeros(0, np.float32), 1, 1, 11, 1024,
+        )
+        if probe is None:
+            pytest.skip("native library unavailable (no compiler)")
+        t0 = time.perf_counter()
+        bucketed.pack_bucketed(rows, cols, vals, n, d, host_only=True)
+        native_wall = time.perf_counter() - t0
+        assert native_wall < numpy_wall * 1.5, (
+            f"native pack {native_wall:.3f}s vs numpy {numpy_wall:.3f}s — "
+            "the counting sort regressed below the oracle it replaced"
+        )

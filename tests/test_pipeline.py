@@ -136,6 +136,18 @@ class TestPipelineParity:
         # The dominant prepare stages must be non-trivially attributed.
         assert est.fit_timing["re_build"] > 0.0
         assert est.fit_timing["compile"] > 0.0
+        # Pack placement split (r06 satellite): always present, even when
+        # no bucketed pack engaged this fit — the bench e2e contract fails
+        # loudly on their absence.
+        assert "pack_device_s" in est.fit_timing
+        assert "pack_host_s" in est.fit_timing
+        assert est.fit_timing["pack_path"] in (
+            "none",
+            "device",
+            "native-sharded",
+            "native",
+            "numpy",
+        )
 
 
 class TestCompileCacheSharing:
@@ -154,10 +166,17 @@ class TestCompileCacheSharing:
         c_user = est._coordinate_for(ds, "per-user", prepared["per-user"], cfg)
         c_movie = est._coordinate_for(ds, "per-movie", prepared["per-movie"], cfg)
         # Same static recipe + no normalization => the process-wide RE jit
-        # cache must hand both coordinates the SAME jitted callables.
+        # cache must hand both coordinates the SAME jitted callables — the
+        # per-bucket solver AND the scan-dispatched sweep program.
         assert c_user._train_bucket is c_movie._train_bucket
+        assert c_user._train_scan is c_movie._train_scan
+        from photon_ml_tpu.game.coordinate import sweep_scan_enabled
+
+        solver = (
+            c_user._train_scan if sweep_scan_enabled() else c_user._train_bucket
+        )
         c_user.train(ds.offsets)
-        counter = getattr(c_user._train_bucket, "_cache_size", None)
+        counter = getattr(solver, "_cache_size", None)
         if counter is None:
             pytest.skip("jax version exposes no jit cache counter")
         entries_after_first = counter()
