@@ -43,6 +43,7 @@ import sys
 import time
 
 _CHILD = "--run-child"
+_MULTICHIP_CHILD = "--run-multichip"
 
 # Physical HBM roofline per chip (GB/s): v5e HBM2 peak ~819 GB/s. Any
 # achieved-bandwidth figure above it is a measurement artifact (rtt
@@ -129,6 +130,260 @@ def _solve_stats(res) -> dict:
         "fn_evals": int(np.asarray(res.fn_evals)),
         "converged_reason": int(np.asarray(res.reason)),
     }
+
+
+def _multichip_child() -> None:
+    """Entity-sharded pod-scale measurement on an 8-virtual-device mesh.
+
+    Launched as its own subprocess (JAX_PLATFORMS=cpu +
+    xla_force_host_platform_device_count=8 — the same virtual mesh the
+    test suite and MULTICHIP dryrun use) because the parent bench child
+    has already initialized its backend. The certificate: a random-effect
+    coefficient matrix DELIBERATELY sized past one virtual device's HBM
+    budget trains through the sharded scan sweep and serves through the
+    sharded bundle, with per-batch wall + analytic collective bytes
+    reported, per-shard residency measured under the budget, and — on an
+    overlap problem that fits one device — sharded serving bitwise-equal
+    to the single-device path (training parity to f32 reduction order).
+    Prints exactly one JSON line."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.data.game_dataset import (
+        GameDataset,
+        RandomEffectDataConfig,
+        build_random_effect_dataset,
+    )
+    from photon_ml_tpu.game.coordinate import RandomEffectCoordinate
+    from photon_ml_tpu.game.model import (
+        Coefficients,
+        FixedEffectModel,
+        GameModel,
+        RandomEffectModel,
+    )
+    from photon_ml_tpu.optimize.config import (
+        L2,
+        CoordinateOptimizationConfig,
+        OptimizerConfig,
+    )
+    from photon_ml_tpu.parallel.mesh import (
+        make_mesh,
+        pad_game_dataset,
+        shard_game_dataset,
+        shard_random_effect_dataset,
+    )
+    from photon_ml_tpu.serving import ScoreRequest, ServingBundle, ServingEngine
+    from photon_ml_tpu.transformers.game_transformer import CoordinateScoringSpec
+    from photon_ml_tpu.types import TaskType
+
+    task = TaskType.LOGISTIC_REGRESSION
+    mesh = make_mesh()
+    ndev = int(mesh.devices.size)
+    budget = int(os.environ.get("PHOTON_BENCH_VDEV_BUDGET", str(1 << 20)))
+    d_re = 8
+    # Matrix rows chosen so the full f32 matrix EXCEEDS the per-device
+    # budget while one shard stays well under it.
+    n_entities = (budget // (d_re * 4)) + 8 * ndev
+    rows_per_entity = 2
+    n = n_entities * rows_per_entity
+    rng = np.random.default_rng(17)
+
+    def build_re_problem(e, rows_each, seed):
+        r = np.random.default_rng(seed)
+        m = e * rows_each
+        Xe = r.normal(size=(m, d_re)).astype(np.float32)
+        entity = np.repeat(np.arange(e), rows_each)
+        u = r.normal(size=(e, d_re)).astype(np.float32) * 0.5
+        margin = np.einsum("nd,nd->n", Xe, u[entity])
+        y = (r.uniform(size=m) < 1 / (1 + np.exp(-margin))).astype(np.float32)
+        return Xe, entity, y
+
+    Xe, entity, y = build_re_problem(n_entities, rows_per_entity, 29)
+    cfg_r = CoordinateOptimizationConfig(
+        optimizer=OptimizerConfig(max_iterations=3, tolerance=1e-6),
+        regularization=L2,
+        reg_weight=1.0,
+    )
+    # max_block_cells bounds each scan step's (E, S) block so the sweep is
+    # a multi-step scan (several same-shape buckets -> ONE program).
+    re_cfg = RandomEffectDataConfig(
+        "entityId", "re", min_bucket=8, max_block_cells=1 << 16
+    )
+    ds = pad_game_dataset(
+        GameDataset.build(
+            {"re": jnp.asarray(Xe)}, y, id_tags={"entityId": entity}
+        ),
+        ndev,
+    )
+    sharded = shard_game_dataset(ds, mesh)
+    red = shard_random_effect_dataset(
+        build_random_effect_dataset(sharded, re_cfg), mesh
+    )
+    coord = RandomEffectCoordinate(sharded, red, cfg_r, task)
+    assert coord._entity_mesh is not None, "entity mesh did not engage"
+    # Warm-up compile, then the timed sweep (traced reg weight: same
+    # programs, perturbed numerics so nothing is result-cached).
+    model_big, _ = coord.train(sharded.offsets, reg_weight=1.001)
+    jax.block_until_ready(model_big.coefficients_matrix)
+    t0 = time.perf_counter()
+    model_big, _ = coord.train(sharded.offsets)
+    jax.block_until_ready(model_big.coefficients_matrix)
+    sweep_wall = time.perf_counter() - t0
+    n_buckets = len(red.buckets)
+    matrix = model_big.coefficients_matrix
+    shard_bytes = [s.data.nbytes for s in matrix.addressable_shards]
+    collective = coord.sweep_collective_bytes()
+
+    # ---- serve the over-budget model through the sharded bundle ----------
+    d_fe = 16
+    w_fe = rng.normal(size=d_fe).astype(np.float32)
+    gm = GameModel(
+        {
+            "fixed": FixedEffectModel(Coefficients(jnp.asarray(w_fe)), task),
+            "per-entity": model_big,
+        }
+    )
+    specs = {
+        "fixed": CoordinateScoringSpec(shard="g"),
+        "per-entity": CoordinateScoringSpec(
+            shard="re",
+            random_effect_type="entityId",
+            entity_index=dict(red.entity_index),
+        ),
+    }
+    n_req = 256
+    Xq_fe = rng.normal(size=(n_req, d_fe)).astype(np.float32)
+    Xq_re = rng.normal(size=(n_req, d_re)).astype(np.float32)
+    q_ent = rng.integers(0, n_entities, size=n_req)
+    reqs = [
+        ScoreRequest(
+            features={"g": Xq_fe[i], "re": Xq_re[i]},
+            entity_ids={"entityId": int(q_ent[i])},
+            uid=str(i),
+        )
+        for i in range(n_req)
+    ]
+    bundle = ServingBundle.from_model(gm, specs, task)  # adopts the sharding
+    assert bundle.coordinates["per-entity"].mesh is not None
+    with ServingEngine(bundle, max_batch=64) as eng:
+        eng.warmup()
+        scores = np.asarray([r.score for r in eng.score_batch(reqs)])
+        serving_sharding = eng.metrics()["sharding"]
+    # Reference: THE single-device path — the same model staged as one
+    # replicated matrix (the budget is virtual, so a full host copy is
+    # computable here) served by its own engine. Exact row movement keeps
+    # the sharded answers bitwise-equal to it.
+    gm_repl = GameModel(
+        {
+            "fixed": FixedEffectModel(Coefficients(jnp.asarray(w_fe)), task),
+            "per-entity": RandomEffectModel(
+                jnp.asarray(np.asarray(matrix)),
+                None,
+                task,
+                n_entities=model_big.num_entities,
+            ),
+        }
+    )
+    with ServingEngine(
+        ServingBundle.from_model(gm_repl, specs, task), max_batch=64
+    ) as eng_repl:
+        ref = np.asarray(
+            [r.score for r in eng_repl.score_batch(reqs)], np.float64
+        )
+    big_serve_bitwise = bool(np.array_equal(scores.astype(np.float64), ref))
+
+    # ---- overlap problem (fits one device): parity certificates ----------
+    e_small = 64 * ndev
+    Xs, ents_s, ys = build_re_problem(e_small, 4, 31)
+    ds_small = GameDataset.build(
+        {"re": jnp.asarray(Xs)}, ys, id_tags={"entityId": ents_s}
+    )
+    red_small = build_random_effect_dataset(
+        ds_small, RandomEffectDataConfig("entityId", "re", min_bucket=8)
+    )
+    c_single = RandomEffectCoordinate(ds_small, red_small, cfg_r, task)
+    m_single, _ = c_single.train(ds_small.offsets)
+    ds_small_sh = shard_game_dataset(pad_game_dataset(
+        GameDataset.build(
+            {"re": jnp.asarray(Xs)}, ys, id_tags={"entityId": ents_s}
+        ),
+        ndev,
+    ), mesh)
+    red_small_sh = shard_random_effect_dataset(
+        build_random_effect_dataset(
+            ds_small_sh, RandomEffectDataConfig("entityId", "re", min_bucket=8)
+        ),
+        mesh,
+    )
+    c_sh = RandomEffectCoordinate(ds_small_sh, red_small_sh, cfg_r, task)
+    m_sh, _ = c_sh.train(ds_small_sh.offsets)
+    W_a = np.asarray(m_single.coefficients_matrix)
+    W_b = np.asarray(m_sh.coefficients_matrix)
+    rows_a = [red_small.entity_index[e] for e in red_small.entity_index]
+    rows_b = [red_small_sh.entity_index[e] for e in red_small.entity_index]
+    dw = np.abs(W_a[rows_a] - W_b[rows_b]).max()
+    scale_w = np.abs(W_a).max() + 1e-12
+    overlap_rel_dw = float(dw / scale_w)
+
+    # Serving the SAME single-device-trained model replicated vs
+    # mesh-staged vs two-tier must be BITWISE identical (exact row
+    # movement — the tentpole's parity discipline).
+    gm_small = GameModel({"per-entity": m_single})
+    specs_small = {
+        "per-entity": CoordinateScoringSpec(
+            shard="re",
+            random_effect_type="entityId",
+            entity_index=dict(red_small.entity_index),
+        )
+    }
+    reqs_small = [
+        ScoreRequest(
+            features={"re": Xs[i]}, entity_ids={"entityId": int(ents_s[i])}
+        )
+        for i in range(128)
+    ]
+
+    def _serve(**kw):
+        b = ServingBundle.from_model(gm_small, specs_small, task, **kw)
+        try:
+            with ServingEngine(b, max_batch=64) as e:
+                return np.asarray([r.score for r in e.score_batch(reqs_small)])
+        finally:
+            # Join the two-tier promotion worker while the runtime is up:
+            # a daemon thread dispatching during interpreter teardown
+            # aborts the child and loses its buffered JSON line.
+            b.release()
+
+    s_repl = _serve()
+    s_mesh = _serve(mesh=mesh)
+    s_tier = _serve(hot_rows=e_small // 4)
+    overlap_serve_sharded_bitwise = bool(np.array_equal(s_repl, s_mesh))
+    overlap_serve_two_tier_bitwise = bool(np.array_equal(s_repl, s_tier))
+
+    print(
+        json.dumps(
+            dict(
+                n_devices=ndev,
+                budget_bytes_per_device=budget,
+                re_rows=int(matrix.shape[0]),
+                re_dim=d_re,
+                re_matrix_bytes=int(matrix.nbytes),
+                max_shard_bytes=int(max(shard_bytes)),
+                sweep_wall_s=round(sweep_wall, 3),
+                buckets=n_buckets,
+                per_batch_wall_ms=round(sweep_wall / max(1, n_buckets) * 1e3, 2),
+                collective_bytes_per_sweep=int(collective),
+                collective_bytes_per_batch=int(collective // max(1, n_buckets)),
+                sharding=coord.sharding_info(),
+                serving_sharding=serving_sharding,
+                serve_bitwise_vs_replicated=big_serve_bitwise,
+                overlap_train_max_rel_dw=overlap_rel_dw,
+                overlap_serve_sharded_bitwise=overlap_serve_sharded_bitwise,
+                overlap_serve_two_tier_bitwise=overlap_serve_two_tier_bitwise,
+            )
+        )
+    )
 
 
 def _child() -> None:
@@ -495,6 +750,99 @@ def _child() -> None:
         **_bw_metrics(score_bytes, score_wall, platform),
     )
 
+    # ---- multichip: entity-sharded pod-scale path -------------------------
+    # Own subprocess on the 8-virtual-device CPU mesh (this child's backend
+    # is already up, and the TPU path must not be disturbed): an RE matrix
+    # sized past one virtual device's budget trains through the sharded
+    # scan sweep and serves through the sharded bundle; per-batch wall +
+    # analytic collective bytes reported, overlap parity asserted. Same
+    # loud missing-key contract as every other section.
+    try:
+        env_mc = dict(os.environ)
+        env_mc["JAX_PLATFORMS"] = "cpu"
+        env_mc.pop("PALLAS_AXON_POOL_IPS", None)
+        flags_mc = env_mc.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags_mc:
+            env_mc["XLA_FLAGS"] = (
+                flags_mc + " --xla_force_host_platform_device_count=8"
+            ).strip()
+        out_mc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), _MULTICHIP_CHILD],
+            capture_output=True,
+            text=True,
+            timeout=600,
+            env=env_mc,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        line_mc = next(
+            (l for l in out_mc.stdout.splitlines() if l.startswith("{")), None
+        )
+        if line_mc is None:
+            raise RuntimeError(
+                f"multichip child produced no JSON: {out_mc.stderr[-1500:]}"
+            )
+        mc = json.loads(line_mc)
+        required_mc = (
+            "n_devices",
+            "budget_bytes_per_device",
+            "re_matrix_bytes",
+            "max_shard_bytes",
+            "per_batch_wall_ms",
+            "collective_bytes_per_batch",
+            "collective_bytes_per_sweep",
+            "sharding",
+            "serving_sharding",
+            "serve_bitwise_vs_replicated",
+            "overlap_train_max_rel_dw",
+            "overlap_serve_sharded_bitwise",
+            "overlap_serve_two_tier_bitwise",
+        )
+        missing_mc = [k for k in required_mc if mc.get(k) is None]
+        if missing_mc:
+            raise RuntimeError(
+                f"multichip section is missing keys {missing_mc} — the "
+                "pod-scale metrics contract is broken"
+            )
+        if mc["re_matrix_bytes"] <= mc["budget_bytes_per_device"]:
+            raise RuntimeError(
+                "multichip RE matrix fits one device's budget "
+                f"({mc['re_matrix_bytes']} <= {mc['budget_bytes_per_device']}) "
+                "— the over-HBM certificate measured nothing"
+            )
+        if mc["max_shard_bytes"] > mc["budget_bytes_per_device"]:
+            raise RuntimeError(
+                f"per-shard residency {mc['max_shard_bytes']} B exceeds the "
+                f"{mc['budget_bytes_per_device']} B virtual budget — sharding "
+                "is not bounding per-device memory"
+            )
+        if not (
+            mc["serve_bitwise_vs_replicated"]
+            and mc["overlap_serve_sharded_bitwise"]
+            and mc["overlap_serve_two_tier_bitwise"]
+        ):
+            raise RuntimeError(
+                "sharded/two-tier serving is not bitwise-equal to the "
+                f"single-device path: {mc}"
+            )
+        if mc["overlap_train_max_rel_dw"] > 5e-3:
+            raise RuntimeError(
+                "sharded-vs-single-device training diverged beyond f32 "
+                f"reduction-order tolerance: {mc['overlap_train_max_rel_dw']}"
+            )
+        variants["multichip"] = mc
+        _mark(
+            f"multichip measured ({mc['re_matrix_bytes']} B matrix over "
+            f"{mc['n_devices']} devices, {mc['per_batch_wall_ms']} ms/batch, "
+            f"{mc['collective_bytes_per_batch']} B/batch collective)"
+        )
+    except Exception as exc:  # noqa: BLE001 - bench must still print a line
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        variants["multichip"] = dict(
+            failed=True, reason=f"{type(exc).__name__}: {exc}"
+        )
+
     # ---- online serving (pinned bundle + deadline micro-batcher) ----------
     # The north star serves live traffic; this measures the online path the
     # offline scoring number cannot show: per-request latency through the
@@ -585,6 +933,22 @@ def _child() -> None:
         missing_srv = [
             k for k in required_srv if m_srv_metrics.get(k) is None
         ]
+        # Sharding-decision contract (ISSUE 7): the summary must carry the
+        # axis size / rows-per-shard / hot-set-fraction / collective-bytes
+        # keys even on a single-tier replicated bundle (False/1/.../0), so
+        # their absence is a loud metrics regression, not a silent gap.
+        sharding_srv = m_srv_metrics.get("sharding") or {}
+        missing_srv += [
+            f"sharding.{k}"
+            for k in (
+                "entity_sharded",
+                "axis_size",
+                "rows_per_shard",
+                "hot_set_fraction",
+                "all_to_all_bytes_per_batch",
+            )
+            if sharding_srv.get(k) is None
+        ]
         if missing_srv:
             raise RuntimeError(
                 f"serving_online is missing metric keys {missing_srv} "
@@ -635,6 +999,11 @@ def _child() -> None:
             degraded_batches=m_srv_metrics["degraded_batches"],
             bundle_upload_mb=round(bundle_srv.upload_bytes / 1e6, 1),
             bundle_upload_s=round(bundle_srv.upload_s, 3),
+            sharding=sharding_srv,
+            hot_tier_hits=m_srv_metrics["hot_tier_hits"],
+            cold_tier_hits=m_srv_metrics["cold_tier_hits"],
+            promotions=m_srv_metrics["promotions"],
+            evictions=m_srv_metrics["evictions"],
         )
         _mark(f"serving_online measured ({m_srv_metrics['qps']} qps)")
     except Exception as exc:  # noqa: BLE001 - bench must still print a line
@@ -1145,6 +1514,9 @@ def _child() -> None:
                     "pack_device_s",
                     "pack_host_s",
                     "pack_path",
+                    # Entity-sharding decision (r07): axis size, rows per
+                    # shard, collective bytes — same loud contract.
+                    "sharding",
                 )
                 if k not in fit_timing
             ]
@@ -1192,6 +1564,7 @@ def _child() -> None:
                 pack_host_s=round(fit_timing["pack_host_s"], 2),
                 pack_path=fit_timing["pack_path"],
                 solve_s=round(fit_timing["solve_s"], 1),
+                sharding=fit_timing["sharding"],
                 train_rows_per_s=round(e2e_rows / train_s, 0),
                 eval_s=round(eval_s, 1),
                 auc=round(float(eval_res.primary_value), 4),
@@ -1240,6 +1613,9 @@ def _child() -> None:
 
 
 def main() -> None:
+    if _MULTICHIP_CHILD in sys.argv:
+        _multichip_child()
+        return
     if _CHILD in sys.argv:
         _child()
         return

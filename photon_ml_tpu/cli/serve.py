@@ -186,7 +186,19 @@ def run(args) -> dict:
         bundle.upload_bytes / 1e6,
         bundle.upload_s,
     )
+    # Release on EVERY exit path (finally below): a two-tier store's async
+    # promotion worker must be joined while the XLA runtime is still alive
+    # — a daemon thread dispatching device updates during interpreter
+    # teardown aborts the process ("terminate called without an active
+    # exception"), which on an error path would mask the real traceback.
+    try:
+        return _run_with_bundle(args, bundle)
+    finally:
+        bundle.release()
 
+
+def _run_with_bundle(args, bundle: ServingBundle) -> dict:
+    is_json = args.requests.endswith((".json", ".jsonl"))
     shard_configs = None
     if args.feature_shard_configurations:
         from photon_ml_tpu.cli.config import parse_feature_shard_config

@@ -22,7 +22,13 @@ Execution model:
     into ONE lax.scan program per sweep (sweep_scan_enabled, r06): block
     gather, vmapped solve, coefficient scatter and variance all run inside
     it, so a sweep costs O(distinct block shapes) dispatches instead of
-    3-4 per bucket — bitwise equal to the per-bucket loop.
+    3-4 per bucket — bitwise equal to the per-bucket loop. On an
+    entity-sharded mesh (r07) the scan keeps the coefficient matrix
+    row-sharded end to end: warm-start gathers and coefficient scatters
+    ride the ring collectives INSIDE the scan body, so per-device
+    coefficient state stays total/n_devices — the reference's
+    RDD-partitioned store (RandomEffectModel.scala:36-239) with XLA
+    collectives instead of Spark shuffles.
 
 Each coordinate builds its jitted train/score callables ONCE (per bucket
 shape); repeated coordinate-descent iterations and regularization-weight
@@ -501,6 +507,7 @@ class RandomEffectCoordinate:
         )
         if cached_scan is not None:
             self._train_scan = cached_scan
+            self._build_sharded_scan()
             return
 
         @jax.jit
@@ -590,6 +597,92 @@ class RandomEffectCoordinate:
         if scan_cache_key:
             _RE_JIT_CACHE[scan_cache_key] = train_scan
         self._train_scan = train_scan
+        self._build_sharded_scan()
+
+    def _build_sharded_scan(self) -> None:
+        """Scan-dispatched sweep for the ENTITY-SHARDED store: same shape
+        grouping as the replicated scan, but the coefficient matrix carry
+        stays row-sharded over the mesh and every bucket step moves rows
+        through the ring collectives (parallel/mesh.py) INSIDE the program —
+        gather w0, vmapped shard-local solves, scatter coefficients (and
+        variances) — so a sweep is O(distinct block shapes) XLA programs
+        with per-device coefficient state of total/n_devices, never a full
+        replica. Ops per entity are identical to the sharded per-bucket
+        loop, so the two are bitwise equal
+        (tests/test_parallel.py::test_sharded_scan_sweep_matches_bucket_loop).
+        """
+        self._train_scan_sharded = None
+        mesh = self._entity_mesh
+        if mesh is None or self._per_entity_norm:
+            return
+        cfg = self.config
+        loss = self.loss
+        norm = self.norm
+        sh_cache_key = None
+        if norm is None:
+            from photon_ml_tpu.optimize.config import static_config_key
+
+            sh_cache_key = ("re_scan_sh", static_config_key(cfg), self.task, mesh)
+        cached = _RE_JIT_CACHE.get(sh_cache_key) if sh_cache_key else None
+        if cached is not None:
+            self._train_scan_sharded = cached
+            return
+
+        from photon_ml_tpu.parallel.mesh import ring_gather_rows, ring_scatter_rows
+
+        @jax.jit
+        def train_scan_sharded(
+            features,
+            labels,
+            weights,
+            offsets,
+            matrix,
+            var_matrix,
+            gathers,
+            masks,
+            ents,
+            feature_mask,
+            reg_weight,
+        ):
+            from photon_ml_tpu.data.game_dataset import gather_block_arrays
+
+            traced_cfg = _config_with_traced_weight(cfg, reg_weight)
+
+            def step(carry, xs):
+                m, v = carry
+                gather, mask, ent = xs
+                block = gather_block_arrays(
+                    features, labels, weights, offsets, gather, mask, ent,
+                    feature_mask,
+                )
+                w0 = ring_gather_rows(m, ent, mesh)
+
+                def one(data_e, w0_e):
+                    return problem.solve(
+                        loss, data_e, traced_cfg, w0_e, norm, use_pallas=False
+                    )
+
+                res = jax.vmap(one)(block, w0)
+                m = ring_scatter_rows(m, ent, res.coefficients, mesh)
+                if v is not None:
+
+                    def onev(data_e, w_e):
+                        return problem.compute_variances(
+                            loss, data_e, traced_cfg, w_e, norm
+                        )
+
+                    vv = jax.vmap(onev)(block, res.coefficients)
+                    v = ring_scatter_rows(v, ent, vv, mesh)
+                return (m, v), res.iterations
+
+            (matrix, var_matrix), iters = jax.lax.scan(
+                step, (matrix, var_matrix), (gathers, masks, ents)
+            )
+            return matrix, var_matrix, iters
+
+        if sh_cache_key:
+            _RE_JIT_CACHE[sh_cache_key] = train_scan_sharded
+        self._train_scan_sharded = train_scan_sharded
 
     def train(
         self,
@@ -641,13 +734,40 @@ class RandomEffectCoordinate:
             self.config.reg_weight if reg_weight is None else reg_weight, dtype
         )
 
+        # Analytic wire bytes this sweep will move through the entity-shard
+        # collectives (0 on the replicated path) — read by the
+        # coordinate-descent loop / estimator for the sharding artifact keys.
+        self.last_train_collective_bytes = self.sweep_collective_bytes()
         # No host syncs inside the loop: bucket programs dispatch back-to-back
         # and stats materialize once at the end.
         bucket_iters: List = [None] * len(red.buckets)
+        if (
+            mesh is not None
+            and red.buckets
+            and sweep_scan_enabled()
+            and self._train_scan_sharded is not None
+        ):
+            # Entity-sharded scan sweep: one program per distinct block
+            # shape, ring gather/scatter on shard-local rows INSIDE it.
+            for idxs, gathers, masks, ents in self._scan_group_list():
+                matrix, var_matrix, iters = self._train_scan_sharded(
+                    ds.shards[red.feature_shard],
+                    ds.labels,
+                    ds.weights,
+                    offsets,
+                    matrix,
+                    var_matrix,
+                    gathers,
+                    masks,
+                    ents,
+                    red.feature_mask,
+                    rw,
+                )
+                for k, bi in enumerate(idxs):
+                    bucket_iters[bi] = iters[k]
+            return self._finish_train(matrix, var_matrix, bucket_iters)
         if mesh is None and red.buckets and sweep_scan_enabled():
             # Scan-dispatched sweep: one program per distinct block shape.
-            # The entity-sharded mesh path keeps the per-bucket loop — its
-            # ring collectives are host-orchestrated.
             norm_f = norm_s = None
             if self._per_entity_norm:
                 norm_f, norm_s = self.norm.factors, self.norm.shifts
@@ -709,7 +829,10 @@ class RandomEffectCoordinate:
         """Buckets grouped by block shape, each stacked into (K, E, S)
         scan operands. Built once per coordinate; every (capacity, E)
         shape comes from the canonical discrete set, so the group count —
-        and hence the per-sweep program count — is small by construction."""
+        and hence the per-sweep program count — is small by construction.
+        On the entity-sharded path the stacked operands are re-laid-out
+        with the ENTITY axis (axis 1) sharded over the mesh, so the scan's
+        per-step slices arrive already shard-local."""
         groups = getattr(self, "_scan_groups_cache", None)
         if groups is None:
             by_shape: dict = {}
@@ -725,8 +848,72 @@ class RandomEffectCoordinate:
                 )
                 for idxs in by_shape.values()
             ]
+            if self._entity_mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                mesh = self._entity_mesh
+                ax = mesh.axis_names[0]
+                s3 = NamedSharding(mesh, P(None, ax, None))
+                s2 = NamedSharding(mesh, P(None, ax))
+                groups = [
+                    (
+                        idxs,
+                        jax.device_put(g, s3),
+                        jax.device_put(mk, s3),
+                        jax.device_put(e, s2),
+                    )
+                    for idxs, g, mk, e in groups
+                ]
             self._scan_groups_cache = groups
         return groups
+
+    def sweep_collective_bytes(self) -> int:
+        """Analytic wire bytes one full sweep moves through the ring
+        collectives (gather of warm starts + scatter of coefficients and,
+        when enabled, variances) — 0 on the replicated path. Purely a
+        function of the bucket layout and mesh, so it is exact for both
+        the per-bucket loop and the scan sweep (same calls, same shapes)."""
+        mesh = self._entity_mesh
+        if mesh is None:
+            return 0
+        from photon_ml_tpu.parallel.mesh import (
+            pad_rows_for_mesh,
+            ring_gather_wire_bytes,
+            ring_scatter_wire_bytes,
+        )
+
+        n_rows = pad_rows_for_mesh(self.re_dataset.num_entities + 1, mesh)
+        want_var = self.config.variance_computation != VarianceComputationType.NONE
+        scatters = 2 if want_var else 1
+        total = 0
+        for b in self.re_dataset.buckets:
+            total += ring_gather_wire_bytes(mesh, n_rows, self.dim)
+            total += scatters * ring_scatter_wire_bytes(
+                mesh, b.num_entities, self.dim
+            )
+        return total
+
+    def sharding_info(self) -> dict:
+        """The sharding decision this coordinate trains under, as the
+        proper-JSON keys `fit_timing`/bench artifacts record."""
+        mesh = self._entity_mesh
+        n_rows = self.re_dataset.num_entities + 1
+        if mesh is None:
+            return {
+                "entity_sharded": False,
+                "axis_size": 1,
+                "rows_per_shard": int(n_rows),
+                "collective_bytes_per_sweep": 0,
+            }
+        from photon_ml_tpu.parallel.mesh import pad_rows_for_mesh
+
+        padded = pad_rows_for_mesh(n_rows, mesh)
+        return {
+            "entity_sharded": True,
+            "axis_size": int(mesh.devices.size),
+            "rows_per_shard": int(padded // mesh.devices.size),
+            "collective_bytes_per_sweep": self.sweep_collective_bytes(),
+        }
 
     def _finish_train(self, matrix, var_matrix, bucket_iters):
         red = self.re_dataset

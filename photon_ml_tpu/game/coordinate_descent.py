@@ -96,6 +96,11 @@ class CoordinateDescentResult:
     # out of the seconds-valued `timing` dict so per-coordinate timing
     # artifacts stay pure wall clock). 0 on a clean run.
     diverged_steps: int = 0
+    # Analytic wire bytes moved through entity-shard ring collectives by
+    # the accepted coordinate updates (RandomEffectCoordinate.train sets
+    # last_train_collective_bytes per sweep; 0 on the replicated path) —
+    # the pod-scale accounting `fit_timing["sharding"]` reports.
+    collective_bytes: int = 0
 
 
 def run_coordinate_descent(
@@ -151,6 +156,7 @@ def run_coordinate_descent(
     models: Dict[str, object] = dict(initial_models.models) if initial_models else {}
     timing: Dict[str, float] = {}
     diverged_steps = 0
+    collective_bytes = 0
     validation_history: List[Tuple[int, str, EvaluationResults]] = []
     best_results: Optional[EvaluationResults] = None
     best_models: Dict[str, object] = dict(models)
@@ -347,6 +353,9 @@ def run_coordinate_descent(
                 summed = new_summed
                 scores[cid] = new_scores
                 models[cid] = model
+                collective_bytes += int(
+                    getattr(coord, "last_train_collective_bytes", 0)
+                )
             else:
                 logger.error(
                     "iteration %d coordinate %s diverged on every attempt — "
@@ -406,4 +415,5 @@ def run_coordinate_descent(
         validation_history=validation_history,
         timing=timing,
         diverged_steps=diverged_steps,
+        collective_bytes=collective_bytes,
     )

@@ -139,7 +139,9 @@ def _random_effect_margins_sharded_impl(
     if norm is not None and not norm.is_identity:
         w_rows = jax.vmap(norm.effective_coefficients)(w_rows)
         if norm.shifts is not None:
-            shift = -(w_rows @ norm.shifts)
+            # Per-row reduce, in lockstep with `random_effect_margins` and
+            # `gathered_row_margins` (see the note there).
+            shift = -jnp.sum(w_rows * norm.shifts, axis=-1)
     if isinstance(features, _SF):
         if features.ell_axis == -2:  # transposed (K, N) projected planes
             g = jnp.take_along_axis(
@@ -156,6 +158,74 @@ def _random_effect_margins_sharded_impl(
     if shift is not None:
         out = out + shift
     return out
+
+
+def gathered_row_margins(features: Array, w_rows: Array, norm) -> Array:
+    """Dense margins from already-gathered per-sample coefficient rows:
+    normalization folded per row, then the batch-invariant per-row reduce.
+
+    BITWISE-equal to `random_effect_margins`' dense branch on the same
+    rows: folding norm into the matrix before the gather and into the
+    gathered rows after it are the same elementwise ops on the same
+    values, and the row-shift dot runs in the same order over D. This is
+    the shared tail of every path that moves rows instead of replicating
+    the matrix — the psum-gather margins below and the serving engine's
+    two-tier / entity-sharded bucket programs — and what keeps them all
+    bitwise-equal to the replicated offline scorer."""
+    from photon_ml_tpu.ops.normalization import PerEntityNormalization
+
+    if isinstance(norm, PerEntityNormalization) and not norm.is_identity:
+        raise NotImplementedError(
+            "gathered-row margins with per-entity normalization: its "
+            "factor/shift tables are entity-indexed — use the replicated path"
+        )
+    shift = None
+    if norm is not None and not norm.is_identity:
+        w_rows = jax.vmap(norm.effective_coefficients)(w_rows)
+        if norm.shifts is not None:
+            # Per-row reduce, NOT `w_rows @ shifts`: the matvec's reduction
+            # order varies with the batch dimension (same pitfall as
+            # dense_margins), which would break bitwise parity between the
+            # (N, D) gathered path here and the (E+1, D) matrix-folded path
+            # in `random_effect_margins` — both now reduce row-wise.
+            shift = -jnp.sum(w_rows * norm.shifts, axis=-1)
+    out = jnp.sum(features * w_rows, axis=-1)
+    if shift is not None:
+        out = out + shift
+    return out
+
+
+@_functools.lru_cache(maxsize=16)
+def _margins_bcast_fn(mesh):
+    return jax.jit(
+        _functools.partial(_random_effect_margins_bcast_impl, mesh=mesh)
+    )
+
+
+def random_effect_margins_bcast(
+    features: Array, entity_rows: Array, matrix: Array, norm, mesh
+) -> Array:
+    """Small-batch sharded scoring: the row-sharded matrix is read via the
+    psum broadcast-gather (`parallel/mesh.bcast_gather_rows`) — each shard
+    contributes the requested rows it owns, one all-reduce returns the
+    gathered block everywhere — instead of rotating matrix chunks around
+    the ring. For serving-bucket-sized batches (replicated request
+    buffers) this is one collective of N*D floats vs a full matrix
+    rotation, and the gather is exact row movement, so scores stay
+    BITWISE-equal to the replicated `random_effect_margins` dense branch
+    (asserted in tests/test_parallel.py). Dense features only — the
+    high-volume sparse/sample-sharded paths keep the ring
+    (`random_effect_margins_sharded`)."""
+    return _margins_bcast_fn(mesh)(features, entity_rows, matrix, norm)
+
+
+def _random_effect_margins_bcast_impl(
+    features: Array, entity_rows: Array, matrix: Array, norm, *, mesh
+) -> Array:
+    from photon_ml_tpu.parallel.mesh import bcast_gather_rows
+
+    w_rows = bcast_gather_rows(matrix, entity_rows, mesh)
+    return gathered_row_margins(features, w_rows, norm)
 
 
 def random_effect_margins(features, entity_rows: Array, matrix: Array, norm) -> Array:
@@ -177,7 +247,11 @@ def random_effect_margins(features, entity_rows: Array, matrix: Array, norm) -> 
     elif norm is not None and not norm.is_identity:
         matrix = jax.vmap(norm.effective_coefficients)(matrix)
         if norm.shifts is not None:
-            shift = -(matrix @ norm.shifts)  # (E+1,) margin shifts
+            # Per-row reduce (batch-invariant), matching
+            # `gathered_row_margins` / the sharded twin bitwise — a matvec
+            # here would reduce in an (E+1)-dependent order and diverge
+            # from the (N, D) gathered paths at the last ulp.
+            shift = -jnp.sum(matrix * norm.shifts, axis=-1)  # (E+1,)
     if isinstance(features, _SF):
         if features.ell_axis == -2:
             # Transposed (K, N) projected planes: broadcast the entity rows

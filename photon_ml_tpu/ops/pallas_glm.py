@@ -736,12 +736,13 @@ def sharded_value_gradient_sums(
         stats = jax.lax.psum(jnp.stack([val, sum_u]), axis)
         return stats[0], jax.lax.psum(g, axis), stats[1]
 
-    fn = jax.shard_map(
+    from photon_ml_tpu.parallel.mesh import shard_map_compat
+
+    fn = shard_map_compat(
         per_device,
         mesh=mesh,
         in_specs=(P(), P(), P(axis, None), P(axis), P(axis), P(axis)),
         out_specs=(P(), P(), P()),
-        check_vma=False,
     )
     return fn(w_eff, shift, features, labels, offsets, weights)
 
@@ -771,11 +772,12 @@ def sharded_hessian_vector_sums(
         )
         return jax.lax.psum(hv, axis), jax.lax.psum(sum_r, axis)
 
-    fn = jax.shard_map(
+    from photon_ml_tpu.parallel.mesh import shard_map_compat
+
+    fn = shard_map_compat(
         per_device,
         mesh=mesh,
         in_specs=(P(), P(), P(), P(), P(axis, None), P(axis), P(axis), P(axis)),
         out_specs=(P(), P()),
-        check_vma=False,
     )
     return fn(w_eff, shift, v_eff, v_shift, features, labels, offsets, weights)

@@ -40,6 +40,23 @@ from photon_ml_tpu.data.game_dataset import EntityBlocks, GameDataset, RandomEff
 DATA_AXIS = "data"
 
 
+def shard_map_compat(f, *, mesh, in_specs, out_specs, check=False):
+    """`jax.shard_map` across the API move: new jax exposes it top-level
+    with `check_vma`; 0.4.x keeps it in jax.experimental.shard_map with
+    `check_rep`. Every shard_map in the tree goes through here so the
+    framework runs on both."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        return sm(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=check
+        )
+    from jax.experimental.shard_map import shard_map as _sm
+
+    return _sm(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=check
+    )
+
+
 def make_mesh(devices: Optional[Sequence] = None, axis_name: str = DATA_AXIS) -> Mesh:
     """1-D mesh over all (or given) devices — DP+entity sharding share it."""
     devs = np.asarray(devices if devices is not None else jax.devices())
@@ -260,12 +277,11 @@ def _ring_gather_fn(mesh: Mesh, rows_ndim: int):
 
     spec_rows = P(axis, *([None] * (rows_ndim - 1)))
     return jax.jit(
-        jax.shard_map(
+        shard_map_compat(
             per_device,
             mesh=mesh,
             in_specs=(P(axis, None), spec_rows),
             out_specs=P(axis, *([None] * rows_ndim)),
-            check_vma=False,
         )
     )
 
@@ -321,12 +337,11 @@ def _ring_scatter_fn(mesh: Mesh, rows_ndim: int, vals_ndim: int):
     spec_rows = P(axis, *([None] * (rows_ndim - 1)))
     spec_vals = P(axis, *([None] * (vals_ndim - 1)))
     return jax.jit(
-        jax.shard_map(
+        shard_map_compat(
             per_device,
             mesh=mesh,
             in_specs=(P(axis, None), spec_rows, spec_vals),
             out_specs=P(axis, None),
-            check_vma=False,
         )
     )
 
@@ -342,6 +357,71 @@ def ring_scatter_rows(
     padding entities all write the zero solution to the pinned row).
     """
     return _ring_scatter_fn(mesh, rows.ndim, values.ndim)(matrix, rows, values)
+
+
+@functools.lru_cache(maxsize=64)
+def _bcast_gather_fn(mesh: Mesh, rows_ndim: int):
+    axis = mesh.axis_names[0]
+
+    def per_device(m_loc, rows):
+        my = jax.lax.axis_index(axis)
+        chunk = m_loc.shape[0]
+        base = my * chunk
+        mask = (rows >= base) & (rows < base + chunk)
+        local = jnp.clip(rows - base, 0, chunk - 1)
+        part = jnp.where(mask[..., None], m_loc[local], 0.0)
+        return jax.lax.psum(part, axis)
+
+    return jax.jit(
+        shard_map_compat(
+            per_device,
+            mesh=mesh,
+            in_specs=(P(axis, None), P()),
+            out_specs=P(),
+        )
+    )
+
+
+def bcast_gather_rows(matrix: jax.Array, rows: jax.Array, mesh: Mesh) -> jax.Array:
+    """out[i] = matrix[rows[i]] for a row-sharded matrix and REPLICATED row
+    indices: every shard contributes the rows it owns (others contribute
+    exact zeros) and one psum returns the gathered block everywhere.
+
+    This is the sharded-gather dispatch for SMALL request batches — the
+    serving engine's padded buckets and per-coordinate validation scoring —
+    where replicating the (N, D) gathered block is cheaper than resharding
+    the requests onto the ring (`ring_gather_rows` stays the high-volume
+    path for sample-sharded scoring). Exact row movement: every requested
+    row is owned by exactly one shard, and x + 0.0 is exact in IEEE float,
+    so the psum reproduces matrix[rows] BITWISE — which is what lets the
+    sharded serving path stay bitwise-equal to the replicated one."""
+    return _bcast_gather_fn(mesh, rows.ndim)(matrix, rows)
+
+
+def ring_gather_wire_bytes(mesh: Mesh, n_rows_padded: int, dim: int, itemsize: int = 4) -> int:
+    """Analytic ICI/DCN wire bytes of one `ring_gather_rows` call: each of
+    the ndev devices ppermutes its (n_rows_padded/ndev, dim) matrix chunk
+    ndev times, so total bytes on the wire = ndev * matrix_bytes."""
+    ndev = mesh.devices.size
+    return int(ndev) * int(n_rows_padded) * int(dim) * int(itemsize)
+
+
+def ring_scatter_wire_bytes(
+    mesh: Mesh, n_updates_padded: int, dim: int, itemsize: int = 4
+) -> int:
+    """Analytic wire bytes of one `ring_scatter_rows` call: the
+    (rows int32, values (., dim)) payload rotates ndev steps across ndev
+    devices."""
+    ndev = mesh.devices.size
+    return int(ndev) * int(n_updates_padded) * (4 + int(dim) * int(itemsize))
+
+
+def bcast_gather_wire_bytes(mesh: Mesh, n_rows: int, dim: int, itemsize: int = 4) -> int:
+    """Analytic wire bytes of one `bcast_gather_rows` call: a ring
+    all-reduce of the (n_rows, dim) partial block moves
+    2 * (ndev - 1) / ndev * bytes per device across ndev devices."""
+    ndev = mesh.devices.size
+    return 2 * (ndev - 1) * int(n_rows) * int(dim) * int(itemsize)
 
 
 def shard_random_effect_dataset(

@@ -19,6 +19,7 @@ from photon_ml_tpu.serving.bundle import (
     ScoreRequest,
     ServingBundle,
     ServingCoordinate,
+    TwoTierEntityStore,
     load_bundle,
 )
 from photon_ml_tpu.serving.engine import ScoreResult, ServingEngine
@@ -52,5 +53,6 @@ __all__ = [
     "ServingEngine",
     "ServingState",
     "SwapIncompatible",
+    "TwoTierEntityStore",
     "load_bundle",
 ]

@@ -30,13 +30,17 @@ from __future__ import annotations
 
 import dataclasses
 import glob
+import logging
 import os
+import threading
 import time
 from typing import Dict, List, Mapping, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+logger = logging.getLogger(__name__)
 
 from photon_ml_tpu.data.index_map import IndexMap, feature_key
 from photon_ml_tpu.game.model import FixedEffectModel, GameModel, RandomEffectModel
@@ -75,9 +79,213 @@ class ScoreRequest:
     deadline_ms: Optional[float] = None
 
 
+class TwoTierEntityStore:
+    """Two-tier random-effect row store: HBM-resident HOT set + host-RAM
+    COLD tier with asynchronous promotion (the Snap ML device/host memory
+    hierarchy, PAPERS.md, applied to serving coefficients).
+
+    The hot tier is a pinned `(capacity + 1, dim)` device matrix (slot
+    `capacity` is the pinned zero row — unknown entities and padding gather
+    it). The cold tier is the FULL `(E + 1, dim)` float32 matrix in host
+    RAM. A lookup resolves each logical coefficient row to either its hot
+    slot or, on a hot miss, copies the row out of the cold tier into the
+    request's override buffer — the request still scores BITWISE-identically
+    to a single-tier bundle (the override row IS the matrix row; see
+    `game.model.gathered_row_margins`) — and schedules the row for async
+    promotion into the hot set (LRU eviction under the capacity bound).
+    Rows absent from both tiers fall through to the pinned zero row, the
+    existing cold-start miss tier.
+
+    Consistency: the (hot matrix, row->slot index) pair is read and
+    published under one lock, and promotions build a NEW device matrix
+    (functional `.at[].set`), so an in-flight batch's captured snapshot can
+    never be remapped under it. The promotion worker is a short-lived
+    thread (`photon-serving-promote`, joined by `close()`/`drain()`), so a
+    released bundle leaks nothing.
+    """
+
+    def __init__(self, cold_matrix: np.ndarray, hot_rows: int):
+        self._cold = np.ascontiguousarray(cold_matrix, dtype=np.float32)
+        self.n_rows = int(self._cold.shape[0])  # logical E + 1
+        self.dim = int(self._cold.shape[1])
+        cap = max(0, min(int(hot_rows), self.n_rows - 1))
+        self.capacity = cap
+        self.zero_slot = cap
+        self._lock = threading.Lock()
+        # Deterministic preload: the first `capacity` logical rows (callers
+        # wanting a measured-hotness preload reorder the entity index).
+        hot = np.zeros((cap + 1, self.dim), np.float32)
+        hot[:cap] = self._cold[:cap]
+        self._hot = jnp.asarray(hot)
+        self._slot_of_row: Dict[int, int] = {r: r for r in range(cap)}
+        self._row_of_slot: List[Optional[int]] = list(range(cap))
+        self._tick = 0
+        self._last_used = [0] * cap
+        self._pending: Dict[int, bool] = {}
+        self._worker: Optional[threading.Thread] = None
+        self._closed = False
+        self.hot_hits = 0
+        self.cold_hits = 0
+        self.promotions = 0
+        self.evictions = 0
+
+    @property
+    def hot_nbytes(self) -> int:
+        """Device-resident bytes of the hot tier (the HBM-budget term)."""
+        return (self.capacity + 1) * self.dim * 4
+
+    @property
+    def hot_fraction(self) -> float:
+        return self.capacity / max(1, self.n_rows - 1)
+
+    def snapshot(self) -> Array:
+        with self._lock:
+            return self._hot
+
+    def lookup(
+        self, rows: np.ndarray, bucket: int
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, Array]:
+        """Resolve logical rows -> (hot slots, override rows, override
+        flags, hot-matrix snapshot), all padded to `bucket`. Cold-tier hits
+        carry their row in the override buffer (flag set) and are queued
+        for async promotion. The slot/snapshot pair is captured under one
+        lock so a concurrent promotion can never remap an in-flight batch.
+        """
+        n = len(rows)
+        slots = np.full(bucket, self.zero_slot, np.int32)
+        ovr = np.zeros((bucket, self.dim), np.float32)
+        flags = np.zeros(bucket, bool)
+        with self._lock:
+            self._tick += 1
+            tick = self._tick
+            for i in range(n):
+                r = int(rows[i])
+                if r >= self.n_rows - 1:
+                    continue  # unseen -> pinned zero slot
+                s = self._slot_of_row.get(r)
+                if s is not None:
+                    slots[i] = s
+                    self._last_used[s] = tick
+                    self.hot_hits += 1
+                else:
+                    ovr[i] = self._cold[r]
+                    flags[i] = True
+                    self.cold_hits += 1
+                    if self.capacity and not self._closed:
+                        self._pending.setdefault(r, True)
+            snapshot = self._hot
+            # Kick the worker whenever ANYTHING is pending — not only when
+            # this lookup queued a new row: a row enqueued in the window
+            # where the previous worker had decided to exit but still
+            # reported is_alive() would otherwise never be promoted (no
+            # later lookup of it re-queues, so no restart ever fires).
+            if self._pending and not self._closed:
+                self._maybe_start_worker_locked()
+        return slots, ovr, flags, snapshot
+
+    def _maybe_start_worker_locked(self) -> None:
+        if self._worker is None or not self._worker.is_alive():
+            self._worker = threading.Thread(
+                target=self._promote_pending,
+                name="photon-serving-promote",
+                daemon=True,
+            )
+            self._worker.start()
+
+    def _promote_pending(self) -> None:
+        while True:
+            with self._lock:
+                if self._closed or not self._pending:
+                    return
+                batch = list(self._pending)[: max(1, self.capacity)]
+                idx: List[int] = []
+                srcs: List[int] = []
+                for r in batch:
+                    self._pending.pop(r, None)
+                    if r in self._slot_of_row:
+                        continue
+                    s = self._lru_slot_locked()
+                    old = self._row_of_slot[s]
+                    if old is not None:
+                        del self._slot_of_row[old]
+                        self.evictions += 1
+                    self._row_of_slot[s] = r
+                    self._slot_of_row[r] = s
+                    self._last_used[s] = self._tick
+                    self.promotions += 1
+                    idx.append(s)
+                    srcs.append(r)
+                if idx:
+                    # Functional update INSIDE the critical section: the new
+                    # (matrix, index) pair publishes atomically; snapshots
+                    # already handed out keep their own immutable matrix.
+                    try:
+                        self._hot = self._hot.at[
+                            jnp.asarray(idx, jnp.int32)
+                        ].set(jnp.asarray(self._cold[srcs]))
+                    except Exception:  # noqa: BLE001 - promotion is best-effort
+                        # Device dispatch failed (e.g. runtime tearing down):
+                        # roll the index back — lookups must keep resolving
+                        # these rows through the cold tier, never to a hot
+                        # slot that was not actually written.
+                        for s, r in zip(idx, srcs):
+                            self._slot_of_row.pop(r, None)
+                            self._row_of_slot[s] = None
+                            self.promotions -= 1
+                        self._closed = True
+                        return
+
+    def _lru_slot_locked(self) -> int:
+        return int(np.argmin(self._last_used))
+
+    def drain(self, timeout_s: float = 30.0) -> None:
+        """Block until every queued promotion applied (tests/metrics)."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                w = self._worker
+                busy = bool(self._pending) and not self._closed
+                if busy:
+                    self._maybe_start_worker_locked()
+                    w = self._worker
+            if w is not None and w.is_alive():
+                w.join(timeout=0.2)
+            elif not busy:
+                return
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            self._pending.clear()
+            w = self._worker
+        if w is not None and w is not threading.current_thread():
+            w.join(timeout=10)
+
+    def metrics(self) -> Dict[str, object]:
+        with self._lock:
+            return {
+                "hot_rows": self.capacity,
+                "hot_fraction": round(self.hot_fraction, 6),
+                "hot_tier_hits": self.hot_hits,
+                "cold_tier_hits": self.cold_hits,
+                "promotions": self.promotions,
+                "evictions": self.evictions,
+                "pending_promotions": len(self._pending),
+            }
+
+
 @dataclasses.dataclass
 class ServingCoordinate:
-    """One coordinate's device-resident serving state."""
+    """One coordinate's device-resident serving state.
+
+    Random-effect coordinates come in three storage modes:
+      * single-tier (default): `params` is the full (E + 1, dim) matrix on
+        one device;
+      * entity-sharded: `mesh` set, `params` row-sharded over it (rows
+        padded to a mesh multiple — `logical_rows` keeps the true E + 1);
+      * two-tier: `store` set, `params` is the initial hot-tier matrix and
+        batches score against per-batch store snapshots.
+    """
 
     cid: str
     shard: str
@@ -85,6 +293,9 @@ class ServingCoordinate:
     norm: Optional[object] = None
     random_effect_type: Optional[str] = None
     entity_index: Optional[Mapping[object, int]] = None
+    mesh: Optional[object] = None  # jax.sharding.Mesh when row-sharded
+    logical_rows: Optional[int] = None  # E + 1 when params rows are padded
+    store: Optional[TwoTierEntityStore] = None
 
     @property
     def is_random_effect(self) -> bool:
@@ -96,8 +307,27 @@ class ServingCoordinate:
 
     @property
     def unseen_row(self) -> int:
-        """The pinned zero row unknown entities gather (cold start)."""
+        """The pinned zero row unknown entities gather (cold start) — the
+        LOGICAL row: mesh-padded and two-tier matrices keep extra physical
+        rows past it (all zero / the hot tier), never exposed to lookups."""
+        if self.logical_rows is not None:
+            return int(self.logical_rows) - 1
         return int(self.params.shape[0]) - 1
+
+    def device_nbytes(self) -> int:
+        """Device-resident bytes of this coordinate's model state (the hot
+        tier only for two-tier coordinates — the cold tier is host RAM)."""
+        if self.store is not None:
+            return self.store.hot_nbytes
+        return int(self.params.size) * self.params.dtype.itemsize
+
+    def device_nbytes_per_shard(self) -> int:
+        """Peak bytes on any ONE device: sharded matrices divide over the
+        mesh; everything else is resident whole."""
+        nb = self.device_nbytes()
+        if self.mesh is not None:
+            return nb // int(self.mesh.devices.size)
+        return nb
 
     def lookup_rows(self, entity_ids: Sequence[object]) -> Tuple[np.ndarray, int]:
         """Resolve entity ids to coefficient rows; id None or unknown ->
@@ -150,10 +380,27 @@ class ServingBundle:
         refcounting frees the device memory the moment the last reference
         dies — for the production artifact path (host-built matrices owned
         solely by the bundle) that is immediately. Scoring a released
-        bundle raises; release is idempotent."""
+        bundle raises; release is idempotent. Two-tier stores close their
+        promotion worker here so a retired bundle leaks no thread."""
+        for c in self.coordinates.values():
+            if getattr(c, "store", None) is not None:
+                c.store.close()
         self.coordinates = {}
         self.index_maps = None
         self.released = True
+
+    def device_bytes(self) -> int:
+        """Total device-resident model bytes across every coordinate (the
+        cold tier of two-tier stores is host RAM and excluded)."""
+        return sum(c.device_nbytes() for c in self.coordinates.values())
+
+    def device_bytes_per_shard(self) -> int:
+        """Peak model bytes on any ONE device — the number an HBM budget
+        must bound: entity-sharded matrices divide over their mesh, so a
+        sharded swap is charged per shard, not per total."""
+        return sum(
+            c.device_nbytes_per_shard() for c in self.coordinates.values()
+        )
 
     def shard_dims(self) -> Dict[str, int]:
         """Feature width per shard consumed by any coordinate."""
@@ -212,11 +459,35 @@ class ServingBundle:
         task: TaskType,
         *,
         index_maps: Optional[Mapping[str, IndexMap]] = None,
+        mesh=None,
+        hot_rows: Optional[Union[int, Mapping[str, int]]] = None,
     ) -> "ServingBundle":
         """Stage an in-memory (model, specs) pair. Projected random-effect
         coordinates are rejected — serving scores in original feature space
         (export via model_bridge.artifact_from_game_model, which
-        back-projects, then `from_artifact`)."""
+        back-projects, then `from_artifact`).
+
+        Pod-scale staging knobs (per random-effect coordinate, mutually
+        exclusive):
+          * `mesh`: stage the RE coefficient matrix ROW-SHARDED over the
+            mesh's entity axis (rows padded to a mesh multiple) — per-device
+            model state is total/n_devices, which is what breaks the
+            one-HBM ceiling. A matrix that is ALREADY row-sharded (a
+            mesh-trained model) keeps its sharding without any `mesh`
+            argument — training's sharding decision flows into serving.
+          * `hot_rows` (int, or {cid: int}): stage a two-tier store — an
+            HBM hot set of that many rows plus the full matrix in host RAM
+            (`TwoTierEntityStore`), with async promotion and the pinned
+            zero row as the final miss tier.
+        Both knobs preserve bitwise scoring parity with the single-tier
+        replicated bundle (tests/test_serving_two_tier.py)."""
+        from photon_ml_tpu.ops.normalization import PerEntityNormalization
+        from photon_ml_tpu.parallel.mesh import (
+            leading_axis_mesh,
+            matrix_row_sharding,
+            pad_rows_for_mesh,
+        )
+
         t0 = time.perf_counter()
         coords: Dict[str, ServingCoordinate] = {}
         nbytes = 0
@@ -237,24 +508,96 @@ class ServingBundle:
                         "and build the bundle from it"
                     )
                 matrix = m.coefficients_matrix
-                # Mesh-padded matrices carry inert all-zero rows past the
-                # logical E + 1; slice them off so unseen_row is the pinned
-                # zero row and the replicated gather is exact.
                 logical = m.num_entities + 1
-                if matrix.shape[0] > logical:
-                    matrix = matrix[:logical]
-                params = jnp.asarray(matrix, jnp.float32)
-                coords[cid] = ServingCoordinate(
-                    cid,
-                    spec.shard,
-                    params,
-                    norm=spec.norm,
-                    random_effect_type=spec.random_effect_type,
-                    entity_index=dict(spec.entity_index or {}),
+                hr = (
+                    hot_rows.get(cid)
+                    if isinstance(hot_rows, Mapping)
+                    else hot_rows
                 )
+                coord_mesh = mesh if mesh is not None else leading_axis_mesh(
+                    matrix, require_divisible=True
+                )
+                if hr is not None and coord_mesh is not None:
+                    # Explicit mesh OR a mesh-trained matrix whose sharding
+                    # would be adopted: silently pulling a row-sharded
+                    # store whole into host RAM to build a hot set would
+                    # quietly break the "training's sharding flows into
+                    # serving" guarantee — refuse and make the operator
+                    # pick one.
+                    raise ValueError(
+                        f"coordinate {cid!r}: hot_rows and mesh staging are "
+                        "mutually exclusive (a two-tier hot set is already "
+                        "the small-memory option); the matrix is "
+                        f"{'explicitly' if mesh is not None else 'already'} "
+                        "mesh-sharded"
+                    )
+                if (hr is not None or coord_mesh is not None) and isinstance(
+                    spec.norm, PerEntityNormalization
+                ):
+                    raise ValueError(
+                        f"coordinate {cid!r}: per-entity normalization tables "
+                        "are entity-sized and not sharded/tiered — stage "
+                        "single-tier"
+                    )
+                if hr is not None:
+                    # Two-tier: hot set in HBM, full matrix in host RAM.
+                    if matrix.shape[0] > logical:
+                        matrix = matrix[:logical]
+                    store = TwoTierEntityStore(np.asarray(matrix), hr)
+                    coords[cid] = ServingCoordinate(
+                        cid,
+                        spec.shard,
+                        store.snapshot(),
+                        norm=spec.norm,
+                        random_effect_type=spec.random_effect_type,
+                        entity_index=dict(spec.entity_index or {}),
+                        logical_rows=logical,
+                        store=store,
+                    )
+                elif coord_mesh is not None:
+                    # Entity-sharded: rows padded to the mesh multiple stay
+                    # (or become) row-sharded; rows past logical are inert
+                    # zeros, never exposed (unseen_row is the LOGICAL one).
+                    n_rows = pad_rows_for_mesh(
+                        max(int(matrix.shape[0]), logical), coord_mesh
+                    )
+                    if matrix.shape[0] != n_rows:
+                        matrix = jnp.pad(
+                            jnp.asarray(matrix, jnp.float32),
+                            ((0, n_rows - matrix.shape[0]), (0, 0)),
+                        )
+                    params = jax.device_put(
+                        jnp.asarray(matrix, jnp.float32),
+                        matrix_row_sharding(coord_mesh),
+                    )
+                    coords[cid] = ServingCoordinate(
+                        cid,
+                        spec.shard,
+                        params,
+                        norm=spec.norm,
+                        random_effect_type=spec.random_effect_type,
+                        entity_index=dict(spec.entity_index or {}),
+                        mesh=coord_mesh,
+                        logical_rows=logical,
+                    )
+                else:
+                    # Mesh-padded matrices carry inert all-zero rows past
+                    # the logical E + 1; slice them off so unseen_row is
+                    # the pinned zero row and the replicated gather exact.
+                    if matrix.shape[0] > logical:
+                        matrix = matrix[:logical]
+                    params = jnp.asarray(matrix, jnp.float32)
+                    coords[cid] = ServingCoordinate(
+                        cid,
+                        spec.shard,
+                        params,
+                        norm=spec.norm,
+                        random_effect_type=spec.random_effect_type,
+                        entity_index=dict(spec.entity_index or {}),
+                    )
             else:
                 raise TypeError(f"unknown model type {type(m)} for {cid!r}")
-            nbytes += coords[cid].params.size * coords[cid].params.dtype.itemsize
+            nbytes += coords[cid].device_nbytes()
         # One blocking upload at load: everything after this is pinned.
         jax.block_until_ready([c.params for c in coords.values()])
         return cls(
@@ -271,25 +614,77 @@ class ServingBundle:
         artifact: GameModelArtifact,
         *,
         index_maps: Optional[Mapping[str, IndexMap]] = None,
+        mesh=None,
+        hot_rows: Optional[Union[int, Mapping[str, int]]] = None,
     ) -> "ServingBundle":
         """The production path: persisted artifact (original feature space,
-        string entity ids) -> pinned bundle."""
+        string entity ids) -> pinned bundle. `mesh`/`hot_rows` as in
+        `from_model`."""
         from photon_ml_tpu.io.model_bridge import game_model_from_artifact
 
         model, specs = game_model_from_artifact(artifact)
-        return cls.from_model(model, specs, artifact.task, index_maps=index_maps)
+        return cls.from_model(
+            model,
+            specs,
+            artifact.task,
+            index_maps=index_maps,
+            mesh=mesh,
+            hot_rows=hot_rows,
+        )
+
+
+def serving_entity_mesh():
+    """Env-gated serving mesh: PHOTON_SERVING_ENTITY_SHARD=1 stages RE
+    matrices row-sharded over all local devices (no-op on one device)."""
+    if os.environ.get("PHOTON_SERVING_ENTITY_SHARD", "").strip().lower() not in (
+        "1",
+        "true",
+        "on",
+        "yes",
+    ):
+        return None
+    if len(jax.devices()) < 2:
+        logger.warning(
+            "PHOTON_SERVING_ENTITY_SHARD set with a single device; staging "
+            "replicated"
+        )
+        return None
+    from photon_ml_tpu.parallel.mesh import make_mesh
+
+    return make_mesh()
+
+
+def serving_hot_rows() -> Optional[int]:
+    """Env-gated two-tier hot-set size (PHOTON_SERVING_HOT_ROWS)."""
+    raw = os.environ.get("PHOTON_SERVING_HOT_ROWS", "").strip()
+    if not raw:
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        logger.warning("ignoring malformed PHOTON_SERVING_HOT_ROWS=%r", raw)
+        return None
 
 
 def load_bundle(
     model_dir: str,
     *,
     index_maps: Optional[Mapping[str, IndexMap]] = None,
+    mesh=None,
+    hot_rows: Optional[Union[int, Mapping[str, int]]] = None,
 ) -> ServingBundle:
     """Load a model directory (the training driver's layout) into a serving
     bundle. Index maps default to the JSON maps saved beside the model
-    (`<model_dir>/feature-indexes/<shard>.json`), mirroring cli/score.py."""
+    (`<model_dir>/feature-indexes/<shard>.json`), mirroring cli/score.py.
+    `mesh`/`hot_rows` default to the env knobs (PHOTON_SERVING_ENTITY_SHARD,
+    PHOTON_SERVING_HOT_ROWS) so `cli.serve` picks the pod-scale staging up
+    without new flags."""
     from photon_ml_tpu.io import model_store
 
+    if mesh is None:
+        mesh = serving_entity_mesh()
+    if hot_rows is None:
+        hot_rows = serving_hot_rows()
     if index_maps is None:
         index_dir = os.path.join(model_dir, "feature-indexes")
         index_maps = {
@@ -302,7 +697,9 @@ def load_bundle(
                 "explicitly (e.g. resolved from an off-heap store)"
             )
     artifact = model_store.load_game_model(model_dir, index_maps)
-    return ServingBundle.from_artifact(artifact, index_maps=index_maps)
+    return ServingBundle.from_artifact(
+        artifact, index_maps=index_maps, mesh=mesh, hot_rows=hot_rows
+    )
 
 
 def request_from_record(

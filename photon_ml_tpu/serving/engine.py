@@ -96,18 +96,36 @@ class ScoreResult:
 class _EngineState:
     """One bundle generation's scoring state. Immutable after build except
     `active` (in-flight batch count, guarded by the engine lock) — the
-    swap drain waits on it before releasing the generation's bundle."""
+    swap drain waits on it before releasing the generation's bundle.
+
+    `kinds` name each coordinate's storage mode and pick its margin kernel:
+    "fe" (weight vector), "re" (single-tier matrix), "re_sh" (row-sharded
+    matrix over `meshes[k]` — the fused program becomes a pjit program over
+    the mesh), "re2" (two-tier hot/cold store)."""
 
     bundle: ServingBundle
     coords: List[ServingCoordinate]
     kinds: Tuple[str, ...]
     coord_shards: Tuple[str, ...]
     shard_dims: Dict[str, int]
+    meshes: Tuple[Optional[object], ...] = ()
     version: int = 0
     active: int = 0
 
 
-def _score_program(offsets, shard_feats, rows, params, norms, *, kinds, shards, task):
+def _score_program(
+    offsets,
+    shard_feats,
+    rows,
+    overrides,
+    params,
+    norms,
+    *,
+    kinds,
+    shards,
+    meshes,
+    task,
+):
     """The fused per-bucket program: offsets + per-coordinate margins (same
     kernels and summation order as GameTransformer.transform) + link mean.
 
@@ -115,12 +133,36 @@ def _score_program(offsets, shard_feats, rows, params, norms, *, kinds, shards, 
     coordinates resolving their shard by the static `shards` tuple — never
     as a per-coordinate tuple, which would pass the same device array
     twice when two coordinates share a shard and make buffer donation
-    alias one buffer to two parameters (undefined on accelerators)."""
+    alias one buffer to two parameters (undefined on accelerators).
+
+    Storage-mode kernels, all BITWISE-equal to the single-tier path:
+      * "re_sh": the row-sharded matrix is read via the psum
+        broadcast-gather (exact row movement over the mesh —
+        game.model.random_effect_margins_bcast) so no device materializes
+        the full (E + 1, D) matrix;
+      * "re2": rows resolve against the hot-tier snapshot, with cold-tier
+        hits overridden by the rows the pack stage copied out of host RAM
+        (`overrides[k]` = (values, flags)) — the override row IS the
+        matrix row, so the margin is unchanged."""
+    from photon_ml_tpu.game.model import (
+        _random_effect_margins_bcast_impl,
+        gathered_row_margins,
+    )
+
     total = offsets
     for k, kind in enumerate(kinds):
         feats = shard_feats[shards[k]]
         if kind == "fe":
             total = total + dense_margins(feats, params[k], norms[k])
+        elif kind == "re_sh":
+            total = total + _random_effect_margins_bcast_impl(
+                feats, rows[k], params[k], norms[k], mesh=meshes[k]
+            )
+        elif kind == "re2":
+            ovr_vals, ovr_flags = overrides[k]
+            w = params[k][rows[k]]
+            w = jnp.where(ovr_flags[:, None], ovr_vals, w)
+            total = total + gathered_row_margins(feats, w, norms[k])
         else:
             total = total + random_effect_margins(
                 feats, rows[k], params[k], norms[k]
@@ -170,10 +212,12 @@ class ServingEngine:
         def _engine_score_program(*args, **kwargs):
             return _score_program(*args, **kwargs)
 
-        donate = () if jax.default_backend() == "cpu" else (0, 1, 2)
+        # Donate the per-batch request scratch (offsets, shard buffers,
+        # rows, two-tier overrides) — never the model planes.
+        donate = () if jax.default_backend() == "cpu" else (0, 1, 2, 3)
         self._jit = jax.jit(
             _engine_score_program,
-            static_argnames=("kinds", "shards", "task"),
+            static_argnames=("kinds", "shards", "meshes", "task"),
             donate_argnums=donate,
         )
         self.stages = TimingRegistry()
@@ -267,14 +311,23 @@ class ServingEngine:
         if bundle.released:
             raise RuntimeError("cannot serve a released bundle")
         coords = [bundle.coordinates[cid] for cid in bundle.coordinate_ids]
+
+        def _kind(c: ServingCoordinate) -> str:
+            if not c.is_random_effect:
+                return "fe"
+            if getattr(c, "store", None) is not None:
+                return "re2"
+            if getattr(c, "mesh", None) is not None:
+                return "re_sh"
+            return "re"
+
         return _EngineState(
             bundle=bundle,
             coords=coords,
-            kinds=tuple(
-                "re" if c.is_random_effect else "fe" for c in coords
-            ),
+            kinds=tuple(_kind(c) for c in coords),
             coord_shards=tuple(c.shard for c in coords),
             shard_dims=bundle.shard_dims(),
+            meshes=tuple(getattr(c, "mesh", None) for c in coords),
             version=version,
         )
 
@@ -456,24 +509,52 @@ class ServingEngine:
             re_coords = [c for c in state.coords if c.is_random_effect]
             cold_flags = np.zeros((n, len(re_coords)), bool)
             rows_by_cid: Dict[str, np.ndarray] = {}
+            # Two-tier coordinates: per-batch override buffers (cold-tier
+            # rows copied from host RAM) + the hot-matrix snapshot captured
+            # ATOMICALLY with the slot resolution — a concurrent promotion
+            # can then never remap an in-flight batch (the snapshot matrix
+            # is immutable; promotions build a new one).
+            overrides_by_cid: Dict[str, tuple] = {}
+            tier_params: Dict[str, Array] = {}
             for k, c in enumerate(re_coords):
+                store = getattr(c, "store", None)
                 if fe_only:
                     # Every slot gathers the pinned zero row: the margin
                     # contribution is exactly +0.0, i.e. FE-only scoring
                     # without touching the (possibly failing) index path.
-                    rows_by_cid[c.cid] = np.full(bucket, c.unseen_row, np.int32)
+                    if store is not None:
+                        rows_by_cid[c.cid] = np.full(
+                            bucket, store.zero_slot, np.int32
+                        )
+                        overrides_by_cid[c.cid] = (
+                            np.zeros((bucket, c.dim), np.float32),
+                            np.zeros(bucket, bool),
+                        )
+                        tier_params[c.cid] = store.snapshot()
+                    else:
+                        rows_by_cid[c.cid] = np.full(
+                            bucket, c.unseen_row, np.int32
+                        )
                     continue
                 ids = [r.entity_ids.get(c.random_effect_type) for r in requests]
                 rows, _ = c.lookup_rows(ids)
                 cold_flags[:, k] = rows == c.unseen_row
-                padded = np.full(bucket, c.unseen_row, np.int32)
-                padded[:n] = rows
-                rows_by_cid[c.cid] = padded
+                if store is not None:
+                    slots, ovr, flags, snapshot = store.lookup(rows, bucket)
+                    rows_by_cid[c.cid] = slots
+                    overrides_by_cid[c.cid] = (ovr, flags)
+                    tier_params[c.cid] = snapshot
+                else:
+                    padded = np.full(bucket, c.unseen_row, np.int32)
+                    padded[:n] = rows
+                    rows_by_cid[c.cid] = padded
         return {
             "bucket": bucket,
             "buffers": buffers,
             "offsets": offsets,
             "rows_by_cid": rows_by_cid,
+            "overrides_by_cid": overrides_by_cid,
+            "tier_params": tier_params,
             "cold_flags": cold_flags,
         }
 
@@ -494,16 +575,34 @@ class ServingEngine:
                 else None
                 for c in state.coords
             )
-            params = tuple(c.params for c in state.coords)
+            overrides = tuple(
+                (
+                    jnp.asarray(packed["overrides_by_cid"][c.cid][0]),
+                    jnp.asarray(packed["overrides_by_cid"][c.cid][1]),
+                )
+                if c.is_random_effect
+                and c.cid in packed["overrides_by_cid"]
+                else None
+                for c in state.coords
+            )
+            # Two-tier coordinates score against the hot-matrix snapshot
+            # the pack stage captured with the slots; everyone else serves
+            # the bundle's pinned planes.
+            params = tuple(
+                packed["tier_params"].get(c.cid, c.params)
+                for c in state.coords
+            )
             norms = tuple(c.norm for c in state.coords)
             total, means = self._jit(
                 jnp.asarray(packed["offsets"]),
                 dev_buffers,
                 rows,
+                overrides,
                 params,
                 norms,
                 kinds=state.kinds,
                 shards=state.coord_shards,
+                meshes=state.meshes,
                 task=self.task,
             )
             host_total, host_means = jax.device_get((total, means))
@@ -512,6 +611,59 @@ class ServingEngine:
         return np.asarray(host_total), np.asarray(host_means)
 
     # -------------------------------------------------------------- metrics
+
+    def warmup_buffer_bytes(self, state: Optional[_EngineState] = None) -> int:
+        """Peak per-batch transient request-buffer bytes (largest bucket):
+        offsets + per-shard feature buffers + per-RE rows + two-tier
+        override buffers + both outputs. This is what a hot-swap's
+        pre-warm allocates BESIDE the two resident bundle generations, so
+        BundleManager charges it against the HBM budget."""
+        st = state if state is not None else self._state
+        b = self.max_batch
+        total = b * 4  # offsets
+        total += sum(b * d * 4 for d in st.shard_dims.values())
+        for k, c in enumerate(st.coords):
+            if c.is_random_effect:
+                total += b * 4  # rows
+                if st.kinds[k] == "re2":
+                    total += b * (c.dim * 4 + 1)  # override values + flags
+        total += 2 * b * 4  # (scores, means)
+        return total
+
+    def _sharding_metrics(self, state: _EngineState) -> Dict[str, object]:
+        """The serving sharding decision as proper JSON keys (the
+        serving-summary/bench contract): mesh axis size, peak coefficient
+        rows resident per shard, two-tier hot-set fraction, and the
+        analytic collective bytes one max_batch bucket moves."""
+        from photon_ml_tpu.parallel.mesh import bcast_gather_wire_bytes
+
+        sharded = False
+        axis = 1
+        rows_per_shard = 0
+        hot_fraction = 1.0
+        wire = 0
+        for k, c in enumerate(state.coords):
+            kind = state.kinds[k]
+            if kind == "re_sh":
+                sharded = True
+                ndev = int(c.mesh.devices.size)
+                axis = max(axis, ndev)
+                rows_per_shard = max(
+                    rows_per_shard, int(c.params.shape[0]) // ndev
+                )
+                wire += bcast_gather_wire_bytes(c.mesh, self.max_batch, c.dim)
+            elif kind == "re2":
+                hot_fraction = min(hot_fraction, c.store.hot_fraction)
+                rows_per_shard = max(rows_per_shard, c.store.capacity + 1)
+            elif kind == "re":
+                rows_per_shard = max(rows_per_shard, int(c.params.shape[0]))
+        return {
+            "entity_sharded": sharded,
+            "axis_size": axis,
+            "rows_per_shard": rows_per_shard,
+            "hot_set_fraction": round(hot_fraction, 6),
+            "all_to_all_bytes_per_batch": wire,
+        }
 
     @property
     def compiles(self) -> int:
@@ -573,6 +725,25 @@ class ServingEngine:
                     round(self._requests / elapsed, 1) if elapsed > 0 else None
                 ),
             }
+        # Pod-scale accounting: the sharding decision this bundle serves
+        # under + the two-tier store counters (all keys always present —
+        # 0/False on a single-tier replicated bundle — so the bench/summary
+        # missing-key contract can be loud).
+        out["sharding"] = self._sharding_metrics(st)
+        tier = {
+            "hot_tier_hits": 0,
+            "cold_tier_hits": 0,
+            "promotions": 0,
+            "evictions": 0,
+            "pending_promotions": 0,
+        }
+        for c in st.coords:
+            store = getattr(c, "store", None)
+            if store is not None:
+                sm = store.metrics()
+                for key in tier:
+                    tier[key] += int(sm[key])
+        out.update(tier)
         health = self.health.snapshot()
         out["state"] = health["state"]
         out["degraded_reasons"] = health["degraded_reasons"]

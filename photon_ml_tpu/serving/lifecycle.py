@@ -405,11 +405,33 @@ def device_memory_budget_bytes() -> Optional[int]:
     return None
 
 
+def _bundle_device_bytes(bundle) -> int:
+    """Per-shard device bytes of a bundle for the HBM budget check: the
+    hot-set tier of two-tier stores plus every pinned plane, with
+    entity-sharded matrices charged at bytes/n_devices (the per-device
+    peak is what a budget bounds). Falls back to `upload_bytes` for
+    bundle-shaped test doubles."""
+    fn = getattr(bundle, "device_bytes_per_shard", None)
+    if fn is not None:
+        try:
+            return int(fn())
+        except Exception:  # noqa: BLE001 - accounting must not kill a swap
+            pass
+    return int(getattr(bundle, "upload_bytes", 0))
+
+
 class BundleManager:
     """Versioned, atomic, rollback-safe hot-swap of a ServingEngine's
     bundle. One manager per engine; `swap()` is serialized (a second
     concurrent swap waits its turn — model pushes are rare and ordering
-    them is the correct semantics)."""
+    them is the correct semantics).
+
+    The HBM budget check charges, per shard: both bundle generations'
+    device-resident bytes (`_bundle_device_bytes` — the hot-set tier for
+    two-tier bundles, bytes/n_devices for entity-sharded matrices) plus
+    the engine's per-bucket warmup request buffers, so a sharded or
+    two-tier swap can't over-commit a shard during the double-buffered
+    window."""
 
     def __init__(self, engine):
         self.engine = engine
@@ -458,7 +480,12 @@ class BundleManager:
             old_state = engine._state
             builder = next_bundle if callable(next_bundle) else None
 
-            # HBM budget: both generations are resident during the swap.
+            # HBM budget: both generations are resident during the swap,
+            # PLUS the pre-warm's per-bucket request buffers (warmup
+            # compiles every bucket against the new parameters before the
+            # flip). Accounting is PER SHARD — entity-sharded matrices
+            # divide over their mesh and two-tier bundles charge only
+            # their hot set — so a sharded swap can't over-commit a shard.
             budget = (
                 hbm_budget_bytes
                 if hbm_budget_bytes is not None
@@ -466,13 +493,21 @@ class BundleManager:
             )
             need = expected_bytes
             if need is None and builder is None:
-                need = int(getattr(next_bundle, "upload_bytes", 0)) or None
-            have = int(old_state.bundle.upload_bytes)
-            if budget is not None and need is not None and have + need > budget:
+                need = _bundle_device_bytes(next_bundle) or None
+            have = _bundle_device_bytes(old_state.bundle)
+            warm = int(
+                getattr(engine, "warmup_buffer_bytes", lambda *a: 0)()
+            )
+            if (
+                budget is not None
+                and need is not None
+                and have + need + warm > budget
+            ):
                 raise HbmBudgetExceeded(
                     f"staging {need} bytes beside the active bundle's {have} "
-                    f"bytes exceeds the {budget}-byte HBM budget; swap refused "
-                    "before staging"
+                    f"bytes + {warm} bytes of warmup request buffers exceeds "
+                    f"the {budget}-byte HBM budget; swap refused before "
+                    "staging"
                 )
 
             staged = None
@@ -487,17 +522,41 @@ class BundleManager:
                 if getattr(staged, "released", False):
                     raise SwapIncompatible("next bundle is already released")
                 # Post-build budget re-check for prebuilt/unknown sizes.
-                got = int(getattr(staged, "upload_bytes", 0))
-                if budget is not None and need is None and have + got > budget:
+                got = _bundle_device_bytes(staged)
+                if (
+                    budget is not None
+                    and need is None
+                    and have + got + warm > budget
+                ):
                     raise HbmBudgetExceeded(
-                        f"staged bundle is {got} bytes; with the active "
-                        f"bundle's {have} bytes that exceeds the {budget}-byte "
-                        "HBM budget"
+                        f"staged bundle is {got} bytes/shard; with the active "
+                        f"bundle's {have} bytes + {warm} bytes of warmup "
+                        f"request buffers that exceeds the {budget}-byte HBM "
+                        "budget"
                     )
                 new_state = engine._build_state(
                     staged, version=old_state.version + 1
                 )
                 self._check_compatible(old_state, new_state)
+                # Re-check against the NEW state's warmup buffers: the
+                # incoming bundle may need bigger per-bucket scratch (a
+                # two-tier coordinate's override buffers, wider shards)
+                # than the pre-staging estimate taken from the old state.
+                warm_new = int(
+                    getattr(engine, "warmup_buffer_bytes", lambda *a: 0)(
+                        new_state
+                    )
+                )
+                if (
+                    budget is not None
+                    and have + got + max(warm, warm_new) > budget
+                ):
+                    raise HbmBudgetExceeded(
+                        f"staged bundle is {got} bytes/shard; with the "
+                        f"active bundle's {have} bytes + {max(warm, warm_new)} "
+                        "bytes of warmup request buffers that exceeds the "
+                        f"{budget}-byte HBM budget"
+                    )
                 # Pre-compile the new parameter shapes for every bucket so
                 # the flip pays zero compile latency on live traffic. The
                 # compile delta bumps the engine's warmup baseline at

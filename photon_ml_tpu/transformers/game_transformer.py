@@ -200,6 +200,12 @@ def _entity_sharded_mesh(matrix):
     return leading_axis_mesh(matrix, require_divisible=True)
 
 
+# Dense batches up to this many rows score sharded matrices through the psum
+# broadcast-gather; beyond it (dataset-scale scoring) the replicated (N, D)
+# gathered block would cost more HBM than the ring rotation it avoids.
+_BCAST_SCORING_MAX_ROWS = 4096
+
+
 def dense_margins(features: Array, w: Array, norm) -> Array:
     """Row-stable dense margins: multiply-broadcast + per-row reduction
     instead of the matvec `features @ w`. The matvec's CPU/TPU lowering picks
@@ -243,11 +249,23 @@ def coordinate_margins(
         from photon_ml_tpu.ops.normalization import PerEntityNormalization
 
         if mesh is not None and not isinstance(spec.norm, PerEntityNormalization):
-            # Mesh-trained row-sharded matrix: score through the ring gather
-            # so the full (E+1, D) matrix is never replicated on one device
-            # (the whole point of the entity-sharded store).
-            from photon_ml_tpu.game.model import random_effect_margins_sharded
+            # Mesh-trained row-sharded matrix: the full (E+1, D) matrix is
+            # never replicated on one device (the whole point of the
+            # entity-sharded store). Dense small batches take the psum
+            # broadcast-gather (one collective of N*D floats — the serving
+            # engine's dispatch, bitwise-equal to the replicated branch);
+            # sparse or dataset-scale sample axes keep the ring, whose wire
+            # cost is independent of N.
+            from photon_ml_tpu.game.model import (
+                random_effect_margins_bcast,
+                random_effect_margins_sharded,
+            )
 
+            dense = isinstance(prepared.features, (jax.Array, np.ndarray))
+            if dense and prepared.entity_rows.shape[0] <= _BCAST_SCORING_MAX_ROWS:
+                return random_effect_margins_bcast(
+                    prepared.features, prepared.entity_rows, matrix, spec.norm, mesh
+                )
             return random_effect_margins_sharded(
                 prepared.features, prepared.entity_rows, matrix, spec.norm, mesh
             )

@@ -52,6 +52,12 @@ def pytest_configure(config):
         "perf: perf-regression guards (engagement + non-dominance contracts "
         "on bench-like shapes); the heavy ones are also slow-marked",
     )
+    config.addinivalue_line(
+        "markers",
+        "multihost: OS-process jax.distributed dryruns (coordinator + "
+        "workers over virtual CPU devices); always slow-marked — tier-1 "
+        "covers the sharded code paths on the single-process 8-device mesh",
+    )
     _assert_fault_sites_registered()
 
 
@@ -113,7 +119,11 @@ def _failure_domain_hygiene(monkeypatch):
       and would make later tests' upload behavior order-dependent;
     * no `photon-serving-flush` thread outlives the test — a MicroBatcher's
       flush thread must be joined by engine/batcher close(); a survivor
-      means serving work kept running against a torn-down fixture.
+      means serving work kept running against a torn-down fixture;
+    * no `photon-serving-promote` thread outlives the test — a two-tier
+      store's promotion worker is short-lived and joined by
+      store.close()/bundle.release(); a survivor means promotions kept
+      mutating a torn-down store.
     """
     from photon_ml_tpu.utils import faults
 
@@ -136,7 +146,13 @@ def _failure_domain_hygiene(monkeypatch):
         leaked = [
             t
             for t in threading.enumerate()
-            if t.name.startswith(("photon-async-upload", "photon-serving-flush"))
+            if t.name.startswith(
+                (
+                    "photon-async-upload",
+                    "photon-serving-flush",
+                    "photon-serving-promote",
+                )
+            )
             and t.is_alive()
         ]
         if not leaked:

@@ -1,0 +1,360 @@
+"""Pod-scale serving: two-tier entity store + entity-sharded bundles.
+
+The load-bearing contract is unchanged from PR 4: every score must be
+BITWISE-identical to the single-tier replicated path, whatever storage mode
+the bundle stages — hot-tier hit, cold-tier override row, entity-sharded
+psum gather, or the pinned zero-row miss. On top of that the two-tier store
+must promote asynchronously, evict under a tiny hot-set budget without ever
+changing an answer, and the HBM budget accounting must charge the hot tier
+plus warmup buffers per shard.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.game.model import (
+    Coefficients,
+    FixedEffectModel,
+    GameModel,
+    RandomEffectModel,
+)
+from photon_ml_tpu.serving import (
+    HbmBudgetExceeded,
+    ScoreRequest,
+    ServingBundle,
+    ServingEngine,
+    TwoTierEntityStore,
+)
+from photon_ml_tpu.transformers.game_transformer import (
+    CoordinateScoringSpec,
+    GameTransformer,
+)
+from photon_ml_tpu.types import TaskType
+
+pytestmark = pytest.mark.serving
+
+TASK = TaskType.LOGISTIC_REGRESSION
+D_FE, D_RE, E = 7, 5, 24
+
+
+def _fixture(rng, n=16):
+    """(model, specs, requests, dataset): FE + RE coordinates; the request
+    stream mixes repeated hot entities, one-shot cold entities, and
+    unknowns."""
+    w = rng.normal(size=D_FE).astype(np.float32)
+    M = np.zeros((E + 1, D_RE), np.float32)
+    M[:E] = rng.normal(size=(E, D_RE))
+    model = GameModel(
+        {
+            "fixed": FixedEffectModel(Coefficients(jnp.asarray(w)), TASK),
+            "per-e": RandomEffectModel(jnp.asarray(M), None, TASK),
+        }
+    )
+    specs = {
+        "fixed": CoordinateScoringSpec(shard="g"),
+        "per-e": CoordinateScoringSpec(
+            shard="re",
+            random_effect_type="eid",
+            entity_index={str(i): i for i in range(E)},
+        ),
+    }
+    X = rng.normal(size=(n, D_FE)).astype(np.float32)
+    Xe = rng.normal(size=(n, D_RE)).astype(np.float32)
+    # hot (preloaded prefix), cold (tail rows), unknown — all in one batch:
+    # even ids 0..E-1 are trained entities (low ones preloaded hot), even
+    # values >= E resolve to nothing (zero-row cold starts).
+    ids = [str((2 * i) % (E + 6)) for i in range(n)]
+    offsets = rng.normal(size=n).astype(np.float32)
+    reqs = [
+        ScoreRequest(
+            features={"g": X[i], "re": Xe[i]},
+            entity_ids={"eid": ids[i]},
+            offset=float(offsets[i]),
+            uid=str(i),
+        )
+        for i in range(n)
+    ]
+    from photon_ml_tpu.data.game_dataset import GameDataset
+
+    ds = GameDataset.build(
+        {"g": X, "re": Xe},
+        np.zeros(n, np.float32),
+        offsets=offsets,
+        id_tags={"eid": np.asarray(ids)},
+    )
+    return model, specs, reqs, ds
+
+
+def _scores(results):
+    return np.asarray([r.score for r in results], np.float64)
+
+
+def _ref_scores(model, specs, reqs):
+    with ServingEngine(
+        ServingBundle.from_model(model, specs, TASK), max_batch=16
+    ) as eng:
+        return _scores(eng.score_batch(reqs))
+
+
+class TestTwoTierStore:
+    def test_mixed_hot_cold_unknown_bitwise(self, rng):
+        """One batch mixing hot-tier hits, cold-tier override rows and
+        unknown entities scores bitwise-equal to the single-tier path AND
+        to the offline transformer."""
+        model, specs, reqs, ds = _fixture(rng)
+        ref = _ref_scores(model, specs, reqs)
+        offline = np.asarray(
+            GameTransformer(model, specs, TASK).transform(ds).scores,
+            np.float64,
+        )
+        bundle = ServingBundle.from_model(model, specs, TASK, hot_rows=6)
+        with ServingEngine(bundle, max_batch=16) as eng:
+            got = _scores(eng.score_batch(reqs))
+            m = eng.metrics()
+        assert np.array_equal(got, ref)
+        assert np.array_equal(got, offline)
+        assert m["cold_tier_hits"] > 0 and m["hot_tier_hits"] > 0
+        # Unknown entities are COLD STARTS (zero row), not cold-tier hits.
+        assert m["cold_start_lookups"] > 0
+
+    def test_promotion_moves_cold_rows_hot(self, rng):
+        model, specs, reqs, _ = _fixture(rng)
+        ref = _ref_scores(model, specs, reqs)
+        bundle = ServingBundle.from_model(model, specs, TASK, hot_rows=8)
+        store = bundle.coordinates["per-e"].store
+        with ServingEngine(bundle, max_batch=16) as eng:
+            s1 = _scores(eng.score_batch(reqs))
+            store.drain()
+            s2 = _scores(eng.score_batch(reqs))
+            store.drain()  # pass 2's own cold hits re-queue (LRU thrash)
+            m = eng.metrics()
+        assert np.array_equal(s1, ref) and np.array_equal(s2, ref)
+        assert m["promotions"] > 0
+        sm = store.metrics()
+        assert sm["pending_promotions"] == 0
+        # Promoted rows really moved tiers: the promoted entities resolve
+        # hot on the second pass (hot hits grew across passes).
+        assert sm["hot_tier_hits"] > 0
+
+    def test_eviction_under_tiny_budget_never_changes_answers(self, rng):
+        """hot_rows=2: every distinct entity beyond two forces an LRU
+        eviction; answers stay bitwise-correct throughout."""
+        model, specs, reqs, _ = _fixture(rng)
+        ref = _ref_scores(model, specs, reqs)
+        bundle = ServingBundle.from_model(model, specs, TASK, hot_rows=2)
+        store = bundle.coordinates["per-e"].store
+        with ServingEngine(bundle, max_batch=16) as eng:
+            for _ in range(3):
+                got = _scores(eng.score_batch(reqs))
+                assert np.array_equal(got, ref)
+                store.drain()
+            m = eng.metrics()
+        assert m["evictions"] > 0
+        assert store.capacity == 2
+        assert len(store._slot_of_row) <= 2
+
+    def test_zero_capacity_serves_everything_from_cold_tier(self, rng):
+        model, specs, reqs, _ = _fixture(rng)
+        ref = _ref_scores(model, specs, reqs)
+        bundle = ServingBundle.from_model(model, specs, TASK, hot_rows=0)
+        with ServingEngine(bundle, max_batch=16) as eng:
+            got = _scores(eng.score_batch(reqs))
+            m = eng.metrics()
+        assert np.array_equal(got, ref)
+        assert m["hot_tier_hits"] == 0 and m["promotions"] == 0
+        assert m["sharding"]["hot_set_fraction"] == 0.0
+
+    def test_unknown_entity_is_zero_row_fallback(self, rng):
+        """The final miss tier: ids in neither tier score FE-only."""
+        model, specs, _, _ = _fixture(rng)
+        n = 4
+        X = rng.normal(size=(n, D_FE)).astype(np.float32)
+        Xe = rng.normal(size=(n, D_RE)).astype(np.float32)
+        reqs = [
+            ScoreRequest(
+                features={"g": X[i], "re": Xe[i]},
+                entity_ids={"eid": f"nope-{i}"},
+            )
+            for i in range(n)
+        ]
+        ref = _ref_scores(model, specs, reqs)
+        bundle = ServingBundle.from_model(model, specs, TASK, hot_rows=4)
+        with ServingEngine(bundle, max_batch=8) as eng:
+            res = eng.score_batch(reqs)
+        assert all(r.cold_start for r in res)
+        assert np.array_equal(_scores(res), ref)
+
+    def test_store_unit_lru_and_snapshot_consistency(self):
+        cold = np.arange(12, dtype=np.float32).reshape(6, 2)
+        cold[5] = 0.0  # pinned zero row
+        store = TwoTierEntityStore(cold, hot_rows=2)
+        try:
+            # rows 0,1 preloaded hot; 3 is a cold hit with override row.
+            slots, ovr, flags, snap = store.lookup(
+                np.asarray([0, 3, 5]), bucket=4
+            )
+            assert slots[0] == 0 and not flags[0]
+            assert flags[1] and np.array_equal(ovr[1], cold[3])
+            assert slots[2] == store.zero_slot and not flags[2]
+            got = np.asarray(snap)[slots]
+            got = np.where(flags[:, None], ovr, got)
+            assert np.array_equal(got, cold[[0, 3, 5, 5]])
+            store.drain()
+            # 3 promoted, evicting the LRU slot (row 1: never touched).
+            slots2, _, flags2, snap2 = store.lookup(
+                np.asarray([3]), bucket=1
+            )
+            assert not flags2[0]
+            assert np.array_equal(np.asarray(snap2)[slots2[0]], cold[3])
+            assert 1 not in store._slot_of_row
+        finally:
+            store.close()
+
+    def test_released_bundle_closes_store(self, rng):
+        model, specs, reqs, _ = _fixture(rng)
+        bundle = ServingBundle.from_model(model, specs, TASK, hot_rows=4)
+        store = bundle.coordinates["per-e"].store
+        with ServingEngine(bundle, max_batch=16) as eng:
+            eng.score_batch(reqs)
+        bundle.release()
+        assert store._closed
+        # conftest's leak check asserts no photon-serving-promote survivor.
+
+
+class TestNormalizedParity:
+    def test_norm_with_shifts_stays_bitwise_across_storage_modes(self, rng):
+        """A shifted+scaled normalization must not break bitwise parity:
+        every margin path reduces the shift ROW-WISE (batch-invariant), so
+        the (E+1, D) matrix-folded replicated path and the (N, D)
+        gathered two-tier/sharded paths agree to the last bit."""
+        from photon_ml_tpu.ops.normalization import NormalizationContext
+        from photon_ml_tpu.parallel.mesh import make_mesh
+
+        model, specs, reqs, _ = _fixture(rng)
+        norm = NormalizationContext(
+            factors=jnp.asarray(
+                rng.uniform(0.5, 2.0, size=D_RE).astype(np.float32)
+            ),
+            shifts=jnp.asarray(rng.normal(size=D_RE).astype(np.float32)),
+        )
+        specs = dict(specs)
+        specs["per-e"] = CoordinateScoringSpec(
+            shard="re",
+            norm=norm,
+            random_effect_type="eid",
+            entity_index={str(i): i for i in range(E)},
+        )
+        ref = _ref_scores(model, specs, reqs)
+        for kw in ({"hot_rows": 6}, {"mesh": make_mesh()}):
+            bundle = ServingBundle.from_model(model, specs, TASK, **kw)
+            with ServingEngine(bundle, max_batch=16) as eng:
+                got = _scores(eng.score_batch(reqs))
+            assert np.array_equal(got, ref), kw
+
+
+class TestEntityShardedServing:
+    def test_sharded_bundle_bitwise_and_sharding_metrics(self, rng):
+        from photon_ml_tpu.parallel.mesh import make_mesh
+
+        model, specs, reqs, _ = _fixture(rng)
+        ref = _ref_scores(model, specs, reqs)
+        mesh = make_mesh()
+        bundle = ServingBundle.from_model(model, specs, TASK, mesh=mesh)
+        c = bundle.coordinates["per-e"]
+        assert c.mesh is mesh and c.logical_rows == E + 1
+        assert c.unseen_row == E  # the LOGICAL pinned row, not a pad row
+        shard_bytes = [s.data.nbytes for s in c.params.addressable_shards]
+        assert len(shard_bytes) == mesh.devices.size
+        assert max(shard_bytes) <= c.params.nbytes // mesh.devices.size
+        with ServingEngine(bundle, max_batch=16) as eng:
+            eng.warmup()
+            got = _scores(eng.score_batch(reqs))
+            m = eng.metrics()
+            assert eng.recompiles_after_warmup == 0
+        assert np.array_equal(got, ref)
+        assert m["sharding"]["entity_sharded"] is True
+        assert m["sharding"]["axis_size"] == mesh.devices.size
+        assert m["sharding"]["all_to_all_bytes_per_batch"] > 0
+
+    def test_mesh_trained_model_adopts_sharding(self, rng):
+        """A row-sharded trained matrix stages sharded with NO mesh
+        argument: training's sharding decision flows into serving."""
+        from photon_ml_tpu.parallel.mesh import make_mesh, matrix_row_sharding
+
+        model, specs, reqs, _ = _fixture(rng)
+        ref = _ref_scores(model, specs, reqs)
+        mesh = make_mesh()
+        M = np.asarray(model["per-e"].coefficients_matrix)
+        padded = np.zeros((-(-(E + 1) // 8) * 8, D_RE), np.float32)
+        padded[: E + 1] = M
+        sharded_m = RandomEffectModel(
+            jax.device_put(jnp.asarray(padded), matrix_row_sharding(mesh)),
+            None,
+            TASK,
+            n_entities=E,
+        )
+        bundle = ServingBundle.from_model(
+            GameModel({"fixed": model["fixed"], "per-e": sharded_m}),
+            specs,
+            TASK,
+        )
+        assert bundle.coordinates["per-e"].mesh is not None
+        with ServingEngine(bundle, max_batch=16) as eng:
+            got = _scores(eng.score_batch(reqs))
+        assert np.array_equal(got, ref)
+
+
+class TestBudgetAccounting:
+    def test_device_bytes_per_shard_divides_sharded_state(self, rng):
+        from photon_ml_tpu.parallel.mesh import make_mesh
+
+        model, specs, _, _ = _fixture(rng)
+        mesh = make_mesh()
+        repl = ServingBundle.from_model(model, specs, TASK)
+        sh = ServingBundle.from_model(model, specs, TASK, mesh=mesh)
+        tt = ServingBundle.from_model(model, specs, TASK, hot_rows=4)
+        # Sharded: the RE matrix divides by the mesh; FE vector replicated.
+        fe_bytes = D_FE * 4
+        assert sh.device_bytes_per_shard() < repl.device_bytes_per_shard()
+        assert sh.device_bytes_per_shard() >= fe_bytes
+        # Two-tier: only the hot set counts against device budgets.
+        assert tt.device_bytes() == fe_bytes + (4 + 1) * D_RE * 4
+
+    def test_swap_budget_counts_hot_tier_and_warmup_buffers(self, rng):
+        """The swap's HBM check must include the staged bundle's hot tier
+        AND the per-bucket warmup request buffers — a budget that fits the
+        matrices alone but not the buffers must refuse before staging."""
+        model, specs, reqs, _ = _fixture(rng)
+        bundle = ServingBundle.from_model(model, specs, TASK, hot_rows=4)
+        with ServingEngine(bundle, max_batch=16) as eng:
+            eng.score_batch(reqs)
+            warm = eng.warmup_buffer_bytes()
+            assert warm > 0
+            have = bundle.device_bytes_per_shard()
+            next_builder_calls = [0]
+
+            def builder():
+                next_builder_calls[0] += 1
+                return ServingBundle.from_model(
+                    model, specs, TASK, hot_rows=4
+                )
+
+            # Budget covers both generations but NOT the warmup buffers.
+            budget = 2 * have + warm // 2
+            with pytest.raises(HbmBudgetExceeded, match="warmup"):
+                eng.bundle_manager.swap(
+                    builder, expected_bytes=have, hbm_budget_bytes=budget
+                )
+            assert next_builder_calls[0] == 0  # refused BEFORE staging
+            # With the buffers accounted, the same swap fits and commits.
+            info = eng.bundle_manager.swap(
+                builder,
+                expected_bytes=have,
+                hbm_budget_bytes=2 * have + warm + 1024,
+            )
+            assert info["version"] == 1
+            assert next_builder_calls[0] == 1
