@@ -180,7 +180,9 @@ def _multichip_child() -> None:
     task = TaskType.LOGISTIC_REGRESSION
     mesh = make_mesh()
     ndev = int(mesh.devices.size)
-    budget = int(os.environ.get("PHOTON_BENCH_VDEV_BUDGET", str(1 << 20)))
+    from photon_ml_tpu.utils.knobs import get_knob
+
+    budget = int(get_knob("PHOTON_BENCH_VDEV_BUDGET"))
     d_re = 8
     # Matrix rows chosen so the full f32 matrix EXCEEDS the per-device
     # budget while one shard stays well under it.
@@ -782,22 +784,9 @@ def _child() -> None:
                 f"multichip child produced no JSON: {out_mc.stderr[-1500:]}"
             )
         mc = json.loads(line_mc)
-        required_mc = (
-            "n_devices",
-            "budget_bytes_per_device",
-            "re_matrix_bytes",
-            "max_shard_bytes",
-            "per_batch_wall_ms",
-            "collective_bytes_per_batch",
-            "collective_bytes_per_sweep",
-            "sharding",
-            "serving_sharding",
-            "serve_bitwise_vs_replicated",
-            "overlap_train_max_rel_dw",
-            "overlap_serve_sharded_bitwise",
-            "overlap_serve_two_tier_bitwise",
-        )
-        missing_mc = [k for k in required_mc if mc.get(k) is None]
+        from photon_ml_tpu.utils.contracts import MULTICHIP_SECTION_KEYS
+
+        missing_mc = [k for k in MULTICHIP_SECTION_KEYS if mc.get(k) is None]
         if missing_mc:
             raise RuntimeError(
                 f"multichip section is missing keys {missing_mc} — the "
@@ -923,15 +912,13 @@ def _child() -> None:
         with engine_srv, engine_srv.batcher(max_wait_ms=1.0) as batcher_srv:
             batcher_srv.score_all(reqs_srv)
             m_srv_metrics = batcher_srv.metrics()
-        required_srv = (
-            "p50_ms",
-            "p99_ms",
-            "qps",
-            "cold_start_fraction",
-            "recompiles_after_warmup",
+        from photon_ml_tpu.utils.contracts import (
+            SERVING_METRIC_KEYS,
+            SERVING_SHARDING_KEYS,
         )
+
         missing_srv = [
-            k for k in required_srv if m_srv_metrics.get(k) is None
+            k for k in SERVING_METRIC_KEYS if m_srv_metrics.get(k) is None
         ]
         # Sharding-decision contract (ISSUE 7): the summary must carry the
         # axis size / rows-per-shard / hot-set-fraction / collective-bytes
@@ -940,13 +927,7 @@ def _child() -> None:
         sharding_srv = m_srv_metrics.get("sharding") or {}
         missing_srv += [
             f"sharding.{k}"
-            for k in (
-                "entity_sharded",
-                "axis_size",
-                "rows_per_shard",
-                "hot_set_fraction",
-                "all_to_all_bytes_per_batch",
-            )
+            for k in SERVING_SHARDING_KEYS
             if sharding_srv.get(k) is None
         ]
         if missing_srv:
@@ -972,13 +953,12 @@ def _child() -> None:
         # Clean-run zero contract (ISSUE 5): an un-faulted, un-overloaded
         # replay must shed nothing, miss no deadline, never open the
         # circuit, and quarantine no Avro block.
-        clean_zero = {
-            "shed": m_srv_metrics["shed"],
-            "deadline_missed": m_srv_metrics["deadline_missed"],
-            "circuit_opens": m_srv_metrics["circuit_opens"],
-            "fe_only_answers": m_srv_metrics["fe_only_answers"],
-            "quarantined_blocks": _sfaults.COUNTERS.get("quarantined_blocks"),
-        }
+        from photon_ml_tpu.utils.contracts import SERVING_CLEAN_ZERO_KEYS
+
+        clean_zero = {k: m_srv_metrics[k] for k in SERVING_CLEAN_ZERO_KEYS}
+        clean_zero["quarantined_blocks"] = _sfaults.COUNTERS.get(
+            "quarantined_blocks"
+        )
         dirty = {k: v for k, v in clean_zero.items() if v}
         if dirty:
             raise RuntimeError(
@@ -1074,7 +1054,11 @@ def _child() -> None:
                         i += n_submitters
 
                 threads_ol = [
-                    _ol_threading.Thread(target=_offer, args=(s,))
+                    _ol_threading.Thread(
+                        target=_offer,
+                        args=(s,),
+                        name=f"photon-bench-overload-{s}",
+                    )
                     for s in range(n_submitters)
                 ]
                 for t in threads_ol:
@@ -1199,7 +1183,11 @@ def _child() -> None:
 
         t_swap0 = time.perf_counter()
         with eng_hs, eng_hs.batcher(max_wait_ms=1.0) as b_hs:
-            th = _threading.Thread(target=_traffic, args=(b_hs,))
+            th = _threading.Thread(
+                target=_traffic,
+                args=(b_hs,),
+                name="photon-bench-hotswap-traffic",
+            )
             th.start()
             time.sleep(0.1)  # traffic flowing against version 0
             info_hs = eng_hs.bundle_manager.swap(
@@ -1374,7 +1362,9 @@ def _child() -> None:
     # (PHOTON_BENCH_E2E_ROWS overrides; the CPU fallback uses 100k).
     e2e = {}
     try:
-        e2e_rows = int(os.environ.get("PHOTON_BENCH_E2E_ROWS", "20000000"))
+        from photon_ml_tpu.utils.knobs import get_knob as _get_knob
+
+        e2e_rows = int(_get_knob("PHOTON_BENCH_E2E_ROWS"))
         elapsed_so_far = time.perf_counter() - t_start
         if elapsed_so_far > 1100:
             raise RuntimeError(f"bench already at {elapsed_so_far:.0f}s")
@@ -1502,23 +1492,16 @@ def _child() -> None:
             # trajectory needs it to attribute the host wall, so a missing
             # stage key is a BENCH BUG and must fail the e2e section loudly,
             # not ship an artifact that silently lost its breakdown.
-            from photon_ml_tpu.estimators.game_estimator import PREPARE_STAGES
+            # The full schema (stages + residual + pack placement split
+            # (r06) + the entity-sharding decision (r07)) lives in
+            # utils/contracts.py — one source of truth, drift-checked.
+            from photon_ml_tpu.utils.contracts import (
+                FIT_TIMING_REQUIRED_KEYS,
+                PREPARE_STAGES,
+            )
 
             missing_stages = [
-                k
-                for k in (
-                    *PREPARE_STAGES,
-                    "other",
-                    # Pack placement split (r06): device-vs-host walls and
-                    # the chosen implementation path, same loud contract.
-                    "pack_device_s",
-                    "pack_host_s",
-                    "pack_path",
-                    # Entity-sharding decision (r07): axis size, rows per
-                    # shard, collective bytes — same loud contract.
-                    "sharding",
-                )
-                if k not in fit_timing
+                k for k in FIT_TIMING_REQUIRED_KEYS if k not in fit_timing
             ]
             if missing_stages:
                 raise RuntimeError(

@@ -1,0 +1,51 @@
+"""photon-lint: self-hosted static analysis for photon-ml-tpu.
+
+Six AST-based checks, each machine-checking an invariant the repo
+previously held only by convention (and has shipped bugs against):
+
+* knob-registry       — PHOTON_* env reads go through utils/knobs.py,
+                        and the registry matches the README knob table
+* fault-site-sync     — fault_point() plants == SITE_DESCRIPTIONS, both
+                        directions, sites literal
+* jit-purity          — no host impurity inside jit/pjit/scan/shard_map
+                        bodies (or one same-module call deep)
+* thread-lifecycle    — threads are named and joinable in their scope
+* donation-aliasing   — donated buffers are never re-read after the
+                        donating call
+* contract-key-drift  — required-key schemas are imported from
+                        utils/contracts.py, never re-typed
+
+Run `python -m photon_ml_tpu.analysis` (`--list-checks`, `--check
+<name>`, paths for fixture corpora); zero findings on the live tree is a
+tier-1 gate (tests/test_analysis.py). Suppress a finding with
+`# photon-lint: disable=<check> — <reason>`; an empty reason is itself a
+finding.
+"""
+
+from photon_ml_tpu.analysis.core import (  # noqa: F401
+    CHECKS,
+    Context,
+    Finding,
+    discover,
+    load_paths,
+    run_checks,
+)
+
+# Importing a check module registers it.
+from photon_ml_tpu.analysis import (  # noqa: F401  isort: skip
+    contract_key_drift,
+    donation_aliasing,
+    fault_site_sync,
+    jit_purity,
+    knob_registry,
+    thread_lifecycle,
+)
+
+__all__ = [
+    "CHECKS",
+    "Context",
+    "Finding",
+    "discover",
+    "load_paths",
+    "run_checks",
+]

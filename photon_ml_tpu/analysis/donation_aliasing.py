@@ -1,0 +1,198 @@
+"""donation-aliasing: donated buffers are dead after the donating call.
+
+The bug class (PR 4): `donate_argnums` hands a buffer's device memory to
+XLA for reuse — reading the donated array afterwards returns garbage (or
+raises "buffer was donated", backend-depending). The serving engine
+already had one shape of this: request buffers shipped as a shared
+object would alias a donated buffer into a live one. On CPU donation is
+a no-op, so the bug ships silently through the test platform and fires
+on TPU.
+
+Rule: for every callable built with `donate_argnums=...` (tracked
+through the name it is assigned to, e.g. `self._jit = jax.jit(f,
+donate_argnums=(0, 1))`, and through immediately-invoked
+`jax.jit(f, donate_argnums=...)(...)` calls), any plain-name argument
+passed at a donated position must not be read again in the same function
+body after the donating call (re-binding the name first is fine).
+Donated positions are harvested as every integer literal in the
+`donate_argnums` expression — a conditional like
+`() if cpu else (0, 1)` conservatively donates {0, 1}, which is exactly
+the accelerator behavior the CPU test platform hides.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from photon_ml_tpu.analysis.core import (
+    CHECKS,
+    Context,
+    Finding,
+    dotted_name,
+    register_check,
+)
+
+NAME = "donation-aliasing"
+
+
+def _donated_positions(expr: ast.AST, scope: ast.AST) -> Set[int]:
+    """Every int literal in the donate_argnums expression; a bare Name is
+    resolved one step to its assignment within `scope`."""
+    if isinstance(expr, ast.Name):
+        for node in ast.walk(scope):
+            if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == expr.id
+                for t in node.targets
+            ):
+                expr = node.value
+                break
+    return {
+        n.value
+        for n in ast.walk(expr)
+        if isinstance(n, ast.Constant) and isinstance(n.value, int)
+        and not isinstance(n.value, bool)
+    }
+
+
+def _donating_call(node: ast.Call) -> Optional[ast.keyword]:
+    for kw in node.keywords:
+        if kw.arg == "donate_argnums":
+            return kw
+    return None
+
+
+def _after(pos_node: ast.AST, call: ast.Call) -> bool:
+    end_line = getattr(call, "end_lineno", call.lineno)
+    end_col = getattr(call, "end_col_offset", 0)
+    return pos_node.lineno > end_line or (
+        pos_node.lineno == end_line and pos_node.col_offset >= end_col
+    )
+
+
+def _check_call_site(
+    call: ast.Call,
+    donated: Set[int],
+    func_body: ast.AST,
+    rel: str,
+    target_label: str,
+) -> List[Finding]:
+    findings: List[Finding] = []
+    for pos in sorted(donated):
+        if pos >= len(call.args):
+            continue
+        arg = call.args[pos]
+        if not isinstance(arg, ast.Name):
+            continue  # inline expressions cannot be re-read
+        name = arg.id
+        # Name uses after the donating call, in order: a Store re-binds
+        # (subsequent loads are a fresh value); a Load before any Store
+        # reads freed device memory. A Store on the call's own line but
+        # lexically BEFORE it is the assignment target (`x = f(x, y)`):
+        # it binds after the call returns, so it counts as a re-bind.
+        end = (getattr(call, "end_lineno", call.lineno),
+               getattr(call, "end_col_offset", 0))
+        keyed = []
+        for n in ast.walk(func_body):
+            if not (isinstance(n, ast.Name) and n.id == name):
+                continue
+            if _after(n, call):
+                keyed.append(((n.lineno, n.col_offset, 1), n))
+            elif (
+                isinstance(n.ctx, ast.Store)
+                and n.lineno == call.lineno
+                and n.col_offset < call.col_offset
+            ):
+                # Binds when the call returns: order it at the call's end,
+                # ahead of any load at the same position.
+                keyed.append(((*end, 0), n))
+        uses = [n for _, n in sorted(keyed, key=lambda kn: kn[0])]
+        for use in uses:
+            if isinstance(use.ctx, ast.Store):
+                break
+            if isinstance(use.ctx, ast.Load):
+                findings.append(
+                    Finding(
+                        NAME,
+                        rel,
+                        use.lineno,
+                        f"{name!r} was donated (position {pos}) to "
+                        f"{target_label} on line {call.lineno} and is "
+                        "read again here — donated device buffers are "
+                        "freed for reuse; copy what you need before the "
+                        "call or re-bind the name",
+                    )
+                )
+                break
+    return findings
+
+
+@register_check(
+    NAME,
+    "arguments passed at donate_argnums positions must not be re-read "
+    "after the donating call in the same scope",
+)
+def check(ctx: Context) -> List[Finding]:
+    findings: List[Finding] = []
+    for f in ctx.in_scope(CHECKS[NAME]):
+        if "donate_argnums" not in f.text:
+            continue
+        funcs = [
+            n
+            for n in ast.walk(f.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        # Donating callables by the dotted name they are bound to,
+        # file-wide (an engine builds self._jit in __init__ and calls it
+        # in _dispatch).
+        donating: Dict[str, Set[int]] = {}
+        for node in ast.walk(f.tree):
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ):
+                kw = _donating_call(node.value)
+                if kw is None:
+                    continue
+                scope = next(
+                    (
+                        fn
+                        for fn in funcs
+                        if node.lineno >= fn.lineno
+                        and node.lineno
+                        <= getattr(fn, "end_lineno", node.lineno)
+                    ),
+                    f.tree,
+                )
+                positions = _donated_positions(kw.value, scope)
+                if not positions:
+                    continue
+                for t in node.targets:
+                    dn = dotted_name(t)
+                    if dn:
+                        donating[dn] = positions
+        for fn in funcs:
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                # Immediately-invoked: jax.jit(f, donate_argnums=...)(args)
+                if isinstance(node.func, ast.Call):
+                    kw = _donating_call(node.func)
+                    if kw is not None:
+                        findings.extend(
+                            _check_call_site(
+                                node,
+                                _donated_positions(kw.value, fn),
+                                fn,
+                                f.rel,
+                                "the jitted callable",
+                            )
+                        )
+                    continue
+                dn = dotted_name(node.func)
+                if dn in donating:
+                    findings.extend(
+                        _check_call_site(
+                            node, donating[dn], fn, f.rel, dn
+                        )
+                    )
+    return findings

@@ -1,0 +1,185 @@
+"""jit-purity: no host impurity lexically inside compiled program bodies.
+
+The bug class (Flare's thesis, PAPERS.md): whole-pipeline compilation
+only beats operator-at-a-time if nothing impure leaks into the compiled
+region. In jax the leak is silent — `os.environ` / `time.*` /
+`np.random` calls inside a traced body execute once at TRACE time and
+bake their value into the program as a constant, so the knob read or
+timestamp silently stops responding; `.item()` forces a mid-program
+device sync; `global` mutation from a traced body runs per-trace, not
+per-call. (The r07 norm-shift parity bug was this shape: host-visible
+behavior assumed per-call, actually baked per-trace.)
+
+What counts as a compiled body:
+
+* a function decorated with `@jax.jit` / `@jit` / `@pjit` /
+  `@partial(jax.jit, ...)`;
+* a function or lambda passed to `jax.jit(...)`, `pjit(...)`,
+  `shard_map(...)`, or `lax.scan(...)` (resolved when it is a plain
+  name defined in the same file);
+* everything lexically nested inside those bodies (inner `def`s run at
+  trace time too);
+* plus functions defined in the same module and called by plain name
+  from a compiled body — one call deep, which is how helpers like a
+  sweep gate get pulled into the traced region.
+
+Flagged inside those regions: `os.environ` / `os.getenv`, `time.*()`
+calls, `np.random` / `numpy.random`, `.item()`, and `global`
+statements.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from photon_ml_tpu.analysis.core import (
+    CHECKS,
+    Context,
+    Finding,
+    SourceFile,
+    dotted_name,
+    register_check,
+    terminal_name,
+)
+
+NAME = "jit-purity"
+
+_JIT_NAMES = {"jit", "pjit"}
+_WRAP_NAMES = {"jit", "pjit", "shard_map"}
+
+
+def _is_jit_decorator(dec: ast.AST) -> bool:
+    t = terminal_name(dec)
+    if t in _JIT_NAMES:
+        return True
+    if isinstance(dec, ast.Call):
+        t = terminal_name(dec.func)
+        if t in _JIT_NAMES:
+            return True
+        if t == "partial" and dec.args:
+            return terminal_name(dec.args[0]) in _JIT_NAMES
+    return False
+
+
+def _wrapped_arg(call: ast.Call) -> Optional[ast.AST]:
+    """The function argument of jit/pjit/shard_map/lax.scan call nodes."""
+    t = terminal_name(call.func)
+    if t in _WRAP_NAMES and call.args:
+        return call.args[0]
+    if t == "scan" and call.args:
+        dn = dotted_name(call.func) or ""
+        if dn.endswith("lax.scan") or dn == "scan":
+            return call.args[0]
+    return None
+
+
+_IMPURE_DOTTED = {
+    "os.environ": "reads os.environ (baked in as a trace-time constant)",
+    "np.random": "uses np.random (host RNG state, fixed at trace time)",
+    "numpy.random": "uses numpy.random (host RNG state, fixed at trace time)",
+}
+
+
+def _impurities(body: ast.AST) -> List[ast.AST]:
+    """Impure nodes lexically inside `body` (inner defs included)."""
+    out = []
+    for node in ast.walk(body):
+        if isinstance(node, ast.Attribute):
+            # Exact chains only: `os.environ.get` also contains an inner
+            # `os.environ` attribute node, which is the one reported.
+            dn = dotted_name(node)
+            if dn in _IMPURE_DOTTED:
+                out.append((node, _IMPURE_DOTTED[dn]))
+            elif dn == "os.getenv":
+                out.append(
+                    (node, "reads the environment via os.getenv (baked in "
+                     "as a trace-time constant)")
+                )
+        elif isinstance(node, ast.Call):
+            func = node.func
+            if (
+                isinstance(func, ast.Attribute)
+                and isinstance(func.value, ast.Name)
+                and func.value.id == "time"
+            ):
+                out.append(
+                    (node, f"calls time.{func.attr}() (host clock, fixed at trace time)")
+                )
+            elif (
+                isinstance(func, ast.Attribute)
+                and func.attr == "item"
+                and not node.args
+                and not node.keywords
+            ):
+                out.append(
+                    (node, "calls .item() (forces a device sync mid-program)")
+                )
+        elif isinstance(node, ast.Global):
+            out.append(
+                (node, "declares `global` (mutation runs per-trace, not per-call)")
+            )
+    return out
+
+
+def _module_defs(f: SourceFile) -> Dict[str, ast.AST]:
+    return {
+        n.name: n
+        for n in ast.walk(f.tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+
+
+def _called_names(body: ast.AST) -> Set[str]:
+    return {
+        n.func.id
+        for n in ast.walk(body)
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Name)
+    }
+
+
+@register_check(
+    NAME,
+    "no os.environ/time.*/np.random/.item()/global mutation inside "
+    "function bodies traced by jax.jit/pjit/lax.scan/shard_map, or in "
+    "same-module helpers one call deep",
+)
+def check(ctx: Context) -> List[Finding]:
+    findings: List[Finding] = []
+    for f in ctx.in_scope(CHECKS[NAME]):
+        defs = _module_defs(f)
+        roots: List[ast.AST] = []
+        for node in ast.walk(f.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if any(_is_jit_decorator(d) for d in node.decorator_list):
+                    roots.append(node)
+            elif isinstance(node, ast.Call):
+                arg = _wrapped_arg(node)
+                if isinstance(arg, ast.Lambda):
+                    roots.append(arg)
+                elif isinstance(arg, ast.Name) and arg.id in defs:
+                    roots.append(defs[arg.id])
+        scanned: Set[int] = set()
+        regions: List[tuple] = []  # (node, via) — via labels the hop
+        for r in roots:
+            if id(r) not in scanned:
+                scanned.add(id(r))
+                regions.append((r, None))
+        for r in list(regions):
+            for name in sorted(_called_names(r[0])):
+                callee = defs.get(name)
+                if callee is not None and id(callee) not in scanned:
+                    scanned.add(id(callee))
+                    regions.append((callee, getattr(r[0], "name", "<lambda>")))
+        for body, via in regions:
+            for node, why in _impurities(body):
+                suffix = (
+                    f" — reachable one call deep from the compiled body "
+                    f"of {via!r}"
+                    if via
+                    else ""
+                )
+                findings.append(
+                    Finding(NAME, f.rel, node.lineno, why + suffix)
+                )
+    return findings

@@ -53,7 +53,6 @@ the ambient stage scope (`pack_path`, `pack_device`/`pack_host`).
 from __future__ import annotations
 
 import dataclasses
-import os
 from typing import Optional, Tuple
 
 import jax
@@ -61,6 +60,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from photon_ml_tpu.data.containers import SparseFeatures
+from photon_ml_tpu.utils.knobs import get_knob
 
 Array = jax.Array
 
@@ -404,11 +404,8 @@ def choose_layout(
     2.01x even at blowup 2.13). Level 2 always stays grouped: its rt=128
     coarse tiles would pay the very 128-row one-hot alignment avoids.
     """
-    env = os.environ.get(_LAYOUT_ENV, "").strip().lower()
-    if not env and os.environ.get("PHOTON_SPARSE_ROWALIGN", "0").lower() in (
-        "1",
-        "true",
-    ):
+    env = str(get_knob(_LAYOUT_ENV)).strip().lower()
+    if not env and get_knob("PHOTON_SPARSE_ROWALIGN"):
         env = "rowalign"
     if env in ("rowalign", "row_aligned", "aligned"):
         return True, None

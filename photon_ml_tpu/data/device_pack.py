@@ -30,18 +30,19 @@ path), =0 disables.
 from __future__ import annotations
 
 import functools
-import os
 from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from photon_ml_tpu.utils.knobs import get_knob
+
 Array = jax.Array
 
 
 def enabled() -> bool:
-    env = os.environ.get("PHOTON_DEVICE_PACK", "").strip().lower()
+    env = str(get_knob("PHOTON_DEVICE_PACK")).strip().lower()
     if env in ("0", "false", "off", "no"):
         return False
     if env in ("1", "true", "on", "yes"):

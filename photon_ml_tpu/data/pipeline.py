@@ -36,6 +36,7 @@ from concurrent.futures import Future
 from typing import Callable, Dict, Optional
 
 from photon_ml_tpu.utils import faults
+from photon_ml_tpu.utils.knobs import get_knob
 from photon_ml_tpu.utils.observability import current_stage_registry
 
 import time
@@ -47,12 +48,9 @@ def effective_host_parallelism() -> int:
     """Usable host cores: PHOTON_HOST_THREADS override, else the scheduler
     affinity mask (cgroup-aware; a 64-core box pinned to 1 core IS a
     1-core host), else os.cpu_count()."""
-    env = os.environ.get("PHOTON_HOST_THREADS", "").strip()
-    if env:
-        try:
-            return max(1, int(env))
-        except ValueError:
-            pass
+    override = int(get_knob("PHOTON_HOST_THREADS"))
+    if override >= 0:  # explicit 0 forces single-threaded, like always
+        return max(1, override)
     try:
         return max(1, len(os.sched_getaffinity(0)))
     except (AttributeError, OSError):  # pragma: no cover - non-Linux
@@ -71,7 +69,7 @@ def pipeline_enabled(override: Optional[bool] = None) -> bool:
     """
     if override is not None:
         return bool(override)
-    env = os.environ.get("PHOTON_PIPELINE", "").strip().lower()
+    env = str(get_knob("PHOTON_PIPELINE")).strip().lower()
     if env in ("0", "false", "off", "no"):
         return False
     if env in ("1", "true", "on", "yes"):
@@ -151,6 +149,10 @@ class AsyncUploader:
                 self._sem.release()
 
         self._sem.acquire()
+        # photon-lint: disable=thread-lifecycle — per-job worker whose
+        # completion is owned by the job Future (consumers block on
+        # fut.result(), the semaphore bounds concurrency, and the conftest
+        # leak guard asserts photon-async-upload threads drain per test).
         threading.Thread(
             target=_run, daemon=True, name="photon-async-upload"
         ).start()

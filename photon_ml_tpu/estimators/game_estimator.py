@@ -79,8 +79,9 @@ GameOptimizationConfiguration = Mapping[str, CoordinateOptimizationConfig]
 # bucketed pack, device uploads, program construction/compile, and the
 # residual host glue. In a synchronous run they tile `prepare_s`; in a
 # pipelined run stages record where the work happens, so overlapped stages
-# can sum past the wall they were hidden behind.
-PREPARE_STAGES = ("re_build", "projector", "stats", "pack", "upload", "compile")
+# can sum past the wall they were hidden behind. The schema itself lives
+# in utils/contracts.py (re-exported here for the existing importers).
+from photon_ml_tpu.utils.contracts import PREPARE_STAGES
 
 
 from photon_ml_tpu.optimize.config import static_config_key as _static_config_key

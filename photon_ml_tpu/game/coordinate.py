@@ -42,7 +42,6 @@ in the reference, Coordinate.scala); train/score take explicit offset vectors.
 from __future__ import annotations
 
 import dataclasses
-import os
 from functools import partial
 from typing import List, Optional, Tuple
 
@@ -61,6 +60,7 @@ from photon_ml_tpu.ops.losses import PointwiseLoss, loss_for_task
 from photon_ml_tpu.ops.normalization import NormalizationContext
 from photon_ml_tpu.optimize import problem
 from photon_ml_tpu.optimize.common import OptResult
+from photon_ml_tpu.utils.knobs import get_knob
 from photon_ml_tpu.optimize.config import CoordinateOptimizationConfig
 from photon_ml_tpu.game.model import (
     Coefficients,
@@ -102,13 +102,12 @@ def sweep_scan_enabled() -> bool:
     Flare's whole-pipeline-compilation thesis applied to the solver loop:
     at bench scale the per-sweep program count drops from O(buckets) to
     O(distinct block shapes), which is what dominates small-coordinate
-    fits on a dispatch-latency-bound (remote or contended) backend."""
-    return os.environ.get("PHOTON_SWEEP_SCAN", "").strip().lower() not in (
-        "0",
-        "false",
-        "off",
-        "no",
-    )
+    fits on a dispatch-latency-bound (remote or contended) backend.
+
+    Reads through the typed knob registry at DISPATCH-DECISION time only
+    (train_sweep's host-side gate) — never from inside a traced body, so
+    the compiled programs stay pure (analysis/jit_purity)."""
+    return bool(get_knob("PHOTON_SWEEP_SCAN"))
 
 
 

@@ -9,12 +9,12 @@ from __future__ import annotations
 
 import ctypes
 import dataclasses
-import os
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from photon_ml_tpu.native.build import load_native
+from photon_ml_tpu.utils.knobs import get_knob
 
 # Record ops (keep in sync with avro_reader.cc).
 NUM_COL, NUM_COL_P, TAG, TAG_P = 1, 2, 3, 4
@@ -386,11 +386,7 @@ def _strings(byte_ptr, offsets_ptr, n: int) -> List[str]:
 
 def _default_threads() -> int:
     """Decode worker count: PHOTON_INGEST_THREADS overrides, 0 = hw auto."""
-    v = os.environ.get("PHOTON_INGEST_THREADS", "")
-    try:
-        return max(0, int(v)) if v else 0
-    except ValueError:
-        return 0
+    return max(0, int(get_knob("PHOTON_INGEST_THREADS")))
 
 
 def decode_file_native(

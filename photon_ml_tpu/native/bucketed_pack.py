@@ -8,12 +8,12 @@ numpy path stays as the no-compiler fallback and as the semantics oracle
 from __future__ import annotations
 
 import ctypes
-import os
 from typing import Optional, Tuple
 
 import numpy as np
 
 from photon_ml_tpu.native.build import load_native
+from photon_ml_tpu.utils.knobs import get_knob
 
 _CONFIGURED = False
 
@@ -79,12 +79,9 @@ def _ptr(a: np.ndarray, ctype):
 def pack_threads() -> int:
     """Cores the pack may shard over: PHOTON_PACK_THREADS override, else
     the host's effective parallelism (cgroup-aware)."""
-    env = os.environ.get("PHOTON_PACK_THREADS", "").strip()
-    if env:
-        try:
-            return max(1, int(env))
-        except ValueError:
-            pass
+    override = int(get_knob("PHOTON_PACK_THREADS"))
+    if override >= 0:  # explicit 0 forces a single-threaded pack
+        return max(1, override)
     from photon_ml_tpu.data.pipeline import effective_host_parallelism
 
     return effective_host_parallelism()

@@ -21,6 +21,8 @@ import subprocess
 import threading
 from typing import Optional
 
+from photon_ml_tpu.utils.knobs import get_knob
+
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SOURCES = [
     "index_store.cc",
@@ -57,7 +59,7 @@ def _zlib_failure(stderr: bytes) -> bool:
 def native_library_path() -> Optional[str]:
     """Path to the compiled shared library, or None if unbuildable/disabled."""
     global _CACHED, _ATTEMPTED
-    if os.environ.get(_DISABLE_ENV, ""):
+    if get_knob(_DISABLE_ENV):
         return None
     with _LOCK:
         if _ATTEMPTED:
@@ -139,7 +141,7 @@ def load_native() -> Optional[ctypes.CDLL]:
     # The kill switch is honored per call, not just at first load: flipping
     # PHOTON_DISABLE_NATIVE at runtime disables an already-loaded handle, and
     # setting it for the first call does not permanently poison the cache.
-    if os.environ.get(_DISABLE_ENV, ""):
+    if get_knob(_DISABLE_ENV):
         return None
     with _LOCK:
         if _CDLL_TRIED:

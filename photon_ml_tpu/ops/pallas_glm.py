@@ -72,7 +72,6 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-import os
 from typing import Optional, Tuple, Union
 
 import jax
@@ -91,6 +90,7 @@ except Exception:  # pragma: no cover - exercised only without pallas-tpu
     _SMEM = None
 
 from photon_ml_tpu.ops.losses import PointwiseLoss
+from photon_ml_tpu.utils.knobs import get_knob
 
 Array = jax.Array
 
@@ -102,7 +102,7 @@ Array = jax.Array
 # default with a warning instead of making the whole package unimportable
 # for code paths that never touch the kernels.
 def _env_tile() -> int:
-    raw = os.environ.get("PHOTON_PALLAS_TILE", "1024")
+    raw = str(get_knob("PHOTON_PALLAS_TILE"))
     try:
         tile = int(raw)
         if tile < 8 or tile % 8 != 0:
@@ -166,16 +166,7 @@ _PRECISION_NAMES = {
     "default": jax.lax.Precision.DEFAULT,
     "hilo": None,  # handled by _dot_hilo_parts, not lax precision
 }
-_prec_name = os.environ.get("PHOTON_PALLAS_PRECISION", "hilo").strip().lower()
-if _prec_name not in _PRECISION_NAMES:
-    import logging
-
-    logging.getLogger(__name__).warning(
-        "PHOTON_PALLAS_PRECISION=%r: expected one of %s; using 'hilo'",
-        _prec_name,
-        sorted(_PRECISION_NAMES),
-    )
-    _prec_name = "hilo"
+_prec_name = str(get_knob("PHOTON_PALLAS_PRECISION"))
 _PREC_MODE = _prec_name
 _PRECISION = _PRECISION_NAMES[_prec_name]
 
@@ -184,7 +175,7 @@ _PRECISION = _PRECISION_NAMES[_prec_name]
 # change only affects jit programs traced afterwards — already-compiled
 # coordinates keep their baked-in path. Set the env var before building
 # coordinates (or call set_enabled first) to be sure.
-_ENABLED = not bool(os.environ.get(_DISABLE_ENV, ""))
+_ENABLED = not get_knob(_DISABLE_ENV)
 
 # Test hook: when True, `should_use` accepts non-TPU backends and the
 # objective-layer dispatch passes interpret=True, so CPU CI exercises the
@@ -413,7 +404,7 @@ def prefers_bf16_storage(features, w: Array) -> bool:
     PHOTON_DENSE_BF16X=0. Callers convert once at coordinate construction
     (game/coordinate.py) and train AND score on the converted array so
     coordinate-descent residuals stay consistent."""
-    if os.environ.get("PHOTON_DENSE_BF16X", "1").lower() in ("0", "false"):
+    if not get_knob("PHOTON_DENSE_BF16X"):
         return False
     if _PREC_MODE != "hilo":
         return False

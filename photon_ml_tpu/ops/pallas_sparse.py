@@ -101,7 +101,6 @@ either end-to-end.
 from __future__ import annotations
 
 import functools
-import os
 from typing import Optional, Tuple
 
 import jax
@@ -125,20 +124,14 @@ from photon_ml_tpu.data.bucketed import (
     _ROW_SHIFT,
 )
 from photon_ml_tpu.ops import pallas_glm
+from photon_ml_tpu.utils.knobs import get_knob
 
 Array = jax.Array
 
 # Value-carrying MXU operand precision: "hilo" (two bf16 passes ~= f32) or a
-# jax.lax.Precision name. Validated leniently like the dense kernel's knobs.
-_SPARSE_PREC = os.environ.get("PHOTON_SPARSE_PRECISION", "hilo").strip().lower()
-if _SPARSE_PREC not in ("hilo", "default", "highest"):
-    import logging
-
-    logging.getLogger(__name__).warning(
-        "PHOTON_SPARSE_PRECISION=%r: expected hilo|default|highest; using hilo",
-        _SPARSE_PREC,
-    )
-    _SPARSE_PREC = "hilo"
+# jax.lax.Precision name. The registry validates against the knob's
+# declared choices (malformed values warn and fall back to "hilo").
+_SPARSE_PREC = str(get_knob("PHOTON_SPARSE_PRECISION"))
 
 from photon_ml_tpu.data.bucketed import MAX_SP
 
@@ -597,6 +590,10 @@ def begin_pack_async(csr, n_samples: int) -> None:
             fut.set_exception(exc)
 
     csr.pack_future = fut
+    # photon-lint: disable=thread-lifecycle — one background pack per
+    # dataset shard; finish_pack() joins it via pack_future.result() (or
+    # cancels it unstarted), so completion is owned by the Future, not a
+    # thread handle.
     threading.Thread(target=_run, daemon=True, name="photon-bucketed-pack").start()
 
 

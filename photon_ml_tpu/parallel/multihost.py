@@ -26,6 +26,8 @@ import sys
 import time
 from typing import Optional
 
+from photon_ml_tpu.utils.knobs import get_knob
+
 _WORKER_FLAG = "--multihost-worker"
 
 
@@ -70,7 +72,12 @@ def _worker(coordinator: str, num_processes: int, process_id: int, devices_per_p
     s2 = NamedSharding(mesh, P(mesh.axis_names[0], None))
     s1 = NamedSharding(mesh, P(mesh.axis_names[0]))
 
-    data_dir = os.environ["PHOTON_MH_DATA"]  # written by the launcher
+    data_dir = str(get_knob("PHOTON_MH_DATA"))  # written by the launcher
+    if not data_dir:
+        raise RuntimeError(
+            "PHOTON_MH_DATA is unset — _worker must be spawned by the "
+            "multihost launcher, which writes the scratch-dir handshake"
+        )
     d = 16
 
     def densify(dataset):
@@ -218,6 +225,10 @@ def _worker(coordinator: str, num_processes: int, process_id: int, devices_per_p
     red_loc = build_random_effect_dataset(
         ds_loc, RandomEffectDataConfig("e", "re", min_bucket=4)
     )
+    # photon-lint: disable=knob-registry — save/restore of the process env
+    # around a forced-off window (the restore must reproduce the exact
+    # inherited string, including unset), not a config read; the decision
+    # readers all go through get_knob.
     prev_scan = os.environ.get("PHOTON_SWEEP_SCAN")
     os.environ["PHOTON_SWEEP_SCAN"] = "0"
     try:

@@ -43,6 +43,7 @@ import numpy as np
 logger = logging.getLogger(__name__)
 
 from photon_ml_tpu.data.index_map import IndexMap, feature_key
+from photon_ml_tpu.utils.knobs import get_knob
 from photon_ml_tpu.game.model import FixedEffectModel, GameModel, RandomEffectModel
 from photon_ml_tpu.io.model_store import GameModelArtifact
 from photon_ml_tpu.transformers.game_transformer import CoordinateScoringSpec
@@ -636,12 +637,7 @@ class ServingBundle:
 def serving_entity_mesh():
     """Env-gated serving mesh: PHOTON_SERVING_ENTITY_SHARD=1 stages RE
     matrices row-sharded over all local devices (no-op on one device)."""
-    if os.environ.get("PHOTON_SERVING_ENTITY_SHARD", "").strip().lower() not in (
-        "1",
-        "true",
-        "on",
-        "yes",
-    ):
+    if not get_knob("PHOTON_SERVING_ENTITY_SHARD"):
         return None
     if len(jax.devices()) < 2:
         logger.warning(
@@ -656,14 +652,8 @@ def serving_entity_mesh():
 
 def serving_hot_rows() -> Optional[int]:
     """Env-gated two-tier hot-set size (PHOTON_SERVING_HOT_ROWS)."""
-    raw = os.environ.get("PHOTON_SERVING_HOT_ROWS", "").strip()
-    if not raw:
-        return None
-    try:
-        return int(raw)
-    except ValueError:
-        logger.warning("ignoring malformed PHOTON_SERVING_HOT_ROWS=%r", raw)
-        return None
+    rows = int(get_knob("PHOTON_SERVING_HOT_ROWS"))
+    return rows if rows > 0 else None
 
 
 def load_bundle(

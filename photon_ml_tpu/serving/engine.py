@@ -657,13 +657,23 @@ class ServingEngine:
                 rows_per_shard = max(rows_per_shard, c.store.capacity + 1)
             elif kind == "re":
                 rows_per_shard = max(rows_per_shard, int(c.params.shape[0]))
-        return {
+        # Explicit keys (immune to schema-tuple reorders), checked against
+        # the shared schema so the producer cannot drift from what
+        # bench/serve assert on.
+        from photon_ml_tpu.utils.contracts import SERVING_SHARDING_KEYS
+
+        out = {
             "entity_sharded": sharded,
             "axis_size": axis,
             "rows_per_shard": rows_per_shard,
             "hot_set_fraction": round(hot_fraction, 6),
             "all_to_all_bytes_per_batch": wire,
         }
+        assert set(out) == set(SERVING_SHARDING_KEYS), (
+            "serving sharding block drifted from utils/contracts."
+            "SERVING_SHARDING_KEYS"
+        )
+        return out
 
     @property
     def compiles(self) -> int:

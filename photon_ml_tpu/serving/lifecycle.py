@@ -51,12 +51,12 @@ from __future__ import annotations
 import collections
 import enum
 import logging
-import os
 import threading
 import time
 from typing import Callable, Deque, Dict, List, Optional, Tuple
 
 from photon_ml_tpu.utils import faults
+from photon_ml_tpu.utils.knobs import get_knob
 
 logger = logging.getLogger(__name__)
 
@@ -386,14 +386,9 @@ def device_memory_budget_bytes() -> Optional[int]:
     when set, else the device's reported bytes_limit (TPU/GPU runtimes
     expose memory_stats; CPU does not — None means 'unknown, skip the
     check' there, matching the virtual-mesh test platform)."""
-    raw = os.environ.get("PHOTON_SERVING_HBM_BUDGET_BYTES", "").strip()
-    if raw:
-        try:
-            return int(raw)
-        except ValueError:
-            logger.warning(
-                "ignoring malformed PHOTON_SERVING_HBM_BUDGET_BYTES=%r", raw
-            )
+    budget = int(get_knob("PHOTON_SERVING_HBM_BUDGET_BYTES"))
+    if budget > 0:
+        return budget
     try:
         import jax
 

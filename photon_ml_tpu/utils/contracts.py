@@ -1,0 +1,113 @@
+"""The repo's loud-contract key schemas, in one place.
+
+Every bench section and serving summary enforces a "loud missing-key"
+contract: an artifact that silently lost a metric is a measurement bug,
+so the producer fails the run rather than ship it (bench.py, PR 1/4/7).
+Until r08 the required-key tuples were re-typed at every enforcement
+site — bench, the estimator, the serving engine, and the tests each
+carried their own copy, which is exactly how a renamed key drifts out of
+one copy and the contract silently stops checking it. These tuples are
+the single source of truth; the static analyzer's `contract-key-drift`
+check (photon_ml_tpu/analysis/) fails the build when any other file
+re-types two or more of them as literals instead of importing them.
+
+Producers build dicts from these tuples (e.g. the serving engine zips
+SERVING_SHARDING_KEYS); consumers assert against them. Key ORDER in the
+zipped producers is part of the schema — append, don't reorder.
+
+Stdlib-only on purpose: bench's child processes and the analyzer both
+import this before jax is up.
+"""
+
+from __future__ import annotations
+
+# --------------------------------------------------------------- fit timing
+# Per-stage prepare breakdown recorded by GameEstimator.fit (PR 1): the
+# stages tile prepare_s in a synchronous run; pipelined runs record where
+# the work happened.
+PREPARE_STAGES = ("re_build", "projector", "stats", "pack", "upload", "compile")
+
+# Every key a fit_timing artifact must carry: the stage breakdown plus the
+# residual, the top-level walls, the pack placement split (r06) and the
+# entity-sharding decision (r07).
+FIT_TIMING_REQUIRED_KEYS = (
+    *PREPARE_STAGES,
+    "other",
+    "prepare_s",
+    "solve_s",
+    "pack_device_s",
+    "pack_host_s",
+    "pack_path",
+    "sharding",
+)
+
+# ------------------------------------------------------------ bench sections
+# bench.py multichip section (r07): the pod-scale over-HBM certificate.
+MULTICHIP_SECTION_KEYS = (
+    "n_devices",
+    "budget_bytes_per_device",
+    "re_matrix_bytes",
+    "max_shard_bytes",
+    "per_batch_wall_ms",
+    "collective_bytes_per_batch",
+    "collective_bytes_per_sweep",
+    "sharding",
+    "serving_sharding",
+    "serve_bitwise_vs_replicated",
+    "overlap_train_max_rel_dw",
+    "overlap_serve_sharded_bitwise",
+    "overlap_serve_two_tier_bitwise",
+)
+
+# ------------------------------------------------------------------- serving
+# Latency/quality metrics a serving run must report (batcher.metrics()).
+SERVING_METRIC_KEYS = (
+    "p50_ms",
+    "p95_ms",
+    "p99_ms",
+    "qps",
+    "cold_start_fraction",
+    "recompiles_after_warmup",
+)
+
+# The sharding-decision block inside serving metrics (engine.metrics()
+# zips exactly these, in this order — all present even on a single-tier
+# replicated bundle so absence is loud).
+SERVING_SHARDING_KEYS = (
+    "entity_sharded",
+    "axis_size",
+    "rows_per_shard",
+    "hot_set_fraction",
+    "all_to_all_bytes_per_batch",
+)
+
+# Robustness events that must be ZERO on a clean (un-faulted,
+# un-overloaded) serving run — bench's clean-run zero contract (PR 5).
+SERVING_CLEAN_ZERO_KEYS = (
+    "shed",
+    "deadline_missed",
+    "circuit_opens",
+    "fe_only_answers",
+)
+
+# Top-level serving-summary.json keys written by cli/serve.py.
+SERVING_SUMMARY_KEYS = (
+    "num_requests",
+    "failed_requests",
+    "malformed_records",
+    "serving",
+    "health",
+    "robustness_counters",
+)
+
+# Every schema this module exports, for the analyzer's drift check and
+# for tests that want to iterate all contracts.
+ALL_CONTRACTS = {
+    "PREPARE_STAGES": PREPARE_STAGES,
+    "FIT_TIMING_REQUIRED_KEYS": FIT_TIMING_REQUIRED_KEYS,
+    "MULTICHIP_SECTION_KEYS": MULTICHIP_SECTION_KEYS,
+    "SERVING_METRIC_KEYS": SERVING_METRIC_KEYS,
+    "SERVING_SHARDING_KEYS": SERVING_SHARDING_KEYS,
+    "SERVING_CLEAN_ZERO_KEYS": SERVING_CLEAN_ZERO_KEYS,
+    "SERVING_SUMMARY_KEYS": SERVING_SUMMARY_KEYS,
+}

@@ -51,12 +51,13 @@ from __future__ import annotations
 
 import dataclasses
 import logging
-import os
 import threading
 import time
 import zlib
 from contextlib import contextmanager
 from typing import Callable, Dict, FrozenSet, Mapping, Optional, Tuple
+
+from photon_ml_tpu.utils.knobs import get_knob
 
 logger = logging.getLogger(__name__)
 
@@ -226,9 +227,9 @@ def active_injector() -> Optional[FaultInjector]:
     with _LOCK:
         if not _ENV_CHECKED:
             _ENV_CHECKED = True
-            spec = os.environ.get("PHOTON_FAULTS", "").strip()
+            spec = str(get_knob("PHOTON_FAULTS")).strip()
             if spec:
-                seed = int(os.environ.get("PHOTON_FAULTS_SEED", "0"))
+                seed = int(get_knob("PHOTON_FAULTS_SEED"))
                 _INJECTOR = FaultInjector(FaultPlan.parse(spec, seed=seed))
     return _INJECTOR
 
@@ -321,21 +322,10 @@ class RetryPolicy:
 
 def default_policy() -> RetryPolicy:
     """The env-tunable default (PHOTON_RETRY_* knobs, see module doc)."""
-
-    def _num(name: str, cast, fallback):
-        raw = os.environ.get(name, "").strip()
-        if not raw:
-            return fallback
-        try:
-            return cast(raw)
-        except ValueError:
-            logger.warning("ignoring malformed %s=%r", name, raw)
-            return fallback
-
     return RetryPolicy(
-        max_attempts=max(1, _num("PHOTON_RETRY_MAX_ATTEMPTS", int, 3)),
-        base_delay_s=_num("PHOTON_RETRY_BASE_DELAY_S", float, 0.05),
-        max_delay_s=_num("PHOTON_RETRY_MAX_DELAY_S", float, 2.0),
+        max_attempts=max(1, int(get_knob("PHOTON_RETRY_MAX_ATTEMPTS"))),
+        base_delay_s=float(get_knob("PHOTON_RETRY_BASE_DELAY_S")),
+        max_delay_s=float(get_knob("PHOTON_RETRY_MAX_DELAY_S")),
     )
 
 
@@ -389,12 +379,7 @@ def solve_retry_attempts() -> int:
     back to the fault-free result bitwise; a deterministic divergence
     reproduces on retry and falls through to last-good after one extra
     solve."""
-    raw = os.environ.get("PHOTON_SOLVE_RETRIES", "").strip()
-    try:
-        return max(0, int(raw)) if raw else 1
-    except ValueError:
-        logger.warning("ignoring malformed PHOTON_SOLVE_RETRIES=%r", raw)
-        return 1
+    return max(0, int(get_knob("PHOTON_SOLVE_RETRIES")))
 
 
 # ------------------------------------------------------------------ CLI

@@ -1,0 +1,385 @@
+"""Central typed registry for the PHOTON_* environment knobs.
+
+The reference stack carries its configuration through typed Scala case
+classes (photon-api GameTrainingDriver params), so a knob cannot exist
+without a declared type, default, and docstring. The TPU port grew its
+knobs one `os.environ.get` at a time — ~27 raw reads scattered across the
+data plane, kernels, solver, serving tier, and bench by r07 — exactly the
+"untracked config knobs silently rot tuning decisions" failure mode the
+Spark-ML performance study (PAPERS.md) documents. This module is the
+single choke point:
+
+* `KNOBS` — every `PHOTON_*` env var the system reads, with name, type,
+  default, and a one-line doc. Registration is closed: `get_knob` on an
+  unregistered name raises, and the static analyzer's `knob-registry`
+  check (photon_ml_tpu/analysis/) fails the build on any raw
+  `os.environ` read of a `PHOTON_*` name outside this file — so a knob
+  cannot be added without landing here, and cannot land here without
+  appearing in README's knob table (also machine-checked).
+
+* `get_knob(name)` — the one accessor. Typed parsing with *lenient*
+  validation (the kernel modules' long-standing contract): a malformed
+  value logs a warning and falls back to the default instead of making
+  the package unimportable for code paths that never touch the knob.
+  Empty/unset always means the default.
+
+* `python -m photon_ml_tpu.utils.knobs --table` — prints the README
+  markdown table from the registry (the same source of truth the
+  analyzer verifies README against), mirroring
+  `python -m photon_ml_tpu.utils.faults --list-sites`.
+
+Bool knobs parse canonically: 1/true/yes/on and 0/false/no/off
+(case-insensitive); anything else warns and reads as the default.
+Tri-state knobs (auto | on | off, e.g. PHOTON_DEVICE_PACK) stay `str`
+typed with the empty string meaning "auto" — their policy lives at the
+call site where the hardware context is.
+
+This module imports only the stdlib, so it is safe to read from
+conftest-style code that must run before jax initializes a backend.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+from typing import Dict, Optional, Tuple, Union
+
+logger = logging.getLogger(__name__)
+
+Value = Union[str, int, float, bool]
+
+_TRUE = ("1", "true", "yes", "on")
+_FALSE = ("0", "false", "no", "off")
+
+
+@dataclasses.dataclass(frozen=True)
+class Knob:
+    """One registered environment knob: its name, python type, default,
+    and the one-line doc the README table is generated from."""
+
+    name: str
+    type: type
+    default: Value
+    doc: str
+    choices: Optional[Tuple[str, ...]] = None  # str knobs: legal values
+
+    def parse(self, raw: str) -> Value:
+        """Parse an env string leniently: empty -> default; malformed ->
+        warn + default (a bad knob must never make the package
+        unimportable for code that never touches it)."""
+        raw = raw.strip()
+        if raw == "":
+            return self.default
+        if self.type is bool:
+            low = raw.lower()
+            if low in _TRUE:
+                return True
+            if low in _FALSE:
+                return False
+            logger.warning(
+                "%s=%r: expected one of %s; using default %r",
+                self.name,
+                raw,
+                "/".join((*_TRUE, *_FALSE)),
+                self.default,
+            )
+            return self.default
+        if self.type in (int, float):
+            try:
+                return self.type(raw)
+            except ValueError:
+                logger.warning(
+                    "ignoring malformed %s=%r (default %r)",
+                    self.name,
+                    raw,
+                    self.default,
+                )
+                return self.default
+        value = raw.strip().lower() if self.choices is not None else raw
+        if self.choices is not None and value not in self.choices:
+            logger.warning(
+                "%s=%r: expected one of %s; using default %r",
+                self.name,
+                raw,
+                sorted(self.choices),
+                self.default,
+            )
+            return self.default
+        return value
+
+
+KNOBS: Dict[str, Knob] = {}
+
+
+def _register(
+    name: str,
+    type_: type,
+    default: Value,
+    doc: str,
+    choices: Optional[Tuple[str, ...]] = None,
+) -> None:
+    if not name.startswith("PHOTON_"):
+        raise ValueError(f"knob {name!r} must be PHOTON_-prefixed")
+    if name in KNOBS:
+        raise ValueError(f"duplicate knob registration: {name!r}")
+    if not isinstance(default, type_):
+        raise TypeError(f"{name}: default {default!r} is not {type_.__name__}")
+    KNOBS[name] = Knob(name, type_, default, doc, choices)
+
+
+# ---------------------------------------------------------------- data plane
+_register(
+    "PHOTON_PIPELINE",
+    str,
+    "",
+    "Host data-plane overlap: 1 forces threaded decode/pack/upload overlap, "
+    "0 forces synchronous; empty = auto (on when >1 effective core).",
+    choices=("", *_TRUE, *_FALSE),
+)
+_register(
+    "PHOTON_HOST_THREADS",
+    int,
+    -1,
+    "Usable host cores for the pipeline/prepare pools; unset = auto from "
+    "the scheduler affinity mask (cgroup-aware), explicit values clamp "
+    "to >= 1 (so 0 forces single-threaded).",
+)
+_register(
+    "PHOTON_INGEST_THREADS",
+    int,
+    0,
+    "Native Avro decode worker count; 0 = hardware auto.",
+)
+_register(
+    "PHOTON_PACK_THREADS",
+    int,
+    -1,
+    "Cores the native bucketed pack may shard over; unset = effective "
+    "host parallelism, explicit values clamp to >= 1 (so 0 forces a "
+    "single-threaded pack).",
+)
+_register(
+    "PHOTON_DEVICE_PACK",
+    str,
+    "",
+    "Bucketed placement on device (one XLA program): 1 forces, 0 forces "
+    "host; empty = auto (on for tpu/gpu backends).",
+    choices=("", *_TRUE, *_FALSE),
+)
+_register(
+    "PHOTON_SPARSE_LAYOUT",
+    str,
+    "",
+    "Sparse level-1 layout: rowalign|grouped force a layout; empty/auto = "
+    "Poisson-adaptive economics per shard (data/bucketed.choose_layout).",
+    choices=("", "auto", "rowalign", "row_aligned", "aligned", "grouped", "feature", "legacy"),
+)
+_register(
+    "PHOTON_SPARSE_ROWALIGN",
+    bool,
+    False,
+    "Legacy alias: 1 == PHOTON_SPARSE_LAYOUT=rowalign (ignored when "
+    "PHOTON_SPARSE_LAYOUT is set).",
+)
+_register(
+    "PHOTON_DISABLE_NATIVE",
+    bool,
+    False,
+    "Kill switch for the native C library (Avro/libsvm/pack); honored per "
+    "call, not only at first load.",
+)
+
+# ------------------------------------------------------------------- kernels
+_register(
+    "PHOTON_DISABLE_PALLAS",
+    bool,
+    False,
+    "Kill switch for the fused Pallas objective kernels; affects programs "
+    "traced after the flip.",
+)
+_register(
+    "PHOTON_PALLAS_TILE",
+    int,
+    1024,
+    "Dense kernel row-tile height; multiple of 8, capped at the "
+    "measured-good 1024.",
+)
+_register(
+    "PHOTON_PALLAS_PRECISION",
+    str,
+    "hilo",
+    "Dense MXU operand precision: hilo (two bf16 passes ~= f32) or "
+    "highest|high|default (classic lax precisions).",
+    choices=("hilo", "highest", "high", "default"),
+)
+_register(
+    "PHOTON_SPARSE_PRECISION",
+    str,
+    "hilo",
+    "Sparse kernel MXU operand precision: hilo|default|highest.",
+    choices=("hilo", "default", "highest"),
+)
+_register(
+    "PHOTON_DENSE_BF16X",
+    bool,
+    True,
+    "Pre-scale dense f32 features into bf16-exact space so hilo runs one "
+    "bf16 MXU pass; 0 opts out.",
+)
+
+# -------------------------------------------------------------------- solver
+_register(
+    "PHOTON_SWEEP_SCAN",
+    bool,
+    True,
+    "Scan-dispatch the random-effect bucket sweep (one lax.scan program "
+    "per block shape); 0 reverts to the per-bucket dispatch loop.",
+)
+_register(
+    "PHOTON_SOLVE_RETRIES",
+    int,
+    1,
+    "Extra solve attempts the divergence guard grants a non-finite "
+    "coordinate update before keeping last-good.",
+)
+
+# ------------------------------------------------------------ failure domain
+_register(
+    "PHOTON_FAULTS",
+    str,
+    "",
+    'Deterministic fault-injection plan, e.g. "decode:1,upload:2,'
+    'solve@3,pack:p0.25" (see utils/faults.py).',
+)
+_register(
+    "PHOTON_FAULTS_SEED",
+    int,
+    0,
+    "Seed for probabilistic fault sites (site:pX) — reproducible chaos "
+    "schedules.",
+)
+_register(
+    "PHOTON_RETRY_MAX_ATTEMPTS",
+    int,
+    3,
+    "Bounded-backoff retry attempts for transient failures (min 1).",
+)
+_register(
+    "PHOTON_RETRY_BASE_DELAY_S",
+    float,
+    0.05,
+    "Retry backoff base delay in seconds (doubles per attempt).",
+)
+_register(
+    "PHOTON_RETRY_MAX_DELAY_S",
+    float,
+    2.0,
+    "Retry backoff delay cap in seconds.",
+)
+
+# ------------------------------------------------------------------- serving
+_register(
+    "PHOTON_SERVING_ENTITY_SHARD",
+    bool,
+    False,
+    "Stage serving RE matrices row-sharded over all local devices "
+    "(no-op with one device).",
+)
+_register(
+    "PHOTON_SERVING_HOT_ROWS",
+    int,
+    0,
+    "Two-tier serving store hot-set size (rows kept in HBM); 0 = "
+    "single-tier (everything resident).",
+)
+_register(
+    "PHOTON_SERVING_HBM_BUDGET_BYTES",
+    int,
+    0,
+    "HBM budget a bundle hot-swap must fit in; 0 = use the device's "
+    "reported bytes_limit (or skip the check where unknown).",
+)
+
+# ---------------------------------------------------------- multihost / test
+_register(
+    "PHOTON_MH_DATA",
+    str,
+    "",
+    "Scratch directory handshake written by the multihost dryrun launcher "
+    "for its worker processes; never set by hand.",
+)
+_register(
+    "PHOTON_TEST_PLATFORM",
+    str,
+    "cpu",
+    "Backend the test harness forces before jax init (tests/conftest.py).",
+)
+
+# --------------------------------------------------------------------- bench
+_register(
+    "PHOTON_BENCH_E2E_ROWS",
+    int,
+    20_000_000,
+    "Row count for the bench e2e_from_disk section.",
+)
+_register(
+    "PHOTON_BENCH_VDEV_BUDGET",
+    int,
+    1 << 20,
+    "Per-virtual-device byte budget for the bench multichip over-HBM "
+    "certificate.",
+)
+
+
+def get_knob(name: str, raw: Optional[str] = None) -> Value:
+    """Read knob `name` from the environment (or parse `raw` when given),
+    returning its typed value. Raises KeyError for unregistered names —
+    the registry is the closed set of knobs this system admits."""
+    knob = KNOBS.get(name)
+    if knob is None:
+        raise KeyError(
+            f"unregistered knob {name!r} — add it to "
+            f"photon_ml_tpu.utils.knobs.KNOBS (known: {len(KNOBS)} knobs)"
+        )
+    if raw is None:
+        raw = os.environ.get(name, "")
+    return knob.parse(raw)
+
+
+def readme_table() -> str:
+    """The README markdown knob table, generated from the registry (the
+    analyzer's knob-registry check requires every registered name to
+    appear in README; regenerate with `--table` after editing)."""
+    rows = ["| Knob | Type | Default | What it does |", "| --- | --- | --- | --- |"]
+    for name in sorted(KNOBS):
+        k = KNOBS[name]
+        default = "`(empty)`" if k.default == "" else f"`{k.default}`"
+        rows.append(f"| `{name}` | {k.type.__name__} | {default} | {k.doc} |")
+    return "\n".join(rows)
+
+
+def main(argv=None) -> int:
+    """`python -m photon_ml_tpu.utils.knobs --table`: print the registry
+    as the README markdown table (mirrors utils.faults --list-sites)."""
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="python -m photon_ml_tpu.utils.knobs",
+        description="Inspect the typed PHOTON_* knob registry.",
+    )
+    p.add_argument(
+        "--table",
+        action="store_true",
+        help="print the registry as the README markdown table",
+    )
+    args = p.parse_args(argv)
+    if not args.table:
+        p.print_help()
+        return 2
+    print(readme_table())
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

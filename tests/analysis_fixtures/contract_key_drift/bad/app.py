@@ -1,0 +1,7 @@
+"""Known-bad: re-types two keys of the sibling contract schema instead
+of importing the tuple — the copy a key rename will silently miss."""
+
+
+def verify(timing):
+    required = ("fixture_alpha_s", "fixture_beta_s")  # re-typed schema
+    return [k for k in required if k not in timing]

@@ -1,0 +1,4 @@
+"""Miniature contract schema module."""
+
+FIXTURE_TIMING_KEYS = ("fixture_alpha_s", "fixture_beta_s", "fixture_gamma_s")
+FIXTURE_ALL_KEYS = (*FIXTURE_TIMING_KEYS, "fixture_path")

@@ -1,0 +1,10 @@
+"""Known-good: the schema is imported; reading ONE key as a literal is
+use, not duplication."""
+
+from contracts import FIXTURE_TIMING_KEYS  # the one source of truth
+
+
+def verify(timing):
+    missing = [k for k in FIXTURE_TIMING_KEYS if k not in timing]
+    alpha = timing.get("fixture_alpha_s")  # single-key use is fine
+    return missing, alpha

@@ -1,0 +1,3 @@
+"""Miniature contract schema module."""
+
+FIXTURE_TIMING_KEYS = ("fixture_alpha_s", "fixture_beta_s", "fixture_gamma_s")

@@ -1,0 +1,20 @@
+"""Known-bad: a buffer donated to a jitted callable is read again after
+the donating call — freed device memory on accelerators (invisible on
+the CPU test platform, where donation is a no-op)."""
+
+import jax
+
+
+def kernel(buf, other):
+    return buf * 2 + other
+
+
+def run(x, y):
+    f = jax.jit(kernel, donate_argnums=(0,))
+    out = f(x, y)
+    return out + x.sum()  # x was donated: this reads freed memory
+
+
+def run_inline(x, y):
+    out = jax.jit(kernel, donate_argnums=(0,))(x, y)
+    return out, x.shape  # x was donated to the immediately-invoked jit

@@ -1,0 +1,21 @@
+"""Known-good: donated names are dead after the call — non-donated
+arguments stay readable, and re-binding a donated name first makes
+later reads a fresh value."""
+
+import jax
+
+
+def kernel(buf, other):
+    return buf * 2 + other
+
+
+def run(x, y):
+    f = jax.jit(kernel, donate_argnums=(0,))
+    out = f(x, y)
+    return out + y.sum()  # y (position 1) was not donated
+
+
+def run_rebound(x, y):
+    f = jax.jit(kernel, donate_argnums=(0,))
+    x = f(x, y)  # donated name re-bound by the result
+    return x + 1  # reads the fresh binding, not the donated buffer

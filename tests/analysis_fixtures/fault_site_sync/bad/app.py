@@ -1,0 +1,12 @@
+"""Known-bad: an unregistered site, a computed site name, and (via the
+sibling faults.py) a described-but-unplanted site."""
+
+
+def fault_point(site):
+    pass
+
+
+def run(site_var):
+    fault_point("fixture_decode")  # fine: registered and literal
+    fault_point("fixture_mystery")  # unregistered site
+    fault_point(site_var)  # computed: statically unverifiable

@@ -1,0 +1,6 @@
+"""Miniature fault registry: two described sites, one never planted."""
+
+SITE_DESCRIPTIONS = {
+    "fixture_decode": "planted by app.py",
+    "fixture_upload": "described but never planted (a finding)",
+}

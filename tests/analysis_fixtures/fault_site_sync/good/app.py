@@ -1,0 +1,11 @@
+"""Known-good: every plant is a registered literal; every described
+site is planted."""
+
+
+def fault_point(site):
+    pass
+
+
+def run():
+    fault_point("fixture_decode")
+    fault_point("fixture_upload")

@@ -1,0 +1,6 @@
+"""Miniature fault registry: both sites planted by app.py."""
+
+SITE_DESCRIPTIONS = {
+    "fixture_decode": "planted by app.py",
+    "fixture_upload": "planted by app.py",
+}

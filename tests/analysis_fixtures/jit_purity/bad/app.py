@@ -1,0 +1,39 @@
+"""Known-bad: every impurity class inside compiled bodies — lexically,
+in an inner scan step, and one same-module call deep."""
+
+import os
+import time
+
+import jax
+import numpy as np
+
+COUNT = 0
+
+
+def helper(y):
+    # Impure, and reachable one call deep from the compiled body of g.
+    return y * float(os.environ["PHOTON_FIXTURE_SCALE"])
+
+
+@jax.jit
+def f(x):
+    t0 = time.perf_counter()  # host clock inside a traced body
+    noise = np.random.rand()  # host RNG inside a traced body
+    peak = x.max().item()  # device sync inside a traced body
+    if os.getenv("PHOTON_FIXTURE_DEBUG"):  # env read inside a traced body
+        x = x + 1
+    return x * noise + peak + t0
+
+
+@jax.jit
+def g(x):
+    return helper(x)
+
+
+def sweep(xs):
+    def step(carry, x):
+        global COUNT  # global mutation runs per-trace, not per-call
+        COUNT += 1
+        return carry + x, x
+
+    return jax.lax.scan(step, 0.0, xs)

@@ -1,0 +1,26 @@
+"""Known-good: compiled bodies stay pure; host impurity lives outside
+the traced region (read the knob / clock BEFORE tracing, pass values in
+as arguments)."""
+
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def f(x, scale):
+    return x * scale + jnp.sum(x)
+
+
+def run(x):
+    t0 = time.perf_counter()  # host timing around the dispatch is fine
+    scale = float(os.environ.get("FIXTURE_SCALE", "1.0"))  # outside jit
+    out = f(x, scale)
+
+    def step(carry, v):
+        return carry + v, v * scale  # closes over a host VALUE, pure
+
+    total, _ = jax.lax.scan(step, 0.0, out)
+    return total, time.perf_counter() - t0

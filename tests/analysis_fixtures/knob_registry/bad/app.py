@@ -1,0 +1,19 @@
+"""Known-bad: raw PHOTON_* environment reads in every shape the check
+resolves, plus a get_knob call naming an unregistered knob."""
+
+import os
+
+_INDIRECT = "PHOTON_FIXTURE_INDIRECT"
+
+
+def get_knob(name):  # stand-in accessor so the call parses standalone
+    return None
+
+
+def configure():
+    a = os.environ.get("PHOTON_FIXTURE_TILE", "8")  # raw .get read
+    b = os.environ["PHOTON_FIXTURE_MODE"]  # raw subscript read
+    c = os.getenv("PHOTON_FIXTURE_FLAG")  # raw getenv read
+    d = os.environ.get(_INDIRECT)  # read through a module constant
+    e = get_knob("PHOTON_FIXTURE_UNREGISTERED")  # not in the registry
+    return a, b, c, d, e

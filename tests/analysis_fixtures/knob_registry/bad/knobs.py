@@ -1,0 +1,9 @@
+"""Miniature knob registry: registers one knob the sibling README.md
+does not mention (README-sync direction of the check)."""
+
+
+def _register(name, type_, default, doc):
+    pass
+
+
+_register("PHOTON_FIXTURE_TILE", int, 8, "a knob the README forgot")

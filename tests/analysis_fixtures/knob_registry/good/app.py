@@ -1,0 +1,16 @@
+"""Known-good: every PHOTON_* read goes through the typed accessor;
+env WRITES (subprocess setup) are legal; non-PHOTON env reads are out
+of this check's scope."""
+
+import os
+
+
+def get_knob(name):  # stand-in accessor so the call parses standalone
+    return 8
+
+
+def configure():
+    tile = get_knob("PHOTON_FIXTURE_TILE")
+    os.environ["PHOTON_FIXTURE_TILE"] = "16"  # write: child-process setup
+    path = os.environ.get("HOME", "/")  # non-PHOTON read: out of scope
+    return tile, path

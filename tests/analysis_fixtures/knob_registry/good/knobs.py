@@ -1,0 +1,8 @@
+"""Miniature knob registry for the known-good snippet."""
+
+
+def _register(name, type_, default, doc):
+    pass
+
+
+_register("PHOTON_FIXTURE_TILE", int, 8, "documented in the fixture README")

@@ -1,0 +1,11 @@
+"""Known-bad pragma hygiene: a reasonless disable (suppresses nothing,
+and is itself a finding) and a pragma naming an unknown check."""
+
+import threading
+
+
+def fire(fn):
+    # photon-lint: disable=thread-lifecycle
+    threading.Thread(target=fn).start()
+    # photon-lint: disable=not-a-real-check — the check name is wrong
+    threading.Thread(target=fn).start()

@@ -1,0 +1,12 @@
+"""Known-good pragma hygiene: a reasoned disable suppresses the finding
+on the line it attaches to — trailing, or as a comment line above."""
+
+import threading
+
+
+def fire(fn):
+    t = threading.Thread(target=fn)  # photon-lint: disable=thread-lifecycle — fixture: completion owned by the caller
+    t.start()
+    # photon-lint: disable=thread-lifecycle — fixture: comment-line pragma
+    # attaches past continuation comments to the next code line.
+    threading.Thread(target=fn).start()

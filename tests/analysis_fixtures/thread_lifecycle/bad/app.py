@@ -1,0 +1,23 @@
+"""Known-bad: an unnamed fire-and-forget thread (module scope) and a
+class whose thread is never joined inside the class."""
+
+import threading
+from threading import Thread
+
+
+def fire_and_forget(fn):
+    threading.Thread(target=fn).start()  # unnamed AND never joined
+
+
+def fmt(sep, parts):
+    # A variable-receiver str.join: its call shape (one non-numeric
+    # positional arg) must NOT satisfy the thread-join requirement.
+    return sep.join(parts)
+
+
+class Worker:
+    def start(self, fn):
+        # Named, but this class never joins it — its teardown story is
+        # unwritten.
+        self._t = Thread(target=fn, name="fixture-worker")
+        self._t.start()

@@ -1,0 +1,25 @@
+"""Known-good: threads are named and joined in their owning scope;
+str.join / os.path.join receivers do not count as thread joins."""
+
+import os
+import threading
+
+
+class Worker:
+    def __init__(self, fn):
+        self._t = threading.Thread(target=fn, name="fixture-worker")
+
+    def start(self):
+        self._t.start()
+
+    def close(self):
+        self._t.join(timeout=5)
+
+
+def run_once(fn):
+    t = threading.Thread(target=fn, name="fixture-once")
+    t.start()
+    label = ", ".join(["a", "b"])  # str.join: not a thread join
+    path = os.path.join("/tmp", "x")  # path join: not a thread join
+    t.join()
+    return label, path

@@ -14,7 +14,11 @@ that keeps backend init strictly on the virtual CPU mesh.
 
 import os
 
-_PLATFORM = os.environ.get("PHOTON_TEST_PLATFORM", "cpu")
+from photon_ml_tpu.utils.knobs import get_knob
+
+# Light import: utils.knobs is stdlib-only, so reading the platform knob
+# through the typed registry cannot initialize a backend early.
+_PLATFORM = str(get_knob("PHOTON_TEST_PLATFORM"))
 os.environ["JAX_PLATFORMS"] = _PLATFORM
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
@@ -62,39 +66,29 @@ def pytest_configure(config):
 
 
 def _assert_fault_sites_registered():
-    """Guard: every `fault_point("<site>")` call in the tree must name a
-    site registered in utils.faults.KNOWN_SITES — an unregistered site is
-    unreachable from PHOTON_FAULTS (plans naming it fail to parse), i.e. a
-    fault point no chaos test can ever arm."""
-    import re
+    """Guard: planted fault sites and SITE_DESCRIPTIONS must agree at
+    collection time. Promoted from a local regex to photon-lint's
+    AST-based `fault-site-sync` check (photon_ml_tpu/analysis/), which
+    also enforces the REVERSE direction — a described site nobody plants
+    is advertised chaos coverage that does not exist — and that every
+    site is a string literal."""
+    from photon_ml_tpu.analysis import run_checks
 
-    from photon_ml_tpu.utils import faults
-
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    pat = re.compile(r"fault_point\(\s*[\"']([A-Za-z0-9_]+)[\"']")
-    offenders = []
-    roots = [os.path.join(repo, "photon_ml_tpu"), os.path.join(repo, "bench.py")]
-    for root in roots:
-        files = [root] if os.path.isfile(root) else [
-            os.path.join(dirpath, fn)
-            for dirpath, _, fns in os.walk(root)
-            for fn in fns
-            if fn.endswith(".py")
-        ]
-        for path in files:
-            with open(path, encoding="utf-8") as f:
-                text = f.read()
-            for m in pat.finditer(text):
-                if m.group(1) not in faults.KNOWN_SITES:
-                    line = text.count("\n", 0, m.start()) + 1
-                    offenders.append(f"{path}:{line}: {m.group(1)!r}")
-    if offenders:
+    # Pragma-hygiene findings also ride along in any run; those belong to
+    # the tier-1 analysis gate (test_analysis.py), not this collection
+    # guard, which must fail ONLY for fault-site drift.
+    findings = [
+        f
+        for f in run_checks(checks=["fault-site-sync"])
+        if f.check == "fault-site-sync"
+    ]
+    if findings:
         import pytest as _pytest
 
         raise _pytest.UsageError(
-            "fault_point() calls with unregistered sites (add them to "
-            "photon_ml_tpu.utils.faults.KNOWN_SITES):\n  "
-            + "\n  ".join(offenders)
+            "fault-site-sync findings (run `python -m "
+            "photon_ml_tpu.analysis --check fault-site-sync`):\n  "
+            + "\n  ".join(f.render() for f in findings)
         )
 
 

@@ -125,8 +125,10 @@ class TestPipelineParity:
             )
 
     def test_fit_timing_breakdown_tiles_prepare(self):
+        from photon_ml_tpu.utils.contracts import FIT_TIMING_REQUIRED_KEYS
+
         est, _ = _fit(pipeline=False)
-        for key in (*PREPARE_STAGES, "other", "prepare_s", "solve_s"):
+        for key in FIT_TIMING_REQUIRED_KEYS:
             assert key in est.fit_timing, f"fit_timing missing {key!r}"
         total = sum(est.fit_timing[k] for k in (*PREPARE_STAGES, "other"))
         prepare_s = est.fit_timing["prepare_s"]
