@@ -1437,7 +1437,34 @@ def _child() -> None:
                 id_tag_fields=["userId", "movieId"],
             )
             ingest_s = time.perf_counter() - t0
-            _mark(f"e2e ingest {ingest_s:.1f}s ({total_mb/ingest_s:.0f} MB/s)")
+            # Ingest stage breakdown (r09 streaming data plane): the same
+            # loud missing-key contract the fit_timing stages carry — an
+            # artifact that silently lost its ingest attribution is a
+            # measurement bug, so fail the section rather than ship it.
+            from photon_ml_tpu.utils.contracts import (
+                INGEST_STAGES,
+                INGEST_TIMING_REQUIRED_KEYS,
+            )
+
+            ingest_timing = dict(getattr(ds_e, "ingest_timing", {}))
+            missing_ing = [
+                k for k in INGEST_TIMING_REQUIRED_KEYS if k not in ingest_timing
+            ]
+            if missing_ing:
+                raise RuntimeError(
+                    f"ingest_timing is missing stage keys {missing_ing} "
+                    f"(got {sorted(ingest_timing)}) — the e2e ingest "
+                    "breakdown contract is broken"
+                )
+            ingest_breakdown = {
+                k: round(float(ingest_timing[k]), 2)
+                for k in (*INGEST_STAGES, "other")
+            }
+            _mark(
+                f"e2e ingest {ingest_s:.1f}s ({total_mb/ingest_s:.0f} MB/s, "
+                f"{ingest_timing['ingest_path']}, "
+                f"streaming={ingest_timing['streaming']})"
+            )
 
             t0 = time.perf_counter()
             est = GameEstimator(
@@ -1540,12 +1567,19 @@ def _child() -> None:
                 gen_s=round(gen_s, 1),
                 ingest_s=round(ingest_s, 1),
                 ingest_mb_per_s=round(total_mb / ingest_s, 1),
+                ingest_breakdown=ingest_breakdown,
+                ingest_path=ingest_timing["ingest_path"],
+                ingest_streaming=bool(ingest_timing["streaming"]),
+                ingest_chunks=int(ingest_timing["chunks"]),
                 train_s=round(train_s, 1),
                 prepare_s=round(fit_timing["prepare_s"], 1),
                 prepare_breakdown=prepare_breakdown,
                 pack_device_s=round(fit_timing["pack_device_s"], 3),
                 pack_host_s=round(fit_timing["pack_host_s"], 2),
                 pack_path=fit_timing["pack_path"],
+                re_device_s=round(fit_timing["re_device_s"], 2),
+                re_host_s=round(fit_timing["re_host_s"], 2),
+                re_path=fit_timing["re_path"],
                 solve_s=round(fit_timing["solve_s"], 1),
                 sharding=fit_timing["sharding"],
                 train_rows_per_s=round(e2e_rows / train_s, 0),

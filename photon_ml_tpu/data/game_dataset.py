@@ -37,6 +37,7 @@ from __future__ import annotations
 import dataclasses
 import logging
 import threading
+import time
 from typing import Dict, List, Mapping, Optional, Sequence
 
 import jax
@@ -267,6 +268,11 @@ class GameDataset:
     # so reg-weight sweeps / warm-start chains that rebuild coordinates
     # reuse it instead of re-packing per configuration.
     bucketed_cache: Dict[str, object] = dataclasses.field(default_factory=dict)
+    # Per-stage ingest breakdown (utils/contracts.INGEST_TIMING_REQUIRED_KEYS)
+    # attached by read_game_dataset; empty for hand-built datasets. The
+    # bench e2e contract fails loudly when a dataset that came from disk is
+    # missing any key.
+    ingest_timing: Dict[str, object] = dataclasses.field(default_factory=dict)
 
     @property
     def num_samples(self) -> int:
@@ -455,26 +461,55 @@ def _build_random_effect_dataset(
     if cap is not None:
         np.minimum(a_counts, cap, out=a_counts)
     need_reservoir = cap is not None and bool((counts > cap).any())
-    if need_reservoir:
-        order = np.lexsort((_row_priorities(codes, n), codes))
-    else:
-        order = np.argsort(codes, kind="stable")  # row-ascending per entity
-    if need_reservoir or lower or cap is not None:
-        starts1 = np.zeros(num_entities + 1, np.int64)
-        np.cumsum(counts, out=starts1[1:])
-        rank = np.arange(n, dtype=np.int64) - starts1[codes[order]]
-        active_rows = order[rank < a_counts[codes[order]]]
-        if need_reservoir:
-            # Restore row-ascending order within each entity for the gathers.
-            active_rows = active_rows[
-                np.lexsort((active_rows, codes[active_rows]))
-            ]
-    else:
-        active_rows = order
     num_active = int(a_counts.sum())
 
     kept = np.nonzero(a_counts > 0)[0]  # entity code per kept entity
     kept_sizes = a_counts[kept]
+
+    # Device-resident assembly (data/device_assemble.py): the n-sized sort/
+    # rank/scatter sequence runs as XLA programs and the gather blocks are
+    # BORN on the device that trains from them; the host path below stays
+    # the bitwise-identical fallback (and the only path when the Pearson
+    # feature selection needs host per-entity row lists).
+    from photon_ml_tpu.data import device_assemble
+    from photon_ml_tpu.utils.observability import record_stage, set_stage_note
+
+    use_device = (
+        device_assemble.enabled()
+        and config.num_features_to_samples_ratio_upper_bound is None
+        and n < 2**31
+        and len(kept) > 0
+    )
+    t_assembly = time.perf_counter()
+    assembler = None
+    active_rows = None
+    if use_device:
+        assembler = device_assemble.BlockAssembler(
+            codes,
+            a_counts,
+            counts,
+            num_active,
+            need_reservoir,
+            _row_priorities(codes, n) if need_reservoir else None,
+        )
+    else:
+        if need_reservoir:
+            order = np.lexsort((_row_priorities(codes, n), codes))
+        else:
+            order = np.argsort(codes, kind="stable")  # row-ascending per entity
+        if need_reservoir or lower or cap is not None:
+            starts1 = np.zeros(num_entities + 1, np.int64)
+            np.cumsum(counts, out=starts1[1:])
+            rank = np.arange(n, dtype=np.int64) - starts1[codes[order]]
+            active_rows = order[rank < a_counts[codes[order]]]
+            if need_reservoir:
+                # Restore row-ascending order within each entity for the
+                # gathers.
+                active_rows = active_rows[
+                    np.lexsort((active_rows, codes[active_rows]))
+                ]
+        else:
+            active_rows = order
 
     # Bucket by padded capacity (power of two >= size, floor min_bucket).
     min_b = max(config.min_bucket, 1)
@@ -483,11 +518,15 @@ def _build_random_effect_dataset(
     cap_of_kept = pows[np.searchsorted(pows, kept_sizes)]
 
     # Per-active-row bookkeeping: owning kept-entity ordinal and position
-    # within that entity's active rows.
+    # within that entity's active rows. (E-sized planning is host either
+    # way; only the num_active-sized expansions stay host-path-only.)
     a_starts = np.zeros(len(kept) + 1, np.int64)
     np.cumsum(kept_sizes, out=a_starts[1:])
-    row_kept_ord = np.repeat(np.arange(len(kept), dtype=np.int64), kept_sizes)
-    row_pos = np.arange(num_active, dtype=np.int64) - a_starts[row_kept_ord]
+    if assembler is None:
+        row_kept_ord = np.repeat(
+            np.arange(len(kept), dtype=np.int64), kept_sizes
+        )
+        row_pos = np.arange(num_active, dtype=np.int64) - a_starts[row_kept_ord]
 
     buckets = []
     for capacity in np.unique(cap_of_kept) if len(kept) else []:
@@ -495,13 +534,6 @@ def _build_random_effect_dataset(
         e = len(members)
         local = np.full(len(kept), -1, np.int64)
         local[members] = np.arange(e)
-        in_bucket = local[row_kept_ord] >= 0
-        gather = np.zeros((e, int(capacity)), np.int64)
-        mask = np.zeros((e, int(capacity)), np.float32)
-        li = local[row_kept_ord[in_bucket]]
-        pj = row_pos[in_bucket]
-        gather[li, pj] = active_rows[in_bucket]
-        mask[li, pj] = 1.0
         ent_rows = kept[members]
         max_e = max(1, int(config.max_block_cells) // int(capacity))
         # Canonical entity counts: each chunk holds either max_e entities
@@ -522,19 +554,39 @@ def _build_random_effect_dataset(
         else:
             target = max_e
         pad_e = n_chunks * target - e
+        if assembler is not None:
+            # One scatter program per bucket shape, padded rows included —
+            # the blocks materialize directly in device memory.
+            gather, mask = assembler.bucket_blocks(
+                a_starts, local, e + pad_e, int(capacity)
+            )
+        else:
+            in_bucket = local[row_kept_ord] >= 0
+            gather = np.zeros((e, int(capacity)), np.int64)
+            mask = np.zeros((e, int(capacity)), np.float32)
+            li = local[row_kept_ord[in_bucket]]
+            pj = row_pos[in_bucket]
+            gather[li, pj] = active_rows[in_bucket]
+            mask[li, pj] = 1.0
+            if pad_e:
+                gather = np.concatenate(
+                    [gather, np.zeros((pad_e, int(capacity)), np.int64)]
+                )
+                mask = np.concatenate(
+                    [mask, np.zeros((pad_e, int(capacity)), np.float32)]
+                )
         if pad_e:
-            gather = np.concatenate(
-                [gather, np.zeros((pad_e, int(capacity)), np.int64)]
-            )
-            mask = np.concatenate(
-                [mask, np.zeros((pad_e, int(capacity)), np.float32)]
-            )
             ent_rows = np.concatenate(
                 [ent_rows, np.full(pad_e, num_entities, np.int64)]
             )
         for c in range(n_chunks):
             sl = slice(c * target, (c + 1) * target)
             buckets.append(EntityBlocks(gather[sl], mask[sl], ent_rows[sl]))
+    record_stage(
+        "re_device" if assembler is not None else "re_host",
+        time.perf_counter() - t_assembly,
+    )
+    set_stage_note("re_path", "device" if assembler is not None else "host")
 
     feature_mask = None
     if config.num_features_to_samples_ratio_upper_bound is not None:

@@ -74,10 +74,25 @@ def _summarize_sparse(
     reference wraps is likewise sparse-aware). Padding slots (value 0) drop
     out of every sum and of the nonzero max/min via masking."""
     n = features.shape[0]  # layout-aware sample count (ell_axis either way)
-    dim = features.dim
-    dtype = features.values.dtype
-    idx = features.indices.reshape(-1)
-    val = features.values.reshape(-1)
+    stats = sparse_summary_arrays(features.indices, features.values, features.dim, n)
+    return stats._replace(intercept_index=intercept_index)
+
+
+def sparse_summary_arrays(
+    indices, values, dim: int, n: Optional[int] = None
+) -> FeatureDataStatistics:
+    """Trace-safe core of the sparse summary over raw ELL planes (any
+    shape; `n` defaults to the (N, K) ingest-plane orientation). Callable
+    from inside other jitted programs — the device-assembly build
+    (data/device_assemble.py) fuses this with its projector key sort so
+    one sweep over the planes feeds both consumers; the ops are exactly
+    `_summarize_sparse`'s, so fused and standalone results are identical.
+    """
+    if n is None:
+        n = indices.shape[0]
+    dtype = values.dtype
+    idx = indices.reshape(-1)
+    val = values.reshape(-1)
     nonzero = val != 0.0
 
     seg = lambda v: jax.ops.segment_sum(v, idx, num_segments=dim)
@@ -118,5 +133,5 @@ def _summarize_sparse(
         norm_l1=sum_abs,
         norm_l2=jnp.sqrt(sum_x2),
         mean_abs=sum_abs / n,
-        intercept_index=intercept_index,
+        intercept_index=None,
     )

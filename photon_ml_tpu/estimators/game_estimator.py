@@ -249,7 +249,21 @@ class GameEstimator:
         if self.normalization == NormalizationType.NONE:
             return None
         if isinstance(ps.projector, IndexMapProjector):
-            global_norm = self._norm_for_shard(dataset, original_shard)
+            stats = getattr(ps.projector, "original_stats", None)
+            if stats is not None:
+                # Fused auxiliary pass (device assembly): the summary was
+                # computed in the SAME device program as the projector key
+                # sort — identical ops to summarize(), no second sweep.
+                intercept = self.intercept_indices.get(original_shard)
+                global_norm = from_feature_stats(
+                    self.normalization,
+                    mean=stats.mean,
+                    variance=stats.variance,
+                    max_abs=stats.max_abs,
+                    intercept_index=intercept,
+                )
+            else:
+                global_norm = self._norm_for_shard(dataset, original_shard)
             return project_normalization(global_norm, ps.projector.slot_tables)
         return self._norm_for_shard(
             dataset, ps.shard_name, intercept_shard=original_shard, projected=True
@@ -370,6 +384,14 @@ class GameEstimator:
                                 cfg.projector_type,
                                 projected_dim=cfg.projected_dim,
                                 seed=self.seed,
+                                # Fused pass: a device-built index-map
+                                # projector folds the feature summary into
+                                # its key-sort sweep when normalization
+                                # will need it.
+                                want_stats=(
+                                    self.normalization
+                                    != NormalizationType.NONE
+                                ),
                             )
                         with stage_timer("stats"):
                             if ps.shard_name != original_shard:
@@ -708,6 +730,19 @@ class GameEstimator:
         ) - stage_base.get("pack_host", 0.0)
         self.fit_timing["pack_path"] = (
             self.timing_registry.get_note("pack_path") or "none"
+        )
+        # RE-assembly placement split (nested inside the `re_build` stage,
+        # so NOT part of the tiling sum): where the entity-block build ran
+        # (device_assemble vs the host loops). Keys always present —
+        # `re_path` is "none" when no random-effect coordinate was built.
+        self.fit_timing["re_device_s"] = self.timing_registry.get(
+            "re_device"
+        ) - stage_base.get("re_device", 0.0)
+        self.fit_timing["re_host_s"] = self.timing_registry.get(
+            "re_host"
+        ) - stage_base.get("re_host", 0.0)
+        self.fit_timing["re_path"] = (
+            self.timing_registry.get_note("re_path") or "none"
         )
         # Robustness counter: coordinate updates rejected by the divergence
         # guard across every configuration of this fit (0 on a clean fit —

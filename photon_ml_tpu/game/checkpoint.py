@@ -229,6 +229,43 @@ class CoordinateDescentCheckpoint:
     def exists(self) -> bool:
         return os.path.isfile(os.path.join(self.directory, STATE_FILE))
 
+    def begin_model_write(
+        self, *, completed_steps: int, cid: str, model
+    ) -> tuple:
+        """Start this step's model-npz write on a background thread and
+        return a handle for `save(staged=...)`.
+
+        The npz write is disk I/O (plus a device fetch of the model
+        arrays), and the step's validation evaluation is a device round
+        trip — the coordinate-descent loop overlaps the two when the host
+        pipeline is on. The commit protocol is unchanged: `save` JOINS the
+        write before state.json is replaced, so state.json still only ever
+        references fully-written files, and a failed background write
+        degrades to the synchronous retried write (never a lost step).
+        The model object is immutable once accepted, so the thread reads
+        consistent arrays.
+        """
+        from concurrent.futures import Future
+
+        rel = os.path.join(STEPS_DIR, str(completed_steps), f"{cid}.npz")
+        fut: Future = Future()
+
+        def _run():
+            try:
+                fut.set_result(
+                    _save_model_npz(os.path.join(self.directory, rel), model)
+                )
+            except BaseException as exc:  # noqa: BLE001 - joined in save()
+                fut.set_exception(exc)
+
+        import threading
+
+        thread = threading.Thread(
+            target=_run, daemon=True, name="photon-ckpt-write"
+        )
+        thread.start()
+        return (completed_steps, cid, rel, fut, thread)
+
     def save(
         self,
         *,
@@ -240,6 +277,7 @@ class CoordinateDescentCheckpoint:
         best_is_current: bool,
         best_results,
         validation_history,
+        staged: Optional[tuple] = None,
     ) -> None:
         """Commit one coordinate update.
 
@@ -247,10 +285,39 @@ class CoordinateDescentCheckpoint:
         full write); any coordinate without an existing file (initial
         warm-start models on the first save) is also written. When
         `best_is_current`, the best snapshot re-references the current model
-        files instead of copying them.
+        files instead of copying them. `staged` is a begin_model_write
+        handle whose (joined) result stands in for that coordinate's write.
         """
         step_rel = os.path.join(STEPS_DIR, str(completed_steps))
+        staged_cid = None
+        if staged is not None:
+            s_steps, s_cid, s_rel, s_fut, s_thread = staged
+            s_thread.join()
+            if s_steps == completed_steps and s_cid == trained_cid:
+                try:
+                    self._checksums[s_rel] = s_fut.result()
+                except Exception:
+                    # The background write's own retries gave up: fall
+                    # through to the synchronous retried write below — the
+                    # overlap moves only WHEN the write runs, never whether
+                    # the step commits.
+                    import logging
+
+                    from photon_ml_tpu.utils import faults as _faults
+
+                    logging.getLogger(__name__).warning(
+                        "background checkpoint write of %r failed; "
+                        "rewriting synchronously",
+                        s_cid,
+                        exc_info=True,
+                    )
+                    _faults.COUNTERS.increment("fallback_sync_ckpt_writes")
+                else:
+                    self._model_files[s_cid] = s_rel
+                    staged_cid = s_cid
         for cid, model in models.items():
+            if cid == staged_cid:
+                continue
             if cid == trained_cid or cid not in self._model_files:
                 rel = os.path.join(step_rel, f"{cid}.npz")
                 self._checksums[rel] = _save_model_npz(

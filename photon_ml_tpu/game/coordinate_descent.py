@@ -367,6 +367,25 @@ def run_coordinate_descent(
             timing[f"{cid}/iter{it}"] = time.perf_counter() - t0
             logger.info("iteration %d coordinate %s trained in %.3fs", it, cid, timing[f"{cid}/iter{it}"])
 
+            # Overlap the step's durable model write with the validation
+            # evaluation below (EvaluationSuite's device round trip): the
+            # npz write is host disk I/O, so the two hide behind each
+            # other. save() joins the write before the state.json commit —
+            # the crash-exact protocol is untouched. Pipeline-gated like
+            # every other overlap (a write thread on a 1-core host only
+            # steals the evaluator's core).
+            staged_write = None
+            if (
+                accepted
+                and ckpt is not None
+                and prefetch
+                and validation_scorer is not None
+                and validation_suite is not None
+            ):
+                staged_write = ckpt.begin_model_write(
+                    completed_steps=step + 1, cid=cid, model=model
+                )
+
             if accepted and validation_scorer is not None and validation_suite is not None:
                 val_scores[cid] = validation_scorer(cid, model)
                 # Seed with the validation offsets so selection uses the same
@@ -403,7 +422,10 @@ def run_coordinate_descent(
                     best_is_current=best_updated,
                     best_results=best_results,
                     validation_history=validation_history,
+                    staged=staged_write,
                 )
+            elif staged_write is not None:  # pragma: no cover - ckpt is set
+                staged_write[4].join()
 
     final = GameModel(dict(models))
     best = GameModel(dict(best_models)) if best_models else final

@@ -80,6 +80,15 @@ class IndexMapProjector:
         self.slot_tables = slot_tables  # (E + 1, D_proj) int64, -1 = pad
         self.original_dim = int(original_dim)
         self.projected_dim = int(slot_tables.shape[1])
+        # Device-side mapper (data/device_assemble.DeviceIndexMapper) when
+        # the build ran on device: later projections (training shard,
+        # validation data) are one XLA program each instead of host
+        # searchsorted sweeps. None on the host path — consumers fall back.
+        self._device_mapper = None
+        # Fused-pass byproduct: the original shard's feature summary,
+        # computed in the SAME program as the projector key sort when the
+        # caller asked for it (GameEstimator's normalization contexts).
+        self.original_stats = None
 
     @classmethod
     def build(
@@ -90,19 +99,48 @@ class IndexMapProjector:
         *,
         pad_multiple: int = 8,
         host_planes=None,
+        want_stats: bool = False,
     ) -> "IndexMapProjector":
         """Collect each entity's distinct active feature indices
         (IndexMapProjectorRDD.scala:60-90 unions active+passive; here
         `entity_rows` covers every sample so both are included).
         `host_planes` is ingest's (indices, values) host copy
         (GameDataset.host_ell) — without it, np.asarray on a remote-device
-        array pulls the whole shard back over the interconnect."""
+        array pulls the whole shard back over the interconnect.
+
+        Device path (data/device_assemble.py, PHOTON_DEVICE_ASSEMBLY):
+        the nnz-sized key sort/unique/table scatter runs as XLA programs
+        — bitwise-identical slot tables, with only the E-sized counts
+        crossing back to host. `want_stats` additionally folds the
+        feature-summary moments into the same sweep (the fused auxiliary
+        pass); the host path ignores it (stats run separately there)."""
         if host_planes is not None:
             idx, val = host_planes
         else:
             idx = np.asarray(features.indices)
             val = np.asarray(features.values)
         ent = np.asarray(entity_rows)
+
+        from photon_ml_tpu.data import device_assemble
+
+        if device_assemble.enabled() and device_assemble.projector_supported(
+            num_entities, features.dim
+        ):
+            built = device_assemble.build_index_mapper(
+                idx,
+                val,
+                ent,
+                num_entities,
+                features.dim,
+                pad_multiple=pad_multiple,
+                want_stats=want_stats,
+            )
+            if built is not None:
+                tables, mapper, stats = built
+                proj = cls(tables, features.dim)
+                proj._device_mapper = mapper
+                proj.original_stats = stats
+                return proj
         # Flatten to (entity, global-index) pairs for nonzero entries and
         # take per-entity distinct indices in one vectorized pass. The pair
         # is packed into ONE int64 key — np.unique on a 2-D stack sorts a
@@ -166,15 +204,23 @@ class IndexMapProjector:
         entity_rows: np.ndarray,
         host_planes=None,
     ) -> SparseFeatures:
-        """Rewrite global ELL indices to per-entity local slots (host-side,
-        one-time). Entries whose feature is absent from the entity's table
-        (value-0 padding, or unseen entities) are zeroed out. `host_planes`
-        avoids the remote-device pull (see build)."""
+        """Rewrite global ELL indices to per-entity local slots (one-time).
+        Entries whose feature is absent from the entity's table (value-0
+        padding, or unseen entities) are zeroed out. `host_planes` avoids
+        the remote-device pull (see build). A device-built projector
+        projects as one XLA program (bitwise-equal to the host sweep)."""
         if host_planes is not None:
             idx, val = host_planes
         else:
             idx = np.asarray(features.indices)
             val = np.asarray(features.values)
+        from photon_ml_tpu.data import device_assemble
+
+        if self._device_mapper is not None and device_assemble.enabled():
+            out_d, v_d = device_assemble.project_ell_device(
+                self._device_mapper, idx, val, np.asarray(entity_rows)
+            )
+            return SparseFeatures(out_d, v_d, self.projected_dim)
         out, v = self.project_arrays(idx, val, np.asarray(entity_rows))
         return SparseFeatures(
             jnp.asarray(out), jnp.asarray(v), self.projected_dim
@@ -267,6 +313,7 @@ def build_projector(
     projected_dim: Optional[int] = None,
     seed: int = 0,
     host_planes=None,
+    want_stats: bool = False,
 ) -> Projector:
     """RandomEffectProjector.build (RandomEffectProjector.scala:74). The
     default for random-effect coordinates is INDEX_MAP
@@ -286,7 +333,11 @@ def build_projector(
             # Dense shards have nothing to compact per entity; identity.
             return IdentityProjector(dim)
         return IndexMapProjector.build(
-            features, entity_rows, num_entities, host_planes=host_planes
+            features,
+            entity_rows,
+            num_entities,
+            host_planes=host_planes,
+            want_stats=want_stats,
         )
     raise ValueError(f"unknown projector type {projector_type}")
 
@@ -307,6 +358,7 @@ def project_shard(
     *,
     projected_dim: Optional[int] = None,
     seed: int = 0,
+    want_stats: bool = False,
 ) -> ProjectedShard:
     """Create the projected view of `re_dataset`'s feature shard and register
     it on the GameDataset under '<shard>@<re_type>' — the per-coordinate
@@ -333,6 +385,7 @@ def project_shard(
         projected_dim=projected_dim,
         seed=seed,
         host_planes=host_planes,
+        want_stats=want_stats,
     )
     if isinstance(projector, IdentityProjector):
         return ProjectedShard(shard, projector)
@@ -350,7 +403,34 @@ def project_shard(
             np.asarray(feats_src.indices),
             np.asarray(feats_src.values),
         )
-    if isinstance(projector, IndexMapProjector):
+    if (
+        isinstance(projector, IndexMapProjector)
+        and projector._device_mapper is not None
+    ):
+        # Device-resident path: the projection and the (K, N) transpose
+        # run as XLA programs and the projected shard is BORN in device
+        # memory — no host planes, no upload stage, bitwise-equal entries.
+        # (No host_ell stash: the projected planes have no host consumer —
+        # Pearson statistics read the ORIGINAL shard, before repointing.)
+        # The build's device-resident planes are reused (take_planes) so
+        # the raw ELL ships host->device exactly once.
+        from photon_ml_tpu.data import device_assemble
+
+        staged = projector._device_mapper.take_planes()
+        src_idx, src_val = staged if staged is not None else (
+            host_planes[0],
+            host_planes[1],
+        )
+        out_d, v_d = device_assemble.project_ell_device(
+            projector._device_mapper, src_idx, src_val, entity_rows
+        )
+        idx_t_d, val_t_d = device_assemble.transpose_planes_device(
+            out_d, v_d, projector.projected_dim
+        )
+        dataset.shards[new_name] = SparseFeatures(
+            idx_t_d, val_t_d, projector.projected_dim, ell_axis=-2
+        )
+    elif isinstance(projector, IndexMapProjector):
         # Host-plane path: project on host, stash the projected planes
         # (Pearson stats / downstream host consumers), then upload ONCE in
         # the TRANSPOSED (K, N) block layout — the orientation the

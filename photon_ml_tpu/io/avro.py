@@ -540,3 +540,13 @@ def read_directory(
         schema = schema or s
         records.extend(recs)
     return schema, records
+
+
+def iter_directory(path: str, *, quarantine: bool = False):
+    """Stream (schema, record) pairs across every .avro part-file under a
+    directory (or a single file), in `list_container_files` order — the
+    streaming twin of `read_directory`, for consumers that assemble in
+    bounded chunks instead of materializing every row first (the chunked
+    ingest path of io/avro_data.read_game_dataset)."""
+    for part in list_container_files(path):
+        yield from iter_container(part, quarantine=quarantine)

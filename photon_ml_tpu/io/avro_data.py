@@ -18,6 +18,7 @@ readFeaturesFromRecord:274-352); index maps are built per shard on first read
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Dict, List, Mapping, Optional, Sequence, Tuple, Union
 
 import numpy as np
@@ -211,85 +212,182 @@ def read_game_dataset(
             "and `response_field`"
         )
     cols_early = columns or InputColumnNames(response=response_field)
-    # Fast path: block-level native decode (photon_ml_tpu/io/avro_fast.py).
-    # Falls back to the per-datum Python codec for any schema shape the
-    # native op-program compiler cannot express.
-    try:
-        from photon_ml_tpu.io import avro_fast
 
-        fast = avro_fast.try_read_native(
-            paths, shard_configs, index_maps, id_tag_fields, cols_early, LABEL
-        )
-    except Exception:
-        fast = None
-    if fast is not None:
-        return fast
+    # Every ingest records its per-stage breakdown (INGEST_STAGES) into an
+    # ambient scope and attaches it to the dataset — the bench e2e
+    # contract fails loudly on a dataset-from-disk missing it, the same
+    # discipline PR 1 set for fit_timing's prepare stages.
+    from photon_ml_tpu.utils.observability import TimingRegistry, stage_scope
 
-    records: List[dict] = []
-    for p in paths:
-        # quarantine=True: training ingest is row-shaped — one corrupt
-        # block costs its rows (counted in quarantined_blocks), not the
-        # whole file. Model/score reads keep the loud default.
-        _, recs = avro_io.read_directory(p, quarantine=True)
-        records.extend(recs)
-    n = len(records)
-    if n == 0:
-        raise ValueError(f"no records found under {paths}")
+    reg = TimingRegistry()
+    t_ingest = time.perf_counter()
+    with stage_scope(reg):
+        # Fast path: block-level native decode (io/avro_fast.py), streamed
+        # per file. Falls back to the chunked per-datum Python codec for
+        # any schema shape the native op-program compiler cannot express.
+        try:
+            from photon_ml_tpu.io import avro_fast
 
-    # Parse feature bags once per shard; index maps built from the parsed
-    # lists when not supplied (feature parsing dominates host ETL cost).
+            fast = avro_fast.try_read_native(
+                paths, shard_configs, index_maps, id_tag_fields, cols_early, LABEL
+            )
+        except Exception:
+            fast = None
+        if fast is not None:
+            ds, built = fast
+        else:
+            ds, built = _read_python_chunked(
+                paths, shard_configs, index_maps, id_tag_fields, cols_early
+            )
+    ds.ingest_timing = _ingest_timing(reg, time.perf_counter() - t_ingest)
+    return ds, built
+
+
+def _ingest_timing(reg, total_s: float) -> Dict[str, object]:
+    """Assemble the INGEST_TIMING_REQUIRED_KEYS dict from the ingest stage
+    registry. In a synchronous run the stages + `other` tile the ingest
+    wall; a streaming run records decode where it ran (worker threads), so
+    the stage sum can exceed the wall — that excess IS the overlap win."""
+    from photon_ml_tpu.utils.contracts import INGEST_STAGES
+
+    timing: Dict[str, object] = {k: reg.get(k) for k in INGEST_STAGES}
+    timing["other"] = max(
+        0.0, total_s - sum(timing[k] for k in INGEST_STAGES)
+    )
+    timing["ingest_path"] = reg.get_note("ingest_path") or "python"
+    timing["streaming"] = reg.get_note("streaming") == "1"
+    timing["chunks"] = int(reg.get_note("chunks") or "1")
+    return timing
+
+
+def _read_python_chunked(
+    paths: Sequence[str],
+    shard_configs: Mapping[str, FeatureShardConfig],
+    index_maps: Optional[Mapping[str, IndexMap]],
+    id_tag_fields: Sequence[str],
+    cols: InputColumnNames,
+) -> Tuple[GameDataset, Dict[str, IndexMap]]:
+    """Pure-Python codec ingest, streamed in PHOTON_STREAM_CHUNK_ROWS-row
+    column chunks: each chunk's records decode (io/avro.iter_directory),
+    convert to columnar parts (labels/offsets/weights, parsed feature
+    lists, id-tag strings), and are then FREED — decoded-record residency
+    is bounded by one chunk instead of the whole dataset, and the chunk
+    boundaries provably cannot change results (every per-record conversion
+    is independent; tests pin bitwise parity across chunk sizes)."""
+    from itertools import islice
+
+    from photon_ml_tpu.utils.knobs import get_knob
+    from photon_ml_tpu.utils.observability import set_stage_note, stage_timer
+
+    chunk_rows = max(1, int(get_knob("PHOTON_STREAM_CHUNK_ROWS")))
+
+    def _records():
+        for p in paths:
+            # quarantine=True: training ingest is row-shaped — one corrupt
+            # block costs its rows (counted in quarantined_blocks), not the
+            # whole file. Model/score reads keep the loud default.
+            for _, rec in avro_io.iter_directory(p, quarantine=True):
+                yield rec
+
+    def _get(rec: dict, field: str, default: float) -> float:
+        v = rec.get(field)
+        return default if v is None else float(v)
+
+    n = 0
+    n_chunks = 0
+    labels_p: List[np.ndarray] = []
+    offsets_p: List[np.ndarray] = []
+    weights_p: List[np.ndarray] = []
     parsed: Dict[str, List[List[Tuple[str, float]]]] = {
-        shard: [_record_features(rec, cfg.feature_bags) for rec in records]
-        for shard, cfg in shard_configs.items()
+        shard: [] for shard in shard_configs
     }
+    keysets: Dict[str, set] = {shard: set() for shard in shard_configs}
+    tag_parts: Dict[str, List[np.ndarray]] = {t: [] for t in id_tag_fields}
+    uid_parts: List[np.ndarray] = []
+    any_uid = False
+    stream = iter(_records())
+    while True:
+        with stage_timer("decode"):
+            records = list(islice(stream, chunk_rows))
+        if not records:
+            break
+        n_chunks += 1
+        m = len(records)
+        with stage_timer("assemble"):
+            # Parse feature bags once per shard; index-map key sets build
+            # incrementally from the parsed chunk (feature parsing
+            # dominates host ETL cost on this path).
+            for shard, cfg in shard_configs.items():
+                rows = [
+                    _record_features(rec, cfg.feature_bags) for rec in records
+                ]
+                parsed[shard].extend(rows)
+                if index_maps is None or shard not in index_maps:
+                    ks = keysets[shard]
+                    for row in rows:
+                        ks.update(k for k, _ in row)
+            la = np.empty(m, np.float32)
+            of = np.empty(m, np.float32)
+            we = np.empty(m, np.float32)
+            for i, rec in enumerate(records):
+                if cols.response in rec:
+                    la[i] = _get(rec, cols.response, 0.0)
+                else:
+                    la[i] = _get(rec, LABEL, 0.0)
+                of[i] = _get(rec, cols.offset, 0.0)
+                we[i] = _get(rec, cols.weight, 1.0)
+            labels_p.append(la)
+            offsets_p.append(of)
+            weights_p.append(we)
+        with stage_timer("tags"):
+            for tag in id_tag_fields:
+                # Resolution order (GameConverters.getGameDatumFromRow
+                # id-tag lookup): direct record field; "map.key" dotted
+                # path into a map-typed column; metadataMap fallback.
+                field, _, map_key = tag.partition(".")
+                vals = []
+                for rec in records:
+                    v = rec.get(tag)
+                    if v is None and map_key:
+                        inner = rec.get(field)
+                        if isinstance(inner, dict):
+                            v = inner.get(map_key)
+                    if v is None:
+                        v = (rec.get(cols.metadata_map) or {}).get(tag, "")
+                    vals.append(str(v))
+                tag_parts[tag].append(np.asarray(vals))
+            uids = [rec.get(cols.uid) for rec in records]
+            any_uid = any_uid or any(u is not None for u in uids)
+            uid_parts.append(
+                np.asarray([str(u) if u is not None else "" for u in uids])
+            )
+        n += m
+        del records
+    if n == 0:
+        raise ValueError(f"no records found under {list(paths)}")
+    set_stage_note("ingest_path", "python")
+    set_stage_note("chunks", str(n_chunks))
+    set_stage_note("streaming", "0")
+
+    from photon_ml_tpu.io.avro_fast import _concat_parts
+
+    labels = _concat_parts(labels_p, np.float32)
+    offsets = _concat_parts(offsets_p, np.float32)
+    weights = _concat_parts(weights_p, np.float32)
+    id_tags: Dict[str, np.ndarray] = {
+        tag: _concat_parts(tag_parts[tag], object) for tag in id_tag_fields
+    }
+    if any_uid:
+        id_tags[UID] = _concat_parts(uid_parts, object)
+
     built: Dict[str, IndexMap] = {}
     for shard, cfg in shard_configs.items():
         if index_maps is not None and shard in index_maps:
             built[shard] = index_maps[shard]
         else:
-            keys: set = set()
-            for row in parsed[shard]:
-                keys.update(k for k, _ in row)
-            built[shard] = IndexMap.from_feature_names(keys, add_intercept=cfg.has_intercept)
-
-    # Labels / offsets / weights / uid / tags.
-    def _get(rec: dict, field: str, default: float) -> float:
-        v = rec.get(field)
-        return default if v is None else float(v)
-
-    cols = cols_early
-    labels = np.empty(n, np.float32)
-    offsets = np.empty(n, np.float32)
-    weights = np.empty(n, np.float32)
-    for i, rec in enumerate(records):
-        if cols.response in rec:
-            labels[i] = _get(rec, cols.response, 0.0)
-        else:
-            labels[i] = _get(rec, LABEL, 0.0)
-        offsets[i] = _get(rec, cols.offset, 0.0)
-        weights[i] = _get(rec, cols.weight, 1.0)
-
-    id_tags: Dict[str, np.ndarray] = {}
-    for tag in id_tag_fields:
-        # Resolution order (GameConverters.getGameDatumFromRow id-tag
-        # lookup): direct record field; "map.key" dotted path into a
-        # map-typed column (the reference reads ids from map columns,
-        # AvroDataReader map-field handling); metadataMap fallback.
-        field, _, map_key = tag.partition(".")
-        vals = []
-        for rec in records:
-            v = rec.get(tag)
-            if v is None and map_key:
-                inner = rec.get(field)
-                if isinstance(inner, dict):
-                    v = inner.get(map_key)
-            if v is None:
-                v = (rec.get(cols.metadata_map) or {}).get(tag, "")
-            vals.append(str(v))
-        id_tags[tag] = np.asarray(vals)
-    uids = [rec.get(cols.uid) for rec in records]
-    if any(u is not None for u in uids):
-        id_tags[UID] = np.asarray([str(u) if u is not None else "" for u in uids])
+            built[shard] = IndexMap.from_feature_names(
+                keysets[shard], add_intercept=cfg.has_intercept
+            )
 
     # Per-shard CSR -> ELL.
     shards = {}
@@ -308,24 +406,28 @@ def read_game_dataset(
         indptr = np.zeros(n + 1, np.int64)
         idx_buf: List[int] = []
         val_buf: List[float] = []
-        for i, row in enumerate(parsed[shard]):
-            for key, value in row:
-                j = imap.get_index(key)
-                if j >= 0:
-                    idx_buf.append(j)
-                    val_buf.append(value)
-            if cfg.has_intercept and intercept_idx is not None:
-                idx_buf.append(intercept_idx)
-                val_buf.append(1.0)
-            indptr[i + 1] = len(idx_buf)
-        shards[shard] = pack_csr_to_ell(
-            indptr,
-            np.asarray(idx_buf, np.int64),
-            np.asarray(val_buf, np.float32),
-            imap.size,
-        )
+        with stage_timer("assemble"):
+            for i, row in enumerate(parsed[shard]):
+                for key, value in row:
+                    j = imap.get_index(key)
+                    if j >= 0:
+                        idx_buf.append(j)
+                        val_buf.append(value)
+                if cfg.has_intercept and intercept_idx is not None:
+                    idx_buf.append(intercept_idx)
+                    val_buf.append(1.0)
+                indptr[i + 1] = len(idx_buf)
+        with stage_timer("ell"):
+            shards[shard] = pack_csr_to_ell(
+                indptr,
+                np.asarray(idx_buf, np.int64),
+                np.asarray(val_buf, np.float32),
+                imap.size,
+            )
 
-    ds = GameDataset.build(shards, labels, offsets=offsets, weights=weights, id_tags=id_tags)
+    ds = GameDataset.build(
+        shards, labels, offsets=offsets, weights=weights, id_tags=id_tags
+    )
     return ds, built
 
 

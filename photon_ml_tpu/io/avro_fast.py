@@ -14,19 +14,150 @@ from __future__ import annotations
 
 import logging
 import os
+import time
 from typing import Dict, List, Mapping, Optional, Sequence, Tuple
 
 import numpy as np
 
 from photon_ml_tpu.utils import faults
+from photon_ml_tpu.utils.knobs import get_knob
 
 from photon_ml_tpu.data.containers import pack_csr_to_ell
 from photon_ml_tpu.data.game_dataset import GameDataset, HostCSR
 from photon_ml_tpu.data.index_map import DELIMITER, IndexMap
 from photon_ml_tpu.io import avro as avro_io
 from photon_ml_tpu.native import avro_reader
+from photon_ml_tpu.utils.observability import (
+    current_stage_registry,
+    set_stage_note,
+    stage_timer,
+)
 
 logger = logging.getLogger(__name__)
+
+
+def _concat_parts(parts: Sequence[np.ndarray], empty_dtype) -> np.ndarray:
+    """Concatenate per-file/per-chunk column parts. np.concatenate copies
+    even for a single part; most reads are one container file, so skip
+    the copy there."""
+    if not len(parts):
+        return np.empty(0, empty_dtype)
+    return parts[0] if len(parts) == 1 else np.concatenate(parts)
+
+
+def stream_ingest_enabled() -> bool:
+    """Should ingest overlap decode of chunk k+1 with assembly of chunk k?
+
+    PHOTON_STREAM_INGEST forces (1/0); empty = auto — on only when the
+    host has more than one effective core, the same policy as every other
+    host-parallel knob (a producer thread on a 1-core host only steals
+    the consumer's core; the ORDER of assembly is file order either way,
+    so streaming never changes results, only when work happens)."""
+    env = str(get_knob("PHOTON_STREAM_INGEST")).strip().lower()
+    if env in ("0", "false", "off", "no"):
+        return False
+    if env in ("1", "true", "on", "yes"):
+        return True
+    from photon_ml_tpu.data.pipeline import effective_host_parallelism
+
+    return effective_host_parallelism() > 1
+
+
+class _IngestAssembler:
+    """Incremental per-file assembly of decoded columns.
+
+    `add(d)` consumes one file's DecodedFile strictly in file order —
+    interning its key dictionary into the global id space, remapping its
+    bag keys, and stringifying its tag value table — i.e. the per-chunk
+    host work that the streaming pipeline overlaps with the NEXT file's
+    native decode. `finalize()` is the order-insensitive tail (big
+    concatenations + the per-shard merge), identical whether the adds
+    were interleaved with decode or ran after it, so streaming and
+    monolithic ingest are bitwise-identical by construction.
+    """
+
+    def __init__(self, n_bags: int):
+        self.n_bags = n_bags
+        self.global_ids: Dict[str, int] = {}
+        self.key_list: List[str] = []
+        self.labels: List[np.ndarray] = []
+        self.offsets: List[np.ndarray] = []
+        self.weights: List[np.ndarray] = []
+        self.bag_ip: List[List[np.ndarray]] = [[] for _ in range(n_bags)]
+        self.bag_keys: List[List[np.ndarray]] = [[] for _ in range(n_bags)]
+        self.bag_vals: List[List[np.ndarray]] = [[] for _ in range(n_bags)]
+        self.tag_ids: List[np.ndarray] = []
+        self.val_tables: List[np.ndarray] = []
+        self.n = 0
+        self.n_files = 0
+
+    def _global(self, keys: List[str]) -> np.ndarray:
+        out = np.empty(len(keys), np.int64)
+        global_ids, key_list = self.global_ids, self.key_list
+        for i, k in enumerate(keys):
+            g = global_ids.get(k)
+            if g is None:
+                g = len(key_list)
+                global_ids[k] = g
+                key_list.append(k)
+            out[i] = g
+        return out
+
+    def add(self, d: "avro_reader.DecodedFile") -> None:
+        fi = self.n_files
+        self.n_files += 1
+        self.n += len(d.labels)
+        self.labels.append(d.labels)
+        self.offsets.append(d.offsets)
+        self.weights.append(d.weights)
+        # Intern the file's key dictionary once (not once per bag). The
+        # first file's local ids ARE the global ids by construction — no
+        # remap gather there.
+        l2g = self._global(d.keys)
+        for b in range(self.n_bags):
+            self.bag_ip[b].append(d.bag_indptr[b])
+            if not len(d.bag_keys[b]):
+                self.bag_keys[b].append(np.empty(0, np.int64))
+            elif fi == 0:
+                self.bag_keys[b].append(d.bag_keys[b])  # identity (int32 ok)
+            else:
+                self.bag_keys[b].append(l2g[d.bag_keys[b]])
+            self.bag_vals[b].append(d.bag_vals[b])
+        self.tag_ids.append(d.tag_ids)
+        self.val_tables.append(
+            np.asarray([str(v) for v in d.tag_values] + [""], dtype=object)
+        )
+
+    def finalize(self):
+        """Concatenate the per-file parts: (labels, offsets, weights,
+        per-bag (indptr, global keys, values)). Single-file reads skip
+        every copy, exactly like the monolithic path did."""
+        _concat = _concat_parts
+        labels = _concat(self.labels, np.float64).astype(np.float32, copy=False)
+        offsets = _concat(self.offsets, np.float64).astype(
+            np.float32, copy=False
+        )
+        weights = _concat(self.weights, np.float64).astype(
+            np.float32, copy=False
+        )
+        bag_indptr: List[np.ndarray] = []
+        bag_gkeys: List[np.ndarray] = []
+        bag_vals: List[np.ndarray] = []
+        for b in range(self.n_bags):
+            if self.n_files == 1:
+                bag_indptr.append(self.bag_ip[b][0])
+                bag_gkeys.append(self.bag_keys[b][0])
+                bag_vals.append(self.bag_vals[b][0])
+                continue
+            ip_parts = [np.zeros(1, np.int64)]
+            off = 0
+            for ip in self.bag_ip[b]:
+                ip_parts.append(ip[1:] + off)
+                off += int(ip[-1])
+            bag_indptr.append(np.concatenate(ip_parts))
+            bag_gkeys.append(_concat(self.bag_keys[b], np.int64))
+            bag_vals.append(_concat(self.bag_vals[b], np.float32))
+        return labels, offsets, weights, bag_indptr, bag_gkeys, bag_vals
 
 
 def _stash_worthwhile(n_samples: int) -> bool:
@@ -124,6 +255,9 @@ def try_read_native(
     # thread pool on one core only adds contention — the same reasoning
     # that defers the background bucketed pack below).
     budget = avro_reader._default_threads() or effective_host_parallelism()
+    # Worker threads record their decode walls into the SPAWNER's ingest
+    # stage registry (stage scopes are thread-local, AsyncUploader-style).
+    stage_reg = current_stage_registry()
 
     def _decode_one(c, n_threads):
         path, body, codec, sync, program = c
@@ -138,6 +272,7 @@ def try_read_native(
                 data, body, codec, sync, program, DELIMITER, n_threads=n_threads
             )
 
+        t0 = time.perf_counter()
         try:
             return faults.retry(_attempt, label=f"avro decode {path}")
         except Exception:
@@ -151,11 +286,25 @@ def try_read_native(
                 exc_info=True,
             )
             return None
+        finally:
+            if stage_reg is not None:
+                stage_reg.record("decode", time.perf_counter() - t0)
 
     # One failed file means a full fallback to the Python codec, so stop
     # decoding as soon as a failure surfaces instead of paying for the
     # remaining files' native decode only to discard it.
+    #
+    # Streaming pipeline (tentpole, r09): files decode on a bounded-width
+    # pool and the assembler consumes them IN FILE ORDER as they land —
+    # interning/remap/tag work for file k overlaps the decode of file
+    # k+1, and at most `width + 1` decoded files are ever resident (the
+    # double-buffering discipline of data/pipeline.py applied to ingest).
+    # The monolithic path (1 core, forced off, or a single file) decodes
+    # then assembles; the assembler order is identical, so the results
+    # are bitwise-equal — tests/test_streaming_ingest.py pins it.
     failed = False
+    asm = _IngestAssembler(len(bag_names))
+    streaming = stream_ingest_enabled() and len(compiled) > 1 and budget > 1
     if len(compiled) > 1 and budget > 1:
         from concurrent.futures import ThreadPoolExecutor
 
@@ -171,89 +320,64 @@ def try_read_native(
                 failed = True
             return out
 
-        with ThreadPoolExecutor(max_workers=width) as pool:
-            decoded = list(pool.map(_guarded, compiled))
+        with ThreadPoolExecutor(
+            max_workers=width, thread_name_prefix="photon-ingest-decode"
+        ) as pool:
+            if streaming:
+                from collections import deque
+
+                queue = deque()
+                pending = list(compiled)
+
+                def _submit():
+                    while pending and len(queue) <= width:
+                        queue.append(pool.submit(_guarded, pending.pop(0)))
+
+                _submit()
+                while queue:
+                    out = queue.popleft().result()
+                    if out is None:
+                        failed = True
+                        break
+                    _submit()
+                    with stage_timer("assemble"):
+                        asm.add(out)
+            else:
+                for out in pool.map(_guarded, compiled):
+                    if out is None:
+                        failed = True
+                        break
+                    with stage_timer("assemble"):
+                        asm.add(out)
     else:
-        decoded = []
         for c in compiled:
             out = _decode_one(c, budget)
             if out is None:
                 return None
-            decoded.append(out)
-    if failed or any(d is None for d in decoded):
+            with stage_timer("assemble"):
+                asm.add(out)
+    if failed:
         return None
+    set_stage_note("ingest_path", "native-stream" if streaming else "native")
+    set_stage_note("chunks", str(asm.n_files))
+    set_stage_note("streaming", "1" if streaming else "0")
 
     # ---- concatenate files; remap per-file interned keys to global ids ----
-    n = sum(len(d.labels) for d in decoded)
+    n = asm.n
     if n == 0:
         return None
+    _concat = _concat_parts
 
-    def _concat(parts, empty_dtype):
-        # np.concatenate copies even for a single part; most reads are one
-        # container file, so skip the copy there.
-        if not parts:
-            return np.empty(0, empty_dtype)
-        return parts[0] if len(parts) == 1 else np.concatenate(parts)
-
-    labels = _concat([d.labels for d in decoded], np.float64).astype(
-        np.float32, copy=False
-    )
-    offsets = _concat([d.offsets for d in decoded], np.float64).astype(
-        np.float32, copy=False
-    )
-    weights = _concat([d.weights for d in decoded], np.float64).astype(
-        np.float32, copy=False
-    )
-
-    global_ids: Dict[str, int] = {}
-    key_list: List[str] = []
-
-    def _global(keys: List[str]) -> np.ndarray:
-        out = np.empty(len(keys), np.int64)
-        for i, k in enumerate(keys):
-            g = global_ids.get(k)
-            if g is None:
-                g = len(key_list)
-                global_ids[k] = g
-                key_list.append(k)
-            out[i] = g
-        return out
-
-    # Intern each file's key dictionary once (not once per bag). The first
-    # file's local ids ARE the global ids by construction — no remap gather.
-    file_l2g = [_global(d.keys) for d in decoded]
-
-    # Per bag: concatenated CSR (indptr, global keys, values). Row ids are
-    # NOT materialized here — the clean single-bag path (the common case)
-    # flows indptr straight through to the native ELL fill; only the
-    # multi-bag merge expands rows for its record-order sort.
-    bag_indptr: List[np.ndarray] = []
-    bag_gkeys: List[np.ndarray] = []
-    bag_vals: List[np.ndarray] = []
-    for b in range(len(bag_names)):
-        if len(decoded) == 1:
-            d = decoded[0]
-            bag_indptr.append(d.bag_indptr[b])
-            bag_gkeys.append(d.bag_keys[b])
-            bag_vals.append(d.bag_vals[b])
-            continue
-        ip_parts = [np.zeros(1, np.int64)]
-        keys_parts, vals_parts = [], []
-        off = 0
-        for fi, d in enumerate(decoded):
-            ip = d.bag_indptr[b]
-            ip_parts.append(ip[1:] + off)
-            off += int(ip[-1])
-            if not len(d.bag_keys[b]):
-                keys_parts.append(np.empty(0, np.int64))
-            elif fi == 0:
-                keys_parts.append(d.bag_keys[b])  # identity remap (int32 ok)
-            else:
-                keys_parts.append(file_l2g[fi][d.bag_keys[b]])
-            vals_parts.append(d.bag_vals[b])
-        bag_indptr.append(np.concatenate(ip_parts))
-        bag_gkeys.append(_concat(keys_parts, np.int64))
-        bag_vals.append(_concat(vals_parts, np.float32))
+    with stage_timer("assemble"):
+        (
+            labels,
+            offsets,
+            weights,
+            bag_indptr,
+            bag_gkeys,
+            bag_vals,
+        ) = asm.finalize()
+    key_list = asm.key_list
 
     # ---- id tags --------------------------------------------------------
     # Factorized form: per-file interned value tables merge into ONE sorted
@@ -262,23 +386,22 @@ def try_read_native(
     # table are kept on the dataset (tag_codes) so entity grouping
     # (build_random_effect_dataset) and scoring-time entity resolution sort
     # the SMALL value table instead of n_samples strings.
+    t_tags = time.perf_counter()
     id_tags: Dict[str, np.ndarray] = {}
     tag_codes: Dict[str, tuple] = {}
-    all_tag_ids = np.concatenate([d.tag_ids for d in decoded], axis=0)
-    val_tables = [
-        np.asarray([str(v) for v in d.tag_values] + [""], dtype=object)
-        for d in decoded
-    ]
+    all_tag_ids = _concat(asm.tag_ids, np.int32)
+    val_tables = asm.val_tables
     cat_tbl = np.concatenate(val_tables)
     guniq, ginv = np.unique(cat_tbl.astype(str), return_inverse=True)
     tbl_starts = np.cumsum([0] + [len(t) for t in val_tables])
     file_maps = [
-        ginv[tbl_starts[fi] : tbl_starts[fi + 1]] for fi in range(len(decoded))
+        ginv[tbl_starts[fi] : tbl_starts[fi + 1]]
+        for fi in range(asm.n_files)
     ]
     for slot, tag in enumerate(tag_slots):
         code_parts = []
-        for fi, d in enumerate(decoded):
-            ids = d.tag_ids[:, slot]
+        for fi, ids_f in enumerate(asm.tag_ids):
+            ids = ids_f[:, slot]
             fmap = file_maps[fi]
             code_parts.append(fmap[np.where(ids >= 0, ids, len(fmap) - 1)])
         codes = _concat(code_parts, np.int64).astype(np.int64, copy=False)
@@ -292,6 +415,8 @@ def try_read_native(
         else:
             id_tags[tag] = col
             tag_codes[tag] = (codes, guniq)
+    if stage_reg is not None:
+        stage_reg.record("tags", time.perf_counter() - t_tags)
 
     # ---- per-shard merge, index maps, ELL pack --------------------------
     built: Dict[str, IndexMap] = {}
@@ -393,16 +518,17 @@ def try_read_native(
                 clean = False
                 indptr = np.zeros(n + 1, np.int64)
                 np.cumsum(np.bincount(rows_k, minlength=n), out=indptr[1:])
-        shards[shard], host_planes = pack_csr_to_ell(
-            indptr,
-            fidx_k,
-            vals_k,
-            imap.size,
-            assume_clean=clean,
-            extra_col=extra_col,
-            return_host=True,
-            device=False,  # ShardDict uploads on first device use
-        )
+        with stage_timer("ell"):
+            shards[shard], host_planes = pack_csr_to_ell(
+                indptr,
+                fidx_k,
+                vals_k,
+                imap.size,
+                assume_clean=clean,
+                extra_col=extra_col,
+                return_host=True,
+                device=False,  # ShardDict uploads on first device use
+            )
         host_ell[shard] = host_planes
         # Stash the host CSR (entry order is irrelevant to the bucketed
         # pack — it re-sorts by segment) so the data-plane sparse pack runs
@@ -412,6 +538,7 @@ def try_read_native(
         # and the intercept column are deferred to HostCSR.to_coo(), so the
         # ingest path never pays the COO concatenation.
         if stash_ok:
+            t_stash = time.perf_counter()
             host_csr[shard] = HostCSR(
                 indptr, fidx_k, vals_k, imap.size, extra_col
             )
@@ -434,6 +561,8 @@ def try_read_native(
                 pallas_sparse.begin_pack_async(host_csr[shard], n)
             except Exception:
                 pass
+            if stage_reg is not None:
+                stage_reg.record("stash", time.perf_counter() - t_stash)
 
     ds = GameDataset.build(
         shards, labels, offsets=offsets, weights=weights, id_tags=id_tags

@@ -28,8 +28,9 @@ from __future__ import annotations
 PREPARE_STAGES = ("re_build", "projector", "stats", "pack", "upload", "compile")
 
 # Every key a fit_timing artifact must carry: the stage breakdown plus the
-# residual, the top-level walls, the pack placement split (r06) and the
-# entity-sharding decision (r07).
+# residual, the top-level walls, the pack placement split (r06), the
+# entity-sharding decision (r07) and the RE-assembly placement split (r09
+# — where the entity-block build ran, mirroring the pack split).
 FIT_TIMING_REQUIRED_KEYS = (
     *PREPARE_STAGES,
     "other",
@@ -38,7 +39,28 @@ FIT_TIMING_REQUIRED_KEYS = (
     "pack_device_s",
     "pack_host_s",
     "pack_path",
+    "re_device_s",
+    "re_host_s",
+    "re_path",
     "sharding",
+)
+
+# ------------------------------------------------------------------- ingest
+# Per-stage ingest breakdown recorded by read_game_dataset (r09 streaming
+# data plane) and attached to the returned dataset as `ingest_timing`.
+# The stages tile the ingest wall in a synchronous run; a streaming run
+# records where the work happened (decode on the reader pool can sum past
+# the wall it was hidden behind — that excess IS the overlap win).
+INGEST_STAGES = ("decode", "assemble", "tags", "ell", "stash")
+
+# Every key an ingest_timing artifact must carry: the stage breakdown plus
+# the path taken and the chunk accounting that proves streaming engaged.
+INGEST_TIMING_REQUIRED_KEYS = (
+    *INGEST_STAGES,
+    "other",
+    "ingest_path",
+    "streaming",
+    "chunks",
 )
 
 # ------------------------------------------------------------ bench sections
@@ -105,6 +127,8 @@ SERVING_SUMMARY_KEYS = (
 ALL_CONTRACTS = {
     "PREPARE_STAGES": PREPARE_STAGES,
     "FIT_TIMING_REQUIRED_KEYS": FIT_TIMING_REQUIRED_KEYS,
+    "INGEST_STAGES": INGEST_STAGES,
+    "INGEST_TIMING_REQUIRED_KEYS": INGEST_TIMING_REQUIRED_KEYS,
     "MULTICHIP_SECTION_KEYS": MULTICHIP_SECTION_KEYS,
     "SERVING_METRIC_KEYS": SERVING_METRIC_KEYS,
     "SERVING_SHARDING_KEYS": SERVING_SHARDING_KEYS,

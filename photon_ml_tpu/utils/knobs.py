@@ -160,6 +160,32 @@ _register(
     "single-threaded pack).",
 )
 _register(
+    "PHOTON_STREAM_INGEST",
+    str,
+    "",
+    "Streaming chunked ingest (decode of chunk k+1 overlaps assembly of "
+    "chunk k): 1 forces, 0 forces the monolithic read; empty = auto (on "
+    "when >1 effective core).",
+    choices=("", *_TRUE, *_FALSE),
+)
+_register(
+    "PHOTON_STREAM_CHUNK_ROWS",
+    int,
+    262_144,
+    "Rows per streamed ingest chunk on the pure-Python codec path "
+    "(bounds decoded-record residency); the native path chunks per "
+    "container file.",
+)
+_register(
+    "PHOTON_DEVICE_ASSEMBLY",
+    str,
+    "",
+    "Random-effect entity-block assembly + index-map projection on device "
+    "(stable-sort/segment/scatter XLA programs): 1 forces, 0 forces the "
+    "host path; empty = auto (on for tpu/gpu backends).",
+    choices=("", *_TRUE, *_FALSE),
+)
+_register(
     "PHOTON_DEVICE_PACK",
     str,
     "",

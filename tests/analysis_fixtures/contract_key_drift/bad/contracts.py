@@ -2,3 +2,6 @@
 
 FIXTURE_TIMING_KEYS = ("fixture_alpha_s", "fixture_beta_s", "fixture_gamma_s")
 FIXTURE_ALL_KEYS = (*FIXTURE_TIMING_KEYS, "fixture_path")
+
+# Ingest-stage schema (r09): the streaming data plane's breakdown keys.
+FIXTURE_INGEST_STAGES = ("fixture_decode", "fixture_assemble", "fixture_ell")

@@ -1,0 +1,9 @@
+"""Known-bad: re-types two ingest-stage schema keys (the r09 INGEST_STAGES
+shape) as a literal instead of importing the tuple."""
+
+
+def check_ingest(timing):
+    breakdown = {
+        k: timing[k] for k in ("fixture_decode", "fixture_assemble")
+    }  # re-typed ingest schema
+    return breakdown

@@ -1,0 +1,10 @@
+"""Known-good: the ingest-stage schema is imported; single-key reads are
+use, not duplication."""
+
+from contracts import FIXTURE_INGEST_STAGES
+
+
+def check_ingest(timing):
+    missing = [k for k in FIXTURE_INGEST_STAGES if k not in timing]
+    decode = timing.get("fixture_decode")  # one key is everyday vocabulary
+    return missing, decode

@@ -117,7 +117,10 @@ def _failure_domain_hygiene(monkeypatch):
     * no `photon-serving-promote` thread outlives the test — a two-tier
       store's promotion worker is short-lived and joined by
       store.close()/bundle.release(); a survivor means promotions kept
-      mutating a torn-down store.
+      mutating a torn-down store;
+    * no `photon-ckpt-write` thread outlives the test — a staged
+      checkpoint write is joined by save() before the state.json commit;
+      a survivor means a step committed without its model file durable.
     """
     from photon_ml_tpu.utils import faults
 
@@ -145,6 +148,7 @@ def _failure_domain_hygiene(monkeypatch):
                     "photon-async-upload",
                     "photon-serving-flush",
                     "photon-serving-promote",
+                    "photon-ckpt-write",
                 )
             )
             and t.is_alive()

@@ -1,0 +1,249 @@
+"""Device-resident RE assembly & index-map projection: bitwise parity vs
+the host path (r09). Stable sorts are uniquely determined permutations and
+every scatter destination is unique, so PHOTON_DEVICE_ASSEMBLY=1 must
+reproduce the host arrays bit for bit — gather blocks, masks, entity rows,
+slot tables, projected planes, and the whole trained model."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from photon_ml_tpu.data import device_assemble
+from photon_ml_tpu.data import game_dataset as gd
+from photon_ml_tpu.data.containers import SparseFeatures
+from photon_ml_tpu.data.stats import summarize
+from photon_ml_tpu.game import projector as pj
+from photon_ml_tpu.types import ProjectorType
+
+
+def _dataset(seed=1, n=4000, d=48, k=4, n_entities=250, skew=True):
+    rng = np.random.default_rng(seed)
+    idx = rng.integers(0, d, size=(n, k)).astype(np.int32)
+    val = rng.normal(size=(n, k)).astype(np.float32)
+    val[rng.uniform(size=val.shape) < 0.15] = 0.0
+    ents = rng.integers(0, n_entities, size=n).astype(str)
+    if skew:  # one very frequent entity exercises the reservoir
+        ents[: n // 4] = "0"
+    ds = gd.GameDataset.build(
+        {"g": SparseFeatures(jnp.asarray(idx), jnp.asarray(val), d)},
+        rng.normal(size=n).astype(np.float32),
+        id_tags={"e": ents},
+    )
+    ds.host_ell["g"] = (idx, val)
+    return ds
+
+
+def _build_both(monkeypatch, cfg, **ds_kw):
+    out = []
+    for flag in ("0", "1"):
+        monkeypatch.setenv("PHOTON_DEVICE_ASSEMBLY", flag)
+        out.append(_dataset(**ds_kw))
+    ds_h, ds_d = out
+    monkeypatch.setenv("PHOTON_DEVICE_ASSEMBLY", "0")
+    red_h = gd._build_random_effect_dataset(ds_h, cfg)
+    monkeypatch.setenv("PHOTON_DEVICE_ASSEMBLY", "1")
+    red_d = gd._build_random_effect_dataset(ds_d, cfg)
+    return (ds_h, red_h), (ds_d, red_d)
+
+
+def _assert_blocks_equal(red_h, red_d):
+    assert len(red_h.buckets) == len(red_d.buckets)
+    for i, (bh, bd) in enumerate(zip(red_h.buckets, red_d.buckets)):
+        np.testing.assert_array_equal(
+            np.asarray(bh.gather), np.asarray(bd.gather), err_msg=f"gather {i}"
+        )
+        np.testing.assert_array_equal(
+            np.asarray(bh.mask), np.asarray(bd.mask), err_msg=f"mask {i}"
+        )
+        np.testing.assert_array_equal(
+            np.asarray(bh.entity_rows),
+            np.asarray(bd.entity_rows),
+            err_msg=f"entity_rows {i}",
+        )
+    np.testing.assert_array_equal(
+        np.asarray(red_h.sample_entity_rows),
+        np.asarray(red_d.sample_entity_rows),
+    )
+    assert red_h.num_active_samples == red_d.num_active_samples
+    assert red_h.entity_index == red_d.entity_index
+
+
+class TestEntityBlockParity:
+    @pytest.mark.parametrize(
+        "cfg_kw",
+        [
+            dict(),  # no caps: every row active
+            dict(active_upper_bound=16),  # reservoir engages
+            dict(active_lower_bound=5),  # small entities dropped
+            dict(active_upper_bound=16, active_lower_bound=3),
+            dict(active_upper_bound=8, max_block_cells=1 << 9),  # chunking
+        ],
+    )
+    def test_bitwise(self, monkeypatch, cfg_kw):
+        cfg = gd.RandomEffectDataConfig("e", "g", min_bucket=8, **cfg_kw)
+        (_, red_h), (_, red_d) = _build_both(monkeypatch, cfg)
+        _assert_blocks_equal(red_h, red_d)
+
+    def test_single_entity(self, monkeypatch):
+        cfg = gd.RandomEffectDataConfig("e", "g", active_upper_bound=32)
+        (_, red_h), (_, red_d) = _build_both(
+            monkeypatch, cfg, n=600, n_entities=1, skew=False
+        )
+        _assert_blocks_equal(red_h, red_d)
+
+    def test_auto_gate_off_on_cpu(self, monkeypatch):
+        """Auto mode mirrors device_pack: off on the CPU backend, forced
+        by PHOTON_DEVICE_ASSEMBLY=1 (the path tier-1 exercises)."""
+        monkeypatch.delenv("PHOTON_DEVICE_ASSEMBLY", raising=False)
+        import jax
+
+        expected = jax.default_backend() in ("tpu", "gpu")
+        assert device_assemble.enabled() is expected
+        monkeypatch.setenv("PHOTON_DEVICE_ASSEMBLY", "1")
+        assert device_assemble.enabled() is True
+        monkeypatch.setenv("PHOTON_DEVICE_ASSEMBLY", "0")
+        assert device_assemble.enabled() is False
+
+    def test_pearson_keeps_host_path(self, monkeypatch):
+        """Pearson feature selection needs host per-entity row lists; the
+        device gate must step aside rather than break it."""
+        monkeypatch.setenv("PHOTON_DEVICE_ASSEMBLY", "1")
+        ds = _dataset()
+        cfg = gd.RandomEffectDataConfig(
+            "e", "g", num_features_to_samples_ratio_upper_bound=0.5
+        )
+        red = gd._build_random_effect_dataset(ds, cfg)
+        assert red.feature_mask is not None
+
+
+class TestProjectorParity:
+    def _both(self, monkeypatch, want_stats=False):
+        reds = []
+        for flag in ("0", "1"):
+            monkeypatch.setenv("PHOTON_DEVICE_ASSEMBLY", flag)
+            ds = _dataset(seed=2)
+            cfg = gd.RandomEffectDataConfig("e", "g", min_bucket=8)
+            red = gd._build_random_effect_dataset(ds, cfg)
+            ps = pj.project_shard(
+                ds, red, ProjectorType.INDEX_MAP, want_stats=want_stats
+            )
+            reds.append((ds, red, ps))
+        return reds
+
+    def test_slot_tables_and_planes_bitwise(self, monkeypatch):
+        (ds_h, _, ps_h), (ds_d, _, ps_d) = self._both(monkeypatch)
+        np.testing.assert_array_equal(
+            ps_h.projector.slot_tables, ps_d.projector.slot_tables
+        )
+        assert ps_h.projector.projected_dim == ps_d.projector.projected_dim
+        sh = ds_h.peek_shard(ps_h.shard_name)
+        sd = ds_d.peek_shard(ps_d.shard_name)
+        assert sh.ell_axis == sd.ell_axis == -2
+        np.testing.assert_array_equal(
+            np.asarray(sh.indices), np.asarray(sd.indices)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(sh.values), np.asarray(sd.values)
+        )
+        assert np.asarray(sd.indices).dtype == np.asarray(sh.indices).dtype
+
+    def test_project_features_unseen_entities(self, monkeypatch):
+        """Scoring-time projection (validation data) routes unseen
+        entities to all-zero rows on both paths, bitwise."""
+        (_, red_h, ps_h), (_, red_d, ps_d) = self._both(monkeypatch)
+        rng = np.random.default_rng(9)
+        m, k = 400, 4
+        idx = rng.integers(0, 48, size=(m, k)).astype(np.int32)
+        val = rng.normal(size=(m, k)).astype(np.float32)
+        ents = rng.integers(0, red_h.num_entities + 1, size=m)  # incl unseen
+        feats = SparseFeatures(jnp.asarray(idx), jnp.asarray(val), 48)
+        monkeypatch.setenv("PHOTON_DEVICE_ASSEMBLY", "0")
+        out_h = ps_h.projector.project_features(feats, ents, (idx, val))
+        monkeypatch.setenv("PHOTON_DEVICE_ASSEMBLY", "1")
+        out_d = ps_d.projector.project_features(feats, ents, (idx, val))
+        np.testing.assert_array_equal(
+            np.asarray(out_h.indices), np.asarray(out_d.indices)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(out_h.values), np.asarray(out_d.values)
+        )
+
+    def test_fused_stats_bitwise_vs_summarize(self, monkeypatch):
+        """The fused auxiliary pass: want_stats folds the feature summary
+        into the projector build's sweep; the result must be BITWISE what
+        a standalone summarize() of the original shard computes."""
+        (_, _, ps_h), (ds_d, _, ps_d) = self._both(monkeypatch, want_stats=True)
+        assert ps_h.projector.original_stats is None  # host path: no fusion
+        st = ps_d.projector.original_stats
+        assert st is not None
+        idx, val = ds_d.host_ell["g"]
+        ref = summarize(SparseFeatures(jnp.asarray(idx), jnp.asarray(val), 48))
+        for f in (
+            "count",
+            "mean",
+            "variance",
+            "num_nonzeros",
+            "max",
+            "min",
+            "norm_l1",
+            "norm_l2",
+            "mean_abs",
+        ):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(st, f)),
+                np.asarray(getattr(ref, f)),
+                err_msg=f,
+            )
+
+    def test_unsupported_key_space_falls_back(self, monkeypatch):
+        monkeypatch.setenv("PHOTON_DEVICE_ASSEMBLY", "1")
+        assert not device_assemble.projector_supported(2**16, 2**16)
+        assert device_assemble.projector_supported(140_000, 200)
+
+
+class TestEndToEndFitParity:
+    def test_trained_model_bitwise(self, monkeypatch):
+        """The whole point: a fit under PHOTON_DEVICE_ASSEMBLY=1 trains a
+        model bitwise-equal to the host data plane's."""
+        from photon_ml_tpu.estimators.game_estimator import GameEstimator
+        from photon_ml_tpu.optimize.config import (
+            CoordinateOptimizationConfig,
+            OptimizerConfig,
+        )
+        from photon_ml_tpu.types import TaskType
+
+        models = []
+        for flag in ("0", "1"):
+            monkeypatch.setenv("PHOTON_DEVICE_ASSEMBLY", flag)
+            ds = _dataset(seed=4, n=2500, n_entities=80)
+            est = GameEstimator(
+                TaskType.LOGISTIC_REGRESSION,
+                {
+                    "fe": gd.FixedEffectDataConfig("g"),
+                    "re": gd.RandomEffectDataConfig(
+                        "e", "g", active_upper_bound=24
+                    ),
+                },
+            )
+            cfg = {
+                "fe": CoordinateOptimizationConfig(
+                    optimizer=OptimizerConfig(max_iterations=3)
+                ),
+                "re": CoordinateOptimizationConfig(
+                    optimizer=OptimizerConfig(max_iterations=3)
+                ),
+            }
+            res = est.fit(ds, None, [cfg])
+            models.append((res[0].model, dict(est.fit_timing)))
+        (m_h, t_h), (m_d, t_d) = models
+        assert t_h["re_path"] == "host" and t_d["re_path"] == "device"
+        assert t_d["re_device_s"] > 0.0 and t_h["re_host_s"] > 0.0
+        np.testing.assert_array_equal(
+            np.asarray(m_h.models["fe"].coefficients.means),
+            np.asarray(m_d.models["fe"].coefficients.means),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(m_h.models["re"].coefficients_matrix),
+            np.asarray(m_d.models["re"].coefficients_matrix),
+        )

@@ -18,6 +18,16 @@ GAME_IN = os.path.join(REF, "GameIntegTest/input")
 needs_native = pytest.mark.skipif(
     load_native() is None, reason="native library unavailable"
 )
+# The reference's own integration fixtures (heart.avro,
+# yahoo-music-train.avro) ship with a photon-ml checkout, not with this
+# repo — on hosts without one the parity suite must SKIP with a reason,
+# not fail red forever (TestGeneratedParity covers the same decode paths
+# on generated data everywhere).
+needs_reference_fixtures = pytest.mark.skipif(
+    not os.path.isdir(DRIVER_IN),
+    reason=f"reference fixture tree not present at {REF} "
+    "(clone photon-ml to run the reference-parity suite)",
+)
 
 
 def _dense(ds, shard, size):
@@ -56,6 +66,7 @@ def _assert_parity(path, cfgs, tags=()):
 
 
 @needs_native
+@needs_reference_fixtures
 class TestReferenceFixtureParity:
     def test_heart(self):
         _assert_parity(

@@ -128,3 +128,137 @@ class TestSparsePerfGuards:
             f"native pack {native_wall:.3f}s vs numpy {numpy_wall:.3f}s — "
             "the counting sort regressed below the oracle it replaced"
         )
+
+
+class TestDataPlaneGuards:
+    """r09 streaming data plane: cheap structural gate checks run in
+    tier-1; the scaled-down e2e guard (slow+perf) asserts the two walls
+    the tentpole exists to move — device RE assembly engaged, prepare not
+    dominating solve."""
+
+    def test_device_assembly_auto_on_for_accelerators(self, monkeypatch):
+        """The auto gate must engage on accelerator backends (the r03
+        pack-gate bug class: a silently-off fast path for a whole round).
+        Backend is monkeypatched — this checks the DECISION, not the
+        hardware."""
+        import jax
+
+        from photon_ml_tpu.data import device_assemble
+
+        monkeypatch.delenv("PHOTON_DEVICE_ASSEMBLY", raising=False)
+        for backend, expect in (("tpu", True), ("gpu", True), ("cpu", False)):
+            monkeypatch.setattr(jax, "default_backend", lambda b=backend: b)
+            assert device_assemble.enabled() is expect, backend
+
+    def test_stream_ingest_auto_gates_on_cores(self, monkeypatch):
+        from photon_ml_tpu.io import avro_fast
+
+        monkeypatch.delenv("PHOTON_STREAM_INGEST", raising=False)
+        monkeypatch.setenv("PHOTON_HOST_THREADS", "1")
+        assert avro_fast.stream_ingest_enabled() is False
+        monkeypatch.setenv("PHOTON_HOST_THREADS", "4")
+        assert avro_fast.stream_ingest_enabled() is True
+        monkeypatch.setenv("PHOTON_STREAM_INGEST", "0")
+        assert avro_fast.stream_ingest_enabled() is False
+
+
+@pytest.mark.slow
+@pytest.mark.perf
+class TestPrepareNotDominantGuard:
+    def test_scaled_e2e_prepare_below_solve(self, monkeypatch, tmp_path):
+        """Scaled-down e2e_from_disk shape (the r05 469 s wall, shrunk):
+        with the streaming data plane forced on, device RE assembly must
+        ENGAGE and the prepare wall must come in under the solve wall —
+        the acceptance shape of ISSUE 9, as a regression tripwire."""
+        import photon_ml_tpu.io.avro_data as ad
+        from photon_ml_tpu.data.game_dataset import (
+            FixedEffectDataConfig,
+            RandomEffectDataConfig,
+        )
+        from photon_ml_tpu.estimators.game_estimator import GameEstimator
+        from photon_ml_tpu.native.avro_writer import (
+            write_training_examples_columnar,
+        )
+        from photon_ml_tpu.optimize.config import (
+            L2,
+            CoordinateOptimizationConfig,
+            OptimizerConfig,
+        )
+        from photon_ml_tpu.types import TaskType
+        from photon_ml_tpu.utils.contracts import (
+            INGEST_TIMING_REQUIRED_KEYS,
+        )
+
+        monkeypatch.setenv("PHOTON_DEVICE_ASSEMBLY", "1")
+        monkeypatch.setenv("PHOTON_DEVICE_PACK", "1")
+        monkeypatch.setenv("PHOTON_STREAM_INGEST", "1")
+        monkeypatch.setenv("PHOTON_HOST_THREADS", "4")
+        rows_n, d, k = 120_000, 200, 8
+        n_users, n_movies = rows_n // 145, rows_n // 740
+        rng = np.random.default_rng(11)
+        users = rng.integers(0, n_users, size=rows_n)
+        movies = rng.integers(0, n_movies, size=rows_n)
+        indptr = np.arange(rows_n + 1, dtype=np.int64) * k
+        ids = rng.integers(0, d, size=rows_n * k).astype(np.int32)
+        vals = rng.normal(size=rows_n * k)
+        labels = (rng.uniform(size=rows_n) > 0.5).astype(np.float64)
+        names = [f"f{i}" for i in range(d)]
+        half = rows_n // 2
+        for fi, (lo, hi) in enumerate([(0, half), (half, rows_n)]):
+            write_training_examples_columnar(
+                str(tmp_path / f"part-{fi}.avro"),
+                labels[lo:hi],
+                indptr[lo : hi + 1] - indptr[lo],
+                ids[indptr[lo] : indptr[hi]],
+                vals[indptr[lo] : indptr[hi]],
+                names,
+                int_tags={"userId": users[lo:hi], "movieId": movies[lo:hi]},
+            )
+        ds, _ = ad.read_game_dataset(
+            str(tmp_path),
+            {"g": ad.FeatureShardConfig(("features",), True)},
+            id_tag_fields=["userId", "movieId"],
+        )
+        missing = [
+            k2 for k2 in INGEST_TIMING_REQUIRED_KEYS if k2 not in ds.ingest_timing
+        ]
+        assert not missing, missing
+        est = GameEstimator(
+            TaskType.LOGISTIC_REGRESSION,
+            {
+                "global": FixedEffectDataConfig("g"),
+                "per-user": RandomEffectDataConfig(
+                    "userId", "g", active_upper_bound=128
+                ),
+                "per-movie": RandomEffectDataConfig(
+                    "movieId", "g", active_upper_bound=256
+                ),
+            },
+        )
+        cfgs = {
+            "global": CoordinateOptimizationConfig(
+                optimizer=OptimizerConfig(max_iterations=10, tolerance=1e-6),
+                regularization=L2,
+                reg_weight=1.0,
+            ),
+            "per-user": CoordinateOptimizationConfig(
+                optimizer=OptimizerConfig(max_iterations=5, tolerance=1e-5),
+                regularization=L2,
+                reg_weight=10.0,
+            ),
+            "per-movie": CoordinateOptimizationConfig(
+                optimizer=OptimizerConfig(max_iterations=5, tolerance=1e-5),
+                regularization=L2,
+                reg_weight=10.0,
+            ),
+        }
+        est.fit(ds, None, [cfgs])
+        ft = est.fit_timing
+        assert ft["re_path"] == "device", (
+            "device-side RE assembly did not engage on the e2e shape"
+        )
+        assert ft["re_host_s"] == 0.0
+        assert ft["prepare_s"] < ft["solve_s"], (
+            f"prepare {ft['prepare_s']:.1f}s dominates solve "
+            f"{ft['solve_s']:.1f}s — the r05 wall is back"
+        )

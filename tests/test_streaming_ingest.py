@@ -1,0 +1,263 @@
+"""Streaming-vs-monolithic ingest bitwise parity (r09 streaming data plane).
+
+The chunked/streamed ingest paths move only WHEN decode and assembly run —
+never what they compute: dataset arrays, index maps, and id-tag codes must
+be identical across chunk sizes, file orderings, with the threaded
+decode→assemble overlap forced on or off, and with corrupt-block
+quarantine active.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import photon_ml_tpu.io.avro_data as ad
+from photon_ml_tpu.io import avro as avro_io
+from photon_ml_tpu.io import avro_fast, schemas
+from photon_ml_tpu.native.build import load_native
+from photon_ml_tpu.utils.contracts import INGEST_TIMING_REQUIRED_KEYS
+
+needs_native = pytest.mark.skipif(
+    load_native() is None, reason="native library unavailable"
+)
+
+CFGS = {"g": ad.FeatureShardConfig(("features",), True)}
+
+
+def _write_file(path, n, seed, n_entities=20, d=50):
+    rng = np.random.default_rng(seed)
+    feats = [
+        [
+            (f"f{j}", float(rng.normal()))
+            for j in rng.choice(d, size=5, replace=False)
+        ]
+        for _ in range(n)
+    ]
+    labels = (rng.uniform(size=n) > 0.5).astype(float)
+    ad.write_training_examples(
+        path,
+        feats,
+        labels,
+        offsets=rng.normal(size=n),
+        weights=rng.uniform(0.5, 2.0, size=n),
+        uids=[f"u{seed}-{i}" for i in range(n)],
+        id_tags={"entityId": rng.integers(0, n_entities, size=n).astype(str)},
+    )
+
+
+def _read(paths, **env):
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update({k: str(v) for k, v in env.items()})
+    try:
+        return ad.read_game_dataset(
+            paths, CFGS, id_tag_fields=["entityId"]
+        )
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _assert_datasets_equal(a, b):
+    ds_a, maps_a = a
+    ds_b, maps_b = b
+    assert ds_a.num_samples == ds_b.num_samples
+    for k in ("labels", "offsets", "weights"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(ds_a, k)),
+            np.asarray(getattr(ds_b, k)),
+            err_msg=k,
+        )
+    assert set(ds_a.id_tags) == set(ds_b.id_tags)
+    for t in ds_a.id_tags:
+        assert np.array_equal(ds_a.id_tags[t], ds_b.id_tags[t]), t
+    # Factorized tag codes (when present on both) must agree too — entity
+    # grouping consumes them directly.
+    for t in set(ds_a.tag_codes) & set(ds_b.tag_codes):
+        np.testing.assert_array_equal(ds_a.tag_codes[t][0], ds_b.tag_codes[t][0])
+        np.testing.assert_array_equal(ds_a.tag_codes[t][1], ds_b.tag_codes[t][1])
+    for shard in maps_a:
+        assert maps_a[shard].size == maps_b[shard].size
+        sa, sb = ds_a.shards[shard], ds_b.shards[shard]
+        np.testing.assert_array_equal(
+            np.asarray(sa.indices), np.asarray(sb.indices)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(sa.values), np.asarray(sb.values)
+        )
+
+
+@pytest.fixture
+def three_files(tmp_path):
+    paths = []
+    for i, n in enumerate([120, 80, 150]):
+        p = str(tmp_path / f"part-{i:05d}.avro")
+        _write_file(p, n, seed=10 + i)
+        paths.append(p)
+    return paths
+
+
+class TestPythonChunkedParity:
+    """The pure-Python codec path streams PHOTON_STREAM_CHUNK_ROWS-row
+    column chunks; chunk boundaries cannot change anything."""
+
+    @pytest.mark.parametrize("chunk", [1, 7, 64, 10_000])
+    def test_chunk_sizes_bitwise(self, three_files, chunk):
+        base = _read(three_files, PHOTON_DISABLE_NATIVE="1")
+        chunked = _read(
+            three_files,
+            PHOTON_DISABLE_NATIVE="1",
+            PHOTON_STREAM_CHUNK_ROWS=chunk,
+        )
+        _assert_datasets_equal(base, chunked)
+        ds, _ = chunked
+        expect = -(-350 // chunk)
+        assert ds.ingest_timing["chunks"] == expect
+
+    def test_ingest_timing_contract(self, three_files):
+        ds, _ = _read(three_files, PHOTON_DISABLE_NATIVE="1")
+        missing = [
+            k for k in INGEST_TIMING_REQUIRED_KEYS if k not in ds.ingest_timing
+        ]
+        assert not missing, missing
+        assert ds.ingest_timing["ingest_path"] == "python"
+
+
+@needs_native
+class TestNativeStreamingParity:
+    """The native path's bounded-window decode→assemble overlap consumes
+    files strictly in order; streaming on/off is bitwise-identical."""
+
+    def test_streaming_vs_monolithic(self, three_files):
+        mono = _read(
+            three_files, PHOTON_STREAM_INGEST="0", PHOTON_HOST_THREADS="4"
+        )
+        stream = _read(
+            three_files, PHOTON_STREAM_INGEST="1", PHOTON_HOST_THREADS="4"
+        )
+        assert mono[0].ingest_timing["streaming"] is False
+        assert stream[0].ingest_timing["streaming"] is True
+        assert stream[0].ingest_timing["ingest_path"] == "native-stream"
+        assert stream[0].ingest_timing["chunks"] == 3
+        _assert_datasets_equal(mono, stream)
+
+    def test_streaming_auto_off_on_one_core(self, three_files):
+        """The 1-core auto-off gate every host-parallel knob carries: an
+        unset PHOTON_STREAM_INGEST with one effective core must stay on
+        the monolithic path (a producer thread would steal the core)."""
+        ds, _ = _read(three_files, PHOTON_HOST_THREADS="1")
+        assert ds.ingest_timing["streaming"] is False
+
+    def test_file_ordering(self, three_files):
+        """Path order is data order (the reference's readMerged `paths`
+        contract): a permuted path list must produce the permuted rows and
+        the identical per-row features, and the SAME feature index maps
+        (map construction sorts keys, so file order cannot leak in)."""
+        fwd_ds, fwd_maps = _read(
+            three_files, PHOTON_STREAM_INGEST="1", PHOTON_HOST_THREADS="4"
+        )
+        perm = [three_files[2], three_files[0], three_files[1]]
+        rev_ds, rev_maps = _read(
+            perm, PHOTON_STREAM_INGEST="1", PHOTON_HOST_THREADS="4"
+        )
+        assert fwd_maps["g"].size == rev_maps["g"].size
+        sizes = [120, 80, 150]
+        starts = np.cumsum([0] + sizes)
+        order = np.concatenate(
+            [np.arange(starts[i], starts[i + 1]) for i in (2, 0, 1)]
+        )
+        np.testing.assert_array_equal(
+            np.asarray(rev_ds.labels), np.asarray(fwd_ds.labels)[order]
+        )
+        assert np.array_equal(
+            rev_ds.id_tags["entityId"], fwd_ds.id_tags["entityId"][order]
+        )
+        # Same index map -> per-row dense feature vectors identical.
+        fi, fv = np.asarray(fwd_ds.shards["g"].indices), np.asarray(
+            fwd_ds.shards["g"].values
+        )
+        ri, rv = np.asarray(rev_ds.shards["g"].indices), np.asarray(
+            rev_ds.shards["g"].values
+        )
+        d = fwd_maps["g"].size
+        dense_f = np.zeros((len(order), d), np.float64)
+        dense_r = np.zeros((len(order), d), np.float64)
+        rows = np.repeat(np.arange(len(order)), fi.shape[1])
+        np.add.at(dense_f, (rows, fi.ravel()), fv.ravel())
+        rows_r = np.repeat(np.arange(len(order)), ri.shape[1])
+        np.add.at(dense_r, (rows_r, ri.ravel()), rv.ravel())
+        np.testing.assert_array_equal(dense_r, dense_f[order])
+
+    def test_native_vs_python_after_restructure(self, three_files):
+        """The streaming restructure keeps the native/python parity the
+        fixture suite pins: both paths, same arrays."""
+        nat = _read(three_files, PHOTON_STREAM_INGEST="1", PHOTON_HOST_THREADS="4")
+        py = _read(three_files, PHOTON_DISABLE_NATIVE="1")
+        _assert_datasets_equal(nat, py)
+
+
+class TestQuarantinedIngestParity:
+    """Chunked ingest with quarantine=True corrupt-block handling: the
+    surviving rows are identical across chunk sizes, and the quarantine
+    counter fires exactly once for the one smashed block."""
+
+    def _corrupt_middle_block(self, tmp_path):
+        rows = []
+        rng = np.random.default_rng(3)
+        for i in range(30):
+            rows.append(
+                {
+                    "uid": f"u{i}",
+                    "label": float(i % 2),
+                    "features": [
+                        {"name": f"f{int(j)}", "term": "", "value": 1.0 + i}
+                        for j in rng.choice(20, size=3, replace=False)
+                    ],
+                    "weight": 1.0,
+                    "offset": 0.0,
+                    "metadataMap": {"entityId": str(i % 5)},
+                }
+            )
+        p = str(tmp_path / "q.avro")
+        avro_io.write_container(
+            p, schemas.TRAINING_EXAMPLE, rows, block_records=10
+        )
+        data = bytearray(open(p, "rb").read())
+        _, _, sync, _ = avro_io.read_header(bytes(data), p)
+        marks, start = [], 0
+        while True:
+            i = bytes(data).find(sync, start)
+            if i < 0:
+                break
+            marks.append(i)
+            start = i + 1
+        # marks[0] ends the header; smash block 2 (between marks[1] and
+        # marks[2]).
+        lo, hi = marks[1] + len(sync), marks[2]
+        data[lo:hi] = b"\xff" * (hi - lo)
+        open(p, "wb").write(bytes(data))
+        return p
+
+    @pytest.mark.parametrize("chunk", [4, 1000])
+    def test_quarantine_parity_across_chunks(self, tmp_path, chunk):
+        from photon_ml_tpu.utils import faults
+
+        p = self._corrupt_middle_block(tmp_path)
+        ds, maps = _read(
+            [p],
+            PHOTON_DISABLE_NATIVE="1",
+            PHOTON_STREAM_CHUNK_ROWS=chunk,
+        )
+        # Rows 10..19 (the smashed block) are gone; the rest survive.
+        assert ds.num_samples == 20
+        assert faults.COUNTERS.get("quarantined_blocks") >= 1
+        labels = np.asarray(ds.labels)
+        expect = np.asarray(
+            [float(i % 2) for i in list(range(10)) + list(range(20, 30))],
+            np.float32,
+        )
+        np.testing.assert_array_equal(labels, expect)
+        assert list(ds.id_tags["entityId"][:3]) == ["0", "1", "2"]
