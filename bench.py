@@ -44,6 +44,7 @@ import time
 
 _CHILD = "--run-child"
 _MULTICHIP_CHILD = "--run-multichip"
+_CHAOS_MULTICHIP_CHILD = "--run-chaos-multichip"
 
 # Physical HBM roofline per chip (GB/s): v5e HBM2 peak ~819 GB/s. Any
 # achieved-bandwidth figure above it is a measurement artifact (rtt
@@ -383,6 +384,263 @@ def _multichip_child() -> None:
                 overlap_train_max_rel_dw=overlap_rel_dw,
                 overlap_serve_sharded_bitwise=overlap_serve_sharded_bitwise,
                 overlap_serve_two_tier_bitwise=overlap_serve_two_tier_bitwise,
+            )
+        )
+    )
+
+
+def _chaos_multichip_child() -> None:
+    """Pod-scale chaos certificate (ISSUE 10): an 8-virtual-device mesh
+    with EVERY mesh fault site armed (PHOTON_FAULTS from the parent:
+    collective/shard_upload/promote/resume_load, plus the hang watchdog)
+    must degrade or retry without failing a fit or a request, and recover
+    to bitwise serve parity. Phases:
+
+      1. CLEAN: entity-sharded fit + replicated serve reference (faults
+         explicitly disarmed with an empty installed plan).
+      2. CHAOS FIT: same fit under the armed plan with a sharded
+         checkpoint — the collective re-dispatch must land bitwise.
+      3. CHAOS RESUME: re-run against the checkpoint — resume_load fires
+         on the first shard read, retries, fast-forwards bitwise.
+      4. CHAOS SERVE: sharded bundle (shard_upload fires at staging) and
+         two-tier bundle (promote fires at the first promotion) answer a
+         replay through the micro-batcher — zero failed, zero hangs,
+         bitwise vs the clean reference.
+      5. SHARD LOSS DRILL: mark one shard lost (exactly its entities go
+         bitwise FE-only), restage ONLY that shard, recover bitwise.
+
+    Prints exactly one JSON line."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.data.game_dataset import (
+        GameDataset,
+        RandomEffectDataConfig,
+        build_random_effect_dataset,
+    )
+    from photon_ml_tpu.game.coordinate import RandomEffectCoordinate
+    from photon_ml_tpu.game.coordinate_descent import run_coordinate_descent
+    from photon_ml_tpu.game.model import (
+        Coefficients,
+        FixedEffectModel,
+        GameModel,
+        RandomEffectModel,
+    )
+    from photon_ml_tpu.optimize.config import (
+        L2,
+        CoordinateOptimizationConfig,
+        OptimizerConfig,
+    )
+    from photon_ml_tpu.parallel.mesh import (
+        make_mesh,
+        pad_game_dataset,
+        shard_game_dataset,
+        shard_random_effect_dataset,
+    )
+    from photon_ml_tpu.serving import (
+        ScoreRequest,
+        ServingBundle,
+        ServingEngine,
+    )
+    from photon_ml_tpu.transformers.game_transformer import (
+        CoordinateScoringSpec,
+    )
+    from photon_ml_tpu.types import TaskType
+    from photon_ml_tpu.utils import faults
+    from photon_ml_tpu.utils.knobs import get_knob
+
+    task = TaskType.LOGISTIC_REGRESSION
+    mesh = make_mesh()
+    ndev = int(mesh.devices.size)
+    armed_spec = str(get_knob("PHOTON_FAULTS")).strip()
+    import tempfile
+
+    e, rows_each, d_re = 16 * ndev, 4, 8
+    n = e * rows_each  # divisible by ndev: elastic resume fingerprints match
+    rng = np.random.default_rng(41)
+    Xe = rng.normal(size=(n, d_re)).astype(np.float32)
+    ent = np.repeat(np.arange(e), rows_each)
+    y = (rng.uniform(size=n) > 0.5).astype(np.float32)
+    cfg = CoordinateOptimizationConfig(
+        optimizer=OptimizerConfig(max_iterations=6, tolerance=1e-7),
+        regularization=L2,
+        reg_weight=1.0,
+    )
+    re_cfg = RandomEffectDataConfig("entityId", "re", min_bucket=8)
+
+    def coords(sharded: bool):
+        ds = GameDataset.build(
+            {"re": jnp.asarray(Xe)}, y, id_tags={"entityId": ent}
+        )
+        if sharded:
+            ds = shard_game_dataset(pad_game_dataset(ds, ndev), mesh)
+            red = shard_random_effect_dataset(
+                build_random_effect_dataset(ds, re_cfg), mesh
+            )
+        else:
+            red = build_random_effect_dataset(ds, re_cfg)
+        return {"re": RandomEffectCoordinate(ds, red, cfg, task)}, red
+
+    def logical(result):
+        m = np.asarray(result.model.models["re"].coefficients_matrix)
+        return m[: e + 1]
+
+    # ---- phase 1: CLEAN references (faults disarmed) ----------------------
+    faults.install("")  # empty plan: nothing armed, env plan masked
+    c, red_clean = coords(True)
+    clean = logical(run_coordinate_descent(c, 2, seed=13))
+    d_fe = 8
+    w_fe = rng.normal(size=d_fe).astype(np.float32)
+    entity_index = dict(red_clean.entity_index)
+    specs = {
+        "fixed": CoordinateScoringSpec(shard="g"),
+        "per-entity": CoordinateScoringSpec(
+            shard="re",
+            random_effect_type="entityId",
+            entity_index=entity_index,
+        ),
+    }
+
+    def game_model(matrix):
+        return GameModel(
+            {
+                "fixed": FixedEffectModel(
+                    Coefficients(jnp.asarray(w_fe)), task
+                ),
+                "per-entity": RandomEffectModel(
+                    jnp.asarray(matrix), None, task
+                ),
+            }
+        )
+
+    n_req = 128
+    Xq_fe = rng.normal(size=(n_req, d_fe)).astype(np.float32)
+    Xq_re = rng.normal(size=(n_req, d_re)).astype(np.float32)
+    q_ent = rng.integers(0, e, size=n_req)
+    reqs = [
+        ScoreRequest(
+            features={"g": Xq_fe[i], "re": Xq_re[i]},
+            entity_ids={"entityId": int(q_ent[i])},
+            uid=str(i),
+        )
+        for i in range(n_req)
+    ]
+    gm_clean = game_model(clean)
+    with ServingEngine(
+        ServingBundle.from_model(gm_clean, specs, task), max_batch=32
+    ) as eng_ref:
+        ref_scores = np.asarray(
+            [r.score for r in eng_ref.score_batch(reqs)], np.float64
+        )
+        ref_fe = np.asarray(
+            [r.score for r in eng_ref.score_batch_fe_only(reqs)], np.float64
+        )
+
+    # ---- phases 2-5: CHAOS (the env plan re-arms on clear) ----------------
+    faults.reset_counters()
+    faults.clear()
+    with tempfile.TemporaryDirectory() as td:
+        ck = os.path.join(td, "ck")
+        c, _ = coords(True)
+        chaos = logical(
+            run_coordinate_descent(c, 2, seed=13, checkpoint_dir=ck)
+        )
+        train_bitwise = bool(np.array_equal(clean, chaos))
+        c, _ = coords(True)
+        resumed = logical(
+            run_coordinate_descent(c, 2, seed=13, checkpoint_dir=ck)
+        )
+        resume_bitwise = bool(np.array_equal(chaos, resumed))
+
+    failed_requests = 0
+    hangs = 0
+
+    from concurrent.futures import TimeoutError as _FutTimeout
+
+    def replay(engine):
+        nonlocal failed_requests, hangs
+        out = [None] * n_req
+        with engine.batcher(max_wait_ms=1.0) as b:
+            futs = [b.submit(r, block=True) for r in reqs]
+            for i, f in enumerate(futs):
+                try:
+                    out[i] = f.result(timeout=60)
+                except (_FutTimeout, TimeoutError):
+                    # Both: concurrent.futures.TimeoutError is NOT the
+                    # builtin TimeoutError on 3.10 — catching only the
+                    # builtin would count a hang as a request failure.
+                    hangs += 1
+                except Exception:  # noqa: BLE001 - counted, contract-fatal
+                    failed_requests += 1
+        return np.asarray(
+            [np.nan if r is None else r.score for r in out], np.float64
+        )
+
+    gm_chaos = game_model(chaos)
+    # Sharded bundle: shard_upload fires at staging (retried), then the
+    # shard-loss drill exercises degradation + targeted recovery.
+    bundle_sh = ServingBundle.from_model(gm_chaos, specs, task, mesh=mesh)
+    restaged_bytes = 0
+    with ServingEngine(bundle_sh, max_batch=32) as eng_sh:
+        eng_sh.warmup()
+        got_sh = replay(eng_sh)
+        serve_bitwise = bool(np.array_equal(got_sh, ref_scores))
+        lo, hi = eng_sh.mark_shard_lost("per-entity", 0)
+        got_lost = replay(eng_sh)
+        rows, _ = bundle_sh.coordinates["per-entity"].lookup_rows(
+            [int(i) for i in q_ent]
+        )
+        lost_mask = (rows >= lo) & (rows < hi)
+        expected = np.where(lost_mask, ref_fe, ref_scores)
+        shard_loss_bitwise = bool(np.array_equal(got_lost, expected))
+        restaged_bytes = eng_sh.restage_shard("per-entity", 0)
+        got_rec = replay(eng_sh)
+        recovery_bitwise = bool(np.array_equal(got_rec, ref_scores))
+        loss_fallbacks = eng_sh.metrics()["sharding"][
+            "shard_loss_fallbacks"
+        ]
+    # Two-tier bundle: promote fires at the first promotion batch (rows
+    # stay cold, answers stay bitwise).
+    bundle_tt = ServingBundle.from_model(
+        gm_chaos, specs, task, hot_rows=e // 4
+    )
+    try:
+        with ServingEngine(bundle_tt, max_batch=32) as eng_tt:
+            eng_tt.warmup()
+            got_tt = replay(eng_tt)
+            bundle_tt.coordinates["per-entity"].store.drain()
+            got_tt2 = replay(eng_tt)
+            serve_bitwise = serve_bitwise and bool(
+                np.array_equal(got_tt, ref_scores)
+            ) and bool(np.array_equal(got_tt2, ref_scores))
+    finally:
+        bundle_tt.release()
+
+    counters = faults.counters()
+    print(
+        json.dumps(
+            dict(
+                n_devices=ndev,
+                faults_armed=armed_spec,
+                injected_faults=int(counters.get("injected_faults", 0)),
+                collective_retries=int(
+                    counters.get("collective_retries", 0)
+                ),
+                shard_upload_retries=int(
+                    counters.get("shard_upload_retries", 0)
+                ),
+                promote_failures=int(counters.get("promote_failures", 0)),
+                watchdog_trips=int(counters.get("watchdog_trips", 0)),
+                failed_requests=int(failed_requests),
+                hangs=int(hangs),
+                train_bitwise_vs_clean=train_bitwise,
+                resume_bitwise_vs_train=resume_bitwise,
+                serve_bitwise_vs_clean=serve_bitwise,
+                shard_loss_fe_only_bitwise=shard_loss_bitwise,
+                post_recovery_bitwise=recovery_bitwise,
+                shard_loss_fallbacks=int(loss_fallbacks),
+                restaged_bytes=int(restaged_bytes),
             )
         )
     )
@@ -832,6 +1090,91 @@ def _child() -> None:
             failed=True, reason=f"{type(exc).__name__}: {exc}"
         )
 
+    # ---- chaos multichip: pod-scale failure domains under armed faults ----
+    # Own 8-virtual-device subprocess with EVERY mesh fault site armed
+    # (PHOTON_FAULTS) and the hang watchdog on: the contract asserts zero
+    # failed requests, zero hangs, and bitwise train/resume/serve parity
+    # through the degradations — the pod-scale analogue of the PR 5
+    # serving_overload gate.
+    try:
+        env_cm = dict(os.environ)
+        env_cm["JAX_PLATFORMS"] = "cpu"
+        env_cm.pop("PALLAS_AXON_POOL_IPS", None)
+        flags_cm = env_cm.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags_cm:
+            env_cm["XLA_FLAGS"] = (
+                flags_cm + " --xla_force_host_platform_device_count=8"
+            ).strip()
+        env_cm["PHOTON_FAULTS"] = (
+            "collective:1,shard_upload:1,promote:1,resume_load:1"
+        )
+        env_cm["PHOTON_WATCHDOG_MS"] = "30000"
+        env_cm["PHOTON_RETRY_BASE_DELAY_S"] = "0.01"
+        out_cm = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), _CHAOS_MULTICHIP_CHILD],
+            capture_output=True,
+            text=True,
+            timeout=600,
+            env=env_cm,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        line_cm = next(
+            (l for l in out_cm.stdout.splitlines() if l.startswith("{")), None
+        )
+        if line_cm is None:
+            raise RuntimeError(
+                f"chaos_multichip child produced no JSON: {out_cm.stderr[-1500:]}"
+            )
+        cm = json.loads(line_cm)
+        from photon_ml_tpu.utils.contracts import (
+            CHAOS_MULTICHIP_SECTION_KEYS,
+        )
+
+        missing_cm = [
+            k for k in CHAOS_MULTICHIP_SECTION_KEYS if cm.get(k) is None
+        ]
+        if missing_cm:
+            raise RuntimeError(
+                f"chaos_multichip section is missing keys {missing_cm} — "
+                "the pod-scale chaos contract is broken"
+            )
+        if cm["injected_faults"] == 0:
+            raise RuntimeError(
+                "chaos_multichip injected nothing — the armed plan "
+                f"({cm['faults_armed']!r}) tested nothing"
+            )
+        if cm["failed_requests"] or cm["hangs"]:
+            raise RuntimeError(
+                f"chaos_multichip dropped traffic: {cm['failed_requests']} "
+                f"failed, {cm['hangs']} hung — every armed mesh fault must "
+                "degrade or retry, never fail a request"
+            )
+        # Every bitwise-parity flag in the schema must hold (derived from
+        # the imported contract so a renamed key cannot drift past here).
+        parity_keys = [
+            k for k in CHAOS_MULTICHIP_SECTION_KEYS if "bitwise" in k
+        ]
+        bad_parity = [k for k in parity_keys if not cm[k]]
+        if bad_parity:
+            raise RuntimeError(
+                f"chaos_multichip parity broken: {bad_parity} — a "
+                "degradation changed answers"
+            )
+        variants["chaos_multichip"] = cm
+        _mark(
+            f"chaos_multichip survived ({cm['injected_faults']} faults: "
+            f"{cm['collective_retries']} collective retries, "
+            f"{cm['shard_upload_retries']} shard-upload retries, "
+            f"{cm['promote_failures']} promote failures; 0 failed, 0 hung)"
+        )
+    except Exception as exc:  # noqa: BLE001 - bench must still print a line
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        variants["chaos_multichip"] = dict(
+            failed=True, reason=f"{type(exc).__name__}: {exc}"
+        )
+
     # ---- online serving (pinned bundle + deadline micro-batcher) ----------
     # The north star serves live traffic; this measures the online path the
     # offline scoring number cannot show: per-request latency through the
@@ -950,15 +1293,22 @@ def _child() -> None:
                 "clean serving run reported degraded batches "
                 f"({m_srv_metrics['degraded_batches']}) — robustness regression"
             )
-        # Clean-run zero contract (ISSUE 5): an un-faulted, un-overloaded
-        # replay must shed nothing, miss no deadline, never open the
-        # circuit, and quarantine no Avro block.
-        from photon_ml_tpu.utils.contracts import SERVING_CLEAN_ZERO_KEYS
+        # Clean-run zero contract (ISSUE 5 + ISSUE 10): an un-faulted,
+        # un-overloaded replay must shed nothing, miss no deadline, never
+        # open the circuit, quarantine no Avro block — and fire none of
+        # the pod-scale mesh events (collective retries, shard-upload
+        # retries, promote failures, watchdog trips).
+        from photon_ml_tpu.utils.contracts import (
+            ROBUSTNESS_CLEAN_ZERO_KEYS,
+            SERVING_CLEAN_ZERO_KEYS,
+        )
 
         clean_zero = {k: m_srv_metrics[k] for k in SERVING_CLEAN_ZERO_KEYS}
         clean_zero["quarantined_blocks"] = _sfaults.COUNTERS.get(
             "quarantined_blocks"
         )
+        for k in ROBUSTNESS_CLEAN_ZERO_KEYS:
+            clean_zero[k] = _sfaults.COUNTERS.get(k)
         dirty = {k: v for k, v in clean_zero.items() if v}
         if dirty:
             raise RuntimeError(
@@ -1591,6 +1941,9 @@ def _child() -> None:
                 fallback_sync_uploads=int(
                     fault_counts.get("fallback_sync_uploads", 0)
                 ),
+                # The pod-scale mesh counters for THIS fit (all-zero on a
+                # clean run; schema = ROBUSTNESS_CLEAN_ZERO_KEYS).
+                robustness=dict(fit_timing["robustness"]),
             )
             _mark(f"e2e done: {e2e}")
     except Exception as exc:  # noqa: BLE001 - bench must still print a line
@@ -1632,6 +1985,9 @@ def _child() -> None:
 def main() -> None:
     if _MULTICHIP_CHILD in sys.argv:
         _multichip_child()
+        return
+    if _CHAOS_MULTICHIP_CHILD in sys.argv:
+        _chaos_multichip_child()
         return
     if _CHILD in sys.argv:
         _child()

@@ -295,6 +295,7 @@ def _run_with_bundle(args, bundle: ServingBundle) -> dict:
     # Drain-on-shutdown already ran (the context exits answered every
     # pending future); the health machine must have landed CLOSED.
     from photon_ml_tpu.utils import faults
+    from photon_ml_tpu.utils.contracts import ROBUSTNESS_CLEAN_ZERO_KEYS
 
     summary = {
         "num_requests": n_requests,
@@ -302,7 +303,13 @@ def _run_with_bundle(args, bundle: ServingBundle) -> dict:
         "malformed_records": malformed[0],
         "serving": metrics,
         "health": engine.health.snapshot(),
-        "robustness_counters": faults.counters(),
+        # The pod-scale mesh counters (ROBUSTNESS_CLEAN_ZERO_KEYS) are
+        # always present — an all-zero block is the clean-run proof, and
+        # a missing key would read as one.
+        "robustness_counters": {
+            **{k: 0 for k in ROBUSTNESS_CLEAN_ZERO_KEYS},
+            **faults.counters(),
+        },
     }
     with open(os.path.join(out_root, "serving-summary.json"), "w") as f:
         json.dump(summary, f, indent=2, default=str)

@@ -81,7 +81,10 @@ GameOptimizationConfiguration = Mapping[str, CoordinateOptimizationConfig]
 # pipelined run stages record where the work happens, so overlapped stages
 # can sum past the wall they were hidden behind. The schema itself lives
 # in utils/contracts.py (re-exported here for the existing importers).
-from photon_ml_tpu.utils.contracts import PREPARE_STAGES
+from photon_ml_tpu.utils.contracts import (
+    PREPARE_STAGES,
+    ROBUSTNESS_CLEAN_ZERO_KEYS,
+)
 
 
 from photon_ml_tpu.optimize.config import static_config_key as _static_config_key
@@ -569,6 +572,13 @@ class GameEstimator:
         # glue) recorded by the data-plane functions themselves.
         t0 = time.perf_counter()
         stage_base = dict(self.timing_registry.sections)
+        # Snapshot the pod-scale robustness counters so fit_timing reports
+        # THIS fit's events (the process-wide counters are cumulative).
+        from photon_ml_tpu.utils import faults as _faults
+
+        robustness_base = {
+            k: _faults.COUNTERS.get(k) for k in ROBUSTNESS_CLEAN_ZERO_KEYS
+        }
         prepared = self.prepare(data)
         for cfgs in opt_configs:
             missing = [c for c in self.update_sequence if c not in cfgs and c not in self.locked]
@@ -748,6 +758,14 @@ class GameEstimator:
         # guard across every configuration of this fit (0 on a clean fit —
         # nonzero in a bench artifact is a loud regression signal).
         self.fit_timing["diverged_steps"] = diverged_steps
+        # Pod-scale robustness counters for THIS fit (ISSUE 10): collective
+        # re-dispatches, shard-staging retries, failed promotions, watchdog
+        # trips — all keys always present and all-zero on a clean fit (the
+        # bench clean-run contract enforces it).
+        self.fit_timing["robustness"] = {
+            k: _faults.COUNTERS.get(k) - robustness_base[k]
+            for k in ROBUSTNESS_CLEAN_ZERO_KEYS
+        }
         # The pod-scale sharding decision as proper JSON keys (ISSUE 7):
         # always present — `entity_sharded` False with axis_size 1 on the
         # single-device path — so the bench e2e contract can fail loudly on

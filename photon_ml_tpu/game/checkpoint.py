@@ -53,7 +53,7 @@ import os
 import shutil
 import tempfile
 import zlib
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Mapping, Optional, Tuple
 
 import jax.numpy as jnp
 import numpy as np
@@ -103,12 +103,146 @@ def _atomic_write(path: str, data: bytes) -> None:
         raise
 
 
-def _save_model_npz(path: str, model) -> str:
-    """Write the model npz atomically (with transient-failure retries) and
-    return its content checksum for the state.json integrity record."""
+def _sharded_row_blocks(matrix):
+    """Per-mesh-shard row blocks of a row-sharded coefficient matrix:
+    [(shard_index, row_start, rows)] ordered by row range — or None when
+    the matrix is not row-sharded over a >1-device mesh. Each block is
+    fetched from ITS shard's device buffer, so writing a sharded
+    checkpoint never materializes the full (E+1, D) matrix on one host
+    buffer bigger than one shard at a time requires."""
+    from photon_ml_tpu.parallel.mesh import leading_axis_mesh
+
+    try:
+        mesh = leading_axis_mesh(matrix, require_divisible=True)
+    except Exception:  # noqa: BLE001 - host arrays have no sharding
+        return None
+    if mesh is None or mesh.devices.size < 2:
+        return None
+    try:
+        shards = sorted(
+            matrix.addressable_shards,
+            key=lambda s: s.index[0].start or 0,
+        )
+        blocks = [
+            (k, int(s.index[0].start or 0), np.asarray(s.data))
+            for k, s in enumerate(shards)
+        ]
+    except Exception:  # noqa: BLE001 - fall back to the single-blob layout
+        return None
+    if sum(b.shape[0] for _, _, b in blocks) != matrix.shape[0]:
+        return None  # replicated/partial layouts keep the single blob
+    return blocks
+
+
+def _npz_bytes(arrays: Dict[str, np.ndarray]) -> bytes:
     import io as _io
 
     buf = _io.BytesIO()
+    np.savez(buf, **arrays)
+    return buf.getvalue()
+
+
+def _write_model_bytes(path: str, data: bytes) -> str:
+    """Atomic retried write of one model file; returns its checksum."""
+    faults.retry(
+        lambda: _atomic_write(path, data), label=f"checkpoint write {path}"
+    )
+    return _checksum(data)
+
+
+def _save_random_effect_sharded(
+    directory: str, rel_base: str, model, blocks
+) -> Tuple[List[str], Dict[str, str]]:
+    """The ELASTIC sharded layout: one npz per entity-shard
+    (`<cid>.shard<k>of<n>.npz`, each carrying its row block of the
+    coefficient/variance matrices plus its index and row offset), written
+    in PARALLEL worker threads — the multi-shard counterpart of the
+    begin_model_write overlap. Returns (ordered shard rel-paths,
+    {rel: checksum}) for the state.json integrity record; `load()`
+    reassembles the blocks onto whatever mesh shape the resuming process
+    has (the warm-start path re-pads/re-shards host matrices)."""
+    import threading
+
+    n = len(blocks)
+    stem = rel_base[: -len(".npz")]
+    var = model.variances_matrix
+    rels: List[str] = []
+    payloads: List[Dict[str, np.ndarray]] = []
+    for k, start, block in blocks:
+        arrays = {
+            "kind": np.asarray("random_shard"),
+            "matrix": block,
+            "shard_index": np.asarray(k),
+            "n_shards": np.asarray(n),
+            "row_start": np.asarray(start),
+        }
+        if var is not None:
+            arrays["variances"] = np.asarray(
+                var[start : start + block.shape[0]]
+            )
+        if model.n_entities is not None:
+            arrays["n_entities"] = np.asarray(model.n_entities)
+        rels.append(f"{stem}.shard{k}of{n}.npz")
+        payloads.append(arrays)
+    checksums: Dict[str, str] = {}
+    errors: List[BaseException] = []
+    lock = threading.Lock()
+
+    def _write_one(rel: str, arrays: Dict[str, np.ndarray]) -> None:
+        try:
+            ck = _write_model_bytes(
+                os.path.join(directory, rel), _npz_bytes(arrays)
+            )
+            with lock:
+                checksums[rel] = ck
+        except BaseException as exc:  # noqa: BLE001 - re-raised after join
+            with lock:
+                errors.append(exc)
+
+    threads = [
+        threading.Thread(
+            target=_write_one,
+            args=(rel, arrays),
+            name=f"photon-ckpt-write-shard{k}",
+            daemon=True,
+        )
+        for k, (rel, arrays) in enumerate(zip(rels, payloads))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errors:
+        raise errors[0]
+    return rels, checksums
+
+
+def _save_model_files(directory: str, rel: str, model):
+    """Write `model` under relative path `rel`; returns
+    (rel_or_shard_list, {rel: checksum}). Random-effect models whose
+    coefficient matrix is row-sharded over a mesh take the elastic
+    per-shard layout; everything else keeps the single npz blob."""
+    if isinstance(model, RandomEffectModel):
+        blocks = _sharded_row_blocks(model.coefficients_matrix)
+        if blocks is not None:
+            return _save_random_effect_sharded(directory, rel, model, blocks)
+    ck = _save_model_npz(os.path.join(directory, rel), model)
+    return rel, {rel: ck}
+
+
+def _flat_rels(values):
+    """model_files values are a str (single blob) or a list of shard
+    paths; iterate every referenced file path."""
+    for v in values:
+        if isinstance(v, str):
+            yield v
+        else:
+            yield from v
+
+
+def _save_model_npz(path: str, model) -> str:
+    """Write the model npz atomically (with transient-failure retries) and
+    return its content checksum for the state.json integrity record."""
     if isinstance(model, FixedEffectModel):
         arrays = {"kind": np.asarray("fixed"), "means": np.asarray(model.coefficients.means)}
         if model.coefficients.variances is not None:
@@ -121,12 +255,34 @@ def _save_model_npz(path: str, model) -> str:
             arrays["n_entities"] = np.asarray(model.n_entities)
     else:
         raise TypeError(f"unknown model type {type(model)}")
-    np.savez(buf, **arrays)
-    data = buf.getvalue()
-    faults.retry(
-        lambda: _atomic_write(path, data), label=f"checkpoint write {path}"
+    return _write_model_bytes(path, _npz_bytes(arrays))
+
+
+def _resume_read_policy():
+    """Bounded retry for checkpoint reads on resume: transient I/O blips
+    (and the armed `resume_load` fault site) re-read; a genuinely MISSING
+    file is not transient — refusing it must not wait out three backoffs."""
+    base = faults.default_policy()
+    return dataclasses.replace(
+        base,
+        is_transient=lambda exc: not isinstance(exc, FileNotFoundError)
+        and base.is_transient(exc),
     )
-    return _checksum(data)
+
+
+def _read_checkpoint_bytes(path: str) -> bytes:
+    """One checkpoint file read under the `resume_load` fault site +
+    bounded retry (the distributed-resume counterpart of the
+    `checkpoint_write` site on the write side)."""
+
+    def attempt() -> bytes:
+        faults.fault_point("resume_load")
+        with open(path, "rb") as f:
+            return f.read()
+
+    return faults.retry(
+        attempt, _resume_read_policy(), label=f"checkpoint read {path}"
+    )
 
 
 def _load_model_npz(path: str, task, expected_checksum: Optional[str] = None):
@@ -140,8 +296,9 @@ def _load_model_npz(path: str, task, expected_checksum: Optional[str] = None):
         f"— delete the checkpoint directory {directory or '.'} to start fresh"
     )
     try:
-        with open(path, "rb") as f:
-            data = f.read()
+        data = _read_checkpoint_bytes(path)
+    except faults.InjectedFault:
+        raise  # retries exhausted on an armed resume_load plan: surface
     except FileNotFoundError:
         raise CheckpointIntegrityError(
             f"checkpoint is missing model file {path} (state.json references "
@@ -189,6 +346,96 @@ def _load_model_npz(path: str, task, expected_checksum: Optional[str] = None):
     )
 
 
+def _load_sharded_model(
+    directory: str, rels: List[str], task, checksums: Mapping[str, str]
+):
+    """Reassemble one random-effect model from its per-shard npz files.
+
+    Every shard is read under the `resume_load` fault site (bounded
+    retry), checksum-verified, and structurally validated (its recorded
+    shard_index/n_shards must match its position in state.json's list) —
+    a torn, corrupt, or mislabeled shard raises a CheckpointIntegrityError
+    NAMING that shard. The blocks concatenate in row order into the full
+    host matrix, which the warm-start path then re-pads/re-shards onto the
+    CURRENT mesh — an N-shard checkpoint resumes on any device count."""
+    import io as _io
+
+    remedy = (
+        f"— delete the checkpoint directory {directory or '.'} to start fresh"
+    )
+    n = len(rels)
+    blocks = []
+    n_entities: Optional[int] = None
+    for pos, rel in enumerate(rels):
+        path = os.path.join(directory, rel)
+        try:
+            data = _read_checkpoint_bytes(path)
+        except faults.InjectedFault:
+            raise
+        except FileNotFoundError:
+            raise CheckpointIntegrityError(
+                f"checkpoint is missing shard file {path} (state.json lists "
+                f"{n} shards for this coordinate) {remedy}"
+            ) from None
+        except OSError as exc:
+            raise CheckpointIntegrityError(
+                f"checkpoint shard file {path} is unreadable ({exc}) {remedy}"
+            ) from exc
+        expected = checksums.get(rel)
+        if expected is not None and _checksum(data) != expected:
+            raise CheckpointIntegrityError(
+                f"corrupt/torn checkpoint shard {path}: content checksum "
+                f"{_checksum(data)} does not match the recorded {expected} "
+                f"{remedy}"
+            )
+        try:
+            with np.load(_io.BytesIO(data), allow_pickle=False) as z:
+                arrays = {name: np.asarray(z[name]) for name in z.files}
+        except Exception as exc:  # BadZipFile, truncated npz, ...
+            raise CheckpointIntegrityError(
+                f"corrupt/torn checkpoint shard {path} "
+                f"({type(exc).__name__}: {exc}) {remedy}"
+            ) from exc
+        if str(arrays.get("kind")) != "random_shard" or "matrix" not in arrays:
+            raise CheckpointIntegrityError(
+                f"{path}: not a random-effect shard file (kind="
+                f"{arrays.get('kind')!r}) {remedy}"
+            )
+        if (
+            int(arrays["shard_index"]) != pos
+            or int(arrays["n_shards"]) != n
+        ):
+            raise CheckpointIntegrityError(
+                f"{path}: records shard {int(arrays['shard_index'])} of "
+                f"{int(arrays['n_shards'])} but state.json lists it as "
+                f"shard {pos} of {n} (mixed checkpoints?) {remedy}"
+            )
+        if "n_entities" in arrays:
+            n_entities = int(arrays["n_entities"])
+        blocks.append(
+            (
+                int(arrays["row_start"]),
+                arrays["matrix"],
+                arrays.get("variances"),
+            )
+        )
+    blocks.sort(key=lambda b: b[0])
+    matrix = np.concatenate([m for _, m, _ in blocks], axis=0)
+    var = None
+    if all(v is not None for _, _, v in blocks):
+        var = jnp.asarray(np.concatenate([v for _, _, v in blocks], axis=0))
+    return RandomEffectModel(
+        jnp.asarray(matrix),
+        var,
+        task,
+        n_entities=(
+            n_entities
+            if n_entities is not None and matrix.shape[0] != n_entities + 1
+            else None
+        ),
+    )
+
+
 def _results_to_json(res) -> dict:
     return {"primary": str(res.primary), "results": dict(res.results)}
 
@@ -220,9 +467,10 @@ class CoordinateDescentCheckpoint:
 
     def __init__(self, directory: str):
         self.directory = directory
-        # cid -> relative npz path currently representing the coordinate.
-        self._model_files: Dict[str, str] = {}
-        self._best_files: Dict[str, str] = {}
+        # cid -> relative npz path (str) OR ordered shard-path list
+        # currently representing the coordinate.
+        self._model_files: Dict[str, object] = {}
+        self._best_files: Dict[str, object] = {}
         # relative npz path -> content checksum, committed with state.json.
         self._checksums: Dict[str, str] = {}
 
@@ -252,9 +500,9 @@ class CoordinateDescentCheckpoint:
 
         def _run():
             try:
-                fut.set_result(
-                    _save_model_npz(os.path.join(self.directory, rel), model)
-                )
+                # (rel_or_shard_list, {rel: checksum}) — sharded models
+                # fan their per-shard writes out in parallel inside.
+                fut.set_result(_save_model_files(self.directory, rel, model))
             except BaseException as exc:  # noqa: BLE001 - joined in save()
                 fut.set_exception(exc)
 
@@ -295,7 +543,7 @@ class CoordinateDescentCheckpoint:
             s_thread.join()
             if s_steps == completed_steps and s_cid == trained_cid:
                 try:
-                    self._checksums[s_rel] = s_fut.result()
+                    s_rel_files, s_cks = s_fut.result()
                 except Exception:
                     # The background write's own retries gave up: fall
                     # through to the synchronous retried write below — the
@@ -313,20 +561,22 @@ class CoordinateDescentCheckpoint:
                     )
                     _faults.COUNTERS.increment("fallback_sync_ckpt_writes")
                 else:
-                    self._model_files[s_cid] = s_rel
+                    self._checksums.update(s_cks)
+                    self._model_files[s_cid] = s_rel_files
                     staged_cid = s_cid
         for cid, model in models.items():
             if cid == staged_cid:
                 continue
             if cid == trained_cid or cid not in self._model_files:
                 rel = os.path.join(step_rel, f"{cid}.npz")
-                self._checksums[rel] = _save_model_npz(
-                    os.path.join(self.directory, rel), model
-                )
-                self._model_files[cid] = rel
+                rel_files, cks = _save_model_files(self.directory, rel, model)
+                self._checksums.update(cks)
+                self._model_files[cid] = rel_files
         if best_is_current and best_results is not None:
             self._best_files = dict(self._model_files)
-        live = set(self._model_files.values()) | set(self._best_files.values())
+        live = set(_flat_rels(self._model_files.values())) | set(
+            _flat_rels(self._best_files.values())
+        )
         self._checksums = {
             rel: c for rel, c in self._checksums.items() if rel in live
         }
@@ -357,8 +607,10 @@ class CoordinateDescentCheckpoint:
         """Remove step directories no longer referenced (best-effort)."""
         live = {
             os.path.dirname(rel)
-            for rel in list(state["model_files"].values())
-            + list(state["best_files"].values())
+            for rel in _flat_rels(
+                list(state["model_files"].values())
+                + list(state["best_files"].values())
+            )
         }
         root = os.path.join(self.directory, STEPS_DIR)
         if not os.path.isdir(root):
@@ -383,15 +635,11 @@ class CoordinateDescentCheckpoint:
         # written from now on gain checksums at the next commit.
         self._checksums = dict(state.get("checksums", {}))
         models = {
-            cid: _load_model_npz(
-                os.path.join(self.directory, rel), task, self._checksums.get(rel)
-            )
+            cid: self._load_one(rel, task)
             for cid, rel in self._model_files.items()
         }
         best = {
-            cid: _load_model_npz(
-                os.path.join(self.directory, rel), task, self._checksums.get(rel)
-            )
+            cid: self._load_one(rel, task)
             for cid, rel in self._best_files.items()
         }
         return CheckpointState(
@@ -404,4 +652,17 @@ class CoordinateDescentCheckpoint:
                 (int(it), cid, _results_from_json(res))
                 for it, cid, res in state["validation_history"]
             ],
+        )
+
+    def _load_one(self, rel, task):
+        """One coordinate's durable model: a single npz blob (str) or the
+        elastic per-shard layout (list of shard paths)."""
+        if isinstance(rel, str):
+            return _load_model_npz(
+                os.path.join(self.directory, rel),
+                task,
+                self._checksums.get(rel),
+            )
+        return _load_sharded_model(
+            self.directory, list(rel), task, self._checksums
         )

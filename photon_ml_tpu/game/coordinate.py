@@ -42,11 +42,14 @@ in the reference, Coordinate.scala); train/score take explicit offset vectors.
 from __future__ import annotations
 
 import dataclasses
+import logging
 from functools import partial
 from typing import List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+
+logger = logging.getLogger(__name__)
 
 from photon_ml_tpu.data.containers import LabeledData, SparseFeatures
 from photon_ml_tpu.data.game_dataset import (
@@ -60,6 +63,7 @@ from photon_ml_tpu.ops.losses import PointwiseLoss, loss_for_task
 from photon_ml_tpu.ops.normalization import NormalizationContext
 from photon_ml_tpu.optimize import problem
 from photon_ml_tpu.optimize.common import OptResult
+from photon_ml_tpu.utils import faults
 from photon_ml_tpu.utils.knobs import get_knob
 from photon_ml_tpu.optimize.config import CoordinateOptimizationConfig
 from photon_ml_tpu.game.model import (
@@ -741,55 +745,134 @@ class RandomEffectCoordinate:
         # and stats materialize once at the end.
         bucket_iters: List = [None] * len(red.buckets)
         if (
-            mesh is not None
-            and red.buckets
+            red.buckets
             and sweep_scan_enabled()
-            and self._train_scan_sharded is not None
+            and (mesh is None or self._train_scan_sharded is not None)
         ):
-            # Entity-sharded scan sweep: one program per distinct block
-            # shape, ring gather/scatter on shard-local rows INSIDE it.
-            for idxs, gathers, masks, ents in self._scan_group_list():
-                matrix, var_matrix, iters = self._train_scan_sharded(
-                    ds.shards[red.feature_shard],
-                    ds.labels,
-                    ds.weights,
-                    offsets,
-                    matrix,
-                    var_matrix,
-                    gathers,
-                    masks,
-                    ents,
-                    red.feature_mask,
-                    rw,
-                )
-                for k, bi in enumerate(idxs):
-                    bucket_iters[bi] = iters[k]
+            # Scan-dispatched sweep: one program per distinct block shape
+            # (on the entity-sharded path with ring gather/scatter on
+            # shard-local rows INSIDE it). Each group dispatch runs under
+            # the mesh failure domain: the `collective` fault site +
+            # bounded re-dispatch (entity-sharded groups), the optional
+            # hang watchdog, and — when retries exhaust — a degraded
+            # fallback to the bitwise-equal per-bucket loop for exactly
+            # that group's buckets (entity buckets are disjoint, so the
+            # carry update order across groups cannot change any row).
+            from photon_ml_tpu.parallel.mesh import (
+                collective_faults_suppressed,
+            )
+            from photon_ml_tpu.utils.watchdog import Watchdog, watchdog_ms
+
+            wd_ms = watchdog_ms()
+            wd = Watchdog() if wd_ms > 0 else None
+            try:
+                for group in self._scan_group_list():
+                    idxs = group[0]
+                    try:
+                        matrix, var_matrix, iters = self._dispatch_scan_group(
+                            group, matrix, var_matrix, offsets, rw, wd, wd_ms
+                        )
+                    except BaseException as exc:  # noqa: BLE001 - gated below
+                        if not faults.is_device_error(exc):
+                            raise
+                        # Bounded re-dispatches exhausted on a device-shaped
+                        # failure: degrade THIS group to the per-bucket
+                        # loop, with the armed `collective` site suppressed
+                        # (a degradation tier must keep working precisely
+                        # while the primary path is broken).
+                        faults.COUNTERS.increment("collective_fallbacks")
+                        logger.warning(
+                            "scan sweep group of %d bucket(s) failed (%s); "
+                            "degrading to the per-bucket loop",
+                            len(idxs),
+                            exc,
+                        )
+                        with collective_faults_suppressed():
+                            matrix, var_matrix = self._train_buckets(
+                                idxs, matrix, var_matrix, bucket_iters,
+                                offsets, rw,
+                            )
+                        continue
+                    for k, bi in enumerate(idxs):
+                        bucket_iters[bi] = iters[k]
+            finally:
+                if wd is not None:
+                    wd.close()
             return self._finish_train(matrix, var_matrix, bucket_iters)
-        if mesh is None and red.buckets and sweep_scan_enabled():
-            # Scan-dispatched sweep: one program per distinct block shape.
-            norm_f = norm_s = None
-            if self._per_entity_norm:
-                norm_f, norm_s = self.norm.factors, self.norm.shifts
-            for idxs, gathers, masks, ents in self._scan_group_list():
-                matrix, var_matrix, iters = self._train_scan(
-                    ds.shards[red.feature_shard],
-                    ds.labels,
-                    ds.weights,
-                    offsets,
-                    matrix,
-                    var_matrix,
-                    gathers,
-                    masks,
-                    ents,
-                    red.feature_mask,
-                    norm_f,
-                    norm_s,
-                    rw,
+        matrix, var_matrix = self._train_buckets(
+            range(len(red.buckets)), matrix, var_matrix, bucket_iters,
+            offsets, rw,
+        )
+        return self._finish_train(matrix, var_matrix, bucket_iters)
+
+    def _dispatch_scan_group(
+        self, group, matrix, var_matrix, offsets, rw, wd, wd_ms
+    ):
+        """One scan-group device dispatch under the mesh failure domain:
+        `collective` fault site (entity-sharded groups — the program's ring
+        gather/scatters are inside the trace, so the host dispatch carries
+        the site), bounded re-dispatch (PHOTON_COLLECTIVE_RETRIES), and
+        the hang watchdog when armed. Deterministic programs make a
+        re-dispatch bitwise-identical; with the watchdog armed the carry
+        is blocked on INSIDE the guard so a wedged dispatch is observable
+        (trading the back-to-back pipelining for hang detection)."""
+        from photon_ml_tpu.parallel.mesh import collective_retry_policy
+
+        idxs, gathers, masks, ents = group
+        ds, red = self.dataset, self.re_dataset
+        mesh = self._entity_mesh
+
+        def run():
+            if mesh is not None:
+                m, v, iters = self._train_scan_sharded(
+                    ds.shards[red.feature_shard], ds.labels, ds.weights,
+                    offsets, matrix, var_matrix, gathers, masks, ents,
+                    red.feature_mask, rw,
                 )
-                for k, bi in enumerate(idxs):
-                    bucket_iters[bi] = iters[k]
-            return self._finish_train(matrix, var_matrix, bucket_iters)
-        for bi, blocks in enumerate(red.buckets):
+            else:
+                norm_f = norm_s = None
+                if self._per_entity_norm:
+                    norm_f, norm_s = self.norm.factors, self.norm.shifts
+                m, v, iters = self._train_scan(
+                    ds.shards[red.feature_shard], ds.labels, ds.weights,
+                    offsets, matrix, var_matrix, gathers, masks, ents,
+                    red.feature_mask, norm_f, norm_s, rw,
+                )
+            if wd is not None:
+                jax.block_until_ready(m)
+            return m, v, iters
+
+        def attempt():
+            if mesh is not None:
+                faults.fault_point("collective")
+            if wd is None:
+                return run()
+            with wd.guard(wd_ms, f"scan sweep group ({len(idxs)} buckets)"):
+                return run()
+
+        return faults.retry(
+            attempt,
+            collective_retry_policy(),
+            label=f"scan sweep group of {len(idxs)} bucket(s)",
+            counter="collective_retries" if mesh is not None else "retries",
+        )
+
+    def _train_buckets(
+        self, bucket_indices, matrix, var_matrix, bucket_iters, offsets, rw
+    ):
+        """The per-bucket dispatch loop over `bucket_indices` — the default
+        path with the scan sweep off, and the degraded fallback tier for a
+        scan group whose collective dispatch exhausted its retries (bitwise
+        equal to the scan by construction — same ops per entity)."""
+        ds, red = self.dataset, self.re_dataset
+        mesh = self._entity_mesh
+        if mesh is not None:
+            from photon_ml_tpu.parallel.mesh import (
+                ring_gather_rows,
+                ring_scatter_rows,
+            )
+        for bi in bucket_indices:
+            blocks = red.buckets[bi]
             block_data = gather_block_data(
                 ds, red.feature_shard, blocks, offsets, feature_mask=red.feature_mask
             )
@@ -822,7 +905,7 @@ class RandomEffectCoordinate:
                 else:
                     var_matrix = var_matrix.at[blocks.entity_rows].set(v)
             bucket_iters[bi] = res.iterations
-        return self._finish_train(matrix, var_matrix, bucket_iters)
+        return matrix, var_matrix
 
     def _scan_group_list(self):
         """Buckets grouped by block shape, each stacked into (K, E, S)

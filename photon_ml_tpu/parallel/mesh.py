@@ -36,6 +36,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from photon_ml_tpu.data.containers import LabeledData, SparseFeatures
 from photon_ml_tpu.data.game_dataset import EntityBlocks, GameDataset, RandomEffectDataset
+from photon_ml_tpu.utils import faults
 
 DATA_AXIS = "data"
 
@@ -168,6 +169,70 @@ def _shard_game_dataset(dataset: GameDataset, mesh: Mesh) -> GameDataset:
 
 
 import functools
+import threading
+from contextlib import contextmanager
+
+
+# --------------------------------------------------- collective failure domain
+#
+# The `collective` fault site (utils/faults.py, ISSUE 10): every HOST-side
+# dispatch of a ring/bcast collective program goes through
+# `dispatch_collective`, which fires the fault point and re-dispatches a
+# transient failure a bounded number of times (PHOTON_COLLECTIVE_RETRIES,
+# counted in COUNTERS["collective_retries"]). Collective programs are
+# deterministic, so a re-dispatch reproduces the same bits. The wrappers
+# below are ALSO called while tracing (inside the scan sweep and the
+# serving pjit programs) — tracing must stay pure (analysis/jit_purity),
+# so tracer arguments bypass the failure domain entirely; the enclosing
+# host dispatch (game/coordinate.py's scan-group dispatch) carries the
+# fault site for those programs instead.
+
+_COLLECTIVE_STATE = threading.local()
+
+
+@contextmanager
+def collective_faults_suppressed():
+    """Scope marking the DEGRADED tier: the per-bucket fallback loop a
+    failed scan sweep retreats to must not be re-killed by the same armed
+    `collective` plan (the FE-only-tier precedent: a degradation path
+    keeps working precisely while the primary path is broken)."""
+    prev = getattr(_COLLECTIVE_STATE, "suppressed", False)
+    _COLLECTIVE_STATE.suppressed = True
+    try:
+        yield
+    finally:
+        _COLLECTIVE_STATE.suppressed = prev
+
+
+def collective_retry_policy():
+    """Bounded re-dispatch policy for failed collective programs: 1 +
+    PHOTON_COLLECTIVE_RETRIES attempts under the standard backoff."""
+    from photon_ml_tpu.utils.knobs import get_knob
+
+    return faults.bounded_policy(int(get_knob("PHOTON_COLLECTIVE_RETRIES")))
+
+
+def dispatch_collective(fn, *, label: str):
+    """Run one host-side collective program dispatch under the `collective`
+    fault site + bounded re-dispatch. Exhausted retries propagate (the
+    caller owns the degraded fallback — e.g. the sweep's bucket loop)."""
+    if getattr(_COLLECTIVE_STATE, "suppressed", False):
+        return fn()
+
+    def attempt():
+        faults.fault_point("collective")
+        return fn()
+
+    return faults.retry(
+        attempt,
+        collective_retry_policy(),
+        label=f"collective dispatch {label}",
+        counter="collective_retries",
+    )
+
+
+def _is_tracing(*arrays) -> bool:
+    return any(isinstance(a, jax.core.Tracer) for a in arrays)
 
 
 def matrix_row_sharding(mesh: Mesh) -> NamedSharding:
@@ -299,7 +364,12 @@ def ring_gather_rows(matrix: jax.Array, rows: jax.Array, mesh: Mesh) -> jax.Arra
     (the reference's RDD[(REId, model)] partitioning,
     photon-api model/RandomEffectModel.scala:36-239).
     """
-    return _ring_gather_fn(mesh, rows.ndim)(matrix, rows)
+    fn = _ring_gather_fn(mesh, rows.ndim)
+    if _is_tracing(matrix, rows):
+        return fn(matrix, rows)
+    return dispatch_collective(
+        lambda: fn(matrix, rows), label="ring_gather_rows"
+    )
 
 
 @functools.lru_cache(maxsize=64)
@@ -356,7 +426,12 @@ def ring_scatter_rows(
     Duplicate rows must carry equal values (the padded-entity contract:
     padding entities all write the zero solution to the pinned row).
     """
-    return _ring_scatter_fn(mesh, rows.ndim, values.ndim)(matrix, rows, values)
+    fn = _ring_scatter_fn(mesh, rows.ndim, values.ndim)
+    if _is_tracing(matrix, rows, values):
+        return fn(matrix, rows, values)
+    return dispatch_collective(
+        lambda: fn(matrix, rows, values), label="ring_scatter_rows"
+    )
 
 
 @functools.lru_cache(maxsize=64)
@@ -395,7 +470,12 @@ def bcast_gather_rows(matrix: jax.Array, rows: jax.Array, mesh: Mesh) -> jax.Arr
     row is owned by exactly one shard, and x + 0.0 is exact in IEEE float,
     so the psum reproduces matrix[rows] BITWISE — which is what lets the
     sharded serving path stay bitwise-equal to the replicated one."""
-    return _bcast_gather_fn(mesh, rows.ndim)(matrix, rows)
+    fn = _bcast_gather_fn(mesh, rows.ndim)
+    if _is_tracing(matrix, rows):
+        return fn(matrix, rows)
+    return dispatch_collective(
+        lambda: fn(matrix, rows), label="bcast_gather_rows"
+    )
 
 
 def ring_gather_wire_bytes(mesh: Mesh, n_rows_padded: int, dim: int, itemsize: int = 4) -> int:

@@ -19,9 +19,11 @@ from photon_ml_tpu.serving.bundle import (
     ScoreRequest,
     ServingBundle,
     ServingCoordinate,
+    ShardHealth,
     TwoTierEntityStore,
     load_bundle,
 )
+from photon_ml_tpu.utils.faults import DeviceHang
 from photon_ml_tpu.serving.engine import ScoreResult, ServingEngine
 from photon_ml_tpu.serving.lifecycle import (
     BatcherUnhealthy,
@@ -42,6 +44,7 @@ __all__ = [
     "CircuitBreaker",
     "CircuitState",
     "DeadlineExceeded",
+    "DeviceHang",
     "HbmBudgetExceeded",
     "HealthStateMachine",
     "MicroBatcher",
@@ -52,6 +55,7 @@ __all__ = [
     "ServingCoordinate",
     "ServingEngine",
     "ServingState",
+    "ShardHealth",
     "SwapIncompatible",
     "TwoTierEntityStore",
     "load_bundle",

@@ -382,6 +382,22 @@ class MicroBatcher:
             return
         try:
             results = self.engine.score_batch(requests)
+        except faults.DeviceHang:
+            # A batch-level watchdog trip is UNAMBIGUOUS device evidence
+            # (unlike a poisoned pack): feed the breaker directly and
+            # answer the WHOLE batch FE-only — re-probing a wedged device
+            # once per co-batched request would stall the flush thread
+            # for many watchdog periods while the queue blows deadlines.
+            breaker.on_failure(permit)
+            faults.COUNTERS.increment("serving_degraded_batches")
+            with self._cv:
+                self._degraded += 1
+            logger.warning(
+                "batch of %d hit the dispatch watchdog; answering FE-only",
+                len(requests),
+            )
+            self._dispatch_fe_only(batch)
+            return
         except BaseException as exc:  # noqa: BLE001 - isolated below
             # ANY mid-batch failure degrades to per-request dispatch:
             # transient faults (injected, device blip) get the bounded
@@ -433,6 +449,15 @@ class MicroBatcher:
                     breaker.on_failure(permit)
                 else:
                     breaker.on_abandon(permit)  # the request's fault, not the device's
+                if isinstance(exc, faults.DeviceHang):
+                    # A watchdog-tripped dispatch that outlived its bounded
+                    # retries still ANSWERS: the hang contract (ISSUE 10)
+                    # is a DEGRADED health transition + FE-only answers,
+                    # never a stuck-or-failed future — the FE-only tier
+                    # has no watchdog (it must work while the full path is
+                    # wedged).
+                    self._dispatch_fe_only([(req, fut, t0, None)])
+                    continue
                 with self._cv:
                     self._failed += 1
                 fut.set_exception(exc)

@@ -43,6 +43,7 @@ import numpy as np
 logger = logging.getLogger(__name__)
 
 from photon_ml_tpu.data.index_map import IndexMap, feature_key
+from photon_ml_tpu.utils import faults
 from photon_ml_tpu.utils.knobs import get_knob
 from photon_ml_tpu.game.model import FixedEffectModel, GameModel, RandomEffectModel
 from photon_ml_tpu.io.model_store import GameModelArtifact
@@ -78,6 +79,99 @@ class ScoreRequest:
     offset: float = 0.0
     uid: Optional[str] = None
     deadline_ms: Optional[float] = None
+
+
+def _shard_upload_policy():
+    """Bounded retry for per-shard model staging/restage: 1 +
+    PHOTON_SHARD_UPLOAD_RETRIES attempts under the standard backoff."""
+    return faults.bounded_policy(int(get_knob("PHOTON_SHARD_UPLOAD_RETRIES")))
+
+
+def _stage_shard(label: str, fn):
+    """One per-shard staging step under the `shard_upload` fault site
+    (counted in COUNTERS["shard_upload_retries"]). Exhausted retries
+    propagate: at bundle build time that fails the build (a hot-swap's
+    builder failure rides the existing BundleManager rollback — the old
+    bundle never stops serving); at shard RESTAGE time the shard simply
+    stays lost and the engine keeps serving its entities FE-only."""
+
+    def attempt():
+        faults.fault_point("shard_upload")
+        return fn()
+
+    return faults.retry(
+        attempt,
+        _shard_upload_policy(),
+        label=f"shard staging {label}",
+        counter="shard_upload_retries",
+    )
+
+
+class ShardHealth:
+    """Per-shard health of one random-effect coordinate's device-resident
+    coefficient rows (ISSUE 10 shard-loss degradation).
+
+    A "shard" is one device's contiguous row block of the (padded)
+    coefficient matrix on the entity-sharded path, or the whole matrix
+    (one shard) on the replicated path. Marking a shard LOST makes the
+    engine resolve every request row in its range to the pinned zero row
+    at lookup time — bitwise FE-only answers for exactly those entities,
+    the same degradation tier as a circuit-open but scoped to one shard —
+    while every other shard keeps serving full-fidelity. Recovery
+    (`ServingBundle.restage_shard`) re-uploads ONLY the lost shard's rows.
+
+    Thread-safe: lookups snapshot the lost set under the lock; the mask
+    math itself runs lock-free on the snapshot.
+    """
+
+    def __init__(self, n_shards: int, rows_per_shard: int):
+        self.n_shards = int(n_shards)
+        self.rows_per_shard = int(rows_per_shard)
+        self._lock = threading.Lock()
+        self._lost: set = set()
+
+    def _check(self, idx: int) -> int:
+        idx = int(idx)
+        if not 0 <= idx < self.n_shards:
+            raise ValueError(
+                f"shard index {idx} out of range (n_shards={self.n_shards})"
+            )
+        return idx
+
+    def row_range(self, idx: int) -> Tuple[int, int]:
+        idx = self._check(idx)
+        lo = idx * self.rows_per_shard
+        return lo, lo + self.rows_per_shard
+
+    def mark_lost(self, idx: int) -> None:
+        with self._lock:
+            self._lost.add(self._check(idx))
+
+    def mark_ok(self, idx: int) -> None:
+        with self._lock:
+            self._lost.discard(self._check(idx))
+
+    @property
+    def lost(self) -> Tuple[int, ...]:
+        with self._lock:
+            return tuple(sorted(self._lost))
+
+    @property
+    def any_lost(self) -> bool:
+        with self._lock:
+            return bool(self._lost)
+
+    def lost_mask(self, rows: np.ndarray) -> np.ndarray:
+        """Bool mask over `rows` of those living in a LOST shard."""
+        with self._lock:
+            lost = tuple(self._lost)
+        if not lost:
+            return np.zeros(len(rows), bool)
+        shard_of = np.asarray(rows, np.int64) // self.rows_per_shard
+        mask = np.zeros(len(rows), bool)
+        for idx in lost:
+            mask |= shard_of == idx
+        return mask
 
 
 class TwoTierEntityStore:
@@ -129,6 +223,7 @@ class TwoTierEntityStore:
         self.cold_hits = 0
         self.promotions = 0
         self.evictions = 0
+        self.promote_failures = 0
 
     @property
     def hot_nbytes(self) -> int:
@@ -221,18 +316,38 @@ class TwoTierEntityStore:
                     # (matrix, index) pair publishes atomically; snapshots
                     # already handed out keep their own immutable matrix.
                     try:
+                        faults.fault_point("promote")
                         self._hot = self._hot.at[
                             jnp.asarray(idx, jnp.int32)
                         ].set(jnp.asarray(self._cold[srcs]))
-                    except Exception:  # noqa: BLE001 - promotion is best-effort
-                        # Device dispatch failed (e.g. runtime tearing down):
-                        # roll the index back — lookups must keep resolving
+                    except BaseException as exc:  # noqa: BLE001 - see below
+                        # Roll the index back — lookups must keep resolving
                         # these rows through the cold tier, never to a hot
                         # slot that was not actually written.
                         for s, r in zip(idx, srcs):
                             self._slot_of_row.pop(r, None)
                             self._row_of_slot[s] = None
                             self.promotions -= 1
+                        self.promote_failures += len(idx)
+                        faults.COUNTERS.increment(
+                            "promote_failures", len(idx)
+                        )
+                        if faults.is_device_error(exc):
+                            # Transient/injected (the `promote` fault
+                            # site): the rows simply STAY COLD — counted,
+                            # never fatal, never a lost request (cold rows
+                            # keep scoring bitwise through the per-request
+                            # override buffers); the worker lives on and a
+                            # later touch re-queues the promotion.
+                            logger.warning(
+                                "promotion of %d row(s) failed (%s); rows "
+                                "stay cold",
+                                len(idx),
+                                exc,
+                            )
+                            continue
+                        # Non-transient (e.g. runtime tearing down): stop
+                        # promoting for good.
                         self._closed = True
                         return
 
@@ -271,6 +386,7 @@ class TwoTierEntityStore:
                 "cold_tier_hits": self.cold_hits,
                 "promotions": self.promotions,
                 "evictions": self.evictions,
+                "promote_failures": self.promote_failures,
                 "pending_promotions": len(self._pending),
             }
 
@@ -297,6 +413,10 @@ class ServingCoordinate:
     mesh: Optional[object] = None  # jax.sharding.Mesh when row-sharded
     logical_rows: Optional[int] = None  # E + 1 when params rows are padded
     store: Optional[TwoTierEntityStore] = None
+    # Per-shard loss tracking for device-resident matrices (ISSUE 10):
+    # requests resolving into a LOST shard's row range degrade to the
+    # pinned zero row until the shard is restaged.
+    shard_health: Optional[ShardHealth] = None
 
     @property
     def is_random_effect(self) -> bool:
@@ -403,6 +523,79 @@ class ServingBundle:
             c.device_nbytes_per_shard() for c in self.coordinates.values()
         )
 
+    # ------------------------------------------------- shard loss / recovery
+
+    def mark_shard_lost(self, cid: str, shard_index: int) -> Tuple[int, int]:
+        """Record one coefficient shard as LOST (a failed refresh, a dead
+        device's rows). The serving engine keeps answering: requests whose
+        entity row falls in the returned [lo, hi) range resolve to the
+        pinned zero row — bitwise FE-only for exactly those entities —
+        until `restage_shard` recovers it. Returns the lost row range."""
+        c = self.coordinates[cid]
+        if c.shard_health is None:
+            raise ValueError(
+                f"coordinate {cid!r} has no device-resident shard tracking "
+                "(fixed-effect or two-tier coordinate)"
+            )
+        c.shard_health.mark_lost(shard_index)
+        logger.warning(
+            "serving shard lost: %s shard %d (rows %s) — its entities "
+            "degrade to pinned-zero-row answers until restaged",
+            cid,
+            shard_index,
+            c.shard_health.row_range(shard_index),
+        )
+        return c.shard_health.row_range(shard_index)
+
+    def restage_shard(
+        self, cid: str, shard_index: int, rows: Optional[np.ndarray] = None
+    ) -> int:
+        """Recover ONE lost shard: re-upload only its row block (never the
+        whole matrix), under the `shard_upload` fault site + bounded retry.
+        `rows` is the host source for the block (the model artifact / a
+        replica); None re-reads the resident device block — the refresh
+        case where the data is intact but was marked stale/lost. Returns
+        the bytes restaged; a terminal failure leaves the shard lost (the
+        engine keeps serving degraded) and re-raises.
+
+        Memory shape: only the shard's rows cross the host->device wire,
+        and the functional `.at[].set` keeps every OTHER device's chunk
+        untouched — the transient device cost is ~2 chunks on the
+        affected devices (old + new generation, the same double-buffer
+        envelope the BundleManager swap budget already charges), never a
+        replica. In-flight batches keep scoring their captured params
+        snapshot, which is why the update must stay functional (a
+        donating in-place write would invalidate their buffers)."""
+        c = self.coordinates[cid]
+        if c.shard_health is None:
+            raise ValueError(
+                f"coordinate {cid!r} has no device-resident shard tracking"
+            )
+        lo, hi = c.shard_health.row_range(shard_index)
+        if rows is None:
+            rows = np.asarray(c.params[lo:hi])
+        rows = np.ascontiguousarray(rows, np.float32)
+        if rows.shape != (hi - lo, c.dim):
+            raise ValueError(
+                f"restage rows shape {rows.shape} != shard shape "
+                f"{(hi - lo, c.dim)}"
+            )
+
+        def upload():
+            new = c.params.at[lo:hi].set(jnp.asarray(rows))
+            jax.block_until_ready(new)
+            return new
+
+        c.params = _stage_shard(f"{cid} shard {shard_index} restage", upload)
+        c.shard_health.mark_ok(shard_index)
+        logger.info(
+            "serving shard restaged: %s shard %d (%d bytes)",
+            cid,
+            shard_index,
+            rows.nbytes,
+        )
+        return int(rows.nbytes)
+
     def shard_dims(self) -> Dict[str, int]:
         """Feature width per shard consumed by any coordinate."""
         dims: Dict[str, int] = {}
@@ -496,7 +689,10 @@ class ServingBundle:
             spec = specs[cid]
             m = model[cid]
             if isinstance(m, FixedEffectModel):
-                params = jnp.asarray(m.coefficients.means, jnp.float32)
+                params = _stage_shard(
+                    f"{cid} (fixed-effect plane)",
+                    lambda: jnp.asarray(m.coefficients.means, jnp.float32),
+                )
                 coords[cid] = ServingCoordinate(
                     cid, spec.shard, params, norm=spec.norm
                 )
@@ -544,7 +740,10 @@ class ServingBundle:
                     # Two-tier: hot set in HBM, full matrix in host RAM.
                     if matrix.shape[0] > logical:
                         matrix = matrix[:logical]
-                    store = TwoTierEntityStore(np.asarray(matrix), hr)
+                    store = _stage_shard(
+                        f"{cid} (two-tier hot set)",
+                        lambda: TwoTierEntityStore(np.asarray(matrix), hr),
+                    )
                     coords[cid] = ServingCoordinate(
                         cid,
                         spec.shard,
@@ -567,10 +766,14 @@ class ServingBundle:
                             jnp.asarray(matrix, jnp.float32),
                             ((0, n_rows - matrix.shape[0]), (0, 0)),
                         )
-                    params = jax.device_put(
-                        jnp.asarray(matrix, jnp.float32),
-                        matrix_row_sharding(coord_mesh),
+                    params = _stage_shard(
+                        f"{cid} (row-sharded matrix)",
+                        lambda: jax.device_put(
+                            jnp.asarray(matrix, jnp.float32),
+                            matrix_row_sharding(coord_mesh),
+                        ),
                     )
+                    ndev_c = int(coord_mesh.devices.size)
                     coords[cid] = ServingCoordinate(
                         cid,
                         spec.shard,
@@ -580,6 +783,7 @@ class ServingBundle:
                         entity_index=dict(spec.entity_index or {}),
                         mesh=coord_mesh,
                         logical_rows=logical,
+                        shard_health=ShardHealth(ndev_c, n_rows // ndev_c),
                     )
                 else:
                     # Mesh-padded matrices carry inert all-zero rows past
@@ -587,7 +791,10 @@ class ServingBundle:
                     # the pinned zero row and the replicated gather exact.
                     if matrix.shape[0] > logical:
                         matrix = matrix[:logical]
-                    params = jnp.asarray(matrix, jnp.float32)
+                    params = _stage_shard(
+                        f"{cid} (replicated matrix)",
+                        lambda: jnp.asarray(matrix, jnp.float32),
+                    )
                     coords[cid] = ServingCoordinate(
                         cid,
                         spec.shard,
@@ -595,6 +802,7 @@ class ServingBundle:
                         norm=spec.norm,
                         random_effect_type=spec.random_effect_type,
                         entity_index=dict(spec.entity_index or {}),
+                        shard_health=ShardHealth(1, int(params.shape[0])),
                     )
             else:
                 raise TypeError(f"unknown model type {type(m)} for {cid!r}")

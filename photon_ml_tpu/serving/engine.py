@@ -73,6 +73,7 @@ from photon_ml_tpu.transformers.game_transformer import dense_margins
 from photon_ml_tpu.types import TaskType
 from photon_ml_tpu.utils import faults
 from photon_ml_tpu.utils.observability import TimingRegistry, stage_scope, stage_timer
+from photon_ml_tpu.utils.watchdog import Watchdog, watchdog_ms
 
 Array = jax.Array
 
@@ -198,6 +199,7 @@ class ServingEngine:
         task: Optional[TaskType] = None,
         circuit_threshold: int = 5,
         circuit_probe_interval_s: float = 1.0,
+        watchdog_ms_override: Optional[float] = None,
     ):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
@@ -240,6 +242,18 @@ class ServingEngine:
         self._slots_total = 0
         self._slots_padded = 0
         self._fe_only_requests = 0
+        self._shard_loss_fallbacks = 0
+        # Hang watchdog around live-traffic dispatches (PHOTON_WATCHDOG_MS,
+        # constructor override for tests; 0 = off). Warmup and the FE-only
+        # degradation tier are exempt: compiles legitimately exceed a
+        # serving deadline, and the degraded tier must keep answering —
+        # warm up BEFORE arming a tight deadline on live traffic.
+        self._watchdog_ms = (
+            float(watchdog_ms()) if watchdog_ms_override is None
+            else float(watchdog_ms_override)
+        )
+        self._watchdog = Watchdog(on_trip=self._on_watchdog_trip)
+        self._hang_seen = False
         self._warmup_compiles: Optional[int] = None
         self._dispatched_buckets: set = set()
         self._t_first: Optional[float] = None
@@ -290,7 +304,37 @@ class ServingEngine:
         self.health.begin_drain()
         for b in self._batchers:
             b.close()
+        self._watchdog.close()
         self.health.close()
+
+    def _on_watchdog_trip(self, label: str) -> None:
+        """A device dispatch blew its deadline — fired FROM the monitor
+        thread while the dispatch may still be stuck, so a hung-forever
+        device flips health immediately; the next successful dispatch
+        clears the reason."""
+        self._hang_seen = True
+        self.health.add_degraded("device_hang")
+
+    # --------------------------------------------------- shard loss/recovery
+
+    def mark_shard_lost(self, cid: str, shard_index: int) -> Tuple[int, int]:
+        """Record one coefficient shard LOST (see ServingBundle): its
+        entities degrade to bitwise FE-only pinned-zero-row answers, the
+        engine stays up, health reports DEGRADED with the shard named."""
+        rng = self._state.bundle.mark_shard_lost(cid, shard_index)
+        self.health.add_degraded(f"shard_loss:{cid}/{shard_index}")
+        return rng
+
+    def restage_shard(
+        self, cid: str, shard_index: int, rows=None
+    ) -> int:
+        """Recover one lost shard (re-uploads ONLY its rows, under the
+        `shard_upload` fault site); clears the shard's degraded reason on
+        success. A terminal staging failure re-raises and the shard stays
+        lost — the engine keeps serving its entities FE-only."""
+        nbytes = self._state.bundle.restage_shard(cid, shard_index, rows=rows)
+        self.health.clear_degraded(f"shard_loss:{cid}/{shard_index}")
+        return nbytes
 
     def _on_batcher_unhealthy(self, exc: BaseException) -> None:
         """A batcher's flush thread died (serving/batcher.py failed all its
@@ -538,6 +582,27 @@ class ServingEngine:
                     continue
                 ids = [r.entity_ids.get(c.random_effect_type) for r in requests]
                 rows, _ = c.lookup_rows(ids)
+                sh = getattr(c, "shard_health", None)
+                if sh is not None and sh.any_lost:
+                    # Shard-loss degradation: rows living in a LOST shard
+                    # resolve to the pinned zero row — bitwise FE-only for
+                    # exactly those entities; every other row keeps
+                    # full-fidelity answers.
+                    # Rows ALREADY at the pinned zero row (cold starts)
+                    # are excluded: they were FE-only by design, and
+                    # counting them would report cold-start traffic as
+                    # shard-loss degradation.
+                    lost = sh.lost_mask(rows) & (rows != c.unseen_row)
+                    if lost.any():
+                        rows = np.where(lost, c.unseen_row, rows).astype(
+                            np.int32
+                        )
+                        n_lost = int(lost.sum())
+                        faults.COUNTERS.increment(
+                            "shard_loss_fallbacks", n_lost
+                        )
+                        with self._lock:
+                            self._shard_loss_fallbacks += n_lost
                 cold_flags[:, k] = rows == c.unseen_row
                 if store is not None:
                     slots, ovr, flags, snapshot = store.lookup(rows, bucket)
@@ -566,49 +631,70 @@ class ServingEngine:
         with stage_timer("serve_score"):
             if inject:
                 faults.fault_point("score")
-            dev_buffers = {
-                s: jnp.asarray(b) for s, b in packed["buffers"].items()
-            }
-            rows = tuple(
-                jnp.asarray(packed["rows_by_cid"][c.cid])
-                if c.is_random_effect
-                else None
-                for c in state.coords
-            )
-            overrides = tuple(
-                (
-                    jnp.asarray(packed["overrides_by_cid"][c.cid][0]),
-                    jnp.asarray(packed["overrides_by_cid"][c.cid][1]),
-                )
-                if c.is_random_effect
-                and c.cid in packed["overrides_by_cid"]
-                else None
-                for c in state.coords
-            )
-            # Two-tier coordinates score against the hot-matrix snapshot
-            # the pack stage captured with the slots; everyone else serves
-            # the bundle's pinned planes.
-            params = tuple(
-                packed["tier_params"].get(c.cid, c.params)
-                for c in state.coords
-            )
-            norms = tuple(c.norm for c in state.coords)
-            total, means = self._jit(
-                jnp.asarray(packed["offsets"]),
-                dev_buffers,
-                rows,
-                overrides,
-                params,
-                norms,
-                kinds=state.kinds,
-                shards=state.coord_shards,
-                meshes=state.meshes,
-                task=self.task,
-            )
-            host_total, host_means = jax.device_get((total, means))
+            # Hang watchdog (live traffic only — warmup/FE-only exempt):
+            # the guard wraps upload + fused program + fetch; an
+            # over-deadline dispatch raises a typed DeviceHang that the
+            # batcher's breaker counts toward circuit-open FE-only routing.
+            wd_ms = self._watchdog_ms if inject else 0.0
+            with self._watchdog.guard(
+                wd_ms, f"serving dispatch (bucket {packed['bucket']})"
+            ):
+                out = self._dispatch_device(packed, state)
+            if wd_ms > 0 and self._hang_seen:
+                # A GUARDED dispatch finished inside its deadline: the
+                # device answered again, so the hang degradation
+                # self-clears (an unguarded FE-only dispatch proves
+                # nothing about the full path).
+                self._hang_seen = False
+                self.health.clear_degraded("device_hang")
+        host_total, host_means = out
         with self._lock:
             self._dispatched_buckets.add(packed["bucket"])
         return np.asarray(host_total), np.asarray(host_means)
+
+    def _dispatch_device(
+        self, packed: dict, state: _EngineState
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        dev_buffers = {
+            s: jnp.asarray(b) for s, b in packed["buffers"].items()
+        }
+        rows = tuple(
+            jnp.asarray(packed["rows_by_cid"][c.cid])
+            if c.is_random_effect
+            else None
+            for c in state.coords
+        )
+        overrides = tuple(
+            (
+                jnp.asarray(packed["overrides_by_cid"][c.cid][0]),
+                jnp.asarray(packed["overrides_by_cid"][c.cid][1]),
+            )
+            if c.is_random_effect
+            and c.cid in packed["overrides_by_cid"]
+            else None
+            for c in state.coords
+        )
+        # Two-tier coordinates score against the hot-matrix snapshot
+        # the pack stage captured with the slots; everyone else serves
+        # the bundle's pinned planes.
+        params = tuple(
+            packed["tier_params"].get(c.cid, c.params)
+            for c in state.coords
+        )
+        norms = tuple(c.norm for c in state.coords)
+        total, means = self._jit(
+            jnp.asarray(packed["offsets"]),
+            dev_buffers,
+            rows,
+            overrides,
+            params,
+            norms,
+            kinds=state.kinds,
+            shards=state.coord_shards,
+            meshes=state.meshes,
+            task=self.task,
+        )
+        return jax.device_get((total, means))
 
     # -------------------------------------------------------------- metrics
 
@@ -642,8 +728,12 @@ class ServingEngine:
         rows_per_shard = 0
         hot_fraction = 1.0
         wire = 0
+        shards_lost = 0
         for k, c in enumerate(state.coords):
             kind = state.kinds[k]
+            sh = getattr(c, "shard_health", None)
+            if sh is not None:
+                shards_lost += len(sh.lost)
             if kind == "re_sh":
                 sharded = True
                 ndev = int(c.mesh.devices.size)
@@ -662,12 +752,16 @@ class ServingEngine:
         # bench/serve assert on.
         from photon_ml_tpu.utils.contracts import SERVING_SHARDING_KEYS
 
+        with self._lock:
+            loss_fallbacks = self._shard_loss_fallbacks
         out = {
             "entity_sharded": sharded,
             "axis_size": axis,
             "rows_per_shard": rows_per_shard,
             "hot_set_fraction": round(hot_fraction, 6),
             "all_to_all_bytes_per_batch": wire,
+            "shards_lost": shards_lost,
+            "shard_loss_fallbacks": loss_fallbacks,
         }
         assert set(out) == set(SERVING_SHARDING_KEYS), (
             "serving sharding block drifted from utils/contracts."
@@ -745,6 +839,7 @@ class ServingEngine:
             "cold_tier_hits": 0,
             "promotions": 0,
             "evictions": 0,
+            "promote_failures": 0,
             "pending_promotions": 0,
         }
         for c in st.coords:

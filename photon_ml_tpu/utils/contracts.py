@@ -43,6 +43,9 @@ FIT_TIMING_REQUIRED_KEYS = (
     "re_host_s",
     "re_path",
     "sharding",
+    # r10: the pod-scale robustness counters for THIS fit (a dict zipping
+    # ROBUSTNESS_CLEAN_ZERO_KEYS) — all-zero on a clean fit.
+    "robustness",
 )
 
 # ------------------------------------------------------------------- ingest
@@ -94,13 +97,18 @@ SERVING_METRIC_KEYS = (
 
 # The sharding-decision block inside serving metrics (engine.metrics()
 # zips exactly these, in this order — all present even on a single-tier
-# replicated bundle so absence is loud).
+# replicated bundle so absence is loud). r10 appends the per-shard
+# health keys: how many coefficient shards are currently LOST (serving
+# degraded pinned-zero-row answers for their entities) and how many
+# requests resolved through that degradation.
 SERVING_SHARDING_KEYS = (
     "entity_sharded",
     "axis_size",
     "rows_per_shard",
     "hot_set_fraction",
     "all_to_all_bytes_per_batch",
+    "shards_lost",
+    "shard_loss_fallbacks",
 )
 
 # Robustness events that must be ZERO on a clean (un-faulted,
@@ -112,6 +120,19 @@ SERVING_CLEAN_ZERO_KEYS = (
     "fe_only_answers",
 )
 
+# Robustness events of the pod-scale mesh failure domain (ISSUE 10) that
+# must be ZERO on a clean run: collective re-dispatches, per-shard
+# staging retries, failed two-tier promotions, and watchdog trips. The
+# bench clean-run contract reads these from faults.COUNTERS; fit_timing
+# ("robustness") and serving-summary.json ("robustness_counters") always
+# carry all four keys so absence is loud.
+ROBUSTNESS_CLEAN_ZERO_KEYS = (
+    "collective_retries",
+    "shard_upload_retries",
+    "promote_failures",
+    "watchdog_trips",
+)
+
 # Top-level serving-summary.json keys written by cli/serve.py.
 SERVING_SUMMARY_KEYS = (
     "num_requests",
@@ -120,6 +141,29 @@ SERVING_SUMMARY_KEYS = (
     "serving",
     "health",
     "robustness_counters",
+)
+
+# bench.py chaos_multichip section (r10): the pod-scale chaos
+# certificate — an 8-virtual-device subprocess with every mesh fault
+# site armed must degrade/retry without failing a fit or a request, and
+# recover to bitwise serve parity.
+CHAOS_MULTICHIP_SECTION_KEYS = (
+    "n_devices",
+    "faults_armed",
+    "injected_faults",
+    "collective_retries",
+    "shard_upload_retries",
+    "promote_failures",
+    "watchdog_trips",
+    "failed_requests",
+    "hangs",
+    "train_bitwise_vs_clean",
+    "resume_bitwise_vs_train",
+    "serve_bitwise_vs_clean",
+    "shard_loss_fe_only_bitwise",
+    "post_recovery_bitwise",
+    "shard_loss_fallbacks",
+    "restaged_bytes",
 )
 
 # Every schema this module exports, for the analyzer's drift check and
@@ -133,5 +177,7 @@ ALL_CONTRACTS = {
     "SERVING_METRIC_KEYS": SERVING_METRIC_KEYS,
     "SERVING_SHARDING_KEYS": SERVING_SHARDING_KEYS,
     "SERVING_CLEAN_ZERO_KEYS": SERVING_CLEAN_ZERO_KEYS,
+    "ROBUSTNESS_CLEAN_ZERO_KEYS": ROBUSTNESS_CLEAN_ZERO_KEYS,
     "SERVING_SUMMARY_KEYS": SERVING_SUMMARY_KEYS,
+    "CHAOS_MULTICHIP_SECTION_KEYS": CHAOS_MULTICHIP_SECTION_KEYS,
 }

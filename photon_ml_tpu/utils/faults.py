@@ -10,7 +10,9 @@ module is the shared machinery:
 
 * `FaultPlan` / `install` / `fault_point(site)` — a seeded, deterministic
   fault-injection registry. Sites are the data-plane and solver boundaries
-  (`decode`, `pack`, `upload`, `solve`, `checkpoint_write`); a plan arms a
+  (`decode`, `pack`, `upload`, `solve`, `checkpoint_write`), the serving
+  tier (`lookup`/`score`/`admit`/`swap_*`), and the pod-scale mesh layers
+  (`collective`, `shard_upload`, `promote`, `resume_load`); a plan arms a
   site for its first N invocations, explicit invocation indices, or a
   seeded probability — all reproducible, so a chaos test can replay the
   exact same failure schedule. Configured programmatically (tests) or via
@@ -37,7 +39,12 @@ module is the shared machinery:
   `injected_faults`, `serving_degraded_batches`, `serving_shed_requests`,
   `serving_deadline_misses`, `serving_circuit_opens`,
   `serving_fe_only_requests`, `serving_swaps`, `serving_swap_rollbacks`,
-  `serving_flush_thread_failures`, `quarantined_blocks`). Zero on a clean
+  `serving_flush_thread_failures`, `quarantined_blocks`, and the pod-scale
+  mesh counters `collective_retries` / `collective_fallbacks` /
+  `shard_upload_retries` / `promote_failures` / `watchdog_trips` /
+  `shard_loss_fallbacks` — the four in
+  contracts.ROBUSTNESS_CLEAN_ZERO_KEYS are additionally enforced all-zero
+  by the bench clean-run contract). Zero on a clean
   run by construction, so a nonzero
   value in a bench artifact (bench.py e2e_from_disk) is a loud robustness
   regression signal, and tests assert exact counts.
@@ -84,12 +91,35 @@ SITE_DESCRIPTIONS = {
     "admit": "serving admission control (an armed fault sheds the request)",
     "swap_stage": "bundle hot-swap staging (build + upload + warm the next bundle)",
     "swap_commit": "bundle hot-swap commit (the atomic flip between batches)",
+    # Pod-scale mesh failure domain (ISSUE 10): the distributed layers'
+    # own fault sites. Each has a bounded retry plus a degraded fallback —
+    # a failed collective re-dispatches then falls back to the bitwise-
+    # equal per-bucket loop for that sweep, a failed promotion leaves the
+    # row cold (counted, never fatal), a failed shard upload rolls a
+    # hot-swap back / leaves the shard degraded-FE-only, and a failed
+    # checkpoint-shard read retries before refusing with an integrity
+    # error naming the shard.
+    "collective": "mesh collective program dispatch (ring gather/scatter, "
+    "psum bcast-gather, scan sweeps over them)",
+    "shard_upload": "per-shard serving model staging (bundle build + "
+    "shard restage after loss)",
+    "promote": "two-tier serving store promotion (cold row -> HBM hot set)",
+    "resume_load": "checkpoint model/shard file reads on resume",
 }
 KNOWN_SITES = tuple(SITE_DESCRIPTIONS)
 
 
 class InjectedFault(RuntimeError):
     """Raised by an armed `fault_point`. Always classified transient."""
+
+
+class DeviceHang(RuntimeError):
+    """A device dispatch exceeded its watchdog deadline (utils/watchdog.py).
+
+    Classified transient/device-shaped: the coordinate sweep converts it to
+    a bounded re-dispatch (then the per-bucket fallback), and the serving
+    breaker counts it toward opening — the 'stuck forever on a bad device'
+    hole becomes a typed, counted degradation instead of a silent stall."""
 
 
 # --------------------------------------------------------------- fault plans
@@ -297,7 +327,9 @@ def _default_transient(exc: BaseException) -> bool:
     XLA runtime errors a remote-device tunnel surfaces transport blips as.
     Deliberately NOT retried: programming errors (TypeError/ValueError/
     KeyError...), which would re-fail identically and mask the bug."""
-    if isinstance(exc, (InjectedFault, OSError, ConnectionError, TimeoutError)):
+    if isinstance(
+        exc, (InjectedFault, DeviceHang, OSError, ConnectionError, TimeoutError)
+    ):
         return True
     return type(exc).__name__ == "XlaRuntimeError"
 
@@ -326,6 +358,17 @@ def default_policy() -> RetryPolicy:
         max_attempts=max(1, int(get_knob("PHOTON_RETRY_MAX_ATTEMPTS"))),
         base_delay_s=float(get_knob("PHOTON_RETRY_BASE_DELAY_S")),
         max_delay_s=float(get_knob("PHOTON_RETRY_MAX_DELAY_S")),
+    )
+
+
+def bounded_policy(extra_attempts: int) -> RetryPolicy:
+    """The default backoff/transient classification with an explicit
+    attempt bound: 1 initial try + `extra_attempts` retries. The one
+    builder behind every per-site retry knob (collective re-dispatch,
+    per-shard staging), so backoff/classification changes cannot drift
+    across sites."""
+    return dataclasses.replace(
+        default_policy(), max_attempts=1 + max(0, int(extra_attempts))
     )
 
 

@@ -303,6 +303,30 @@ _register(
     2.0,
     "Retry backoff delay cap in seconds.",
 )
+_register(
+    "PHOTON_WATCHDOG_MS",
+    int,
+    0,
+    "Hang-watchdog deadline (ms) armed around scanned-sweep and serving "
+    "device dispatches; an over-deadline dispatch raises a typed "
+    "DeviceHang (sweep re-dispatch / serving FE-only degradation). 0 = "
+    "off (bench arms it for its chaos sections).",
+)
+_register(
+    "PHOTON_COLLECTIVE_RETRIES",
+    int,
+    1,
+    "Extra re-dispatches a failed mesh collective program gets before "
+    "the sweep degrades to the bitwise-equal per-bucket loop.",
+)
+_register(
+    "PHOTON_SHARD_UPLOAD_RETRIES",
+    int,
+    2,
+    "Extra attempts a failed per-shard serving staging/restage gets "
+    "before the failure surfaces (hot-swap rollback / shard stays "
+    "degraded).",
+)
 
 # ------------------------------------------------------------------- serving
 _register(

@@ -119,8 +119,14 @@ def _failure_domain_hygiene(monkeypatch):
       store.close()/bundle.release(); a survivor means promotions kept
       mutating a torn-down store;
     * no `photon-ckpt-write` thread outlives the test — a staged
-      checkpoint write is joined by save() before the state.json commit;
-      a survivor means a step committed without its model file durable.
+      checkpoint write is joined by save() before the state.json commit
+      (sharded checkpoints fan out `photon-ckpt-write-shard<k>` workers,
+      joined the same way); a survivor means a step committed without its
+      model file durable;
+    * no `photon-watchdog` monitor outlives the test — a Watchdog is
+      joined by its owner's close() (the serving engine, the sweep's
+      per-train instance); a survivor means deadlines kept arming against
+      a torn-down dispatcher.
     """
     from photon_ml_tpu.utils import faults
 
@@ -131,6 +137,9 @@ def _failure_domain_hygiene(monkeypatch):
         "PHOTON_RETRY_BASE_DELAY_S",
         "PHOTON_RETRY_MAX_DELAY_S",
         "PHOTON_SOLVE_RETRIES",
+        "PHOTON_WATCHDOG_MS",
+        "PHOTON_COLLECTIVE_RETRIES",
+        "PHOTON_SHARD_UPLOAD_RETRIES",
     ):
         monkeypatch.delenv(var, raising=False)
     faults.clear()
@@ -149,6 +158,7 @@ def _failure_domain_hygiene(monkeypatch):
                     "photon-serving-flush",
                     "photon-serving-promote",
                     "photon-ckpt-write",
+                    "photon-watchdog",
                 )
             )
             and t.is_alive()

@@ -786,6 +786,132 @@ class TestKillResume:
         _assert_bitwise_equal(_model_arrays(straight), _model_arrays(resumed))
 
 
+# ----------------------------------------- sharded kill-resume (ISSUE 10)
+
+
+_SHARDED_CHILD_SCRIPT = """
+import os, sys
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Device count is injected by the parent via XLA_FLAGS
+# (--xla_force_host_platform_device_count): the SAME checkpoint resumes
+# on 1, 2, and 8 virtual devices.
+sys.path.insert(0, {repo!r})
+import time
+import numpy as np
+
+from tests.test_mesh_faults import N_ENTITIES, _coords
+from photon_ml_tpu.game.coordinate_descent import run_coordinate_descent
+
+ck = sys.argv[1]
+mode = sys.argv[2]  # "train" (stalled, parent SIGKILLs mid-sweep) | "resume"
+
+
+class _Stall:
+    # Slows each sweep so the parent can SIGKILL mid-run; timing-only.
+    def __init__(self, inner):
+        self.inner = inner
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+    def train(self, *args, **kwargs):
+        out = self.inner.train(*args, **kwargs)
+        time.sleep(0.5)
+        return out
+
+
+coords = _coords(True)  # entity-sharded over however many devices exist
+if mode == "train":
+    coords = {{cid: _Stall(c) for cid, c in coords.items()}}
+res = run_coordinate_descent(coords, 3, seed=11, checkpoint_dir=ck)
+if mode == "resume":
+    m = np.asarray(res.model.models["re"].coefficients_matrix)
+    np.save(sys.argv[3], m[: N_ENTITIES + 1])
+print("CHILD_DONE", flush=True)
+"""
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+class TestShardedKillResume:
+    """The elastic-resume acceptance contract (ISSUE 10): SIGKILL an
+    entity-sharded fit mid-sweep on the 8-virtual-device mesh, then resume
+    its N-shard checkpoint on 1, 2, and 8 devices — every resumed run must
+    land bitwise on the uninterrupted single-device fit."""
+
+    def _env(self, ndev):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env["XLA_FLAGS"] = (
+            f"--xla_force_host_platform_device_count={ndev}"
+        )
+        return env
+
+    def test_sigkill_mid_sweep_resumes_on_1_2_8_devices(self, tmp_path):
+        from tests.test_mesh_faults import _coords as _mesh_coords, _matrix
+
+        ck = str(tmp_path / "ck")
+        script = tmp_path / "child.py"
+        script.write_text(_SHARDED_CHILD_SCRIPT.format(repo=REPO))
+        proc = subprocess.Popen(
+            [sys.executable, str(script), ck, "train"],
+            cwd=REPO,
+            env=self._env(8),
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+        )
+        try:
+            state_path = os.path.join(ck, "state.json")
+            deadline = time.monotonic() + 180
+            killed = False
+            while time.monotonic() < deadline and proc.poll() is None:
+                try:
+                    if json.load(open(state_path))["completed_steps"] >= 1:
+                        proc.send_signal(signal.SIGKILL)
+                        killed = True
+                        break
+                except (OSError, ValueError, KeyError):
+                    pass
+                time.sleep(0.02)
+            proc.wait(timeout=120)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+        if killed:
+            assert proc.returncode == -signal.SIGKILL
+        assert os.path.isfile(state_path), "no step committed before timeout"
+        # The interrupted checkpoint's sharded layout really landed (a
+        # mid-fit state.json references per-shard files + checksums).
+        state = json.load(open(state_path))
+        rels = state["model_files"]["re"]
+        assert isinstance(rels, list) and len(rels) == 8
+
+        # Uninterrupted SINGLE-DEVICE reference (in-process, replicated —
+        # bitwise-equal to the sharded fit per test_mesh_faults).
+        straight = _matrix(
+            run_coordinate_descent(_mesh_coords(False), 3, seed=11)
+        )
+        for ndev in (1, 2, 8):
+            out = tmp_path / f"resume{ndev}.npy"
+            r = subprocess.run(
+                [sys.executable, str(script), ck, "resume", str(out)],
+                cwd=REPO,
+                env=self._env(ndev),
+                capture_output=True,
+                text=True,
+                timeout=600,
+            )
+            assert "CHILD_DONE" in r.stdout, (
+                f"resume on {ndev} device(s) failed: {r.stderr[-2000:]}"
+            )
+            resumed = np.load(out)
+            np.testing.assert_array_equal(
+                straight,
+                resumed,
+                err_msg=f"resume on {ndev} device(s) diverged bitwise",
+            )
+
+
 # -------------------------------------------- producer-thread degradation
 
 

@@ -1,0 +1,327 @@
+"""Pod-scale failure domains (ISSUE 10): mesh fault sites, elastic sharded
+checkpoints, the hang watchdog, and serving shard-loss degradation.
+
+The contracts, extending tests/test_faults.py to the distributed layers:
+
+* an armed `collective` fault re-dispatches (bounded) and, exhausted,
+  degrades THAT sweep group to the per-bucket loop — the trained model
+  stays BITWISE-identical to the clean sharded fit either way;
+* a checkpoint written from an entity-sharded fit lands as one npz per
+  shard (per-shard crc32 in state.json) and resumes bitwise on a
+  DIFFERENT mesh shape (replicated in-process; 1/2/8-device subprocesses
+  in the slow kill-resume test in test_faults.py); a corrupt or armed
+  (`resume_load`) shard read retries then refuses naming the shard;
+* the watchdog converts an over-deadline dispatch into a typed
+  `DeviceHang` — the sweep re-dispatches, serving degrades to FE-only
+  answers + a DEGRADED health transition — and `watchdog_trips` counts
+  what previously no counter observed;
+* a LOST serving shard keeps the engine answering: exactly its entities
+  get bitwise FE-only (pinned zero row) answers, per-shard health shows
+  in metrics()["sharding"], and recovery restages ONLY the lost shard.
+"""
+
+import json
+import os
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.data.game_dataset import (
+    GameDataset,
+    RandomEffectDataConfig,
+    build_random_effect_dataset,
+)
+from photon_ml_tpu.game.checkpoint import (
+    CheckpointIntegrityError,
+    CoordinateDescentCheckpoint,
+)
+from photon_ml_tpu.game.coordinate import RandomEffectCoordinate
+from photon_ml_tpu.game.coordinate_descent import run_coordinate_descent
+from photon_ml_tpu.optimize.config import (
+    L2,
+    CoordinateOptimizationConfig,
+    OptimizerConfig,
+)
+from photon_ml_tpu.parallel.mesh import (
+    make_mesh,
+    pad_game_dataset,
+    shard_game_dataset,
+    shard_random_effect_dataset,
+)
+from photon_ml_tpu.types import TaskType
+from photon_ml_tpu.utils import faults
+from photon_ml_tpu.utils.watchdog import Watchdog
+
+pytestmark = pytest.mark.chaos
+
+TASK = TaskType.LOGISTIC_REGRESSION
+# 40 entities x 6 rows = 240 samples: divisible by 8, so the padded
+# sharded dataset is IDENTICAL to the replicated one and the checkpoint
+# config fingerprint matches across mesh shapes (elastic resume).
+N_ENTITIES, ROWS_EACH, D_RE = 40, 6, 5
+
+
+def _problem(seed=0):
+    rng = np.random.default_rng(seed)
+    n = N_ENTITIES * ROWS_EACH
+    Xe = rng.normal(size=(n, D_RE)).astype(np.float32)
+    ent = np.repeat(np.arange(N_ENTITIES), ROWS_EACH)
+    y = (rng.uniform(size=n) > 0.5).astype(np.float32)
+    return Xe, ent, y
+
+
+_CFG = CoordinateOptimizationConfig(
+    optimizer=OptimizerConfig(max_iterations=8, tolerance=1e-7),
+    regularization=L2,
+    reg_weight=1.0,
+)
+_RE_CFG = RandomEffectDataConfig("entityId", "re", min_bucket=8)
+
+
+def _coords(sharded: bool, seed=0):
+    Xe, ent, y = _problem(seed)
+    ds = GameDataset.build(
+        {"re": jnp.asarray(Xe)}, y, id_tags={"entityId": ent}
+    )
+    if sharded:
+        mesh = make_mesh()
+        ds = shard_game_dataset(pad_game_dataset(ds, mesh.devices.size), mesh)
+        red = shard_random_effect_dataset(
+            build_random_effect_dataset(ds, _RE_CFG), mesh
+        )
+    else:
+        red = build_random_effect_dataset(ds, _RE_CFG)
+    return {"re": RandomEffectCoordinate(ds, red, _CFG, TASK)}
+
+
+def _matrix(result) -> np.ndarray:
+    """Logical rows (E + 1) of the trained RE matrix — mesh padding rows
+    are inert zeros and excluded from parity checks."""
+    m = np.asarray(result.model.models["re"].coefficients_matrix)
+    return m[: N_ENTITIES + 1]
+
+
+# ------------------------------------------------------- collective faults
+
+
+class TestCollectiveFaults:
+    def test_sharded_scan_bitwise_equals_replicated(self):
+        """Foundation for everything below: the entity-sharded scan sweep
+        is BITWISE-equal to the single-device fit on logical rows."""
+        a = _matrix(run_coordinate_descent(_coords(False), 2, seed=3))
+        b = _matrix(run_coordinate_descent(_coords(True), 2, seed=3))
+        np.testing.assert_array_equal(a, b)
+
+    def test_collective_fault_redispatches_to_bitwise_parity(
+        self, monkeypatch
+    ):
+        monkeypatch.setenv("PHOTON_RETRY_BASE_DELAY_S", "0.001")
+        clean = _matrix(run_coordinate_descent(_coords(True), 2, seed=3))
+        with faults.inject("collective:1") as inj:
+            faulted = _matrix(
+                run_coordinate_descent(_coords(True), 2, seed=3)
+            )
+        assert inj.injected == {"collective": 1}
+        assert faults.counters()["collective_retries"] == 1
+        np.testing.assert_array_equal(clean, faulted)
+
+    def test_exhausted_collective_degrades_to_bucket_loop(self, monkeypatch):
+        """Retries exhausted on EVERY dispatch: each sweep group falls back
+        to the per-bucket loop (collective site suppressed there) and the
+        fit still lands bitwise on the clean sharded result."""
+        monkeypatch.setenv("PHOTON_RETRY_BASE_DELAY_S", "0.001")
+        clean = _matrix(run_coordinate_descent(_coords(True), 2, seed=3))
+        with faults.inject("collective:9999"):
+            degraded = _matrix(
+                run_coordinate_descent(_coords(True), 2, seed=3)
+            )
+        c = faults.counters()
+        assert c["collective_fallbacks"] > 0
+        assert c["collective_retries"] > 0
+        np.testing.assert_array_equal(clean, degraded)
+
+    def test_non_device_error_propagates(self):
+        """The fallback tier is for device-shaped failures only — a
+        programming error inside the sweep must surface, not be silently
+        'degraded' around."""
+        coords = _coords(True)
+        coord = coords["re"]
+        orig = coord._dispatch_scan_group
+
+        def boom(*a, **k):
+            raise ValueError("a bug, not weather")
+
+        coord._dispatch_scan_group = boom
+        with pytest.raises(ValueError, match="a bug"):
+            run_coordinate_descent(coords, 1, seed=3)
+        coord._dispatch_scan_group = orig
+
+
+# ------------------------------------------------- elastic sharded ckpt
+
+
+class TestElasticShardedCheckpoint:
+    def test_sharded_layout_with_per_shard_checksums(self, tmp_path):
+        ck = str(tmp_path / "ck")
+        run_coordinate_descent(_coords(True), 1, seed=5, checkpoint_dir=ck)
+        state = json.load(open(os.path.join(ck, "state.json")))
+        rels = state["model_files"]["re"]
+        assert isinstance(rels, list) and len(rels) == 8
+        assert all(f".shard{k}of8.npz" in rels[k] for k in range(8))
+        for rel in rels:
+            assert state["checksums"][rel].startswith("crc32:")
+            assert os.path.isfile(os.path.join(ck, rel))
+
+    def test_resume_onto_other_mesh_shape_bitwise(self, tmp_path):
+        """N-shard checkpoint -> replicated (1-device path) resume, and
+        back: the reassembled matrix re-pads/re-shards onto the resuming
+        layout and the final model is bitwise the uninterrupted one (the
+        1/2/8-device SUBPROCESS matrix of this contract lives in
+        test_faults.py::TestShardedKillResume)."""
+        straight = _matrix(run_coordinate_descent(_coords(True), 2, seed=5))
+        ck = str(tmp_path / "ck")
+
+        class _Preempt:
+            def __init__(self, inner, allowed):
+                self.inner, self.allowed, self.calls = inner, allowed, 0
+
+            def __getattr__(self, name):
+                return getattr(self.inner, name)
+
+            def train(self, *args, **kwargs):
+                if self.calls >= self.allowed:
+                    raise RuntimeError("simulated preemption")
+                self.calls += 1
+                return self.inner.train(*args, **kwargs)
+
+        coords = _coords(True)
+        coords["re"] = _Preempt(coords["re"], 1)  # step 1 commits, step 2 dies
+        with pytest.raises(RuntimeError, match="preemption"):
+            run_coordinate_descent(coords, 2, seed=5, checkpoint_dir=ck)
+        # Resume on the REPLICATED layout (a 1-device mesh shape).
+        resumed_repl = _matrix(
+            run_coordinate_descent(_coords(False), 2, seed=5, checkpoint_dir=ck)
+        )
+        np.testing.assert_array_equal(straight, resumed_repl)
+        # And the replicated run's (single-blob) checkpoint resumes back
+        # onto the 8-device mesh bitwise too.
+        resumed_sharded = _matrix(
+            run_coordinate_descent(_coords(True), 2, seed=5, checkpoint_dir=ck)
+        )
+        np.testing.assert_array_equal(straight, resumed_sharded)
+
+    def test_corrupt_shard_refused_naming_the_shard(self, tmp_path):
+        ck = str(tmp_path / "ck")
+        run_coordinate_descent(_coords(True), 1, seed=5, checkpoint_dir=ck)
+        state = json.load(open(os.path.join(ck, "state.json")))
+        rel = state["model_files"]["re"][3]
+        path = os.path.join(ck, rel)
+        data = open(path, "rb").read()
+        with open(path, "wb") as f:
+            f.write(data[: len(data) // 2])
+        with pytest.raises(CheckpointIntegrityError, match="shard") as exc:
+            CoordinateDescentCheckpoint(ck).load(TASK)
+        assert rel in str(exc.value)
+
+    def test_missing_shard_refused(self, tmp_path):
+        ck = str(tmp_path / "ck")
+        run_coordinate_descent(_coords(True), 1, seed=5, checkpoint_dir=ck)
+        state = json.load(open(os.path.join(ck, "state.json")))
+        os.remove(os.path.join(ck, state["model_files"]["re"][0]))
+        with pytest.raises(
+            CheckpointIntegrityError, match="missing shard file"
+        ):
+            CoordinateDescentCheckpoint(ck).load(TASK)
+
+    def test_resume_load_fault_retried(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PHOTON_RETRY_BASE_DELAY_S", "0.001")
+        ck = str(tmp_path / "ck")
+        r1 = _matrix(
+            run_coordinate_descent(_coords(True), 1, seed=5, checkpoint_dir=ck)
+        )
+        with faults.inject("resume_load:1") as inj:
+            r2 = _matrix(
+                run_coordinate_descent(
+                    _coords(True), 1, seed=5, checkpoint_dir=ck
+                )
+            )
+        assert inj.injected == {"resume_load": 1}
+        assert faults.counters()["retries"] >= 1
+        np.testing.assert_array_equal(r1, r2)
+
+
+# -------------------------------------------------------------- watchdog
+
+
+class TestWatchdog:
+    def test_trip_raises_device_hang_and_counts(self):
+        with Watchdog() as wd:
+            with pytest.raises(faults.DeviceHang, match="watchdog deadline"):
+                with wd.guard(5, "slow dispatch"):
+                    time.sleep(0.08)
+            assert wd.trips == 1
+        assert faults.counters()["watchdog_trips"] == 1
+
+    def test_fast_scope_is_free_and_disabled_is_noop(self):
+        with Watchdog() as wd:
+            with wd.guard(10_000, "fast"):
+                pass
+            with wd.guard(0, "disabled"):
+                time.sleep(0.01)
+            assert wd.trips == 0
+        assert faults.counters().get("watchdog_trips", 0) == 0
+
+    def test_on_trip_fires_while_still_stuck(self):
+        """The callback must fire AT trip time (a hung-forever dispatch
+        still flips health), not at scope exit."""
+        seen = []
+        with Watchdog(on_trip=seen.append) as wd:
+            try:
+                with wd.guard(5, "wedged"):
+                    deadline = time.monotonic() + 2.0
+                    while not seen and time.monotonic() < deadline:
+                        time.sleep(0.005)
+            except faults.DeviceHang:
+                pass
+        assert seen == ["wedged"]
+
+    def test_close_joins_monitor(self):
+        import threading
+
+        wd = Watchdog()
+        with wd.guard(10_000, "x"):
+            pass
+        wd.close()
+        assert not any(
+            t.name == "photon-watchdog" and t.is_alive()
+            for t in threading.enumerate()
+        )
+
+    def test_sweep_converts_hang_to_redispatch(self, monkeypatch):
+        """A scan-group dispatch that blows its deadline once re-dispatches
+        and lands bitwise (the deterministic program reproduces itself)."""
+        monkeypatch.setenv("PHOTON_RETRY_BASE_DELAY_S", "0.001")
+        monkeypatch.setenv("PHOTON_WATCHDOG_MS", "50")
+        clean = _matrix(run_coordinate_descent(_coords(True), 1, seed=3))
+
+        coords = _coords(True)
+        coord = coords["re"]
+        real = coord._train_scan_sharded
+        calls = {"n": 0}
+
+        def slow_once(*args):
+            out = real(*args)
+            import jax
+
+            jax.block_until_ready(out[0])
+            calls["n"] += 1
+            if calls["n"] == 1:
+                time.sleep(0.2)  # first dispatch: simulated wedge
+            return out
+
+        coord._train_scan_sharded = slow_once
+        hung = _matrix(run_coordinate_descent(coords, 1, seed=3))
+        assert faults.counters()["watchdog_trips"] >= 1
+        np.testing.assert_array_equal(clean, hung)

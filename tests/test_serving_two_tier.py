@@ -308,6 +308,236 @@ class TestEntityShardedServing:
         assert np.array_equal(got, ref)
 
 
+class TestPromotionFaults:
+    """ISSUE 10 promotion-worker fault cases: an armed `promote` fault
+    never loses a request, never leaks the `photon-serving-promote` thread
+    (conftest guard), and the cold row still scores bitwise through the
+    override-buffer path."""
+
+    pytestmark = [pytest.mark.serving, pytest.mark.chaos]
+
+    def test_failed_promotion_leaves_rows_cold_and_bitwise(
+        self, rng, monkeypatch
+    ):
+        from photon_ml_tpu.utils import faults
+
+        monkeypatch.setenv("PHOTON_RETRY_BASE_DELAY_S", "0.001")
+        model, specs, reqs, _ = _fixture(rng)
+        ref = _ref_scores(model, specs, reqs)
+        bundle = ServingBundle.from_model(model, specs, TASK, hot_rows=6)
+        store = bundle.coordinates["per-e"].store
+        with faults.inject("promote:1"):
+            with ServingEngine(bundle, max_batch=16) as eng:
+                s1 = _scores(eng.score_batch(reqs))
+                store.drain()
+                s2 = _scores(eng.score_batch(reqs))
+                store.drain()
+                m = eng.metrics()
+        # Never a lost request, never a changed answer.
+        assert np.array_equal(s1, ref) and np.array_equal(s2, ref)
+        # The first promotion batch failed (counted), the worker LIVED ON
+        # (not fatal): later touches re-queued and promoted successfully.
+        assert m["promote_failures"] > 0
+        assert m["promotions"] > 0
+        assert not store._closed
+        assert faults.counters()["promote_failures"] == m["promote_failures"]
+        bundle.release()
+
+    def test_persistent_promotion_failure_serves_from_cold_tier(
+        self, rng, monkeypatch
+    ):
+        from photon_ml_tpu.utils import faults
+
+        monkeypatch.setenv("PHOTON_RETRY_BASE_DELAY_S", "0.001")
+        model, specs, reqs, _ = _fixture(rng)
+        ref = _ref_scores(model, specs, reqs)
+        bundle = ServingBundle.from_model(model, specs, TASK, hot_rows=6)
+        store = bundle.coordinates["per-e"].store
+        with faults.inject("promote:9999"):
+            with ServingEngine(bundle, max_batch=16) as eng:
+                for _ in range(3):
+                    got = _scores(eng.score_batch(reqs))
+                    assert np.array_equal(got, ref)
+                    store.drain()
+                m = eng.metrics()
+        # Rows stayed cold forever — counted, never fatal, never wrong.
+        assert m["promote_failures"] > 0
+        assert m["cold_tier_hits"] > 0
+        assert not store._closed
+        bundle.release()
+        # conftest's leak guard asserts no photon-serving-promote survivor.
+
+
+class TestShardLossDegradation:
+    """ISSUE 10 serving shard loss: the engine keeps serving — requests
+    resolving to a LOST shard get the pinned zero row (bitwise FE-only
+    for exactly those entities), per-shard health reports in
+    metrics()["sharding"], and recovery re-stages ONLY the lost shard."""
+
+    pytestmark = [pytest.mark.serving, pytest.mark.chaos]
+
+    def _fe_only_ref(self, model, specs, reqs):
+        with ServingEngine(
+            ServingBundle.from_model(model, specs, TASK), max_batch=16
+        ) as eng:
+            return _scores(eng.score_batch_fe_only(reqs))
+
+    def test_lost_shard_serves_fe_only_exactly_its_entities(self, rng):
+        from photon_ml_tpu.parallel.mesh import make_mesh
+
+        model, specs, reqs, _ = _fixture(rng)
+        ref = _ref_scores(model, specs, reqs)
+        ref_fe = self._fe_only_ref(model, specs, reqs)
+        mesh = make_mesh()
+        bundle = ServingBundle.from_model(model, specs, TASK, mesh=mesh)
+        c = bundle.coordinates["per-e"]
+        assert c.shard_health.n_shards == mesh.devices.size
+        with ServingEngine(bundle, max_batch=16) as eng:
+            assert np.array_equal(_scores(eng.score_batch(reqs)), ref)
+            lo, hi = eng.mark_shard_lost("per-e", 1)
+            degraded = _scores(eng.score_batch(reqs))
+            m = eng.metrics()
+            # Exactly the lost shard's entities are FE-only; all others
+            # keep their full-fidelity bitwise answers.
+            rows, _ = c.lookup_rows(
+                [r.entity_ids.get("eid") for r in reqs]
+            )
+            lost_mask = (rows >= lo) & (rows < hi)
+            assert lost_mask.any() and not lost_mask.all()
+            expected = np.where(lost_mask, ref_fe, ref)
+            assert np.array_equal(degraded, expected)
+            assert m["state"] == "DEGRADED"
+            assert "shard_loss:per-e/1" in m["degraded_reasons"]
+            assert m["sharding"]["shards_lost"] == 1
+            assert m["sharding"]["shard_loss_fallbacks"] == int(
+                lost_mask.sum()
+            )
+            # Recovery: restage ONLY the lost shard, back to bitwise-full.
+            nbytes = eng.restage_shard("per-e", 1)
+            assert nbytes == (hi - lo) * c.dim * 4
+            assert np.array_equal(_scores(eng.score_batch(reqs)), ref)
+            m2 = eng.metrics()
+            assert m2["state"] == "READY"
+            assert m2["sharding"]["shards_lost"] == 0
+
+    def test_failed_restage_keeps_serving_degraded(self, rng, monkeypatch):
+        from photon_ml_tpu.parallel.mesh import make_mesh
+        from photon_ml_tpu.utils import faults
+
+        monkeypatch.setenv("PHOTON_RETRY_BASE_DELAY_S", "0.001")
+        model, specs, reqs, _ = _fixture(rng)
+        ref = _ref_scores(model, specs, reqs)
+        ref_fe = self._fe_only_ref(model, specs, reqs)
+        mesh = make_mesh()
+        bundle = ServingBundle.from_model(model, specs, TASK, mesh=mesh)
+        c = bundle.coordinates["per-e"]
+        with ServingEngine(bundle, max_batch=16) as eng:
+            lo, hi = eng.mark_shard_lost("per-e", 0)
+            with faults.inject("shard_upload:9999"):
+                with pytest.raises(faults.InjectedFault):
+                    eng.restage_shard("per-e", 0)
+                # Still serving, still degraded, still bitwise FE-only for
+                # the lost shard's entities.
+                degraded = _scores(eng.score_batch(reqs))
+            assert faults.counters()["shard_upload_retries"] > 0
+            rows, _ = c.lookup_rows([r.entity_ids.get("eid") for r in reqs])
+            lost_mask = (rows >= lo) & (rows < hi)
+            assert np.array_equal(
+                degraded, np.where(lost_mask, ref_fe, ref)
+            )
+            assert eng.metrics()["state"] == "DEGRADED"
+            # A later (un-faulted) restage recovers fully.
+            eng.restage_shard("per-e", 0)
+            assert np.array_equal(_scores(eng.score_batch(reqs)), ref)
+
+    def test_staging_fault_retried_bitwise(self, rng, monkeypatch):
+        from photon_ml_tpu.utils import faults
+
+        monkeypatch.setenv("PHOTON_RETRY_BASE_DELAY_S", "0.001")
+        model, specs, reqs, _ = _fixture(rng)
+        ref = _ref_scores(model, specs, reqs)
+        with faults.inject("shard_upload:1") as inj:
+            bundle = ServingBundle.from_model(model, specs, TASK)
+        assert inj.injected == {"shard_upload": 1}
+        assert faults.counters()["shard_upload_retries"] == 1
+        with ServingEngine(bundle, max_batch=16) as eng:
+            assert np.array_equal(_scores(eng.score_batch(reqs)), ref)
+
+
+class TestServingWatchdog:
+    """ISSUE 10 hang watchdog in the serving score path: an over-deadline
+    dispatch becomes a typed DeviceHang, the health machine goes DEGRADED,
+    and every request still gets an answer (FE-only once the circuit
+    opens) — never a hang, never a lost future."""
+
+    pytestmark = [pytest.mark.serving, pytest.mark.chaos]
+
+    def test_wedged_dispatch_degrades_to_fe_only_answers(
+        self, rng, monkeypatch
+    ):
+        import time as _time
+
+        from photon_ml_tpu.utils import faults
+
+        monkeypatch.setenv("PHOTON_RETRY_BASE_DELAY_S", "0.001")
+        model, specs, reqs, _ = _fixture(rng)
+        ref_fe = None
+        with ServingEngine(
+            ServingBundle.from_model(model, specs, TASK), max_batch=16
+        ) as ref_eng:
+            ref = _scores(ref_eng.score_batch(reqs))
+            ref_fe = _scores(ref_eng.score_batch_fe_only(reqs))
+        eng = ServingEngine(
+            ServingBundle.from_model(model, specs, TASK),
+            max_batch=16,
+            circuit_threshold=1,
+            circuit_probe_interval_s=60.0,
+            watchdog_ms_override=10.0,
+        )
+        eng.warmup()  # warmup is watchdog-exempt (compiles are slow)
+        real = eng._dispatch_device
+
+        def wedged(packed, state):
+            out = real(packed, state)
+            _time.sleep(0.08)  # every full-path dispatch blows the 10ms
+            return out
+
+        eng._dispatch_device = wedged
+        with eng, eng.batcher(max_wait_ms=0.5) as batcher:
+            futs = [batcher.submit(r, block=True) for r in reqs]
+            results = [f.result(timeout=120) for f in futs]
+            m = eng.metrics()
+        # Every request answered — the hang hole is closed with ANSWERS.
+        assert len(results) == len(reqs)
+        assert faults.counters()["watchdog_trips"] >= 1
+        assert m["circuit_state"] == "OPEN"
+        assert m["state"] == "DEGRADED"
+        # FE-only answers are bitwise the FE-only reference; any requests
+        # answered before the circuit opened are bitwise the full path.
+        got = _scores(results)
+        fe_mask = np.asarray([r.fe_only for r in results])
+        assert fe_mask.any()
+        assert np.array_equal(got[fe_mask], ref_fe[fe_mask])
+        assert np.array_equal(got[~fe_mask], ref[~fe_mask])
+
+    def test_recovered_dispatch_clears_degradation(self, rng):
+        """A guarded dispatch finishing inside its deadline clears the
+        device_hang reason (self-healing)."""
+        model, specs, reqs, _ = _fixture(rng)
+        with ServingEngine(
+            ServingBundle.from_model(model, specs, TASK),
+            max_batch=16,
+            watchdog_ms_override=60_000.0,
+        ) as eng:
+            eng.warmup()
+            eng._hang_seen = True
+            eng.health.add_degraded("device_hang")
+            eng.score_batch(reqs)
+            m = eng.metrics()
+        assert "device_hang" not in m["degraded_reasons"]
+        assert m["state"] in ("READY", "DRAINING", "CLOSED")
+
+
 class TestBudgetAccounting:
     def test_device_bytes_per_shard_divides_sharded_state(self, rng):
         from photon_ml_tpu.parallel.mesh import make_mesh
