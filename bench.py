@@ -1891,6 +1891,25 @@ def _child() -> None:
             }
             _mark(f"e2e train {train_s:.1f}s ({fit_timing})")
 
+            # Run-profile round trip (ISSUE 11): persist the fit's
+            # profile.json and RE-READ it through telemetry.read_profile —
+            # the same loud missing-key contract the planner will consume
+            # it with. A profile that silently lost a section fails the
+            # e2e section here, not at plan time.
+            from photon_ml_tpu.utils import telemetry as _tel
+
+            profile_back = _tel.read_profile(
+                _tel.write_profile(
+                    os.path.join(td, "profile.json"), est.run_profile()
+                ),
+                kind="fit",
+            )
+            _mark(
+                "e2e profile round-tripped "
+                f"({len(profile_back['bucket_shapes'])} coordinate "
+                "bucket-shape set(s))"
+            )
+
             t0 = time.perf_counter()
             from photon_ml_tpu.transformers.game_transformer import (
                 GameTransformer,
@@ -1944,6 +1963,10 @@ def _child() -> None:
                 # The pod-scale mesh counters for THIS fit (all-zero on a
                 # clean run; schema = ROBUSTNESS_CLEAN_ZERO_KEYS).
                 robustness=dict(fit_timing["robustness"]),
+                # Proof the persisted planner profile re-read through its
+                # loud contract (telemetry.read_profile above).
+                profile_roundtrip_ok=True,
+                profile_dispatch=dict(profile_back["dispatch"]),
             )
             _mark(f"e2e done: {e2e}")
     except Exception as exc:  # noqa: BLE001 - bench must still print a line

@@ -1,6 +1,6 @@
 """photon-lint: self-hosted static analysis for photon-ml-tpu.
 
-Six AST-based checks, each machine-checking an invariant the repo
+Seven AST-based checks, each machine-checking an invariant the repo
 previously held only by convention (and has shipped bugs against):
 
 * knob-registry       — PHOTON_* env reads go through utils/knobs.py,
@@ -14,6 +14,9 @@ previously held only by convention (and has shipped bugs against):
                         donating call
 * contract-key-drift  — required-key schemas are imported from
                         utils/contracts.py, never re-typed
+* metric-name-sync    — incremented metric names == declared
+                        utils/telemetry.METRIC_DESCRIPTIONS, both
+                        directions, names statically resolvable
 
 Run `python -m photon_ml_tpu.analysis` (`--list-checks`, `--check
 <name>`, paths for fixture corpora); zero findings on the live tree is a
@@ -38,6 +41,7 @@ from photon_ml_tpu.analysis import (  # noqa: F401  isort: skip
     fault_site_sync,
     jit_purity,
     knob_registry,
+    metric_name_sync,
     thread_lifecycle,
 )
 

@@ -209,6 +209,33 @@ def run(args) -> Dict[str, object]:
         level=getattr(logging, args.logging_level.upper(), logging.INFO),
         format="%(asctime)s %(name)s %(levelname)s %(message)s",
     )
+    from photon_ml_tpu.utils import telemetry
+    from photon_ml_tpu.utils.observability import EventEmitter, journal_listener
+
+    out_dir = args.output_directory
+    if os.path.exists(out_dir):
+        if not args.delete_output_dirs_if_exist:
+            raise FileExistsError(
+                f"{out_dir} exists; pass --delete-output-dirs-if-exist"
+            )
+        shutil.rmtree(out_dir)
+    os.makedirs(out_dir)
+
+    state = _State()
+    emitter = EventEmitter()
+    # Run journal (ISSUE 11): the legacy GLM driver gets the same typed
+    # JSONL lifecycle record as the GAME training driver.
+    journal = telemetry.RunJournal(os.path.join(out_dir, "journal.jsonl"))
+    emitter.register(journal_listener(journal))
+    try:
+        return _run_stages(args, state, emitter, out_dir)
+    finally:
+        # Close on EVERY exit path — a failed stage otherwise leaks the
+        # open journal handle (cli/train and cli/serve close in a finally).
+        journal.close()
+
+
+def _run_stages(args, state, emitter, out_dir) -> Dict[str, object]:
     import jax.numpy as jnp
 
     from photon_ml_tpu.data.stats import summarize
@@ -222,22 +249,10 @@ def run(args) -> Dict[str, object]:
         RegularizationContext,
     )
     from photon_ml_tpu.utils.observability import (
-        EventEmitter,
         TrainingFinishEvent,
         TrainingStartEvent,
     )
 
-    out_dir = args.output_directory
-    if os.path.exists(out_dir):
-        if not args.delete_output_dirs_if_exist:
-            raise FileExistsError(
-                f"{out_dir} exists; pass --delete-output-dirs-if-exist"
-            )
-        shutil.rmtree(out_dir)
-    os.makedirs(out_dir)
-
-    state = _State()
-    emitter = EventEmitter()
     emitter.send(TrainingStartEvent(num_samples=-1))
 
     # INIT -> PREPROCESSED (Driver.preprocess: read, summarize, normalize).

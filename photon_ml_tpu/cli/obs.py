@@ -1,0 +1,186 @@
+"""photon-obs: inspect the telemetry artifacts a run persists (ISSUE 11).
+
+Three subcommands over the three file artifacts of utils/telemetry.py:
+
+  * `trace <trace.json>` — summarize a Chrome trace-event export (span
+    count, per-thread tracks, wall coverage). `--min-coverage P` exits
+    nonzero when the span union covers less than P% of the traced wall —
+    the acceptance gate for "spans cover the run".
+  * `journal <journal.jsonl>` — event counts by type; `--validate`
+    re-checks every line against its contracts.JOURNAL_EVENT_SCHEMAS
+    schema and exits nonzero on any invalid line.
+  * `profile <profile.json>` — pretty-print a run profile read through
+    the loud `read_profile` contract (stage table, dispatch decisions,
+    topology, roofline).
+
+Load the trace itself in Perfetto (https://ui.perfetto.dev) or
+chrome://tracing; this CLI is the headless companion.
+
+Usage: python -m photon_ml_tpu.cli.obs --help
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional, Tuple
+
+from photon_ml_tpu.utils import telemetry
+
+
+def _interval_union_us(spans: List[Tuple[float, float]]) -> float:
+    """Total microseconds covered by the union of [start, end) intervals."""
+    total = 0.0
+    end = None
+    for s, e in sorted(spans):
+        if end is None or s > end:
+            total += e - s
+            end = e
+        elif e > end:
+            total += e - end
+            end = e
+    return total
+
+
+def cmd_trace(args) -> int:
+    with open(args.path) as f:
+        doc = json.load(f)
+    events = [e for e in doc.get("traceEvents", []) if e.get("ph") == "X"]
+    threads = {
+        e["tid"]: e["args"]["name"]
+        for e in doc.get("traceEvents", [])
+        if e.get("ph") == "M" and e.get("name") == "thread_name"
+    }
+    if not events:
+        print("no spans recorded (was PHOTON_TRACE=1 set?)")
+        return 1
+    intervals = [(e["ts"], e["ts"] + e.get("dur", 0.0)) for e in events]
+    t0 = min(s for s, _ in intervals)
+    t1 = max(e for _, e in intervals)
+    wall_us = max(t1 - t0, 1e-9)
+    covered = _interval_union_us(intervals)
+    coverage = 100.0 * covered / wall_us
+    by_thread: dict = {}
+    for e in events:
+        by_thread.setdefault(e["tid"], []).append(e)
+    print(f"trace: {len(events)} span(s), {len(by_thread)} thread track(s), "
+          f"{wall_us / 1e6:.3f}s traced wall")
+    print(f"span coverage of traced wall: {coverage:.1f}%")
+    for tid, evs in sorted(by_thread.items(), key=lambda kv: -len(kv[1])):
+        name = threads.get(tid, str(tid))
+        top = max(evs, key=lambda e: e.get("dur", 0.0))
+        print(
+            f"  {name:32s} {len(evs):6d} span(s)  "
+            f"longest: {top['name']} ({top.get('dur', 0.0) / 1e3:.1f} ms)"
+        )
+    span_ids = {e["args"].get("span_id") for e in events}
+    orphans = [
+        e
+        for e in events
+        if e["args"].get("parent_id") is not None
+        and e["args"]["parent_id"] not in span_ids
+    ]
+    if orphans:
+        print(f"WARNING: {len(orphans)} span(s) reference a missing parent")
+    if args.min_coverage is not None and coverage < args.min_coverage:
+        print(
+            f"FAIL: coverage {coverage:.1f}% < required {args.min_coverage}%"
+        )
+        return 1
+    return 0
+
+
+def cmd_journal(args) -> int:
+    n_ok, errors = telemetry.validate_journal(args.path)
+    counts: dict = {}
+    with open(args.path) as f:
+        for raw in f:
+            raw = raw.strip()
+            if not raw:
+                continue
+            try:
+                etype = json.loads(raw).get("type")
+            except ValueError:
+                etype = "<unparseable>"
+            counts[etype] = counts.get(etype, 0) + 1
+    total = sum(counts.values())
+    print(f"journal: {total} line(s), {n_ok} valid, {len(errors)} invalid")
+    for etype in sorted(counts, key=counts.get, reverse=True):
+        print(f"  {etype:24s} {counts[etype]}")
+    for err in errors[:20]:
+        print(f"  INVALID: {err}")
+    if args.validate and errors:
+        return 1
+    return 0
+
+
+def cmd_profile(args) -> int:
+    profile = telemetry.read_profile(args.path)  # loud missing-key contract
+    topo = profile["device_topology"]
+    print(
+        f"{profile['kind']} profile: {profile['wall_s']}s wall on "
+        f"{topo['device_count']}x {topo['platform']} "
+        f"({topo.get('device_kind', '?')})"
+    )
+    roof = profile["roofline"].get("hbm_gb_per_s")
+    if roof:
+        print(f"  HBM roofline: {roof} GB/s")
+    print("  stages:")
+    stages = profile["stages"]
+    width = max((len(k) for k in stages), default=0)
+    for k in sorted(stages, key=lambda k: -float(stages[k] or 0)):
+        print(f"    {k.ljust(width)}  {float(stages[k]):10.3f}s")
+    print("  dispatch decisions:")
+    for k, v in sorted(profile["dispatch"].items()):
+        print(f"    {k}: {json.dumps(v, default=str)}")
+    shapes = profile["bucket_shapes"]
+    if shapes:
+        print("  bucket shapes:")
+        for k, v in sorted(shapes.items()):
+            print(f"    {k}: {json.dumps(v)[:120]}")
+    counters = (profile.get("metrics") or {}).get("counters") or {}
+    nonzero = {k: v for k, v in counters.items() if v}
+    print(f"  nonzero counters: {json.dumps(nonzero) if nonzero else '(none)'}")
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m photon_ml_tpu.cli.obs",
+        description="Inspect photon-trace telemetry artifacts "
+        "(trace.json / journal.jsonl / profile.json)",
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+    t = sub.add_parser("trace", help="summarize a Chrome trace export")
+    t.add_argument("path")
+    t.add_argument(
+        "--min-coverage",
+        type=float,
+        default=None,
+        help="exit 1 when span union covers less than this %% of the "
+        "traced wall",
+    )
+    j = sub.add_parser("journal", help="summarize/validate a run journal")
+    j.add_argument("path")
+    j.add_argument(
+        "--validate",
+        action="store_true",
+        help="exit 1 when any line fails its schema",
+    )
+    pr = sub.add_parser("profile", help="pretty-print a run profile")
+    pr.add_argument("path")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.cmd == "trace":
+        return cmd_trace(args)
+    if args.cmd == "journal":
+        return cmd_journal(args)
+    return cmd_profile(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

@@ -30,6 +30,7 @@ import json
 import logging
 import os
 import sys
+import time
 from typing import Iterator, List, Optional
 
 import numpy as np
@@ -179,22 +180,52 @@ def run(args) -> dict:
             for s in (args.feature_shard_configurations or [])
         )
         index_maps = resolve_offheap_index_maps(args.offheap_indexmap_dir, cfgs)
-    bundle = load_bundle(args.model_input_directory, index_maps=index_maps)
-    logger.info(
-        "bundle pinned: %d coordinate(s), %.1f MB uploaded in %.3fs",
-        len(bundle.coordinates),
-        bundle.upload_bytes / 1e6,
-        bundle.upload_s,
-    )
-    # Release on EVERY exit path (finally below): a two-tier store's async
-    # promotion worker must be joined while the XLA runtime is still alive
-    # — a daemon thread dispatching device updates during interpreter
-    # teardown aborts the process ("terminate called without an active
-    # exception"), which on an error path would mask the real traceback.
+
+    # Run telemetry (ISSUE 11): the journal records health transitions,
+    # swaps, watchdog trips and shard loss during the replay; PHOTON_TRACE
+    # exports a Perfetto-loadable trace; the serve profile persists below.
+    from photon_ml_tpu.utils import telemetry
+
+    out_root = args.root_output_directory
+    os.makedirs(out_root, exist_ok=True)
+    journal = telemetry.RunJournal(os.path.join(out_root, "journal.jsonl"))
+    # Only adopt the process-ambient slots we own (same discipline for
+    # journal and tracer): a caller's pre-installed journal/tracer must
+    # survive this run, not be clobbered and uninstalled to None.
+    journal_owned = telemetry.current_journal() is None
+    if journal_owned:
+        telemetry.install_journal(journal)
+    tracer_owned = telemetry.current_tracer() is None
+    tracer = telemetry.start_tracing_if_enabled()
+
+    # The ambient journal/tracer uninstall on EVERY exit path — including
+    # a failed bundle load — or the process-global sinks leak into the
+    # next run in this process (and its trace would never export).
     try:
-        return _run_with_bundle(args, bundle)
+        bundle = load_bundle(args.model_input_directory, index_maps=index_maps)
+        logger.info(
+            "bundle pinned: %d coordinate(s), %.1f MB uploaded in %.3fs",
+            len(bundle.coordinates),
+            bundle.upload_bytes / 1e6,
+            bundle.upload_s,
+        )
+        # Release on EVERY exit path (finally below): a two-tier store's
+        # async promotion worker must be joined while the XLA runtime is
+        # still alive — a daemon thread dispatching device updates during
+        # interpreter teardown aborts the process ("terminate called
+        # without an active exception"), which on an error path would mask
+        # the real traceback.
+        try:
+            return _run_with_bundle(args, bundle)
+        finally:
+            bundle.release()
     finally:
-        bundle.release()
+        if tracer is not None and tracer_owned:
+            tracer.export(os.path.join(out_root, "trace.json"))
+            telemetry.uninstall_tracer()
+        if journal_owned:
+            telemetry.uninstall_journal()
+        journal.close()
 
 
 def _run_with_bundle(args, bundle: ServingBundle) -> dict:
@@ -216,10 +247,15 @@ def _run_with_bundle(args, bundle: ServingBundle) -> dict:
             args.requests, bundle, shard_configs, malformed
         )
 
+    from photon_ml_tpu.utils import telemetry
+
     out_root = args.root_output_directory
     os.makedirs(out_root, exist_ok=True)
     engine = ServingEngine(bundle, max_batch=args.max_batch)
-    compiles = engine.warmup()
+    t_warm = time.perf_counter()
+    with telemetry.span("serve_warmup"):
+        compiles = engine.warmup()
+    warmup_s = time.perf_counter() - t_warm
     logger.info("engine warm: %d bucket program(s) compiled", compiles)
 
     # Scores are written one part file per replay window, so memory stays
@@ -234,7 +270,8 @@ def _run_with_bundle(args, bundle: ServingBundle) -> dict:
     model_id = args.model_id or "game-model"
     n_requests = 0
     n_failed = 0
-    with engine, engine.batcher(
+    t_replay = time.perf_counter()
+    with telemetry.span("serve_replay"), engine, engine.batcher(
         max_wait_ms=args.max_wait_ms,
         max_pending=args.max_pending,
         default_deadline_ms=args.deadline_ms,
@@ -283,6 +320,7 @@ def _run_with_bundle(args, bundle: ServingBundle) -> dict:
                 os.replace(tmp, part)
             n_requests += len(window)
         metrics = batcher.metrics()
+    replay_s = time.perf_counter() - t_replay
     logger.info(
         "replayed %d request(s), %d failed, %d malformed record(s) skipped; "
         "scores written to %s",
@@ -313,6 +351,24 @@ def _run_with_bundle(args, bundle: ServingBundle) -> dict:
     }
     with open(os.path.join(out_root, "serving-summary.json"), "w") as f:
         json.dump(summary, f, indent=2, default=str)
+    # The persisted serve profile (ISSUE 11): latency/dispatch record the
+    # planner consumes beside the fit profile (same loud-read contract).
+    profile = telemetry.build_profile(
+        "serve",
+        wall_s=warmup_s + replay_s,
+        stages={
+            "warmup_s": round(warmup_s, 4),
+            "replay_s": round(replay_s, 4),
+        },
+        dispatch={
+            "max_batch": int(args.max_batch),
+            "max_wait_ms": float(args.max_wait_ms),
+            "sharding": metrics.get("sharding"),
+        },
+        bucket_shapes={"engine_buckets": list(engine.buckets)},
+        serving=metrics,
+    )
+    telemetry.write_profile(os.path.join(out_root, "profile.json"), profile)
     logger.info("serving metrics: %s", metrics)
     return summary
 

@@ -288,41 +288,60 @@ def run(args, event_emitter=None) -> Dict[str, object]:
     os.makedirs(out_root, exist_ok=True)
 
     # Job-scoped observability: file log under the output root (the
-    # reference's PhotonLogger HDFS file), timed sections, lifecycle events.
+    # reference's PhotonLogger HDFS file), timed sections, lifecycle
+    # events — and since ISSUE 11 the run journal (every lifecycle event
+    # as a typed JSONL line), optional span tracing (PHOTON_TRACE=1 ->
+    # Perfetto-loadable trace.json), and the persisted run profile.
+    from photon_ml_tpu.utils import telemetry
     from photon_ml_tpu.utils.observability import (
+        EventEmitter,
         PhotonLogger,
         PhotonSetupEvent,
         Timed,
         TimingRegistry,
-        TrainingFinishEvent,
-        TrainingStartEvent,
+        journal_listener,
     )
 
     timings = TimingRegistry()
     job_logger = PhotonLogger(
         os.path.join(out_root, "photon-ml-tpu.log"), level=args.logging_level
     )
-    if event_emitter is not None:
-        event_emitter.send(PhotonSetupEvent(args=str(vars(args))))
+    if event_emitter is None:
+        event_emitter = EventEmitter()
+    journal = telemetry.RunJournal(os.path.join(out_root, "journal.jsonl"))
+    event_emitter.register(journal_listener(journal))
+    # Only adopt the process-ambient slots we own (same discipline for
+    # journal and tracer): a caller's pre-installed journal/tracer must
+    # survive this run, not be clobbered and uninstalled to None.
+    journal_owned = telemetry.current_journal() is None
+    if journal_owned:
+        telemetry.install_journal(journal)
+    tracer_owned = telemetry.current_tracer() is None
+    tracer = telemetry.start_tracing_if_enabled()
+    event_emitter.send(PhotonSetupEvent(args=str(vars(args))))
     try:
         return _run_job(
             args, event_emitter, out_root, models_root, timings, Timed,
-            TrainingStartEvent, TrainingFinishEvent,
         )
     except Exception as e:
         from photon_ml_tpu.utils.observability import PhotonFailureEvent
 
         logger.exception("training job failed")
-        if event_emitter is not None:
-            event_emitter.send(PhotonFailureEvent(error=repr(e)))
+        event_emitter.send(PhotonFailureEvent(error=repr(e)))
         raise
     finally:
+        if tracer is not None and tracer_owned:
+            tracer.export(os.path.join(out_root, "trace.json"))
+            telemetry.uninstall_tracer()
+            logger.info("trace written to %s", os.path.join(out_root, "trace.json"))
+        if journal_owned:
+            telemetry.uninstall_journal()
+        journal.close()
         job_logger.close()
 
 
 def _run_job(
     args, event_emitter, out_root, models_root, timings, Timed,
-    TrainingStartEvent, TrainingFinishEvent,
 ) -> Dict[str, object]:
     coordinate_configs = {}
     for s in args.coordinate_configurations:
@@ -361,8 +380,6 @@ def _run_job(
         _validate_rows(train, args.training_task, args.data_validation)
         if validation is not None:
             _validate_rows(validation, args.training_task, args.data_validation)
-    if event_emitter is not None:
-        event_emitter.send(TrainingStartEvent(num_samples=train.num_samples))
 
     # Feature-shard summarization output (calculateAndSaveFeatureShardStats,
     # GameTrainingDriver.scala:575-593 -> writeBasicStatistics).
@@ -454,6 +471,10 @@ def _run_job(
         },
         seed=args.random_seed,
         checkpoint_dir=getattr(args, "checkpoint_directory", None),
+        # The estimator emits start/sweep/coordinate/checkpoint/finish
+        # events itself (ISSUE 11 satellite), so library fits and CLI
+        # fits produce the same journal record.
+        event_emitter=event_emitter,
     )
 
     # Warm start / partial retrain (GameTrainingDriver.scala:370-409).
@@ -560,13 +581,14 @@ def _run_job(
 
     mode = args.output_mode
     if mode != ModelOutputMode.NONE:
-        _save(best, "best")
-        if mode in (ModelOutputMode.EXPLICIT, ModelOutputMode.ALL):
-            for i, r in enumerate(explicit_results):
-                _save(r, f"explicit-{i}")
-        if mode in (ModelOutputMode.TUNED, ModelOutputMode.ALL):
-            for i, r in enumerate(tuned_results):
-                _save(r, f"tuned-{i}")
+        with Timed("save models", registry=timings):
+            _save(best, "best")
+            if mode in (ModelOutputMode.EXPLICIT, ModelOutputMode.ALL):
+                for i, r in enumerate(explicit_results):
+                    _save(r, f"explicit-{i}")
+            if mode in (ModelOutputMode.TUNED, ModelOutputMode.ALL):
+                for i, r in enumerate(tuned_results):
+                    _save(r, f"tuned-{i}")
 
     for i, r in enumerate(all_results):
         logger.info(
@@ -589,16 +611,17 @@ def _run_job(
     }
     with open(os.path.join(out_root, "training-summary.json"), "w") as f:
         json.dump(summary, f, indent=2, default=str)
+    # The persisted run profile (ISSUE 11): the machine-readable artifact
+    # the adaptive-runtime planner consumes — stage breakdown, dispatch
+    # decisions, bucket shapes, topology, metrics snapshot. Validated on
+    # write; consumers re-read through telemetry.read_profile (loud).
+    from photon_ml_tpu.utils import telemetry
+
+    profile_path = telemetry.write_profile(
+        os.path.join(out_root, "profile.json"), estimator.run_profile()
+    )
+    logger.info("run profile written to %s", profile_path)
     logger.info("timing summary:\n%s", timings.summary())
-    if event_emitter is not None:
-        event_emitter.send(
-            TrainingFinishEvent(
-                num_configs=len(all_results),
-                best_metric=(
-                    None if best.evaluation is None else best.evaluation.primary_value
-                ),
-            )
-        )
     return summary
 
 

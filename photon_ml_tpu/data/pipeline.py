@@ -35,7 +35,7 @@ import threading
 from concurrent.futures import Future
 from typing import Callable, Dict, Optional
 
-from photon_ml_tpu.utils import faults
+from photon_ml_tpu.utils import faults, telemetry
 from photon_ml_tpu.utils.knobs import get_knob
 from photon_ml_tpu.utils.observability import current_stage_registry
 
@@ -129,6 +129,7 @@ class AsyncUploader:
             fut = Future()
             self._jobs[key] = fut
         registry = current_stage_registry()
+        span_h = telemetry.span_handoff()  # parent the worker's span
 
         def _run():
             if not fut.set_running_or_notify_cancel():
@@ -136,11 +137,16 @@ class AsyncUploader:
                 return
             t0 = time.perf_counter()
             try:
-                fut.set_result(
-                    faults.retry(
-                        fn, self._policy, label=f"async {self._stage} {key!r}"
+                with telemetry.adopt_span(span_h), telemetry.span(
+                    f"async_{self._stage}", key=str(key)
+                ):
+                    fut.set_result(
+                        faults.retry(
+                            fn,
+                            self._policy,
+                            label=f"async {self._stage} {key!r}",
+                        )
                     )
-                )
             except BaseException as exc:  # noqa: BLE001 - surfaced at result()
                 fut.set_exception(exc)
             finally:

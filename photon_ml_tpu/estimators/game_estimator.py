@@ -64,8 +64,15 @@ from photon_ml_tpu.transformers.game_transformer import (
     prepare_coordinate_data,
 )
 from photon_ml_tpu.types import NormalizationType, TaskType
+from photon_ml_tpu.utils import telemetry
 from photon_ml_tpu.utils.observability import (
+    CheckpointEvent,
+    CoordinateUpdateEvent,
+    EventEmitter,
+    SweepConfigEvent,
     TimingRegistry,
+    TrainingFinishEvent,
+    TrainingStartEvent,
     stage_scope,
     stage_timer,
 )
@@ -136,6 +143,7 @@ class GameEstimator:
         seed: int = 0,
         checkpoint_dir: Optional[str] = None,
         pipeline: Optional[bool] = None,
+        event_emitter: Optional[EventEmitter] = None,
     ):
         self.task = task
         self.data_configs = dict(coordinate_data_configs)
@@ -160,6 +168,11 @@ class GameEstimator:
         # fit is bitwise-identical to a synchronous one — the pipeline only
         # moves WHEN host builds/uploads run (tests/test_pipeline.py).
         self.pipeline = pipeline
+        # Lifecycle event bus (ISSUE 11 satellite): library callers get
+        # the same start/coordinate/sweep/checkpoint/finish record as CLI
+        # jobs — register a telemetry journal_listener (or any listener)
+        # on this emitter. None keeps fit() emission-free.
+        self.event_emitter = event_emitter
         # Per-stage prepare walls (PREPARE_STAGES) accumulated across
         # prepare() + coordinate construction; surfaced via `fit_timing`.
         self.timing_registry = TimingRegistry()
@@ -333,12 +346,16 @@ class GameEstimator:
                         # finished-but-unconsumed results must not pile up.
                         pending_re = list(re_cids)
                         reg = self.timing_registry
+                        span_h = telemetry.span_handoff()
 
                         def _build_in_scope(cfg_re):
                             # Stage scopes are thread-local: hand the
                             # spawning fit's registry to the worker so its
-                            # re_build wall lands in THIS fit's breakdown.
-                            with stage_scope(reg):
+                            # re_build wall lands in THIS fit's breakdown
+                            # (and its re_build span under the fit span).
+                            with stage_scope(reg), telemetry.adopt_span(
+                                span_h
+                            ):
                                 return build_random_effect_dataset(
                                     dataset, cfg_re
                                 )
@@ -558,7 +575,53 @@ class GameEstimator:
         `initial_model` seeds the first configuration (the driver's warm-start
         path, GameTrainingDriver.scala:370-378) and must contain every locked
         coordinate's model.
+
+        The whole fit runs under a root `fit` trace span (so a traced run's
+        spans cover the full wall), and when an `event_emitter` was given,
+        start/sweep/coordinate/checkpoint/finish lifecycle events flow
+        through it — the same record cli/train jobs get (ISSUE 11).
         """
+        emit = self.event_emitter.send if self.event_emitter is not None else None
+        with telemetry.span("fit", num_configs=len(opt_configs)):
+            if emit is not None:
+                emit(TrainingStartEvent(num_samples=int(data.num_samples)))
+            results = self._fit(
+                data, validation_data, opt_configs, initial_model=initial_model
+            )
+            if emit is not None:
+                best_eval = (
+                    select_best_result(results)[1].evaluation if results else None
+                )
+                emit(
+                    TrainingFinishEvent(
+                        num_configs=len(results),
+                        best_metric=(
+                            None
+                            if best_eval is None
+                            else float(best_eval.primary_value)
+                        ),
+                    )
+                )
+            return results
+
+    def _on_cd_event(self, etype: str, **fields) -> None:
+        """run_coordinate_descent's event hook -> typed bus events
+        (listener failures are isolated by EventEmitter.send)."""
+        if self.event_emitter is None:
+            return
+        if etype == "coordinate":
+            self.event_emitter.send(CoordinateUpdateEvent(**fields))
+        elif etype == "checkpoint":
+            self.event_emitter.send(CheckpointEvent(**fields))
+
+    def _fit(
+        self,
+        data: GameDataset,
+        validation_data: Optional[GameDataset],
+        opt_configs: Sequence[GameOptimizationConfiguration],
+        *,
+        initial_model: Optional[GameModel] = None,
+    ) -> List[GameResult]:
         if not opt_configs:
             raise ValueError("at least one optimization configuration required")
         from photon_ml_tpu.data.pipeline import pipeline_enabled
@@ -616,6 +679,10 @@ class GameEstimator:
         sharding_infos: Dict[str, dict] = {}
         default_cfg = CoordinateOptimizationConfig()
         for ci, cfgs in enumerate(opt_configs):
+            if self.event_emitter is not None:
+                self.event_emitter.send(
+                    SweepConfigEvent(index=ci, total=len(opt_configs))
+                )
             t_coord = time.perf_counter()
             coordinates = {
                 cid: self._coordinate_for(
@@ -689,6 +756,11 @@ class GameEstimator:
                     # background thread) — the stage the reference hides
                     # inside executor-parallel dataset construction.
                     prefetch=pipelined,
+                    on_event=(
+                        self._on_cd_event
+                        if self.event_emitter is not None
+                        else None
+                    ),
                 )
             evaluation = None
             if validation_data is not None and suite is not None:
@@ -787,6 +859,53 @@ class GameEstimator:
             "collective_bytes_total": int(collective_bytes),
         }
         return results
+
+    # ---------------------------------------------------------- run profile
+
+    def run_profile(self) -> Dict[str, object]:
+        """The machine-readable run profile of the LAST fit (ISSUE 11):
+        stage breakdown, ingest breakdown, dispatch decisions, bucket
+        shapes, device topology, roofline annotation, and a metrics
+        snapshot — the artifact the adaptive-runtime planner consumes.
+        Persist with `telemetry.write_profile(path, est.run_profile())`;
+        consumers re-read it through `telemetry.read_profile` (loud
+        missing-key contract)."""
+        if not hasattr(self, "fit_timing"):
+            raise RuntimeError("run_profile() needs a completed fit()")
+        ft = dict(self.fit_timing)
+        stages = {k: round(float(ft[k]), 4) for k in (*PREPARE_STAGES, "other")}
+        stages["prepare_s"] = round(float(ft["prepare_s"]), 4)
+        stages["solve_s"] = round(float(ft["solve_s"]), 4)
+        # Every runtime decision this fit took — the knobs the Spark-ML
+        # performance study shows dominate end-to-end cost, recorded so a
+        # planner (or a human) can audit WHY this run ran the way it did.
+        from photon_ml_tpu.data.pipeline import pipeline_enabled
+
+        dispatch = {
+            "pack_path": ft["pack_path"],
+            "re_path": ft["re_path"],
+            "sharding": dict(ft["sharding"]),
+            "pipeline": bool(pipeline_enabled(self.pipeline)),
+        }
+        bucket_shapes: Dict[str, object] = {}
+        for cid, prep in (self._prepared or {}).items():
+            if prep.re_dataset is not None:
+                bucket_shapes[cid] = [
+                    [b.num_entities, b.capacity]
+                    for b in prep.re_dataset.buckets
+                ]
+        ingest = dict(
+            getattr(self._prepared_dataset, "ingest_timing", None) or {}
+        )
+        return telemetry.build_profile(
+            "fit",
+            wall_s=float(ft["prepare_s"]) + float(ft["solve_s"]),
+            stages=stages,
+            dispatch=dispatch,
+            bucket_shapes=bucket_shapes,
+            fit_timing=ft,
+            ingest=ingest,
+        )
 
 
 def select_best_result(

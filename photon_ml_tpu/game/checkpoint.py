@@ -64,7 +64,7 @@ from photon_ml_tpu.game.model import (
     GameModel,
     RandomEffectModel,
 )
-from photon_ml_tpu.utils import faults
+from photon_ml_tpu.utils import faults, telemetry
 
 STATE_FILE = "state.json"
 STEPS_DIR = "steps"
@@ -187,12 +187,16 @@ def _save_random_effect_sharded(
     checksums: Dict[str, str] = {}
     errors: List[BaseException] = []
     lock = threading.Lock()
+    span_h = telemetry.span_handoff()  # parent the shard writers' spans
 
     def _write_one(rel: str, arrays: Dict[str, np.ndarray]) -> None:
         try:
-            ck = _write_model_bytes(
-                os.path.join(directory, rel), _npz_bytes(arrays)
-            )
+            with telemetry.adopt_span(span_h), telemetry.span(
+                "ckpt_write_shard", file=rel
+            ):
+                ck = _write_model_bytes(
+                    os.path.join(directory, rel), _npz_bytes(arrays)
+                )
             with lock:
                 checksums[rel] = ck
         except BaseException as exc:  # noqa: BLE001 - re-raised after join
@@ -497,12 +501,18 @@ class CoordinateDescentCheckpoint:
 
         rel = os.path.join(STEPS_DIR, str(completed_steps), f"{cid}.npz")
         fut: Future = Future()
+        span_h = telemetry.span_handoff()  # parent the writer's span
 
         def _run():
             try:
                 # (rel_or_shard_list, {rel: checksum}) — sharded models
                 # fan their per-shard writes out in parallel inside.
-                fut.set_result(_save_model_files(self.directory, rel, model))
+                with telemetry.adopt_span(span_h), telemetry.span(
+                    "ckpt_write", step=completed_steps, coordinate=cid
+                ):
+                    fut.set_result(
+                        _save_model_files(self.directory, rel, model)
+                    )
             except BaseException as exc:  # noqa: BLE001 - joined in save()
                 fut.set_exception(exc)
 

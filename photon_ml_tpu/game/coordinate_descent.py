@@ -31,7 +31,7 @@ import jax.numpy as jnp
 
 from photon_ml_tpu.evaluation.suite import EvaluationResults, EvaluationSuite
 from photon_ml_tpu.game.model import GameModel
-from photon_ml_tpu.utils import faults
+from photon_ml_tpu.utils import faults, telemetry
 from photon_ml_tpu.utils.observability import record_stage
 
 logger = logging.getLogger(__name__)
@@ -116,6 +116,7 @@ def run_coordinate_descent(
     seed: int = 0,
     checkpoint_dir: Optional[str] = None,
     prefetch: bool = False,
+    on_event=None,
 ) -> CoordinateDescentResult:
     """Run cyclic coordinate descent (CoordinateDescent.run, :132-134).
 
@@ -130,6 +131,11 @@ def run_coordinate_descent(
     materialization), so the transfer hides behind the solve instead of
     faulting synchronously at coordinate k+1's first gather. Prefetching
     changes only when uploads happen, never their content.
+
+    `on_event(etype, **fields)` is the lifecycle hook (ISSUE 11): called
+    with ("coordinate", iteration/coordinate/seconds/accepted) after every
+    update and ("checkpoint", step/coordinate) after every durable save —
+    the estimator forwards these as typed bus events into the run journal.
 
     `checkpoint_dir` enables checkpoint-restart of the outer loop (SURVEY
     §5.3's replacement for Spark lineage recovery): after every coordinate
@@ -312,42 +318,49 @@ def run_coordinate_descent(
             model = None
             new_scores = None
             new_summed = None
-            for attempt in range(1 + faults.solve_retry_attempts()):
-                try:
-                    faults.fault_point("solve")
-                except faults.InjectedFault:
-                    # Only the solve site's OWN injection reads as a
-                    # divergence; faults raised inside train/score (e.g. an
-                    # upload whose retries exhausted) keep their surface
-                    # semantics — swallowing them here would ship an
-                    # untrained model as a "diverged" counter.
-                    finite = False
-                else:
-                    cand_model, _stats = coord.train(
-                        offsets, models.get(cid), **kwargs
+            # One trace span per coordinate update (utils/telemetry.py):
+            # the solver's wall structure in Perfetto, no-op untraced.
+            with telemetry.span(
+                "coordinate_update", coordinate=cid, iteration=it
+            ) as _span:
+                for attempt in range(1 + faults.solve_retry_attempts()):
+                    try:
+                        faults.fault_point("solve")
+                    except faults.InjectedFault:
+                        # Only the solve site's OWN injection reads as a
+                        # divergence; faults raised inside train/score (e.g.
+                        # an upload whose retries exhausted) keep their
+                        # surface semantics — swallowing them here would ship
+                        # an untrained model as a "diverged" counter.
+                        finite = False
+                    else:
+                        cand_model, _stats = coord.train(
+                            offsets, models.get(cid), **kwargs
+                        )
+                        cand_scores = coord.score(cand_model)
+                        # One fused program: the next summed-scores vector
+                        # and the divergence guard's reduction; one bool
+                        # fetch.
+                        cand_summed, ok = _commit_update(
+                            residual,
+                            cand_scores,
+                            _model_arrays(cand_model, cand_scores),
+                        )
+                        finite = bool(ok)
+                    if finite:
+                        model, new_scores = cand_model, cand_scores
+                        new_summed = cand_summed
+                        break
+                    diverged_steps += 1
+                    record_stage("diverged", 1.0)
+                    logger.warning(
+                        "iteration %d coordinate %s: non-finite update "
+                        "rejected (attempt %d)",
+                        it,
+                        cid,
+                        attempt + 1,
                     )
-                    cand_scores = coord.score(cand_model)
-                    # One fused program: the next summed-scores vector and
-                    # the divergence guard's reduction; one bool fetch.
-                    cand_summed, ok = _commit_update(
-                        residual,
-                        cand_scores,
-                        _model_arrays(cand_model, cand_scores),
-                    )
-                    finite = bool(ok)
-                if finite:
-                    model, new_scores = cand_model, cand_scores
-                    new_summed = cand_summed
-                    break
-                diverged_steps += 1
-                record_stage("diverged", 1.0)
-                logger.warning(
-                    "iteration %d coordinate %s: non-finite update rejected "
-                    "(attempt %d)",
-                    it,
-                    cid,
-                    attempt + 1,
-                )
+                _span.set(accepted=model is not None)
             accepted = model is not None
             if accepted:
                 summed = new_summed
@@ -365,6 +378,17 @@ def run_coordinate_descent(
                     cid,
                 )
             timing[f"{cid}/iter{it}"] = time.perf_counter() - t0
+            telemetry.METRICS.observe(
+                "coordinate_update_s", timing[f"{cid}/iter{it}"]
+            )
+            if on_event is not None:
+                on_event(
+                    "coordinate",
+                    iteration=it,
+                    coordinate=cid,
+                    seconds=timing[f"{cid}/iter{it}"],
+                    accepted=accepted,
+                )
             logger.info("iteration %d coordinate %s trained in %.3fs", it, cid, timing[f"{cid}/iter{it}"])
 
             # Overlap the step's durable model write with the validation
@@ -424,6 +448,8 @@ def run_coordinate_descent(
                     validation_history=validation_history,
                     staged=staged_write,
                 )
+                if on_event is not None:
+                    on_event("checkpoint", step=step + 1, coordinate=cid)
             elif staged_write is not None:  # pragma: no cover - ckpt is set
                 staged_write[4].join()
 

@@ -19,7 +19,7 @@ from typing import Dict, List, Mapping, Optional, Sequence, Tuple
 
 import numpy as np
 
-from photon_ml_tpu.utils import faults
+from photon_ml_tpu.utils import faults, telemetry
 from photon_ml_tpu.utils.knobs import get_knob
 
 from photon_ml_tpu.data.containers import pack_csr_to_ell
@@ -256,8 +256,11 @@ def try_read_native(
     # that defers the background bucketed pack below).
     budget = avro_reader._default_threads() or effective_host_parallelism()
     # Worker threads record their decode walls into the SPAWNER's ingest
-    # stage registry (stage scopes are thread-local, AsyncUploader-style).
+    # stage registry (stage scopes are thread-local, AsyncUploader-style)
+    # — and their trace spans under the spawner's span via the same
+    # handoff discipline, so photon-ingest-decode tracks parent correctly.
     stage_reg = current_stage_registry()
+    span_h = telemetry.span_handoff()
 
     def _decode_one(c, n_threads):
         path, body, codec, sync, program = c
@@ -274,7 +277,10 @@ def try_read_native(
 
         t0 = time.perf_counter()
         try:
-            return faults.retry(_attempt, label=f"avro decode {path}")
+            with telemetry.adopt_span(span_h), telemetry.span(
+                "decode_file", file=os.path.basename(path)
+            ):
+                return faults.retry(_attempt, label=f"avro decode {path}")
         except Exception:
             # Retries exhausted (or non-transient): degrade to the
             # synchronous pure-Python codec instead of killing the read —

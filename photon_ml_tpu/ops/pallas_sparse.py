@@ -566,8 +566,12 @@ def begin_pack_async(csr, n_samples: int) -> None:
     # Capture the submitter's ambient stage registry (the AsyncUploader
     # pattern): the worker thread's own stack is empty, and without this
     # the pack_host wall + pack_path note of the DOMINANT host pack would
-    # silently vanish from the fit's breakdown.
+    # silently vanish from the fit's breakdown. The span handoff parents
+    # the photon-bucketed-pack thread's trace span the same way.
     submit_registry = current_stage_registry()
+    from photon_ml_tpu.utils import telemetry
+
+    span_h = telemetry.span_handoff()
 
     def _run():
         if not fut.set_running_or_notify_cancel():
@@ -580,7 +584,9 @@ def begin_pack_async(csr, n_samples: int) -> None:
                 if submit_registry is not None
                 else contextlib.nullcontext()
             )
-            with scope:
+            with scope, telemetry.adopt_span(span_h), telemetry.span(
+                "background_pack"
+            ):
                 faults.fault_point("pack")
                 rows, cols, vals, dim = csr.to_coo()
                 fut.set_result(

@@ -50,11 +50,17 @@ faults). Each degradation increments the per-batcher `degraded_batches`
 metric and the process-wide COUNTERS["serving_degraded_batches"], zero on
 clean runs by construction.
 
-Observability: per-request wall latency is recorded at completion;
-`metrics()` reports p50/p95/p99, qps, shed/deadline-miss/fe-only counts,
-and the engine's counters (cold-start fraction, padding waste, recompiles,
-health + circuit state) in one snapshot — the serving counterpart of
-PR 1's fit_timing stage breakdown.
+Observability: per-request wall latency is recorded at completion into a
+BOUNDED tracker (utils/telemetry.LatencyStats — a mergeable fixed-bucket
+histogram plus a small reservoir for exact small-run percentiles; the
+former unbounded sample list grew without bound under sustained traffic,
+ISSUE 11 satellite). `metrics()` reports p50/p95/p99 (exact while the
+run fits the reservoir, within one log-bucket width beyond it), qps,
+shed/deadline-miss/fe-only counts, and the engine's counters in one
+snapshot — the serving counterpart of PR 1's fit_timing stage breakdown.
+Queue wait, batch size and latency also feed the process metrics
+registry, and each dispatched batch opens a `serving_batch` trace span
+carrying queue-wait and deadline-budget attribution.
 
 The flush thread is named `photon-serving-flush` and MUST be joined via
 `close()` (or the engine's close, or context-manager exit) — the test
@@ -70,8 +76,6 @@ import time
 from concurrent.futures import Future
 from typing import Deque, Dict, Iterable, List, Optional, Tuple
 
-import numpy as np
-
 from photon_ml_tpu.serving.bundle import ScoreRequest
 from photon_ml_tpu.serving.engine import ScoreResult, ServingEngine
 from photon_ml_tpu.serving.lifecycle import (
@@ -79,7 +83,7 @@ from photon_ml_tpu.serving.lifecycle import (
     DeadlineExceeded,
     Overloaded,
 )
-from photon_ml_tpu.utils import faults
+from photon_ml_tpu.utils import faults, telemetry
 
 logger = logging.getLogger(__name__)
 
@@ -104,7 +108,7 @@ class MicroBatcher:
         max_wait_ms: float = 2.0,
         max_pending: Optional[int] = None,
         default_deadline_ms: Optional[float] = None,
-        latency_window: int = 1 << 20,
+        latency_reservoir: int = 4096,
     ):
         self.engine = engine
         self.max_batch = int(
@@ -135,7 +139,12 @@ class MicroBatcher:
         self._cv = threading.Condition()
         self._stop = False
         self._unhealthy: Optional[BaseException] = None
-        self._latencies_ms: Deque[float] = collections.deque(maxlen=latency_window)
+        # Bounded latency accounting (ISSUE 11 satellite): the mergeable
+        # fixed-bucket histogram + a `latency_reservoir`-sample reservoir
+        # replace the unbounded per-request list — memory stays O(1) in
+        # request count under sustained traffic, percentiles stay exact
+        # for small runs and within one bucket width beyond.
+        self._latency = telemetry.LatencyStats(reservoir=latency_reservoir)
         self._completed = 0
         self._failed = 0
         self._shed = 0
@@ -312,6 +321,9 @@ class MicroBatcher:
                         continue
                     if item[1].set_running_or_notify_cancel():
                         batch.append(item)
+                telemetry.METRICS.set_gauge(
+                    "serving_pending_depth", len(self._pending)
+                )
                 if expired:
                     self._deadline_missed += len(expired)
                     self._failed += len(expired)
@@ -371,6 +383,29 @@ class MicroBatcher:
             self._service_tail_s = max(wall_s, 0.9 * self._service_tail_s)
 
     def _dispatch(self, batch: List[_Pending]) -> None:
+        # Request-path telemetry (ISSUE 11): queue wait per claimed
+        # request, batch size, and one `serving_batch` span carrying the
+        # queue-wait and remaining-deadline-budget attribution — the
+        # engine's serve_pack/serve_lookup/serve_score stage spans nest
+        # under it, so a traced replay shows queue-wait -> assembly ->
+        # device dispatch -> harvest per batch.
+        now = time.monotonic()
+        waits_ms = [(now - t0) * 1e3 for _, _, t0, _ in batch]
+        for w in waits_ms:
+            telemetry.METRICS.observe("serving_queue_wait_ms", w)
+        telemetry.METRICS.observe("serving_batch_size", len(batch))
+        budgets = [(e - now) * 1e3 for _, _, _, e in batch if e is not None]
+        with telemetry.span(
+            "serving_batch",
+            size=len(batch),
+            queue_wait_ms_max=round(max(waits_ms), 3),
+            deadline_budget_ms_min=(
+                round(min(budgets), 3) if budgets else None
+            ),
+        ):
+            self._dispatch_batch(batch)
+
+    def _dispatch_batch(self, batch: List[_Pending]) -> None:
         requests = [r for r, _, _, _ in batch]
         t_d = time.monotonic()
         breaker = self.engine.breaker
@@ -487,8 +522,9 @@ class MicroBatcher:
             self._complete(fut, res, now - t0)
 
     def _complete(self, fut: Future, res: ScoreResult, wall_s: float) -> None:
+        self._latency.record(wall_s * 1e3)
+        telemetry.METRICS.observe("serving_latency_ms", wall_s * 1e3)
         with self._cv:
-            self._latencies_ms.append(wall_s * 1e3)
             self._completed += 1
             self._t_last_done = time.monotonic()
         fut.set_result(res)
@@ -500,7 +536,6 @@ class MicroBatcher:
         deadline/circuit accounting + the engine's counters. Keys are the
         serving_online bench contract."""
         with self._cv:
-            lat = np.asarray(self._latencies_ms, np.float64)
             completed = self._completed
             failed = self._failed
             degraded = self._degraded
@@ -519,12 +554,13 @@ class MicroBatcher:
             "max_pending": self.max_pending,
             "unhealthy": None if unhealthy is None else repr(unhealthy),
         }
-        if lat.size:
-            p50, p95, p99 = np.percentile(lat, [50.0, 95.0, 99.0])
+        if self._latency.count:
+            # Exact while the run still fits the reservoir; histogram
+            # quantile (one log-bucket accuracy) under sustained traffic.
             out.update(
-                p50_ms=round(float(p50), 4),
-                p95_ms=round(float(p95), 4),
-                p99_ms=round(float(p99), 4),
+                p50_ms=round(float(self._latency.percentile(50.0)), 4),
+                p95_ms=round(float(self._latency.percentile(95.0)), 4),
+                p99_ms=round(float(self._latency.percentile(99.0)), 4),
             )
         else:
             out.update(p50_ms=None, p95_ms=None, p99_ms=None)

@@ -43,7 +43,7 @@ import numpy as np
 logger = logging.getLogger(__name__)
 
 from photon_ml_tpu.data.index_map import IndexMap, feature_key
-from photon_ml_tpu.utils import faults
+from photon_ml_tpu.utils import faults, telemetry
 from photon_ml_tpu.utils.knobs import get_knob
 from photon_ml_tpu.game.model import FixedEffectModel, GameModel, RandomEffectModel
 from photon_ml_tpu.io.model_store import GameModelArtifact
@@ -281,6 +281,9 @@ class TwoTierEntityStore:
 
     def _maybe_start_worker_locked(self) -> None:
         if self._worker is None or not self._worker.is_alive():
+            # Parent the promotion worker's spans under the lookup that
+            # queued the promotions (the stage-registry handoff pattern).
+            self._span_h = telemetry.span_handoff()
             self._worker = threading.Thread(
                 target=self._promote_pending,
                 name="photon-serving-promote",
@@ -289,6 +292,10 @@ class TwoTierEntityStore:
             self._worker.start()
 
     def _promote_pending(self) -> None:
+        with telemetry.adopt_span(getattr(self, "_span_h", None)):
+            self._promote_pending_inner()
+
+    def _promote_pending_inner(self) -> None:
         while True:
             with self._lock:
                 if self._closed or not self._pending:
@@ -317,9 +324,10 @@ class TwoTierEntityStore:
                     # already handed out keep their own immutable matrix.
                     try:
                         faults.fault_point("promote")
-                        self._hot = self._hot.at[
-                            jnp.asarray(idx, jnp.int32)
-                        ].set(jnp.asarray(self._cold[srcs]))
+                        with telemetry.span("promote_rows", rows=len(idx)):
+                            self._hot = self._hot.at[
+                                jnp.asarray(idx, jnp.int32)
+                            ].set(jnp.asarray(self._cold[srcs]))
                     except BaseException as exc:  # noqa: BLE001 - see below
                         # Roll the index back — lookups must keep resolving
                         # these rows through the cold tier, never to a hot

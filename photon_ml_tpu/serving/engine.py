@@ -71,7 +71,7 @@ from photon_ml_tpu.serving.lifecycle import (
 )
 from photon_ml_tpu.transformers.game_transformer import dense_margins
 from photon_ml_tpu.types import TaskType
-from photon_ml_tpu.utils import faults
+from photon_ml_tpu.utils import faults, telemetry
 from photon_ml_tpu.utils.observability import TimingRegistry, stage_scope, stage_timer
 from photon_ml_tpu.utils.watchdog import Watchdog, watchdog_ms
 
@@ -323,6 +323,7 @@ class ServingEngine:
         engine stays up, health reports DEGRADED with the shard named."""
         rng = self._state.bundle.mark_shard_lost(cid, shard_index)
         self.health.add_degraded(f"shard_loss:{cid}/{shard_index}")
+        telemetry.emit_event("shard_loss", coordinate=cid, shard_index=shard_index)
         return rng
 
     def restage_shard(
@@ -334,6 +335,9 @@ class ServingEngine:
         lost — the engine keeps serving its entities FE-only."""
         nbytes = self._state.bundle.restage_shard(cid, shard_index, rows=rows)
         self.health.clear_degraded(f"shard_loss:{cid}/{shard_index}")
+        telemetry.emit_event(
+            "shard_restage", coordinate=cid, shard_index=shard_index, bytes=nbytes
+        )
         return nbytes
 
     def _on_batcher_unhealthy(self, exc: BaseException) -> None:

@@ -55,7 +55,7 @@ import threading
 import time
 from typing import Callable, Deque, Dict, List, Optional, Tuple
 
-from photon_ml_tpu.utils import faults
+from photon_ml_tpu.utils import faults, telemetry
 from photon_ml_tpu.utils.knobs import get_knob
 
 logger = logging.getLogger(__name__)
@@ -172,6 +172,14 @@ class HealthStateMachine:
         self._history.append((self._clock(), self._state.value, new.value))
         self._transitions_total += 1
         logger.info("serving state %s -> %s", self._state.value, new.value)
+        # Run journal (ISSUE 11): every health transition is a typed JSONL
+        # line in the ambient journal (free no-op without one installed).
+        telemetry.emit_event(
+            "health_transition",
+            from_state=self._state.value,
+            to_state=new.value,
+            reasons=list(self._reasons),
+        )
         self._state = new
 
     def mark_ready(self) -> None:
@@ -564,6 +572,11 @@ class BundleManager:
             except BaseException:
                 self._rollbacks += 1
                 faults.COUNTERS.increment("serving_swap_rollbacks")
+                telemetry.emit_event(
+                    "bundle_swap",
+                    version=old_state.version + 1,
+                    outcome="rolled_back",
+                )
                 logger.warning(
                     "bundle swap to version %d rolled back; version %d "
                     "keeps serving",
@@ -583,6 +596,12 @@ class BundleManager:
             engine._commit_state(new_state, baseline_bump=staging_compiles)
             self._swaps += 1
             faults.COUNTERS.increment("serving_swaps")
+            telemetry.emit_event(
+                "bundle_swap", version=new_state.version, outcome="committed"
+            )
+            telemetry.METRICS.set_gauge(
+                "serving_bundle_generation", new_state.version
+            )
             drained = engine._drain_state(old_state, timeout_s=drain_timeout_s)
             if not drained:
                 logger.warning(

@@ -166,6 +166,52 @@ CHAOS_MULTICHIP_SECTION_KEYS = (
     "restaged_bytes",
 )
 
+# ------------------------------------------------------------------ journal
+# The run journal (utils/telemetry.RunJournal, ISSUE 11): every JSONL
+# line carries the common envelope keys plus EXACTLY its event type's
+# schema fields — emit validates before writing, `validate_journal` and
+# `cli/obs journal --validate` re-validate after the fact, and
+# tests/test_telemetry.py round-trips every type. Append fields, don't
+# reorder; adding an event type means adding its schema here first.
+JOURNAL_LINE_KEYS = ("ts", "type")
+JOURNAL_EVENT_SCHEMAS = {
+    # -- training lifecycle (EventEmitter -> journal_listener) --
+    "setup": ("args",),
+    "fit_start": ("num_samples",),
+    "sweep_config": ("index", "total"),
+    "coordinate_update": ("iteration", "coordinate", "seconds", "accepted"),
+    "checkpoint": ("step", "coordinate"),
+    "fit_finish": ("num_configs", "best_metric"),
+    "failure": ("error",),
+    # -- infra sites (emitted through the ambient journal) --
+    "health_transition": ("from_state", "to_state", "reasons"),
+    "bundle_swap": ("version", "outcome"),
+    "fault_retry": ("label", "counter", "attempt", "error"),
+    "fault_injected": ("site", "invocation"),
+    "watchdog_trip": ("label",),
+    "shard_loss": ("coordinate", "shard_index"),
+    "shard_restage": ("coordinate", "shard_index", "bytes"),
+}
+
+# ------------------------------------------------------------------- profile
+# The persisted run profile (utils/telemetry.build_profile/read_profile):
+# the machine-readable artifact the adaptive-runtime planner consumes.
+# Every profile carries the common keys; fit and serve runs add their
+# kind's sections. read_profile enforces these loudly — bench.py writes
+# its e2e fit profile and re-reads it through the same contract.
+PROFILE_REQUIRED_KEYS = (
+    "kind",
+    "wall_s",
+    "stages",
+    "dispatch",
+    "bucket_shapes",
+    "device_topology",
+    "roofline",
+    "metrics",
+)
+PROFILE_FIT_KEYS = (*PROFILE_REQUIRED_KEYS, "fit_timing", "ingest")
+PROFILE_SERVE_KEYS = (*PROFILE_REQUIRED_KEYS, "serving")
+
 # Every schema this module exports, for the analyzer's drift check and
 # for tests that want to iterate all contracts.
 ALL_CONTRACTS = {
@@ -180,4 +226,8 @@ ALL_CONTRACTS = {
     "ROBUSTNESS_CLEAN_ZERO_KEYS": ROBUSTNESS_CLEAN_ZERO_KEYS,
     "SERVING_SUMMARY_KEYS": SERVING_SUMMARY_KEYS,
     "CHAOS_MULTICHIP_SECTION_KEYS": CHAOS_MULTICHIP_SECTION_KEYS,
+    "JOURNAL_LINE_KEYS": JOURNAL_LINE_KEYS,
+    "PROFILE_REQUIRED_KEYS": PROFILE_REQUIRED_KEYS,
+    "PROFILE_FIT_KEYS": PROFILE_FIT_KEYS,
+    "PROFILE_SERVE_KEYS": PROFILE_SERVE_KEYS,
 }

@@ -64,6 +64,7 @@ import zlib
 from contextlib import contextmanager
 from typing import Callable, Dict, FrozenSet, Mapping, Optional, Tuple
 
+from photon_ml_tpu.utils import telemetry
 from photon_ml_tpu.utils.knobs import get_knob
 
 logger = logging.getLogger(__name__)
@@ -219,6 +220,7 @@ class FaultInjector:
                 self.injected[site] = self.injected.get(site, 0) + 1
         if fail:
             COUNTERS.increment("injected_faults")
+            telemetry.emit_event("fault_injected", site=site, invocation=n)
             logger.warning("injected fault at site %r (invocation %d)", site, n)
             raise InjectedFault(f"injected fault at site {site!r} (invocation {n})")
 
@@ -285,27 +287,26 @@ def inject(spec: str, seed: int = 0):
 
 
 class _Counters:
-    """Process-wide robustness event counters (thread-safe)."""
-
-    def __init__(self) -> None:
-        self._counts: Dict[str, int] = {}
-        self._lock = threading.Lock()
+    """Process-wide robustness event counters — since ISSUE 11 a view
+    over the typed telemetry metrics registry (utils/telemetry.METRICS),
+    so every counter name is declared exactly once in
+    METRIC_DESCRIPTIONS (the analyzer's `metric-name-sync` check fails
+    the build on an undeclared increment) and robustness counters ride
+    the same snapshot/merge machinery as every other metric."""
 
     def increment(self, name: str, by: int = 1) -> None:
-        with self._lock:
-            self._counts[name] = self._counts.get(name, 0) + by
+        telemetry.METRICS.increment(name, by)
 
     def get(self, name: str) -> int:
-        with self._lock:
-            return self._counts.get(name, 0)
+        return telemetry.METRICS.get_counter(name)
 
     def snapshot(self) -> Dict[str, int]:
-        with self._lock:
-            return dict(self._counts)
+        return telemetry.METRICS.counters()
 
     def reset(self) -> None:
-        with self._lock:
-            self._counts.clear()
+        # Counters ONLY: bench resets fault counters at section
+        # boundaries and must not wipe unrelated histogram/gauge state.
+        telemetry.METRICS.reset_counters()
 
 
 COUNTERS = _Counters()
@@ -393,6 +394,13 @@ def retry(
                 raise
             delay = policy.delay(attempt)
             COUNTERS.increment(counter)
+            telemetry.emit_event(
+                "fault_retry",
+                label=label,
+                counter=counter,
+                attempt=attempt,
+                error=repr(exc),
+            )
             logger.warning(
                 "transient failure in %s (attempt %d/%d): %s — retrying in %.2fs",
                 label,

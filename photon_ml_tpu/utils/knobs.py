@@ -351,6 +351,16 @@ _register(
     "reported bytes_limit (or skip the check where unknown).",
 )
 
+# ------------------------------------------------------------- observability
+_register(
+    "PHOTON_TRACE",
+    bool,
+    False,
+    "Span tracing (utils/telemetry.py): 1 records spans across the "
+    "worker fleet and exports Chrome trace-event JSON (Perfetto-"
+    "loadable) from the CLI drivers; 0 (default) keeps span() a no-op.",
+)
+
 # ---------------------------------------------------------- multihost / test
 _register(
     "PHOTON_MH_DATA",

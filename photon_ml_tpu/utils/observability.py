@@ -27,6 +27,7 @@ from typing import Callable, Dict, List, Optional, Type
 import numpy as np
 
 from photon_ml_tpu.optimize.common import ConvergenceReason, OptResult
+from photon_ml_tpu.utils import telemetry
 
 logger = logging.getLogger("photon_ml_tpu")
 
@@ -97,9 +98,15 @@ class Timed(ContextDecorator):
 
     def __enter__(self) -> "Timed":
         self._t0 = time.perf_counter()
+        # Timed sections double as trace spans (utils/telemetry.py): the
+        # driver's section structure shows up as named tracks in Perfetto
+        # for free. span() is the shared no-op when tracing is off.
+        self._span = telemetry.span(self.message)
+        self._span.__enter__()
         return self
 
     def __exit__(self, exc_type, exc, tb) -> bool:
+        self._span.__exit__(exc_type, exc, tb)
         self.elapsed = time.perf_counter() - self._t0
         status = "" if exc_type is None else f" (FAILED: {exc_type.__name__})"
         self.log.log(self.level, "%s: %.3fs%s", self.message, self.elapsed, status)
@@ -170,12 +177,16 @@ def set_stage_note(name: str, value: str) -> None:
 @contextmanager
 def stage_timer(name: str):
     """`with stage_timer("upload"):` — record the block's wall clock into
-    the ambient stage scope."""
+    the ambient stage scope. Also opens a trace span of the same name
+    (utils/telemetry.py): the data-plane stages become Perfetto tracks
+    without a second instrumentation pass. Span + stage record are both
+    free no-ops when their ambient sinks are absent."""
     t0 = time.perf_counter()
-    try:
-        yield
-    finally:
-        record_stage(name, time.perf_counter() - t0)
+    with telemetry.span(name):
+        try:
+            yield
+        finally:
+            record_stage(name, time.perf_counter() - t0)
 
 
 # -------------------------------------------------------------- PhotonLogger
@@ -268,6 +279,34 @@ class PhotonFailureEvent(Event):
     error: str = ""
 
 
+@dataclasses.dataclass(frozen=True)
+class SweepConfigEvent(Event):
+    """One optimization configuration of the reg-weight sweep starting
+    (GameEstimator.fit's outer loop)."""
+
+    index: int = 0
+    total: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class CoordinateUpdateEvent(Event):
+    """One coordinate-descent update finished (accepted or rejected by
+    the divergence guard)."""
+
+    iteration: int = 0
+    coordinate: str = ""
+    seconds: float = 0.0
+    accepted: bool = True
+
+
+@dataclasses.dataclass(frozen=True)
+class CheckpointEvent(Event):
+    """One durable checkpoint step committed (state.json + model npz)."""
+
+    step: int = 0
+    coordinate: str = ""
+
+
 class EventEmitter:
     """Synchronous listener bus (EventEmitter.scala:24-58). Listeners
     register per event type (or Event for all); send() dispatches in
@@ -292,6 +331,43 @@ class EventEmitter:
 
     def clear(self) -> None:
         self._listeners.clear()
+
+
+def journal_listener(journal) -> Callable[[Event], None]:
+    """An EventEmitter listener writing lifecycle events into a
+    `telemetry.RunJournal` — the JSONL sink behind the event bus
+    (ISSUE 11). Each Event class maps to one typed journal schema
+    (contracts.JOURNAL_EVENT_SCHEMAS); an Event type without a mapping
+    is skipped, never an error (the bus is open for callers' own
+    types)."""
+
+    def _listen(event: Event) -> None:
+        if isinstance(event, PhotonSetupEvent):
+            journal.emit("setup", args=event.args)
+        elif isinstance(event, TrainingStartEvent):
+            journal.emit("fit_start", num_samples=event.num_samples)
+        elif isinstance(event, SweepConfigEvent):
+            journal.emit("sweep_config", index=event.index, total=event.total)
+        elif isinstance(event, CoordinateUpdateEvent):
+            journal.emit(
+                "coordinate_update",
+                iteration=event.iteration,
+                coordinate=event.coordinate,
+                seconds=round(event.seconds, 6),
+                accepted=event.accepted,
+            )
+        elif isinstance(event, CheckpointEvent):
+            journal.emit("checkpoint", step=event.step, coordinate=event.coordinate)
+        elif isinstance(event, TrainingFinishEvent):
+            journal.emit(
+                "fit_finish",
+                num_configs=event.num_configs,
+                best_metric=event.best_metric,
+            )
+        elif isinstance(event, PhotonFailureEvent):
+            journal.emit("failure", error=event.error)
+
+    return _listen
 
 
 # ------------------------------------------------- optimization summaries

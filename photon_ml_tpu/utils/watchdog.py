@@ -44,7 +44,7 @@ import time
 from contextlib import contextmanager
 from typing import Callable, Dict, Optional, Tuple
 
-from photon_ml_tpu.utils import faults
+from photon_ml_tpu.utils import faults, telemetry
 from photon_ml_tpu.utils.knobs import get_knob
 
 logger = logging.getLogger(__name__)
@@ -114,6 +114,7 @@ class Watchdog:
                     flag[0] = True
                     self.trips += 1
                     faults.COUNTERS.increment("watchdog_trips")
+                    telemetry.emit_event("watchdog_trip", label=label)
                     logger.warning(
                         "watchdog tripped: %s exceeded its deadline "
                         "(dispatch still in flight)",

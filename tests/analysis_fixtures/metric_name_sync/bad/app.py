@@ -1,0 +1,22 @@
+"""Known-bad: an undeclared name, a computed name, an unresolvable
+counter= keyword, and (via the sibling telemetry.py) a declared-but-
+never-incremented metric."""
+
+
+class _Counters:
+    def increment(self, name, by=1):
+        pass
+
+
+COUNTERS = _Counters()
+
+
+def retry(fn, counter="fixture_hits"):
+    return fn
+
+
+def run(name_var, chosen):
+    COUNTERS.increment("fixture_hits")  # fine: declared literal
+    COUNTERS.increment("fixture_mystery")  # undeclared name
+    COUNTERS.increment(name_var)  # computed: statically unresolvable
+    retry(run, counter=chosen)  # counter= with no literal

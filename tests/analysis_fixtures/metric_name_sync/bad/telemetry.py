@@ -1,0 +1,6 @@
+"""Miniature metric registry: two declared names, one never incremented."""
+
+METRIC_DESCRIPTIONS = {
+    "fixture_hits": "incremented by app.py",
+    "fixture_ghost": "declared but never incremented (a finding)",
+}

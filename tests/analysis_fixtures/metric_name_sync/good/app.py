@@ -1,0 +1,38 @@
+"""Known-good: every incremented name is declared (including the
+conditional counter= expression and the parameter default), and every
+declared name is incremented. The instance-level recorder call with a
+numeric first argument is not a registry call and must be skipped."""
+
+
+class _Registry:
+    def increment(self, name, by=1):
+        pass
+
+    def observe(self, name, value):
+        pass
+
+    def set_gauge(self, name, value):
+        pass
+
+
+class _Hist:
+    def observe(self, value):
+        pass
+
+
+METRICS = _Registry()
+
+
+def retry(fn, counter="fixture_retries"):
+    return fn
+
+
+def run(mesh, hist: _Hist):
+    METRICS.increment("fixture_hits")
+    METRICS.observe("fixture_latency_ms", 1.5)
+    METRICS.set_gauge("fixture_depth", 3)
+    retry(
+        run,
+        counter="fixture_alt_retries" if mesh is not None else "fixture_retries",
+    )
+    hist.observe(0.25)  # instance recorder: a value, not a metric name

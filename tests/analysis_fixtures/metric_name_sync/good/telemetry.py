@@ -1,0 +1,9 @@
+"""Miniature metric registry: every declared name is incremented."""
+
+METRIC_DESCRIPTIONS = {
+    "fixture_hits": "incremented by app.py",
+    "fixture_latency_ms": "observed by app.py",
+    "fixture_retries": "planted via a counter= default and keyword",
+    "fixture_alt_retries": "planted via the conditional counter= branch",
+    "fixture_depth": "gauged by app.py",
+}

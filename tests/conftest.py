@@ -128,7 +128,7 @@ def _failure_domain_hygiene(monkeypatch):
       per-train instance); a survivor means deadlines kept arming against
       a torn-down dispatcher.
     """
-    from photon_ml_tpu.utils import faults
+    from photon_ml_tpu.utils import faults, telemetry
 
     for var in (
         "PHOTON_FAULTS",
@@ -143,10 +143,10 @@ def _failure_domain_hygiene(monkeypatch):
     ):
         monkeypatch.delenv(var, raising=False)
     faults.clear()
-    faults.reset_counters()
+    telemetry.METRICS.reset()  # counters AND histograms/gauges start clean
     yield
     faults.clear()
-    faults.reset_counters()
+    telemetry.METRICS.reset()
     deadline = time.monotonic() + 10.0
     while time.monotonic() < deadline:
         leaked = [

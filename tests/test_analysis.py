@@ -33,6 +33,7 @@ CHECK_DIRS = {
     "thread-lifecycle": "thread_lifecycle",
     "donation-aliasing": "donation_aliasing",
     "contract-key-drift": "contract_key_drift",
+    "metric-name-sync": "metric_name_sync",
 }
 
 
@@ -115,6 +116,16 @@ def test_bad_fixtures_cover_every_direction():
         checks=["donation-aliasing"],
     )
     assert len(ds) == 2  # named-callable AND immediately-invoked forms
+
+    ms = run_checks(
+        paths=[_fixture("metric-name-sync", "bad")],
+        checks=["metric-name-sync"],
+    )
+    msgs = "\n".join(f.message for f in ms)
+    assert "not declared" in msgs  # undeclared increment
+    assert "nothing increments it" in msgs  # declared-but-unincremented
+    assert "statically resolvable" in msgs  # computed name
+    assert "counter= argument" in msgs  # unresolvable retry counter
 
 
 # ------------------------------------------------------------------ pragmas
