@@ -1010,6 +1010,249 @@ def _child() -> None:
         **_bw_metrics(score_bytes, score_wall, platform),
     )
 
+    # ---- sweep: pod-parallel hyperparameter search (ISSUE 12) -------------
+    # A 16-trial Bayesian sweep through the batched trial executor
+    # (trial-stacked: each proposal round is ONE XLA dispatch) against the
+    # serial per-trial baseline — the GameTrainingDriver-inherited loop
+    # cli/train.py still runs for tuning: one full estimator.fit per
+    # observation. The shape is the dispatch-bound AutoML regime the
+    # executor targets (many small fits swept over configs); it is fixed,
+    # not BENCH_SCALE-scaled, because the measurement is overhead
+    # amortization, not throughput. Proposal (GP fit + qEI picks) is
+    # identical host work in both drivers and is reported separately;
+    # `speedup_vs_serial` compares TRIAL-EVALUATION walls on the same 16
+    # candidate points (speedup_basis names this). Same loud missing-key
+    # contract as every other section, plus the clean-run zero robustness
+    # counters.
+    try:
+        import dataclasses as _dc
+
+        from photon_ml_tpu.data.game_dataset import FixedEffectDataConfig
+        from photon_ml_tpu.estimators.game_estimator import GameEstimator
+        from photon_ml_tpu.hyperparameter import (
+            HyperparameterConfig,
+            HyperparameterTuningMode,
+            get_tuner,
+        )
+        from photon_ml_tpu.utils import faults as _faults_sw
+        from photon_ml_tpu.utils.contracts import (
+            ROBUSTNESS_CLEAN_ZERO_KEYS,
+            SWEEP_SECTION_KEYS,
+            SWEEP_TRIAL_KEYS,
+        )
+
+        n_sw, e_sw, nval_sw = 768, 64, 256
+        d_fsw, d_resw = 12, 4
+
+        def _sweep_data(n_rows, seed):
+            r = np.random.default_rng(seed)
+            ent = r.integers(0, e_sw, size=n_rows)
+            Xfs = r.normal(size=(n_rows, d_fsw)).astype(np.float32)
+            Xes = r.normal(size=(n_rows, d_resw)).astype(np.float32)
+            wt = r.normal(size=d_fsw).astype(np.float32)
+            ut = r.normal(size=(e_sw, d_resw)).astype(np.float32)
+            mg = Xfs @ wt + np.einsum("nd,nd->n", Xes, ut[ent])
+            ys = (r.uniform(size=n_rows) < 1 / (1 + np.exp(-mg))).astype(
+                np.float32
+            )
+            return GameDataset.build(
+                {"g": jnp.asarray(Xfs), "e": jnp.asarray(Xes)},
+                ys,
+                id_tags={"entityId": ent},
+            )
+
+        ds_sw = _sweep_data(n_sw, 31)
+        val_sw = _sweep_data(nval_sw, 37)
+        base_sw = {
+            "fixed": CoordinateOptimizationConfig(
+                optimizer=OptimizerConfig(max_iterations=12, tolerance=1e-7),
+                regularization=L2,
+                reg_weight=1.0,
+            ),
+            "per-entity": CoordinateOptimizationConfig(
+                optimizer=OptimizerConfig(max_iterations=8, tolerance=1e-7),
+                regularization=L2,
+                reg_weight=1.0,
+            ),
+        }
+        est_sw = GameEstimator(
+            TaskType.LOGISTIC_REGRESSION,
+            {
+                "fixed": FixedEffectDataConfig("g"),
+                "per-entity": RandomEffectDataConfig(
+                    "entityId", "e", min_bucket=16
+                ),
+            },
+            seed=7,
+        )
+        executor = est_sw.sweep_executor(
+            ds_sw, val_sw, base_sw, mode="stacked", max_stack=8
+        )
+        dims_sw = [
+            HyperparameterConfig("fixed", 1e-3, 1e3, transform="LOG"),
+            HyperparameterConfig("per-entity", 1e-3, 1e3, transform="LOG"),
+        ]
+        # Warm-up: compile the cold + warm-started round programs on
+        # throwaway candidates, then reset trial state (programs survive).
+        rng_sw = np.random.default_rng(41)
+        warm_sw = 10 ** rng_sw.uniform(-3, 3, size=(8, 2))
+        executor.evaluate_batch(warm_sw)
+        executor.evaluate_batch(warm_sw)
+        executor.reset()
+        _mark("sweep executor warm (round programs compiled)")
+
+        rob_base_sw = {
+            k: _faults_sw.COUNTERS.get(k) for k in ROBUSTNESS_CLEAN_ZERO_KEYS
+        }
+        tuner_sw = get_tuner(HyperparameterTuningMode.BAYESIAN)
+        t_sw = time.perf_counter()
+        _search_sw, sweep_res = tuner_sw.sweep(
+            16,
+            dims_sw,
+            HyperparameterTuningMode.BAYESIAN,
+            executor,
+            seed=11,
+            batch_size=8,
+        )
+        sweep_wall = time.perf_counter() - t_sw
+        eval_wall = sum(t.seconds for t in sweep_res.trials)
+        _mark(
+            f"sweep: 16 trials in {sweep_wall:.2f}s "
+            f"(trial-eval {eval_wall:.3f}s)"
+        )
+
+        # Serial baseline: the same 16 candidate points, each one full
+        # estimator.fit (coordinate descent + validation evaluation) — the
+        # pre-ISSUE-12 tuning path. Warmed by the executor's serial-shaped
+        # programs above; first fit additionally warms the transformer
+        # evaluation path before timing.
+        def _fit_trial(point):
+            cfgs_t = {
+                "fixed": _dc.replace(
+                    base_sw["fixed"], reg_weight=float(point[0])
+                ),
+                "per-entity": _dc.replace(
+                    base_sw["per-entity"], reg_weight=float(point[1])
+                ),
+            }
+            return est_sw.fit(ds_sw, val_sw, [cfgs_t])[0]
+
+        _fit_trial(warm_sw[0])
+        t_serial = time.perf_counter()
+        for rec in sweep_res.trials:
+            _fit_trial(rec.point)
+        serial_wall = time.perf_counter() - t_serial
+
+        # Winner parity: the sweep's cold-refit winner model must be
+        # bitwise-equal to a standalone fit of the winning configuration.
+        winner_cfg = {
+            "fixed": _dc.replace(
+                base_sw["fixed"], reg_weight=float(sweep_res.best_point[0])
+            ),
+            "per-entity": _dc.replace(
+                base_sw["per-entity"],
+                reg_weight=float(sweep_res.best_point[1]),
+            ),
+        }
+        standalone = est_sw.fit(ds_sw, val_sw, [winner_cfg])[0]
+        winner_bitwise = bool(
+            np.array_equal(
+                np.asarray(
+                    sweep_res.winner_model["fixed"].coefficients.means
+                ),
+                np.asarray(standalone.model["fixed"].coefficients.means),
+            )
+            and np.array_equal(
+                np.asarray(
+                    sweep_res.winner_model["per-entity"].coefficients_matrix
+                ),
+                np.asarray(
+                    standalone.model["per-entity"].coefficients_matrix
+                ),
+            )
+        )
+        rob_sw = {
+            k: _faults_sw.COUNTERS.get(k) - rob_base_sw[k]
+            for k in ROBUSTNESS_CLEAN_ZERO_KEYS
+        }
+        rob_sw["diverged_steps"] = sum(
+            t.diverged_steps for t in sweep_res.trials
+        )
+        sweep_section = dict(
+            shape=dict(
+                n_samples=n_sw,
+                n_validation=nval_sw,
+                n_entities=e_sw,
+                d_fixed=d_fsw,
+                d_re=d_resw,
+            ),
+            trials=len(sweep_res.trials),
+            rounds=executor.rounds,
+            batch_size=8,
+            modes=sorted({t.mode for t in sweep_res.trials}),
+            stack_decisions=sweep_res.stack_decisions,
+            trial_timings=[t.timing_entry() for t in sweep_res.trials],
+            sweep_wall_s=round(sweep_wall, 3),
+            trial_eval_wall_s=round(eval_wall, 4),
+            proposal_wall_s=round(
+                max(0.0, sweep_wall - eval_wall - sweep_res.winner_refit_s),
+                3,
+            ),
+            winner_refit_s=round(sweep_res.winner_refit_s, 3),
+            serial_baseline_wall_s=round(serial_wall, 3),
+            speedup_vs_serial=round(serial_wall / max(eval_wall, 1e-9), 1),
+            speedup_basis=(
+                "trial-evaluation walls on the SAME 16 candidate points: "
+                "stacked executor rounds vs one full estimator.fit per "
+                "point (the GameTrainingDriver-inherited serial loop); "
+                "proposal (GP fit + qEI picks) is identical host work in "
+                "both drivers and reported as proposal_wall_s"
+            ),
+            best_point=[float(v) for v in sweep_res.best_point],
+            winner_value=float(sweep_res.winner_value),
+            winner_bitwise_vs_standalone=winner_bitwise,
+            robustness=rob_sw,
+        )
+        missing_sw = [
+            k for k in SWEEP_SECTION_KEYS if sweep_section.get(k) is None
+        ]
+        missing_sw += [
+            f"trial:{k}"
+            for k in SWEEP_TRIAL_KEYS
+            for t in sweep_section["trial_timings"]
+            if k not in t
+        ]
+        if missing_sw:
+            raise RuntimeError(
+                f"sweep section is missing keys {missing_sw} — the "
+                "pod-parallel sweep contract regressed"
+            )
+        if not winner_bitwise:
+            raise RuntimeError(
+                "sweep winner refit is not bitwise-equal to the standalone "
+                "fit of the winning config — parity regression"
+            )
+        if any(v != 0 for v in rob_sw.values()):
+            raise RuntimeError(
+                f"clean sweep run reported nonzero robustness events "
+                f"{rob_sw} — robustness regression"
+            )
+        variants["sweep"] = sweep_section
+        if sweep_section["speedup_vs_serial"] < 10.0:
+            _mark(
+                "sweep WARNING: trial-stacked speedup "
+                f"{sweep_section['speedup_vs_serial']}x is below the 10x "
+                "target (dispatch-bound backends amortize far more; on a "
+                "contended CPU host this is a measurement-noise signal)"
+            )
+        _mark(
+            f"sweep measured ({sweep_section['speedup_vs_serial']}x vs "
+            f"serial trials, winner bitwise={winner_bitwise})"
+        )
+    except Exception as e:  # noqa: BLE001 - the artifact reports the failure
+        variants["sweep"] = dict(error=repr(e))
+        _mark(f"sweep section FAILED: {e!r}")
+
     # ---- multichip: entity-sharded pod-scale path -------------------------
     # Own subprocess on the 8-virtual-device CPU mesh (this child's backend
     # is already up, and the TPU path must not be disturbed): an RE matrix

@@ -1,0 +1,296 @@
+"""Hyperparameter sweep driver: the pod-parallel tuning CLI (ISSUE 12).
+
+Where `cli/train.py` reproduces GameTrainingDriver's tuning loop — one full
+`estimator.fit` per observation, the reference's inherently serial search
+(GameTrainingDriver.scala:643-680) — this driver runs the sweep through the
+batched trial executor (`hyperparameter/sweep.py`): the GP/Sobol searcher
+proposes k-candidate rounds and each round evaluates as ONE stacked XLA
+dispatch (or one trial per device shard group), with per-trial
+`trial_start`/`trial_finish` journal events and warm-started rounds. The
+winner is cold-refit and saved, bitwise-equal to a standalone fit of the
+winning configuration.
+
+Pipeline:
+
+    parse args -> read training/validation Avro data
+    -> GameEstimator.sweep_executor (stacked | shard_group | serial | auto)
+    -> HyperparameterTuner.sweep (RANDOM | BAYESIAN, batched rounds)
+    -> save winner model + tuning-summary.json (+ journal.jsonl, trace)
+
+Usage: python -m photon_ml_tpu.cli.tune --help
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+from typing import Dict, List, Optional
+
+from photon_ml_tpu.cli.config import parse_coordinate_config
+from photon_ml_tpu.cli.train import (
+    TUNING_REG_WEIGHT_RANGE,
+    _read_data,
+    _tuning_dimensions,
+    _validate_rows,
+)
+from photon_ml_tpu.estimators.game_estimator import GameEstimator
+from photon_ml_tpu.evaluation.suite import EvaluatorType
+from photon_ml_tpu.hyperparameter.tuner import (
+    HyperparameterTuningMode,
+    get_tuner,
+)
+from photon_ml_tpu.io import model_bridge, model_store
+from photon_ml_tpu.types import (
+    DataValidationType,
+    NormalizationType,
+    TaskType,
+)
+
+logger = logging.getLogger("photon_ml_tpu.cli.tune")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="photon_ml_tpu.cli.tune",
+        description="Pod-parallel hyperparameter sweeps over GAME/GLMix "
+        "regularization weights (batched trial executor)",
+    )
+    p.add_argument("--training-task", required=True, type=TaskType.parse)
+    p.add_argument("--input-data-directories", required=True, nargs="+")
+    p.add_argument("--validation-data-directories", required=True, nargs="+",
+                   help="validation data (the trial metric) — a sweep "
+                        "without validation has no objective")
+    p.add_argument("--input-column-names", default=None)
+    p.add_argument("--root-output-directory", required=True)
+    p.add_argument("--override-output-directory", action="store_true")
+    p.add_argument("--feature-shard-configurations", required=True, nargs="+",
+                   metavar="DSL")
+    p.add_argument("--coordinate-configurations", required=True, nargs="+",
+                   metavar="DSL",
+                   help="same mini-DSL as cli/train; each coordinate's "
+                        "reg weight is the BASE the sweep tunes around")
+    p.add_argument("--coordinate-update-sequence", default=None)
+    p.add_argument("--coordinate-descent-iterations", type=int, default=1)
+    p.add_argument("--normalization", type=NormalizationType.parse,
+                   default=NormalizationType.NONE)
+    p.add_argument("--validation-evaluators", nargs="*", default=[])
+    p.add_argument("--offheap-indexmap-dir", default=None)
+    p.add_argument("--data-validation",
+                   type=lambda s: DataValidationType[s.strip().upper()],
+                   default=DataValidationType.VALIDATE_FULL)
+    p.add_argument("--tuning-mode", type=HyperparameterTuningMode.parse,
+                   default=HyperparameterTuningMode.BAYESIAN,
+                   help="RANDOM | BAYESIAN (constant-liar qEI rounds)")
+    p.add_argument("--tuning-iter", type=int, default=16,
+                   help="total trials across all rounds")
+    p.add_argument("--tuning-batch-size", type=int, default=4,
+                   help="candidates proposed AND evaluated per round (one "
+                        "stacked dispatch / one pass over the shard groups)")
+    p.add_argument("--sweep-mode", default=None,
+                   choices=["stacked", "shard_group", "serial"],
+                   help="trial evaluation mode (default: auto — stacked "
+                        "when every coordinate store is replicated, else "
+                        "shard groups on a multi-device fleet)")
+    p.add_argument("--no-warm-start", action="store_true",
+                   help="disable warm-starting rounds from the incumbent "
+                        "(the bitwise-parity comparison mode)")
+    p.add_argument("--max-stack", type=int, default=None,
+                   help="override PHOTON_SWEEP_MAX_STACK for this run")
+    p.add_argument("--shard-groups", type=int, default=None,
+                   help="override PHOTON_SWEEP_SHARD_GROUPS for this run")
+    p.add_argument("--random-seed", type=int, default=0)
+    p.add_argument("--logging-level", default="INFO")
+    return p
+
+
+def run(args) -> Dict[str, object]:
+    logging.basicConfig(
+        level=getattr(logging, args.logging_level.upper(), logging.INFO),
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    import time
+
+    out_root = args.root_output_directory
+    models_root = os.path.join(out_root, "models")
+    if os.path.exists(models_root):
+        if not args.override_output_directory:
+            raise FileExistsError(
+                f"{models_root} exists; pass --override-output-directory"
+            )
+        import shutil
+
+        shutil.rmtree(models_root)
+    os.makedirs(out_root, exist_ok=True)
+
+    # Same job-scoped observability surface as cli/train: run journal
+    # (trial_start/trial_finish land here), optional span tracing.
+    from photon_ml_tpu.utils import telemetry
+
+    journal = telemetry.RunJournal(os.path.join(out_root, "journal.jsonl"))
+    journal_owned = telemetry.current_journal() is None
+    if journal_owned:
+        telemetry.install_journal(journal)
+    tracer_owned = telemetry.current_tracer() is None
+    tracer = telemetry.start_tracing_if_enabled()
+    try:
+        return _run_job(args, out_root, models_root, time)
+    finally:
+        if tracer is not None and tracer_owned:
+            tracer.export(os.path.join(out_root, "trace.json"))
+            telemetry.uninstall_tracer()
+        if journal_owned:
+            telemetry.uninstall_journal()
+        journal.close()
+
+
+def _run_job(args, out_root, models_root, time) -> Dict[str, object]:
+    coordinate_configs = {}
+    for s in args.coordinate_configurations:
+        cfg = parse_coordinate_config(s)
+        coordinate_configs[cfg.name] = cfg
+    update_sequence = (
+        [c.strip() for c in args.coordinate_update_sequence.split(",")]
+        if args.coordinate_update_sequence
+        else list(coordinate_configs.keys())
+    )
+
+    train, validation, index_maps, _shard_configs = _read_data(
+        args, coordinate_configs
+    )
+    if validation is None:
+        raise ValueError("--validation-data-directories produced no data")
+    _validate_rows(train, args.training_task, args.data_validation)
+    _validate_rows(validation, args.training_task, args.data_validation)
+    logger.info(
+        "sweep data: %d training / %d validation samples",
+        train.num_samples,
+        validation.num_samples,
+    )
+
+    dims = _tuning_dimensions(coordinate_configs, set(update_sequence))
+    if not dims:
+        raise ValueError(
+            "no tunable coordinates: every coordinate's regularization is "
+            "NONE (the sweep tunes reg weights)"
+        )
+
+    estimator = GameEstimator(
+        args.training_task,
+        {cid: c.data_config for cid, c in coordinate_configs.items()},
+        update_sequence=update_sequence,
+        coordinate_descent_iterations=args.coordinate_descent_iterations,
+        normalization=args.normalization,
+        validation_evaluators=[
+            EvaluatorType.parse(e) for e in args.validation_evaluators
+        ],
+        intercept_indices={
+            shard: index_maps[shard].intercept_index
+            for shard in index_maps
+            if index_maps[shard].intercept_index is not None
+        },
+        seed=args.random_seed,
+    )
+    base_config = {
+        cid: coordinate_configs[cid].opt_config for cid in update_sequence
+    }
+    executor = estimator.sweep_executor(
+        train,
+        validation,
+        base_config,
+        tuned_ids=[d.name for d in dims],
+        mode=args.sweep_mode,
+        warm_start=not args.no_warm_start,
+        max_stack=args.max_stack,
+        shard_groups=args.shard_groups,
+    )
+
+    t0 = time.perf_counter()
+    tuner = get_tuner(args.tuning_mode)
+    out = tuner.sweep(
+        args.tuning_iter,
+        dims,
+        args.tuning_mode,
+        executor,
+        seed=args.random_seed + 1,
+        batch_size=args.tuning_batch_size,
+    )
+    if out is None:
+        raise ValueError("tuning mode NONE / zero iterations: nothing to do")
+    search_result, sweep_result = out
+    sweep_wall = time.perf_counter() - t0
+    logger.info(
+        "sweep: %d trials in %.1fs, best %s=%.6f at %s",
+        len(sweep_result.trials),
+        sweep_wall,
+        str(executor.validation_suite.primary),
+        sweep_result.best_value,
+        dict(zip([d.name for d in dims], sweep_result.best_point.tolist())),
+    )
+
+    # Save the winner (the COLD refit — bitwise-equal to a standalone fit
+    # of the winning config) in the same layout cli/train uses.
+    specs = estimator.scoring_specs()
+    artifact = model_bridge.artifact_from_game_model(
+        sweep_result.winner_model,
+        specs,
+        args.training_task,
+        opt_configs={
+            cid: {
+                "optimizer": c.optimizer.optimizer_type.value,
+                "max_iterations": c.optimizer.max_iterations,
+                "tolerance": c.optimizer.tolerance,
+                "regularization": c.regularization.reg_type.value,
+                "reg_weight": (
+                    float(
+                        sweep_result.best_point[
+                            [d.name for d in dims].index(cid)
+                        ]
+                    )
+                    if cid in [d.name for d in dims]
+                    else c.reg_weight
+                ),
+            }
+            for cid, c in base_config.items()
+        },
+    )
+    mdir = os.path.join(models_root, "tuned-best")
+    model_store.save_game_model(mdir, artifact, index_maps)
+    idx_dir = os.path.join(mdir, "feature-indexes")
+    os.makedirs(idx_dir, exist_ok=True)
+    for shard, imap in index_maps.items():
+        imap.save(os.path.join(idx_dir, f"{shard}.json"))
+
+    summary: Dict[str, object] = {
+        "num_training_samples": int(train.num_samples),
+        "num_validation_samples": int(validation.num_samples),
+        "tuning_mode": args.tuning_mode.value,
+        "trials": [t.timing_entry() for t in sweep_result.trials],
+        "rounds": executor.rounds,
+        "batch_size": int(args.tuning_batch_size),
+        "modes": sorted({t.mode for t in sweep_result.trials}),
+        "stack_decisions": sweep_result.stack_decisions,
+        "sweep_wall_s": round(sweep_wall, 3),
+        "winner_refit_s": round(sweep_result.winner_refit_s, 3),
+        "tuned_coordinates": [d.name for d in dims],
+        "tuning_range": list(TUNING_REG_WEIGHT_RANGE),
+        "best_trial": sweep_result.best_trial,
+        "best_point": sweep_result.best_point.tolist(),
+        "best_value": sweep_result.best_value,
+        "winner_value": sweep_result.winner_value,
+        "best_observation": float(search_result.best_value),
+    }
+    with open(os.path.join(out_root, "tuning-summary.json"), "w") as f:
+        json.dump(summary, f, indent=2, default=str)
+    logger.info("winner model saved to %s", mdir)
+    return summary
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    run(build_parser().parse_args(argv))
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -860,6 +860,229 @@ class GameEstimator:
         }
         return results
 
+    # -------------------------------------------------------------- sweeps
+
+    def sweep_executor(
+        self,
+        data: GameDataset,
+        validation_data: GameDataset,
+        base_config: GameOptimizationConfiguration,
+        tuned_ids: Optional[Sequence[str]] = None,
+        *,
+        mode: Optional[str] = None,
+        warm_start: bool = True,
+        max_stack: Optional[int] = None,
+        shard_groups: Optional[int] = None,
+        on_event=None,
+    ):
+        """The batched trial executor for hyperparameter sweeps (ISSUE 12):
+        wires this estimator's prepared coordinates, validation scorers and
+        shard-group builder into a `hyperparameter.sweep.SweepExecutor`.
+
+        `base_config` fixes every coordinate's optimizer statics (and the
+        reg weight of untuned coordinates); `tuned_ids` (default: every
+        coordinate) names the coordinates whose reg weight the candidate
+        columns drive, in column order. The executor's `evaluate_batch` is
+        the `BatchEvaluationFunction` the searchers' `find_batched` calls;
+        `finalize()` cold-refits the winner (bitwise-equal to a standalone
+        fit of the winning config). The trial VALUE is the validation
+        suite's primary metric of each trial's final model — the same
+        definition in every evaluation mode."""
+        from photon_ml_tpu.evaluation.suite import better_than
+        from photon_ml_tpu.hyperparameter.sweep import SweepExecutor
+        from photon_ml_tpu.transformers.game_transformer import (
+            _fe_margins,
+            _re_margins,
+        )
+
+        if validation_data is None:
+            raise ValueError(
+                "sweep_executor needs validation data — the trial value is "
+                "the validation suite's primary metric"
+            )
+        if self.locked:
+            raise ValueError(
+                "hyperparameter sweeps retrain every coordinate; locked "
+                "coordinates are not supported"
+            )
+        missing = [c for c in self.update_sequence if c not in base_config]
+        if missing:
+            raise ValueError(f"base configuration missing coordinates {missing}")
+        prepared = self.prepare(data)
+        coordinates = {
+            cid: self._coordinate_for(data, cid, prepared[cid], base_config[cid])
+            for cid in self.update_sequence
+        }
+        suite = self._validation_suite(validation_data)
+        specs = self.scoring_specs()
+        with stage_scope(self.timing_registry):
+            prefetch_fixed_effect_shards(
+                specs, self.update_sequence, validation_data, self.pipeline
+            )
+            with self._exclusive_stage("projector"):
+                val_prep = {
+                    cid: prepare_coordinate_data(specs[cid], validation_data)
+                    for cid in self.update_sequence
+                }
+        # Traceable per-coordinate validation scorers: model ARRAYS ->
+        # margins through the same jitted programs the serial validation
+        # path dispatches (`coordinate_margins`' replicated branches), so
+        # the stacked program can compute them in-trace.
+        trial_scorers = {}
+        for cid in self.update_sequence:
+            spec, vp = specs[cid], val_prep[cid]
+            if spec.is_random_effect:
+                def scorer(arrays, _f=vp.features, _r=vp.entity_rows, _n=spec.norm):
+                    return _re_margins(_f, _r, arrays["m"], _n)
+            else:
+                def scorer(arrays, _f=vp.features, _n=spec.norm):
+                    return _fe_margins(_f, arrays["w"], _n)
+            trial_scorers[cid] = scorer
+        return SweepExecutor(
+            coordinates,
+            list(tuned_ids) if tuned_ids is not None else list(self.update_sequence),
+            self.cd_iterations,
+            task=self.task,
+            base_reg_weights={
+                cid: base_config[cid].reg_weight for cid in self.update_sequence
+            },
+            validation_suite=suite,
+            validation_offsets=validation_data.offsets,
+            num_validation_samples=validation_data.num_samples,
+            trial_scorers=trial_scorers,
+            maximize=better_than(suite.primary, 1.0, 0.0),
+            seed=self.seed,
+            mode=mode,
+            warm_start=warm_start,
+            max_stack=max_stack,
+            shard_groups=shard_groups,
+            group_builder=self._sweep_group_builder(data, base_config),
+            on_event=on_event,
+        )
+
+    def _sweep_group_builder(self, data: GameDataset, base_config):
+        """Shard-group coordinate factory: `build(devices)` clones this
+        estimator's prepared coordinates onto a device group so one trial's
+        full serial fit runs there concurrently with the other groups'.
+        Single-device groups are plain device_put clones (bitwise-equal
+        programs on another chip); multi-device groups replicate the sample
+        data over a group mesh and row-shard the RE coefficient store —
+        the PR 7 entity-sharded ring-collective sweep inside the group."""
+
+        def build(devices):
+            import jax
+
+            from photon_ml_tpu.data.game_dataset import EntityBlocks
+
+            prepared = self._prepared
+            if prepared is None:
+                raise RuntimeError("prepare() must run before group builds")
+            multi = len(devices) > 1
+            if multi:
+                from photon_ml_tpu.parallel.mesh import (
+                    make_mesh,
+                    replicated,
+                    shard_random_effect_dataset,
+                )
+
+                mesh = make_mesh(devices)
+                target = rep = replicated(mesh)
+                # Only the RE ENTITY axis shards (the PR 7 ring-collective
+                # sweep, bitwise-equal to replicated) — what shard groups
+                # buy is the row-sharded coefficient store for fits whose
+                # RE matrix exceeds one device.
+                # replicate_sample_rows: the group's SAMPLE axis stays
+                # replicated (see ds_g below), and batch-sharding
+                # sample_entity_rows would demand mesh-divisible sample
+                # counts the sweep never promised.
+                put_red = lambda red: dataclasses.replace(
+                    shard_random_effect_dataset(
+                        red, mesh, replicate_sample_rows=True
+                    ),
+                    feature_mask=put(red.feature_mask),
+                )
+            else:
+                target = devices[0]
+
+                def put_red(red):
+                    buckets = []
+                    for b in red.buckets:
+                        nb = EntityBlocks.__new__(EntityBlocks)
+                        nb.gather = put(b.gather)
+                        nb.mask = put(b.mask)
+                        nb.entity_rows = put(b.entity_rows)
+                        buckets.append(nb)
+                    return dataclasses.replace(
+                        red,
+                        buckets=buckets,
+                        sample_entity_rows=put(red.sample_entity_rows),
+                        feature_mask=put(red.feature_mask),
+                    )
+
+            put = lambda a: None if a is None else jax.device_put(a, target)
+
+            def put_feat(f):
+                if isinstance(f, SparseFeatures):
+                    return dataclasses.replace(
+                        f, indices=put(f.indices), values=put(f.values)
+                    )
+                return put(f)
+
+            # SAMPLE data stays replicated inside a multi-device group
+            # (committed to the one device of a single-device group): a
+            # batch-sharded fixed-effect solve would reorder the gradient
+            # all-reduce and break the bitwise-parity contract.
+            ds_g = GameDataset(
+                shards={
+                    name: put_feat(data.shards[name])
+                    for name in {p.shard for p in prepared.values()}
+                },
+                labels=put(data.labels),
+                offsets=put(data.offsets),
+                weights=put(data.weights),
+                id_tags=data.id_tags,
+            )
+
+            coords = {}
+            for cid in self.update_sequence:
+                prep = prepared[cid]
+                static_cfg = dataclasses.replace(
+                    base_config[cid], reg_weight=0.0
+                )
+                # Norm contexts are NamedTuple pytrees: device_put moves
+                # their factor/shift arrays with the group's data.
+                norm_g = (
+                    None
+                    if prep.norm is None
+                    else jax.device_put(prep.norm, target)
+                )
+                if prep.re_dataset is not None:
+                    coord = RandomEffectCoordinate(
+                        ds_g, put_red(prep.re_dataset), static_cfg,
+                        self.task, norm_g,
+                    )
+                    if multi:
+                        # The ring-gather scoring path emits SAMPLE-sharded
+                        # margins; left alone they propagate sample
+                        # sharding into the next fixed-effect solve, whose
+                        # partitioned gradient reduction would break the
+                        # bitwise contract. Re-replicating is an exact
+                        # all-gather (same bits), so the group fit keeps
+                        # every residual replicated while the coefficient
+                        # store stays row-sharded.
+                        _orig_score = coord.score
+                        coord.score = lambda m, _s=_orig_score, _r=rep: (
+                            jax.device_put(_s(m), _r)
+                        )
+                    coords[cid] = coord
+                else:
+                    coords[cid] = FixedEffectCoordinate(
+                        ds_g, prep.shard, static_cfg, self.task, norm_g
+                    )
+            return coords
+
+        return build
+
     # ---------------------------------------------------------- run profile
 
     def run_profile(self) -> Dict[str, object]:

@@ -194,6 +194,24 @@ class EvaluationSuite:
                         np.asarray(id_tag_values[et.id_tag])
                     )
 
+    def metric_fn(self, et: EvaluatorType) -> Callable:
+        """The bare metric callable `(scores, labels, weights) -> device
+        scalar` for one evaluator — PRECISION k-binding and grouped-gather
+        wrapping resolved HERE, the single dispatch point shared by
+        `evaluate()` and the sweep executor's jitted trial-valuation
+        program (hyperparameter/sweep.py), so a new evaluator variant
+        cannot drift between the two."""
+        if et.name == "PRECISION":
+            base = lambda s, l, w, _k=et.k: metrics.precision_at_k(_k, s, l, w)
+        else:
+            base = _METRIC_FNS[et.name]
+        if et.is_grouped:
+            idx = self._grouped[et.id_tag]
+            return lambda s, l, w, _f=base, _i=idx: _grouped_metric(
+                _f, _i, s, l, w
+            )
+        return base
+
     def evaluate(self, scores: Array) -> "EvaluationResults":
         """Compute every metric, then fetch them in ONE device round trip.
 
@@ -205,14 +223,7 @@ class EvaluationSuite:
         names: List[str] = []
         vals = []
         for et in self.evaluator_types:
-            if et.name == "PRECISION":
-                fn = lambda s, l, w, k=et.k: metrics.precision_at_k(k, s, l, w)
-            else:
-                fn = _METRIC_FNS[et.name]
-            if et.is_grouped:
-                val = _grouped_metric(fn, self._grouped[et.id_tag], scores, self.labels, self.weights)
-            else:
-                val = fn(scores, self.labels, self.weights)
+            val = self.metric_fn(et)(scores, self.labels, self.weights)
             names.append(str(et))
             vals.append(jnp.asarray(val, jnp.float32))
         fetched = np.asarray(jnp.stack(vals))

@@ -322,6 +322,33 @@ class FixedEffectCoordinate:
         through it reuses compiled programs and device residency."""
         return self._features
 
+    # -- stacked-trial hooks (hyperparameter/sweep.py) ----------------------
+    # Traceable single-trial train/score: the SAME jitted recipes train()
+    # and score() dispatch, taken with traced (offsets, w0, reg_weight)
+    # so the sweep executor can lax.scan k reg-weight trials inside ONE
+    # XLA program. A jitted callable invoked under tracing inlines, and
+    # scan sequences the trial axis (it does NOT vmap it — batched matmul
+    # lowering changes reduction order), so each trial's ops — and bits —
+    # are identical to a standalone train()/score() call.
+
+    def trial_train(self, offsets, w0, reg_weight, key):
+        """One trial's solve as traced values; returns the (coefficients,
+        variances) arrays (variances None unless configured)."""
+        ds = self.dataset
+        res = self._train_fn(
+            self._features, ds.labels, offsets, ds.weights, w0, reg_weight, key
+        )
+        variances = None
+        if self.config.variance_computation != VarianceComputationType.NONE:
+            variances = self._variance_fn(
+                self._features, ds.labels, offsets, ds.weights,
+                res.coefficients, reg_weight,
+            )
+        return res.coefficients, variances
+
+    def trial_score(self, coefficients):
+        return self._score_fn(self._features, coefficients)
+
     def prefetch(self) -> None:
         """Start any pending device upload this coordinate's train/score
         will fault on (coordinate-descent calls this on coordinate k+1
@@ -1024,6 +1051,45 @@ class RandomEffectCoordinate:
             n_entities=e_total if matrix.shape[0] != e_total + 1 else None,
         )
         return model, stats
+
+    # -- stacked-trial hooks (hyperparameter/sweep.py) ----------------------
+
+    def trial_train(self, offsets, matrix, var_matrix, reg_weight):
+        """One trial's full bucket sweep as traced values (replicated store
+        only): every scan group's `_train_scan` program runs in bucket
+        order with the trial's (offsets, matrix, reg_weight), then the
+        unseen-entity row pins to zero — the exact op sequence train()
+        dispatches, so a lax.scan of this body over a trial axis is
+        bitwise-equal per trial to the serial per-trial loop
+        (tests/test_sweep.py). Entity-sharded coordinates evaluate trials
+        via shard groups instead (SweepExecutor)."""
+        if self._entity_mesh is not None:
+            raise ValueError(
+                "trial_train is the replicated stacked-trial hook; "
+                "entity-sharded coordinates run one trial per shard group"
+            )
+        ds, red = self.dataset, self.re_dataset
+        for group in self._scan_group_list():
+            _idxs, gathers, masks, ents = group
+            norm_f = norm_s = None
+            if self._per_entity_norm:
+                norm_f, norm_s = self.norm.factors, self.norm.shifts
+            matrix, var_matrix, _iters = self._train_scan(
+                ds.shards[red.feature_shard], ds.labels, ds.weights, offsets,
+                matrix, var_matrix, gathers, masks, ents, red.feature_mask,
+                norm_f, norm_s, reg_weight,
+            )
+        matrix = matrix.at[red.num_entities].set(0.0)
+        if var_matrix is not None:
+            var_matrix = var_matrix.at[red.num_entities].set(0.0)
+        return matrix, var_matrix
+
+    def trial_score(self, matrix):
+        return self._score_fn(
+            self.dataset.shards[self.re_dataset.feature_shard],
+            self.re_dataset.sample_entity_rows,
+            matrix,
+        )
 
     def prefetch(self) -> None:
         """Start the background device upload of the feature shard the

@@ -13,6 +13,11 @@ from photon_ml_tpu.hyperparameter.search import (
     priors_from_json,
     shrink_search_range,
 )
+from photon_ml_tpu.hyperparameter.sweep import (
+    SweepExecutor,
+    SweepResult,
+    TrialRecord,
+)
 from photon_ml_tpu.hyperparameter.tuner import (
     HyperparameterTuner,
     HyperparameterTuningMode,

@@ -103,18 +103,37 @@ class RandomSearch:
         self.maximize = maximize
         self.dim = len(self.configs)
         self._sobol = qmc.Sobol(d=self.dim, scramble=True, seed=seed)
+        # Power-of-two draw buffer: scipy's Sobol.random warns on every
+        # non-power-of-two draw (balance properties), and this searcher
+        # draws 250-point candidate pools and arbitrary-k batches all the
+        # time. _sobol_draw tops the buffer up in power-of-two blocks and
+        # slices — the SERVED point stream is the same sequence prefix the
+        # direct draws produced, warning-free.
+        self._sobol_buffer = np.empty((0, self.dim), np.float64)
         self.observations: List[Observation] = []
         self.prior_observations: List[Observation] = []
+
+    def _sobol_draw(self, m: int) -> np.ndarray:
+        """The next `m` Sobol points, via power-of-two block draws."""
+        while len(self._sobol_buffer) < m:
+            need = m - len(self._sobol_buffer)
+            block = 1 << max(0, (need - 1).bit_length())
+            self._sobol_buffer = np.concatenate(
+                [self._sobol_buffer, self._sobol.random(block)]
+            )
+        out = self._sobol_buffer[:m]
+        self._sobol_buffer = self._sobol_buffer[m:]
+        return out
 
     # -- candidate proposal (overridden by the GP search) --------------------
 
     def propose(self) -> np.ndarray:
-        return backward_scale(self._sobol.random(1)[0], self.configs)
+        return backward_scale(self._sobol_draw(1)[0], self.configs)
 
     def propose_batch(self, k: int) -> np.ndarray:
         """k candidates for one parallel round. Sobol draws are quasi-random
         and space-filling, so a plain batch is already diverse."""
-        return backward_scale(self._sobol.random(k), self.configs)
+        return backward_scale(self._sobol_draw(k), self.configs)
 
     def on_observation(self, obs: Observation) -> None:
         pass
@@ -232,7 +251,7 @@ class GaussianProcessSearch(RandomSearch):
         model = self._fit()
         if model is None:
             return super().propose()
-        pool = self._sobol.random(self.candidate_pool_size)
+        pool = self._sobol_draw(self.candidate_pool_size)
         ei = model.expected_improvement(pool)
         return backward_scale(pool[int(np.argmax(ei))], self.configs)
 
@@ -258,7 +277,7 @@ class GaussianProcessSearch(RandomSearch):
         model = self._fit()
         if model is None:
             return super().propose_batch(k)
-        pool = self._sobol.random(self.candidate_pool_size)
+        pool = self._sobol_draw(self.candidate_pool_size)
         n = model.x.shape[0]
         liar = float(np.min(model.y))  # best value in the internal
         # (standardized, minimization) space

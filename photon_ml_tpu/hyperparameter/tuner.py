@@ -71,6 +71,47 @@ class HyperparameterTuner:
             return searcher.find_batched(n, batch_size, batch_evaluation_function)
         return searcher.find(n)
 
+    def sweep(
+        self,
+        n: int,
+        configs: Sequence[HyperparameterConfig],
+        mode: HyperparameterTuningMode,
+        executor,
+        *,
+        priors: Optional[Sequence[Tuple[np.ndarray, float]]] = None,
+        seed: int = 1,
+        batch_size: int = 4,
+    ):
+        """Pod-parallel sweep (ISSUE 12): drive the batched search through a
+        `hyperparameter.sweep.SweepExecutor` — each proposal round's
+        (k, dim) candidate matrix evaluates as ONE batched computation
+        (trial-stacked or shard-group) instead of k serial fits — then
+        `finalize()` cold-refits the winner so the returned model is
+        bitwise-equal to a standalone fit of the winning config.
+
+        Returns (SearchResult, SweepResult), or None for NONE/empty
+        searches. Construct the executor via `GameEstimator.sweep_executor`.
+        """
+        if mode == HyperparameterTuningMode.NONE or n <= 0:
+            return None
+        cls = (
+            GaussianProcessSearch
+            if mode == HyperparameterTuningMode.BAYESIAN
+            else RandomSearch
+        )
+        searcher = cls(
+            configs,
+            executor.evaluate_point,
+            maximize=executor.maximize,
+            seed=seed,
+        )
+        if priors:
+            searcher.seed_priors(priors)
+        search_result = searcher.find_batched(
+            n, batch_size, executor.evaluate_batch
+        )
+        return search_result, executor.finalize()
+
 
 def get_tuner(mode: HyperparameterTuningMode) -> HyperparameterTuner:
     """HyperparameterTunerFactory: every supported mode maps to the in-repo

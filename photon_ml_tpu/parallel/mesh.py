@@ -505,7 +505,7 @@ def bcast_gather_wire_bytes(mesh: Mesh, n_rows: int, dim: int, itemsize: int = 4
 
 
 def shard_random_effect_dataset(
-    red: RandomEffectDataset, mesh: Mesh
+    red: RandomEffectDataset, mesh: Mesh, *, replicate_sample_rows: bool = False
 ) -> RandomEffectDataset:
     """Shard each bucket's entity axis; pad entity counts to the device count.
 
@@ -513,15 +513,23 @@ def shard_random_effect_dataset(
     into the pinned unseen row — harmless by construction (weight-0 data plus
     L2 keeps a zero warm start at zero). Transfers record under the
     `upload` stage of the ambient timing scope.
+
+    `replicate_sample_rows=True` keeps `sample_entity_rows` replicated
+    instead of batch-sharded — for callers whose SAMPLE axis stays
+    replicated on the mesh (the sweep executor's shard groups), where
+    batch-sharding it would both demand mesh-divisible sample counts and
+    leak sample sharding into downstream fixed-effect solves.
     """
     from photon_ml_tpu.utils.observability import stage_timer
 
     with stage_timer("upload"):
-        return _shard_random_effect_dataset(red, mesh)
+        return _shard_random_effect_dataset(
+            red, mesh, replicate_sample_rows=replicate_sample_rows
+        )
 
 
 def _shard_random_effect_dataset(
-    red: RandomEffectDataset, mesh: Mesh
+    red: RandomEffectDataset, mesh: Mesh, *, replicate_sample_rows: bool = False
 ) -> RandomEffectDataset:
     ndev = mesh.devices.size
     s1 = batch_sharding(mesh, 1)
@@ -541,8 +549,9 @@ def _shard_random_effect_dataset(
         nb.entity_rows = jax.device_put(entity_rows, s1)
         buckets.append(nb)
 
+    rows_sh = replicated(mesh) if replicate_sample_rows else s1
     return dataclasses.replace(
         red,
         buckets=buckets,
-        sample_entity_rows=jax.device_put(red.sample_entity_rows, s1),
+        sample_entity_rows=jax.device_put(red.sample_entity_rows, rows_sh),
     )

@@ -166,6 +166,43 @@ CHAOS_MULTICHIP_SECTION_KEYS = (
     "restaged_bytes",
 )
 
+# -------------------------------------------------------------------- sweep
+# bench.py `sweep` section (ISSUE 12): the pod-parallel hyperparameter
+# sweep certificate — a 16-trial Bayesian sweep through the batched trial
+# executor must beat the serial estimator.fit-per-trial loop (the
+# GameTrainingDriver-inherited path) by >10x wall, with the winner's
+# refit model bitwise-equal to a standalone fit of the winning config and
+# the clean-run robustness counters all zero.
+SWEEP_SECTION_KEYS = (
+    "trials",
+    "rounds",
+    "batch_size",
+    "modes",
+    "stack_decisions",
+    "trial_timings",
+    "sweep_wall_s",
+    "winner_refit_s",
+    "serial_baseline_wall_s",
+    "speedup_vs_serial",
+    "best_point",
+    "winner_value",
+    "winner_bitwise_vs_standalone",
+    "robustness",
+)
+
+# Per-trial timing record inside the sweep section (and the shape of the
+# executor's TrialRecord export): every evaluated trial reports its round,
+# execution mode, wall seconds (stacked rounds amortize the one-dispatch
+# round wall across their trials), value, and divergence-guard count.
+SWEEP_TRIAL_KEYS = (
+    "trial",
+    "round",
+    "mode",
+    "seconds",
+    "value",
+    "diverged_steps",
+)
+
 # ------------------------------------------------------------------ journal
 # The run journal (utils/telemetry.RunJournal, ISSUE 11): every JSONL
 # line carries the common envelope keys plus EXACTLY its event type's
@@ -191,6 +228,10 @@ JOURNAL_EVENT_SCHEMAS = {
     "watchdog_trip": ("label",),
     "shard_loss": ("coordinate", "shard_index"),
     "shard_restage": ("coordinate", "shard_index", "bytes"),
+    # -- hyperparameter sweep lifecycle (SweepExecutor / cli/tune.py) --
+    "trial_start": ("round", "trial", "mode"),
+    "trial_finish": ("round", "trial", "mode", "seconds", "value",
+                     "diverged_steps"),
 }
 
 # ------------------------------------------------------------------- profile
@@ -226,6 +267,8 @@ ALL_CONTRACTS = {
     "ROBUSTNESS_CLEAN_ZERO_KEYS": ROBUSTNESS_CLEAN_ZERO_KEYS,
     "SERVING_SUMMARY_KEYS": SERVING_SUMMARY_KEYS,
     "CHAOS_MULTICHIP_SECTION_KEYS": CHAOS_MULTICHIP_SECTION_KEYS,
+    "SWEEP_SECTION_KEYS": SWEEP_SECTION_KEYS,
+    "SWEEP_TRIAL_KEYS": SWEEP_TRIAL_KEYS,
     "JOURNAL_LINE_KEYS": JOURNAL_LINE_KEYS,
     "PROFILE_REQUIRED_KEYS": PROFILE_REQUIRED_KEYS,
     "PROFILE_FIT_KEYS": PROFILE_FIT_KEYS,

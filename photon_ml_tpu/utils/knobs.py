@@ -263,6 +263,33 @@ _register(
     "per block shape); 0 reverts to the per-bucket dispatch loop.",
 )
 _register(
+    "PHOTON_SWEEP_TRIAL_STACK",
+    str,
+    "",
+    "Trial-stacked hyperparameter sweep evaluation (k reg-weight trials "
+    "scanned inside ONE XLA dispatch): 1 forces, 0 disables (shard-group "
+    "or serial evaluation instead); empty = auto (on when every "
+    "coordinate's store is replicated).",
+    choices=("", *_TRUE, *_FALSE),
+)
+_register(
+    "PHOTON_SWEEP_MAX_STACK",
+    int,
+    8,
+    "Trials per stacked sweep dispatch; larger candidate batches split "
+    "into rounds of at most this many (further tightened by the HBM "
+    "charge when the device reports a bytes limit).",
+)
+_register(
+    "PHOTON_SWEEP_SHARD_GROUPS",
+    int,
+    0,
+    "Trial groups the device fleet partitions into for shard-group sweep "
+    "scheduling (one concurrent trial per group; groups of >1 device run "
+    "the entity-sharded sweep inside the group); 0 = auto (one group per "
+    "device).",
+)
+_register(
     "PHOTON_SOLVE_RETRIES",
     int,
     1,

@@ -1,0 +1,137 @@
+"""Probe: SweepExecutor stacked vs serial bitwise parity on a small GLMix
+problem (FE + RE coordinates), cold and warm-started rounds.
+Run: JAX_PLATFORMS=cpu python scratch/probe_sweep_exec.py
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+import jax.numpy as jnp
+
+from photon_ml_tpu.data.game_dataset import (
+    GameDataset,
+    RandomEffectDataConfig,
+    build_random_effect_dataset,
+)
+from photon_ml_tpu.evaluation.suite import EvaluationSuite, EvaluatorType
+from photon_ml_tpu.game.coordinate import (
+    FixedEffectCoordinate,
+    RandomEffectCoordinate,
+)
+from photon_ml_tpu.hyperparameter.sweep import SweepExecutor
+from photon_ml_tpu.optimize.config import (
+    L2,
+    CoordinateOptimizationConfig,
+    OptimizerConfig,
+)
+from photon_ml_tpu.transformers.game_transformer import _fe_margins, _re_margins
+from photon_ml_tpu.types import TaskType, VarianceComputationType
+
+rng = np.random.default_rng(0)
+
+
+def make_data(n, n_entities, d_fixed=5, d_re=3, seed=0):
+    r = np.random.default_rng(seed)
+    entity = r.integers(0, n_entities, size=n)
+    Xf = r.normal(size=(n, d_fixed)).astype(np.float32)
+    Xe = r.normal(size=(n, d_re)).astype(np.float32)
+    w = r.normal(size=d_fixed).astype(np.float32)
+    u = r.normal(size=(n_entities, d_re)).astype(np.float32)
+    margin = Xf @ w + np.einsum("nd,nd->n", Xe, u[entity])
+    y = (r.uniform(size=n) < 1 / (1 + np.exp(-margin))).astype(np.float32)
+    return GameDataset.build(
+        {"global": jnp.asarray(Xf), "per_entity": jnp.asarray(Xe)},
+        y,
+        id_tags={"entityId": entity},
+    ), entity
+
+
+def cfg(variance=VarianceComputationType.NONE):
+    return CoordinateOptimizationConfig(
+        optimizer=OptimizerConfig(max_iterations=30, tolerance=1e-7),
+        regularization=L2,
+        reg_weight=0.0,
+        variance_computation=variance,
+    )
+
+
+ds, entity = make_data(256, 10, seed=1)
+val, val_entity = make_data(128, 10, seed=2)
+red = build_random_effect_dataset(
+    ds, RandomEffectDataConfig("entityId", "per_entity", min_bucket=8)
+)
+task = TaskType.LOGISTIC_REGRESSION
+fixed = FixedEffectCoordinate(ds, "global", cfg(), task)
+rand = RandomEffectCoordinate(ds, red, cfg(), task)
+coords = {"fixed": fixed, "re": rand}
+
+suite = EvaluationSuite([EvaluatorType("AUC")], val.labels)
+val_rows = np.asarray(
+    [red.entity_index.get(e, red.num_entities) for e in val_entity], np.int32
+)
+val_rows = jnp.asarray(val_rows)
+val_Xf = val.shards["global"]
+val_Xe = val.shards["per_entity"]
+
+scorers = {
+    "fixed": lambda a: _fe_margins(val_Xf, a["w"], None),
+    "re": lambda a: _re_margins(val_Xe, val_rows, a["m"], None),
+}
+
+
+def make_exec(mode, warm_start=True):
+    return SweepExecutor(
+        coords,
+        ["fixed", "re"],
+        num_iterations=2,
+        task=task,
+        base_reg_weights={"fixed": 1.0, "re": 1.0},
+        validation_suite=suite,
+        validation_offsets=val.offsets,
+        num_validation_samples=val.num_samples,
+        trial_scorers=scorers,
+        maximize=True,
+        seed=3,
+        mode=mode,
+        warm_start=warm_start,
+    )
+
+
+points = np.array([[0.1, 0.5], [1.0, 2.0], [10.0, 0.01]])
+points2 = np.array([[0.5, 0.5], [3.0, 0.3]])
+
+for ws in (False, True):
+    ex_serial = make_exec("serial", ws)
+    ex_stacked = make_exec("stacked", ws)
+    vs1 = ex_serial.evaluate_batch(points)
+    vt1 = ex_stacked.evaluate_batch(points)
+    ms1 = ex_serial.last_trial_models
+    mt1 = ex_stacked.last_trial_models
+    vs2 = ex_serial.evaluate_batch(points2)
+    vt2 = ex_stacked.evaluate_batch(points2)
+    ms2 = ex_serial.last_trial_models
+    mt2 = ex_stacked.last_trial_models
+
+    def cmp(ms, mt, tag):
+        ok = True
+        for i, (a, b) in enumerate(zip(ms, mt)):
+            for cid in a:
+                for name in a[cid]:
+                    x, z = a[cid][name], b[cid][name]
+                    if x is None and z is None:
+                        continue
+                    same = np.array_equal(np.asarray(x), np.asarray(z))
+                    if not same:
+                        md = float(
+                            np.abs(np.asarray(x) - np.asarray(z)).max()
+                        )
+                        print(f"  {tag} trial{i} {cid}/{name}: MISMATCH maxdiff={md:.3e}")
+                        ok = False
+        return ok
+
+    print(f"warm_start={ws}")
+    print("  round1 models bitwise:", cmp(ms1, mt1, "r1"))
+    print("  round1 values:", vs1, vt1, "equal:", vs1 == vt1)
+    print("  round2 models bitwise:", cmp(ms2, mt2, "r2"))
+    print("  round2 values:", vs2, vt2, "equal:", vs2 == vt2)

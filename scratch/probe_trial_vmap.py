@@ -1,0 +1,136 @@
+"""Probe: is vmap over a trial axis (reg_weight, w0, offsets) bitwise-equal
+per-trial to the unbatched solve? And same question for lax.scan over trials.
+Run: JAX_PLATFORMS=cpu python scratch/probe_trial_vmap.py
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.data.containers import LabeledData
+from photon_ml_tpu.ops.losses import loss_for_task
+from photon_ml_tpu.optimize import problem
+from photon_ml_tpu.optimize.config import (
+    L2,
+    CoordinateOptimizationConfig,
+    OptimizerConfig,
+)
+from photon_ml_tpu.types import TaskType
+import dataclasses
+
+rng = np.random.default_rng(0)
+n, d = 512, 12
+X = jnp.asarray(rng.normal(size=(n, d)).astype(np.float32))
+y = jnp.asarray((rng.uniform(size=n) > 0.5).astype(np.float32))
+wts = jnp.ones((n,), jnp.float32)
+loss = loss_for_task(TaskType.LOGISTIC_REGRESSION)
+cfg = CoordinateOptimizationConfig(
+    optimizer=OptimizerConfig(max_iterations=25, tolerance=1e-7),
+    regularization=L2,
+    reg_weight=0.0,
+)
+
+def traced_cfg(rw):
+    return dataclasses.replace(cfg, reg_weight=rw)
+
+
+@jax.jit
+def solve_one(offsets, w0, rw):
+    data = LabeledData(X, y, offsets, wts)
+    return problem.solve(loss, data, traced_cfg(rw), w0, None, use_pallas=False)
+
+
+@jax.jit
+def solve_vmap(offsets_k, w0_k, rw_k):
+    def one(o, w0, rw):
+        data = LabeledData(X, y, o, wts)
+        return problem.solve(loss, data, traced_cfg(rw), w0, None, use_pallas=False)
+
+    return jax.vmap(one)(offsets_k, w0_k, rw_k)
+
+
+@jax.jit
+def solve_scan(offsets_k, w0_k, rw_k):
+    def step(carry, xs):
+        o, w0, rw = xs
+        data = LabeledData(X, y, o, wts)
+        res = problem.solve(loss, data, traced_cfg(rw), w0, None, use_pallas=False)
+        return carry, res
+
+    _, res = jax.lax.scan(step, 0, (offsets_k, w0_k, rw_k))
+    return res
+
+
+k = 5
+rws = jnp.asarray([0.1, 1.0, 10.0, 100.0, 3.0], jnp.float32)
+offs = jnp.asarray(rng.normal(size=(k, n)).astype(np.float32) * 0.1)
+w0s = jnp.zeros((k, d), jnp.float32)
+
+serial = [solve_one(offs[i], w0s[i], rws[i]) for i in range(k)]
+vm = solve_vmap(offs, w0s, rws)
+sc = solve_scan(offs, w0s, rws)
+
+for name, batched in [("vmap", vm), ("scan", sc)]:
+    eq_c = all(
+        np.array_equal(np.asarray(serial[i].coefficients), np.asarray(batched.coefficients[i]))
+        for i in range(k)
+    )
+    eq_i = all(
+        np.array_equal(np.asarray(serial[i].iterations), np.asarray(batched.iterations[i]))
+        for i in range(k)
+    )
+    md = max(
+        float(np.abs(np.asarray(serial[i].coefficients) - np.asarray(batched.coefficients[i])).max())
+        for i in range(k)
+    )
+    print(f"{name}: coeff_bitwise={eq_c} iters_equal={eq_i} maxdiff={md:.3e}")
+
+# Also: nested vmap (trial x entity) vs single vmap (entity) — the RE case.
+E, S = 6, 32
+Xe = jnp.asarray(rng.normal(size=(E, S, d)).astype(np.float32))
+ye = jnp.asarray((rng.uniform(size=(E, S)) > 0.5).astype(np.float32))
+we = jnp.ones((E, S), jnp.float32)
+
+
+@jax.jit
+def re_one(offs_e, w0_e, rw):
+    def one(Xi, yi, oi, wi, w0i):
+        data = LabeledData(Xi, yi, oi, wi)
+        return problem.solve(loss, data, traced_cfg(rw), w0i, None, use_pallas=False)
+
+    return jax.vmap(one)(Xe, ye, offs_e, we, w0_e)
+
+
+@jax.jit
+def re_trials(offs_ke, w0_ke, rw_k):
+    return jax.vmap(re_one)(offs_ke, w0_ke, rw_k)
+
+
+@jax.jit
+def re_trials_scan(offs_ke, w0_ke, rw_k):
+    def step(carry, xs):
+        o, w0, rw = xs
+        return carry, re_one(o, w0, rw)
+
+    _, res = jax.lax.scan(step, 0, (offs_ke, w0_ke, rw_k))
+    return res
+
+
+offs_ke = jnp.asarray(rng.normal(size=(k, E, S)).astype(np.float32) * 0.1)
+w0_ke = jnp.zeros((k, E, d), jnp.float32)
+serial_re = [re_one(offs_ke[i], w0_ke[i], rws[i]) for i in range(k)]
+vm_re = re_trials(offs_ke, w0_ke, rws)
+sc_re = re_trials_scan(offs_ke, w0_ke, rws)
+for name, batched in [("re_vmap", vm_re), ("re_scan", sc_re)]:
+    eq_c = all(
+        np.array_equal(np.asarray(serial_re[i].coefficients), np.asarray(batched.coefficients[i]))
+        for i in range(k)
+    )
+    md = max(
+        float(np.abs(np.asarray(serial_re[i].coefficients) - np.asarray(batched.coefficients[i])).max())
+        for i in range(k)
+    )
+    print(f"{name}: coeff_bitwise={eq_c} maxdiff={md:.3e}")

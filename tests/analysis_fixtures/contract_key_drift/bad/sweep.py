@@ -1,0 +1,9 @@
+"""Known-bad: re-types two sweep-section schema keys (the r12
+FIXTURE_SWEEP_KEYS shape) as a literal instead of importing the tuple."""
+
+
+def check_sweep(section):
+    report = {
+        k: section[k] for k in ("fixture_trials", "fixture_speedup")
+    }  # re-typed sweep schema
+    return report

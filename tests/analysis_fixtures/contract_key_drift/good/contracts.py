@@ -4,3 +4,6 @@ FIXTURE_TIMING_KEYS = ("fixture_alpha_s", "fixture_beta_s", "fixture_gamma_s")
 
 # Ingest-stage schema (r09): the streaming data plane's breakdown keys.
 FIXTURE_INGEST_STAGES = ("fixture_decode", "fixture_assemble", "fixture_ell")
+
+# Sweep-section schema (r12): the pod-parallel hyperparameter sweep keys.
+FIXTURE_SWEEP_KEYS = ("fixture_trials", "fixture_sweep_wall", "fixture_speedup")

@@ -1,0 +1,10 @@
+"""Known-good: the sweep-section schema is imported; single-key reads are
+use, not duplication."""
+
+from contracts import FIXTURE_SWEEP_KEYS
+
+
+def check_sweep(section):
+    missing = [k for k in FIXTURE_SWEEP_KEYS if k not in section]
+    trials = section.get("fixture_trials")  # one key is everyday vocabulary
+    return missing, trials

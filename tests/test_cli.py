@@ -435,3 +435,59 @@ class TestHyperparameterTuningCLI:
             meta = json.load(open(os.path.join(out, "models", f"tuned-{i}", "model-metadata.json")))
             weights.add(json.dumps(meta.get("optimizationConfigurations", {}), sort_keys=True))
         assert len(weights) > 1  # the search explored, not repeated, configs
+
+
+class TestTuneDriver:
+    def test_tune_end_to_end(self, tmp_path):
+        """cli/tune.py: the pod-parallel sweep driver — batched Bayesian
+        rounds through the stacked executor, winner model saved in the
+        standard layout, tuning-summary written, and trial_start/
+        trial_finish journal lines validating against their schemas."""
+        from photon_ml_tpu.cli import tune as tune_cli
+        from photon_ml_tpu.utils import telemetry
+
+        train_avro = str(tmp_path / "train.avro")
+        val_avro = str(tmp_path / "val.avro")
+        _write_glmix_avro(train_avro, 0, 300)
+        _write_glmix_avro(val_avro, 1, 150)
+        out = str(tmp_path / "out")
+        tune_cli.main([
+            "--training-task", "LOGISTIC_REGRESSION",
+            "--input-data-directories", train_avro,
+            "--validation-data-directories", val_avro,
+            "--root-output-directory", out,
+            "--feature-shard-configurations",
+            "name=globalShard,feature.bags=features,intercept=true",
+            "--coordinate-configurations",
+            "name=global,feature.shard=globalShard,optimizer=LBFGS,"
+            "tolerance=1e-7,max.iter=15,regularization=L2,reg.weights=1",
+            "name=per-member,random.effect.type=memberId,"
+            "feature.shard=globalShard,optimizer=LBFGS,max.iter=10,"
+            "regularization=L2,reg.weights=1,min.bucket=4",
+            "--validation-evaluators", "AUC",
+            "--tuning-iter", "4",
+            "--tuning-batch-size", "2",
+            "--logging-level", "WARNING",
+        ])
+        summary = json.load(open(os.path.join(out, "tuning-summary.json")))
+        assert len(summary["trials"]) == 4 and summary["rounds"] == 2
+        assert summary["modes"] == ["stacked"]
+        assert summary["tuned_coordinates"] == ["global", "per-member"]
+        assert np.isfinite(summary["winner_value"])
+        assert len(summary["best_point"]) == 2
+        # Winner model in the standard layout, loadable with its indexes.
+        best = os.path.join(out, "models", "tuned-best")
+        assert os.path.isfile(os.path.join(best, "model-metadata.json"))
+        assert os.path.isdir(os.path.join(best, "fixed-effect", "global"))
+        assert os.path.isdir(os.path.join(best, "random-effect", "per-member"))
+        assert os.path.isfile(
+            os.path.join(best, "feature-indexes", "globalShard.json")
+        )
+        meta = json.load(open(os.path.join(best, "model-metadata.json")))
+        tuned_rw = meta["optimizationConfigurations"]["global"]["reg_weight"]
+        assert tuned_rw == summary["best_point"][0]
+        # Journal: every line valid, one start + one finish per trial.
+        n_ok, errors = telemetry.validate_journal(
+            os.path.join(out, "journal.jsonl")
+        )
+        assert errors == [] and n_ok == 8

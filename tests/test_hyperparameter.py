@@ -223,6 +223,107 @@ def test_batch_evaluation_function_not_dropped_at_batch_size_one():
     assert len(result.observations) == 3
 
 
+def test_sobol_draws_never_warn():
+    """scipy's Sobol.random warns on every non-power-of-two draw; the
+    searchers draw 250-point pools and arbitrary-k batches constantly, so
+    they buffer power-of-two blocks and slice (ISSUE 12 satellite)."""
+    import warnings
+
+    gp = GaussianProcessSearch(CONFIGS_2D, _quadratic_eval, seed=7)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", UserWarning)
+        gp.propose()  # cold: Sobol path
+        batch = gp.propose_batch(5)  # arbitrary k
+        assert batch.shape == (5, 2)
+        for _ in range(3):
+            p = gp.propose()
+            gp.observations.append(Observation(p, _quadratic_eval(p)))
+        gp.propose()  # GP path: 250-point candidate pool draw
+        gp.propose_batch(3)
+
+
+def test_sobol_buffer_preserves_sequence_prefix():
+    """The served point stream is the SAME Sobol sequence prefix a direct
+    power-of-two draw produces — buffering changes warnings, not values."""
+    from scipy.stats import qmc
+
+    rs = RandomSearch(CONFIGS_2D, _quadratic_eval, seed=21)
+    served = np.vstack([
+        rs._sobol_draw(1),
+        rs._sobol_draw(5),
+        rs._sobol_draw(2),
+    ])
+    direct = qmc.Sobol(d=2, scramble=True, seed=21).random(8)
+    np.testing.assert_array_equal(served, direct)
+
+
+def test_constant_liar_batch_deterministic():
+    """Two searchers with identical seed + observations propose identical
+    batches (the sweep executor's round inputs must be reproducible)."""
+
+    def make():
+        gp = GaussianProcessSearch(CONFIGS_2D, _quadratic_eval, seed=13)
+        rng = np.random.default_rng(3)
+        for _ in range(5):
+            p = backward_scale(rng.uniform(size=2), CONFIGS_2D)
+            gp.observations.append(Observation(p, _quadratic_eval(p)))
+        return gp.propose_batch(4)
+
+    np.testing.assert_array_equal(make(), make())
+
+
+def test_constant_liar_no_duplicates_at_degenerate_ei():
+    """With every observation identical, EI is ~0 everywhere — the picked
+    pool points must STILL be distinct (taken-mask, not EI diversity)."""
+    gp = GaussianProcessSearch(CONFIGS_2D, _quadratic_eval, seed=5)
+    rng = np.random.default_rng(11)
+    for _ in range(6):
+        p = backward_scale(rng.uniform(size=2), CONFIGS_2D)
+        gp.observations.append(Observation(p, 1.0))  # constant objective
+    batch = gp.propose_batch(5)
+    unit = forward_scale(batch, CONFIGS_2D)
+    for i in range(5):
+        for j in range(i + 1, 5):
+            assert np.linalg.norm(unit[i] - unit[j]) > 0, (
+                f"picks {i} and {j} identical at degenerate EI"
+            )
+
+
+def test_find_batched_tail_round():
+    """n % batch_size != 0: the last round proposes exactly the remainder."""
+    calls = []
+
+    def batch_eval(points):
+        calls.append(len(points))
+        return [float(np.sum((p - 1.0) ** 2)) for p in points]
+
+    rs = RandomSearch(CONFIGS_2D, _quadratic_eval, seed=9)
+    result = rs.find_batched(10, 4, batch_eval)
+    assert calls == [4, 4, 2]
+    assert len(result.observations) == 10
+
+
+def test_find_batched_length_mismatch_raises():
+    rs = RandomSearch(CONFIGS_2D, _quadratic_eval, seed=9)
+    with pytest.raises(ValueError, match="returned 1 values for 3"):
+        rs.find_batched(3, 3, lambda points: [0.5])
+
+
+def test_priors_seeded_batched_search():
+    """seed_priors + find_batched: priors engage the GP from round one and
+    stay separate from evaluated observations."""
+    gp = GaussianProcessSearch(CONFIGS_2D, _quadratic_eval, seed=2)
+    priors = [
+        (np.array([0.31, 0.69]), 1.0004),
+        (np.array([0.9, 0.1]), 1.52),
+    ]
+    gp.seed_priors(priors)
+    result = gp.find_batched(8, 4)
+    assert len(gp.prior_observations) == 2
+    assert len(result.observations) == 8  # priors not double-counted
+    assert result.best_value < 1.2
+
+
 def test_shrink_search_range():
     from photon_ml_tpu.hyperparameter.search import shrink_search_range
 

@@ -341,6 +341,12 @@ class TestJournal:
         "invocation": 4,
         "shard_index": 1,
         "bytes": 4096,
+        # -- hyperparameter sweep lifecycle (ISSUE 12) --
+        "round": 0,
+        "trial": 5,
+        "mode": "stacked",
+        "value": 0.72,
+        "diverged_steps": 0,
     }
 
     def test_every_event_type_round_trips_its_schema(self, tmp_path):
