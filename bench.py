@@ -45,6 +45,7 @@ import time
 _CHILD = "--run-child"
 _MULTICHIP_CHILD = "--run-multichip"
 _CHAOS_MULTICHIP_CHILD = "--run-chaos-multichip"
+_ELASTIC_MESH_CHILD = "--run-elastic-mesh"
 
 # Physical HBM roofline per chip (GB/s): v5e HBM2 peak ~819 GB/s. Any
 # achieved-bandwidth figure above it is a measurement artifact (rtt
@@ -641,6 +642,298 @@ def _chaos_multichip_child() -> None:
                 post_recovery_bitwise=recovery_bitwise,
                 shard_loss_fallbacks=int(loss_fallbacks),
                 restaged_bytes=int(restaged_bytes),
+            )
+        )
+    )
+
+
+def _elastic_mesh_child() -> None:
+    """Live mesh-elasticity certificate (ISSUE 13) on an 8-virtual-device
+    mesh. Phases:
+
+      1. COLD REFERENCES: an engine cold-started at 8 shards and one at 4
+         must already agree bitwise (the PR 7 foundation).
+      2. LIVE SHRINK + REGROW: a closed-loop client scores continuously
+         through the micro-batcher while the engine reshards 8 -> 4 and
+         back 4 -> 8 — zero failed requests, every answer bitwise, and
+         post-reshard probes bitwise-equal to the cold start at that
+         shape. This phase is CLEAN: every reshard/mesh-loss robustness
+         counter must read zero afterwards.
+      3. HOT-ROW REBALANCE: a two-tier bundle replays a hot-tailed stream
+         (cold-tier hits + promotions accrue), the observed promotion
+         stats drive a rebalance through the same orchestrator, and the
+         replayed stream afterwards pays ZERO cold-tier hits — bitwise
+         throughout.
+      4. MID-FIT SHRINK DRILL: a mesh_loss injected into sweep 2 of an
+         entity-sharded fit re-forms onto 4 devices and resumes — bitwise
+         equal to the uninterrupted fit, exactly one repeated sweep.
+
+    Prints exactly one JSON line."""
+    import threading as _threading
+
+    import numpy as np
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.data.game_dataset import (
+        GameDataset,
+        RandomEffectDataConfig,
+        build_random_effect_dataset,
+    )
+    from photon_ml_tpu.game.coordinate import RandomEffectCoordinate
+    from photon_ml_tpu.game.coordinate_descent import run_coordinate_descent
+    from photon_ml_tpu.game.model import (
+        Coefficients,
+        FixedEffectModel,
+        GameModel,
+        RandomEffectModel,
+    )
+    from photon_ml_tpu.optimize.config import (
+        L2,
+        CoordinateOptimizationConfig,
+        OptimizerConfig,
+    )
+    from photon_ml_tpu.parallel.mesh import (
+        make_mesh,
+        pad_game_dataset,
+        shard_game_dataset,
+        shard_random_effect_dataset,
+        surviving_mesh,
+    )
+    from photon_ml_tpu.serving import (
+        ScoreRequest,
+        ServingBundle,
+        ServingEngine,
+        plan_reshard,
+    )
+    from photon_ml_tpu.transformers.game_transformer import (
+        CoordinateScoringSpec,
+    )
+    from photon_ml_tpu.types import TaskType
+    from photon_ml_tpu.utils import faults
+    from photon_ml_tpu.utils.contracts import ROBUSTNESS_CLEAN_ZERO_KEYS
+
+    task = TaskType.LOGISTIC_REGRESSION
+    mesh8 = make_mesh()
+    ndev = int(mesh8.devices.size)
+    shrink_to = max(1, ndev // 2)
+    mesh_small = surviving_mesh(shrink_to)
+    faults.install("")  # nothing armed until the mid-fit drill
+    faults.reset_counters()
+
+    # ---- serving model + request stream -----------------------------------
+    e_srv, d_fe, d_re = 24 * ndev, 16, 8
+    rng = np.random.default_rng(53)
+    w_fe = rng.normal(size=d_fe).astype(np.float32)
+    M = np.zeros((e_srv + 1, d_re), np.float32)
+    M[:e_srv] = rng.normal(size=(e_srv, d_re)).astype(np.float32) * 0.3
+    model = GameModel(
+        {
+            "fixed": FixedEffectModel(Coefficients(jnp.asarray(w_fe)), task),
+            "per-entity": RandomEffectModel(jnp.asarray(M), None, task),
+        }
+    )
+    specs = {
+        "fixed": CoordinateScoringSpec(shard="g"),
+        "per-entity": CoordinateScoringSpec(
+            shard="re",
+            random_effect_type="entityId",
+            entity_index={str(i): i for i in range(e_srv)},
+        ),
+    }
+    n_req = 256
+    Xf = rng.normal(size=(n_req, d_fe)).astype(np.float32)
+    Xr = rng.normal(size=(n_req, d_re)).astype(np.float32)
+    reqs = [
+        ScoreRequest(
+            features={"g": Xf[i], "re": Xr[i]},
+            entity_ids={"entityId": str(int(v))},
+            uid=str(i),
+        )
+        for i, v in enumerate(rng.integers(0, e_srv, size=n_req))
+    ]
+
+    def scores_of(results):
+        return np.asarray([r.score for r in results], np.float64)
+
+    # ---- phase 1: cold references at both shapes --------------------------
+    with ServingEngine(
+        ServingBundle.from_model(model, specs, task), max_batch=64
+    ) as eng_ref:
+        ref = scores_of(eng_ref.score_batch(reqs))
+    with ServingEngine(
+        ServingBundle.from_model(model, specs, task, mesh=mesh_small),
+        max_batch=64,
+    ) as eng_small:
+        ref_small = scores_of(eng_small.score_batch(reqs))
+    foundation_bitwise = bool(np.array_equal(ref, ref_small))
+
+    # ---- phase 2: live shrink + regrow under replay traffic ---------------
+    bundle = ServingBundle.from_model(model, specs, task, mesh=mesh8)
+    eng = ServingEngine(bundle, max_batch=64)
+    eng.warmup()
+    plan = plan_reshard(eng.bundle, mesh_small)
+    stop = _threading.Event()
+    failed_requests = [0]
+    answered = [0]
+    answer_marks: list = []
+
+    def _traffic(b):
+        j = 0
+        while not stop.is_set():
+            try:
+                res = b.score(reqs[j % n_req])
+                if res.score != ref[j % n_req]:
+                    failed_requests[0] += 1  # a wrong answer IS a failure
+                else:
+                    answered[0] += 1
+            except Exception:  # noqa: BLE001 - the zero-failed contract
+                failed_requests[0] += 1
+            j += 1
+
+    with eng, eng.batcher(max_wait_ms=1.0) as batcher:
+        th = _threading.Thread(
+            target=_traffic, args=(batcher,), name="photon-bench-elastic"
+        )
+        th.start()
+        time.sleep(0.2)
+        info_shrink = eng.reshard_orchestrator.reshard(mesh_small)
+        answer_marks.append(answered[0])
+        time.sleep(0.2)
+        shrink_probe = scores_of(eng.score_batch(reqs))
+        info_regrow = eng.reshard_orchestrator.reshard(make_mesh())
+        answer_marks.append(answered[0])
+        time.sleep(0.2)
+        stop.set()
+        th.join(timeout=60)
+        hung = th.is_alive()
+        regrow_probe = scores_of(eng.score_batch(reqs))
+    shrink_bitwise = bool(np.array_equal(shrink_probe, ref_small))
+    regrow_bitwise = bool(np.array_equal(regrow_probe, ref))
+
+    # ---- phase 3: hot-row rebalance from observed promotions --------------
+    hot_ids = [str(e_srv - 1 - (i % 8)) for i in range(n_req)]
+    hot_reqs = [
+        ScoreRequest(
+            features={"g": Xf[i], "re": Xr[i]},
+            entity_ids={"entityId": hot_ids[i]},
+        )
+        for i in range(n_req)
+    ]
+    with ServingEngine(
+        ServingBundle.from_model(model, specs, task), max_batch=64
+    ) as eng_hr:
+        hot_ref = scores_of(eng_hr.score_batch(hot_reqs))
+    bundle_tt = ServingBundle.from_model(model, specs, task, hot_rows=16)
+    store_tt = bundle_tt.coordinates["per-entity"].store
+    eng_tt = ServingEngine(bundle_tt, max_batch=64)
+    with eng_tt:
+        eng_tt.warmup()
+        # Pass 1: the default preload (rows 0..hot-1) misses the hot tail
+        # entirely — every hot lookup pays a cold-tier hit AND queues a
+        # promotion (the observed-hotness signal the rebalance reads).
+        rb_bitwise = bool(
+            np.array_equal(scores_of(eng_tt.score_batch(hot_reqs)), hot_ref)
+        )
+        cold_hits_before = store_tt.cold_hits
+        store_tt.drain()  # promotions recorded into promotion_stats
+        info_rb = eng_tt.reshard_orchestrator.rebalance(
+            "per-entity", min_promotions=1
+        )
+        # Pass 2 on the rebalanced generation: the observed-hot rows were
+        # PRELOADED into the new store's hot tier, so the same stream now
+        # pays zero cold-tier hits.
+        new_store = eng_tt.bundle.coordinates["per-entity"].store
+        cold_mark = new_store.cold_hits
+        rb_bitwise = rb_bitwise and bool(
+            np.array_equal(scores_of(eng_tt.score_batch(hot_reqs)), hot_ref)
+        )
+        cold_hits_after = new_store.cold_hits - cold_mark
+    eng_tt.bundle.release()
+
+    # Clean contract: phases 1-3 armed nothing, so every elastic (and mesh)
+    # robustness counter must be zero BEFORE the injected drill below.
+    counters_clean = faults.counters()
+    clean_zero = {
+        k: int(counters_clean.get(k, 0)) for k in ROBUSTNESS_CLEAN_ZERO_KEYS
+    }
+    clean_counters_zero = not any(clean_zero.values())
+
+    # ---- phase 4: mid-fit shrink drill ------------------------------------
+    e_fit, rows_each, d_fit = 16 * ndev, 4, 8
+    n_fit = e_fit * rows_each
+    rng_f = np.random.default_rng(67)
+    Xe = rng_f.normal(size=(n_fit, d_fit)).astype(np.float32)
+    ent = np.repeat(np.arange(e_fit), rows_each)
+    y = (rng_f.uniform(size=n_fit) > 0.5).astype(np.float32)
+    cfg = CoordinateOptimizationConfig(
+        optimizer=OptimizerConfig(max_iterations=6, tolerance=1e-7),
+        regularization=L2,
+        reg_weight=1.0,
+    )
+    re_cfg = RandomEffectDataConfig("entityId", "re", min_bucket=8)
+
+    def fit_coords(target_mesh):
+        ds = GameDataset.build(
+            {"re": jnp.asarray(Xe)}, y, id_tags={"entityId": ent}
+        )
+        if target_mesh is not None:
+            ds = shard_game_dataset(
+                pad_game_dataset(ds, target_mesh.devices.size), target_mesh
+            )
+            red = shard_random_effect_dataset(
+                build_random_effect_dataset(ds, re_cfg), target_mesh
+            )
+        else:
+            red = build_random_effect_dataset(ds, re_cfg)
+        return {"re": RandomEffectCoordinate(ds, red, cfg, task)}
+
+    def logical(result):
+        m = np.asarray(result.model.models["re"].coefficients_matrix)
+        return m[: e_fit + 1]
+
+    uninterrupted = logical(
+        run_coordinate_descent(fit_coords(make_mesh()), 2, seed=29)
+    )
+    faults.install("mesh_loss@2")  # dies mid-sweep-2, recovers, replays
+    try:
+        drilled = run_coordinate_descent(
+            fit_coords(make_mesh()),
+            2,
+            seed=29,
+            mesh_rebuilder=lambda: fit_coords(mesh_small),
+        )
+    finally:
+        faults.install("")
+    midfit_bitwise = bool(np.array_equal(logical(drilled), uninterrupted))
+
+    print(
+        json.dumps(
+            dict(
+                n_devices=ndev,
+                shrink_to=shrink_to,
+                foundation_bitwise=foundation_bitwise,
+                moved_rows_shrink=int(plan.moved_rows),
+                moved_bytes_shrink=int(plan.moved_bytes),
+                answered_during_shrink=int(answer_marks[0]),
+                answered_during_regrow=int(
+                    answer_marks[1] - answer_marks[0]
+                ),
+                answered_total=int(answered[0]),
+                failed_requests=int(failed_requests[0]),
+                hangs=int(bool(hung)),
+                shrink_bitwise_vs_cold=shrink_bitwise,
+                regrow_bitwise_vs_cold=regrow_bitwise,
+                reshard_stage_s=info_shrink["stage_s"],
+                regrow_stage_s=info_regrow["stage_s"],
+                rebalanced_rows=int(info_rb["rebalanced_rows"]),
+                rebalance_bitwise=rb_bitwise,
+                cold_tier_hits_before_rebalance=int(cold_hits_before),
+                cold_tier_hits_after_rebalance=int(cold_hits_after),
+                midfit_repeated_sweeps=int(drilled.repeated_sweeps),
+                midfit_mesh_losses=int(drilled.mesh_losses),
+                midfit_bitwise_vs_uninterrupted=midfit_bitwise,
+                clean_counters=clean_zero,
+                clean_counters_zero=clean_counters_zero,
             )
         )
     )
@@ -1415,6 +1708,100 @@ def _child() -> None:
 
         traceback.print_exc(file=sys.stderr)
         variants["chaos_multichip"] = dict(
+            failed=True, reason=f"{type(exc).__name__}: {exc}"
+        )
+
+    # ---- elastic mesh: live reshard + mid-fit mesh-loss resume ------------
+    # Own 8-virtual-device subprocess (ISSUE 13): an 8->4 shrink and 4->8
+    # regrow under live replay with zero failed requests and post-reshard
+    # scores bitwise-equal to a cold start at the new shape, a hot-row
+    # rebalance driven by observed promotion stats, and a mid-fit shrink
+    # drill that resumes bitwise at the cost of exactly one repeated
+    # sweep. The clean (un-injected) phases must leave every
+    # reshard/mesh-loss counter at zero.
+    try:
+        env_em = dict(os.environ)
+        env_em["JAX_PLATFORMS"] = "cpu"
+        env_em.pop("PALLAS_AXON_POOL_IPS", None)
+        flags_em = env_em.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags_em:
+            env_em["XLA_FLAGS"] = (
+                flags_em + " --xla_force_host_platform_device_count=8"
+            ).strip()
+        env_em.pop("PHOTON_FAULTS", None)  # the child arms its own drill
+        out_em = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), _ELASTIC_MESH_CHILD],
+            capture_output=True,
+            text=True,
+            timeout=600,
+            env=env_em,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        line_em = next(
+            (l for l in out_em.stdout.splitlines() if l.startswith("{")), None
+        )
+        if line_em is None:
+            raise RuntimeError(
+                f"elastic_mesh child produced no JSON: {out_em.stderr[-1500:]}"
+            )
+        em = json.loads(line_em)
+        from photon_ml_tpu.utils.contracts import ELASTIC_MESH_SECTION_KEYS
+
+        missing_em = [
+            k for k in ELASTIC_MESH_SECTION_KEYS if em.get(k) is None
+        ]
+        if missing_em:
+            raise RuntimeError(
+                f"elastic_mesh section is missing keys {missing_em} — the "
+                "live-elasticity contract is broken"
+            )
+        if em["failed_requests"] or em.get("hangs"):
+            raise RuntimeError(
+                f"elastic_mesh dropped traffic: {em['failed_requests']} "
+                f"failed, {em.get('hangs')} hung — a live reshard must "
+                "never fail a request"
+            )
+        parity_em = [
+            k for k in ELASTIC_MESH_SECTION_KEYS if "bitwise" in k
+        ]
+        bad_em = [k for k in parity_em if not em[k]]
+        if bad_em:
+            raise RuntimeError(
+                f"elastic_mesh parity broken: {bad_em} — a reshard or "
+                "mesh-loss resume changed answers"
+            )
+        if em["midfit_repeated_sweeps"] != 1:
+            raise RuntimeError(
+                "mid-fit mesh loss repeated "
+                f"{em['midfit_repeated_sweeps']} sweeps — the contract is "
+                "exactly one"
+            )
+        if not em["clean_counters_zero"]:
+            raise RuntimeError(
+                "clean elastic_mesh phases left nonzero robustness "
+                f"counters ({em.get('clean_counters')}) — elasticity "
+                "regression"
+            )
+        if em["moved_rows_shrink"] <= 0:
+            raise RuntimeError(
+                "elastic_mesh shrink plan moved no rows — the reshard "
+                "certificate measured nothing"
+            )
+        variants["elastic_mesh"] = em
+        _mark(
+            f"elastic_mesh survived ({em['n_devices']}->{em['shrink_to']}"
+            f"->{em['n_devices']} under replay: "
+            f"{em['answered_during_shrink'] + em['answered_during_regrow']}"
+            " answered, 0 failed; rebalance "
+            f"{em['cold_tier_hits_before_rebalance']}->"
+            f"{em['cold_tier_hits_after_rebalance']} cold hits; mid-fit "
+            "resume bitwise in 1 repeated sweep)"
+        )
+    except Exception as exc:  # noqa: BLE001 - bench must still print a line
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        variants["elastic_mesh"] = dict(
             failed=True, reason=f"{type(exc).__name__}: {exc}"
         )
 
@@ -2254,6 +2641,9 @@ def main() -> None:
         return
     if _CHAOS_MULTICHIP_CHILD in sys.argv:
         _chaos_multichip_child()
+        return
+    if _ELASTIC_MESH_CHILD in sys.argv:
+        _elastic_mesh_child()
         return
     if _CHILD in sys.argv:
         _child()

@@ -84,6 +84,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="per-request deadline budget; a request queued past "
                         "it fails with DeadlineExceeded instead of wasting "
                         "a device slot (default: no deadline)")
+    p.add_argument("--reshard-to", type=int, default=None,
+                   help="live mesh elasticity drill: once replay traffic is "
+                        "flowing, reshard the engine's coefficient layout "
+                        "to this many entity shards (1 = replicated) on a "
+                        "background worker — zero failed requests, rollback "
+                        "on any staging/commit failure; the summary gains a "
+                        "'reshard' block")
     p.add_argument("--model-id", default=None,
                    help="model id tag written into every score record")
     p.add_argument("--logging-level", default="INFO")
@@ -270,16 +277,49 @@ def _run_with_bundle(args, bundle: ServingBundle) -> dict:
     model_id = args.model_id or "game-model"
     n_requests = 0
     n_failed = 0
+    # Live reshard drill (--reshard-to): kicked on a background worker
+    # once the first replay window has answered, so the generation flip
+    # happens UNDER traffic — the elastic_mesh bench contract, driveable
+    # from the CLI. Joined before the summary so the outcome is recorded.
+    reshard_to = getattr(args, "reshard_to", None)
+    reshard_info: dict = {}
+    reshard_thread = None
+
+    def _live_reshard():
+        try:
+            from photon_ml_tpu.parallel.mesh import surviving_mesh
+
+            reshard_info.update(
+                engine.reshard_orchestrator.reshard(
+                    surviving_mesh(reshard_to)
+                )
+            )
+            logger.info("live reshard committed: %s", reshard_info)
+        except Exception as exc:  # noqa: BLE001 - recorded, replay goes on
+            reshard_info["error"] = repr(exc)
+            logger.warning("live reshard rolled back: %r", exc)
+
     t_replay = time.perf_counter()
     with telemetry.span("serve_replay"), engine, engine.batcher(
         max_wait_ms=args.max_wait_ms,
         max_pending=args.max_pending,
         default_deadline_ms=args.deadline_ms,
     ) as batcher:
+      # The reshard worker must be joined on EVERY exit path, inside the
+      # engine context: a replay error escaping this loop would otherwise
+      # close the engine while the worker is mid-stage/mid-commit.
+      try:
         for k in itertools.count():
             window = list(itertools.islice(stream, REPLAY_WINDOW))
             if not window:
                 break
+            if k == 1 and reshard_to is not None and reshard_thread is None:
+                import threading
+
+                reshard_thread = threading.Thread(
+                    target=_live_reshard, name="photon-reshard-cli"
+                )
+                reshard_thread.start()
             # Per-future harvesting, not score_all: one malformed request
             # must cost ONE failed record (logged, counted), never the
             # window's healthy co-batched answers or the summary. Replay is
@@ -319,6 +359,13 @@ def _run_with_bundle(args, bundle: ServingBundle) -> dict:
                 )
                 os.replace(tmp, part)
             n_requests += len(window)
+        if reshard_to is not None and reshard_thread is None:
+            # Single-window replay: the drill still runs (and is still
+            # recorded), just without concurrent traffic to flow past it.
+            _live_reshard()
+      finally:
+        if reshard_thread is not None:
+            reshard_thread.join()
         metrics = batcher.metrics()
     replay_s = time.perf_counter() - t_replay
     logger.info(
@@ -349,6 +396,8 @@ def _run_with_bundle(args, bundle: ServingBundle) -> dict:
             **faults.counters(),
         },
     }
+    if reshard_to is not None:
+        summary["reshard"] = reshard_info
     with open(os.path.join(out_root, "serving-summary.json"), "w") as f:
         json.dump(summary, f, indent=2, default=str)
     # The persisted serve profile (ISSUE 11): latency/dispatch record the

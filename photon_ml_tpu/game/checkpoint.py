@@ -423,14 +423,31 @@ def _load_sharded_model(
                 arrays.get("variances"),
             )
         )
-    blocks.sort(key=lambda b: b[0])
-    matrix = np.concatenate([m for _, m, _ in blocks], axis=0)
+    return _model_from_row_blocks(blocks, task, n_entities)
+
+
+def _model_from_row_blocks(blocks, task, n_entities: Optional[int]):
+    """The any-shape reassembly core shared by the on-disk elastic
+    checkpoint (`_load_sharded_model`) and the IN-MEMORY mesh-loss resume
+    (`reassemble_model_in_memory`): per-shard (row_start, matrix, var)
+    blocks concatenate in row order into one replicated host matrix,
+    which the warm-start path re-pads/re-shards onto whatever mesh the
+    resuming (or surviving) process has."""
+    blocks = sorted(blocks, key=lambda b: b[0])
+    matrix = np.concatenate([np.asarray(m) for _, m, _ in blocks], axis=0)
     var = None
     if all(v is not None for _, _, v in blocks):
-        var = jnp.asarray(np.concatenate([v for _, _, v in blocks], axis=0))
+        var = np.concatenate([np.asarray(v) for _, _, v in blocks], axis=0)
+    if n_entities is not None and matrix.shape[0] > n_entities + 1:
+        # Mesh-padding rows are inert zeros; dropping them here means the
+        # reassembled model is EXACTLY what a fresh fit at the new shape
+        # would warm-start from (and n_entities bookkeeping resets).
+        matrix = matrix[: n_entities + 1]
+        if var is not None:
+            var = var[: n_entities + 1]
     return RandomEffectModel(
         jnp.asarray(matrix),
-        var,
+        None if var is None else jnp.asarray(var),
         task,
         n_entities=(
             n_entities
@@ -438,6 +455,46 @@ def _load_sharded_model(
             else None
         ),
     )
+
+
+def reassemble_model_in_memory(model):
+    """`_load_sharded_model`'s any-shape reassembly applied IN MEMORY — the
+    happy path of the mid-fit mesh-loss resume (no filesystem round trip):
+    pull a model's per-shard device blocks to host through the SURVIVING
+    replicas and rebuild it replicated, sliced to logical rows, ready for
+    the warm-start path to re-shard onto the new (smaller) mesh. Raises
+    whatever the device fetch raises when the blocks are unreachable —
+    the caller falls back to the durable checkpoint then."""
+    if isinstance(model, FixedEffectModel):
+        coeffs = model.coefficients
+        means = jnp.asarray(np.asarray(coeffs.means))
+        var = (
+            None
+            if coeffs.variances is None
+            else jnp.asarray(np.asarray(coeffs.variances))
+        )
+        return FixedEffectModel(Coefficients(means, var), model.task)
+    if isinstance(model, RandomEffectModel):
+        matrix = model.coefficients_matrix
+        var = model.variances_matrix
+        shard_blocks = _sharded_row_blocks(matrix)
+        if shard_blocks is None:
+            blocks = [
+                (0, np.asarray(matrix), None if var is None else np.asarray(var))
+            ]
+        else:
+            blocks = [
+                (
+                    start,
+                    block,
+                    None
+                    if var is None
+                    else np.asarray(var[start : start + block.shape[0]]),
+                )
+                for _, start, block in shard_blocks
+            ]
+        return _model_from_row_blocks(blocks, model.task, model.num_entities)
+    raise TypeError(f"unknown model type {type(model)}")
 
 
 def _results_to_json(res) -> dict:

@@ -976,6 +976,15 @@ class RandomEffectCoordinate:
             self._scan_groups_cache = groups
         return groups
 
+    @property
+    def entity_mesh(self):
+        """The mesh this coordinate's entity store is sharded over (None =
+        replicated). Public because the elastic-resume layer keys on it:
+        a device-shaped failure that beats this coordinate's own failure
+        domain is a MESH loss only when there IS a mesh
+        (game/coordinate_descent.py's sweep-boundary handler)."""
+        return self._entity_mesh
+
     def sweep_collective_bytes(self) -> int:
         """Analytic wire bytes one full sweep moves through the ring
         collectives (gather of warm starts + scatter of coefficients and,

@@ -76,6 +76,72 @@ def _commit_update(residual, new_scores, guarded_arrays):
     return residual + new_scores, ok
 
 
+def _recover_from_mesh_loss(
+    exc,
+    *,
+    snapshot,
+    validation_history,
+    ckpt,
+    ckpt_config_key,
+    task,
+    completed_steps,
+):
+    """Rebuild the outer-loop state after a mid-fit mesh loss.
+
+    HAPPY PATH (in memory): the sweep-boundary snapshot's models
+    reassemble to replicated host-backed models through the surviving
+    replicas (`checkpoint.reassemble_model_in_memory` — the elastic
+    checkpoint's any-shape reassembly without the filesystem round trip);
+    the step cursor is UNCHANGED — the snapshot was taken at the later of
+    (sweep start, resume cursor), so the existing `step <
+    completed_steps` fast-forward already replays exactly the lost work.
+
+    FALLBACK (the device fetch itself fails — the blocks really are
+    gone): reload the durable checkpoint and resume from ITS cursor, the
+    standard kill-resume protocol. No checkpoint configured re-raises the
+    original MeshLoss.
+
+    Returns (models, best_models, best_results, pass_results,
+    completed_steps, source)."""
+    from photon_ml_tpu.game.checkpoint import reassemble_model_in_memory
+
+    snap_models, snap_pass, snap_vh_len, snap_best, snap_best_res = snapshot
+    try:
+        models = {
+            cid: reassemble_model_in_memory(m)
+            for cid, m in snap_models.items()
+        }
+        best_models = {
+            cid: reassemble_model_in_memory(m)
+            for cid, m in snap_best.items()
+        }
+    except Exception:
+        logger.warning(
+            "in-memory mesh-loss reassembly failed; falling back to the "
+            "durable checkpoint",
+            exc_info=True,
+        )
+        if ckpt is None or not ckpt.exists():
+            raise exc
+        state = ckpt.load(task, config_key=ckpt_config_key)
+        validation_history[:] = list(state.validation_history)
+        pass_results = (
+            state.validation_history[-1][2]
+            if state.validation_history
+            else None
+        )
+        return (
+            state.models,
+            state.best_models or dict(state.models),
+            state.best_results,
+            pass_results,
+            state.completed_steps,
+            "checkpoint",
+        )
+    del validation_history[snap_vh_len:]
+    return models, best_models, snap_best_res, snap_pass, completed_steps, "memory"
+
+
 def _update_all_finite(model, scores) -> bool:
     """ONE scalar all-finite check over a coordinate update (new model +
     new scores): the and-reduction builds device-side, so the guard costs a
@@ -101,6 +167,12 @@ class CoordinateDescentResult:
     # last_train_collective_bytes per sweep; 0 on the replicated path) —
     # the pod-scale accounting `fit_timing["sharding"]` reports.
     collective_bytes: int = 0
+    # Mid-fit mesh losses recovered at a sweep boundary (ISSUE 13), and
+    # the sweeps those recoveries repeated — each in-memory recovery
+    # rolls the interrupted sweep back and replays it on the surviving
+    # mesh, so a clean run reports 0/0 and a single loss reports 1/1.
+    mesh_losses: int = 0
+    repeated_sweeps: int = 0
 
 
 def run_coordinate_descent(
@@ -117,6 +189,8 @@ def run_coordinate_descent(
     checkpoint_dir: Optional[str] = None,
     prefetch: bool = False,
     on_event=None,
+    mesh_rebuilder=None,
+    max_mesh_losses: int = 2,
 ) -> CoordinateDescentResult:
     """Run cyclic coordinate descent (CoordinateDescent.run, :132-134).
 
@@ -144,6 +218,24 @@ def run_coordinate_descent(
     from the checkpointed models, and reproduces the uninterrupted result
     (down-sampling keys derive from (seed, step), so resumed subsamples are
     identical).
+
+    MID-FIT MESH ELASTICITY (ISSUE 13): a typed `faults.MeshLoss` raised
+    during a coordinate update — the armed `mesh_loss` fault site, or a
+    device-shaped failure (watchdog-escalated DeviceHang, exhausted
+    collective retries past even the bucket-loop fallback) on an
+    entity-sharded coordinate — is caught AT THE SWEEP BOUNDARY instead of
+    killing the fit: the interrupted sweep rolls back to its boundary
+    state, every model reassembles IN MEMORY through the surviving
+    replicas (`checkpoint.reassemble_model_in_memory`, the elastic
+    checkpoint's any-shape reassembly without the filesystem round trip;
+    the durable checkpoint is the fallback when the device fetch itself
+    fails), `mesh_rebuilder()` supplies coordinates re-formed over the
+    surviving mesh (same ids; None keeps the current ones), residual
+    state recomputes from the models, and the sweep replays — bitwise
+    equal to the uninterrupted fit at the cost of exactly one repeated
+    sweep, because sharded and replicated sweeps are bitwise-identical by
+    construction (PR 7/10). At most `max_mesh_losses` recoveries; the
+    next loss re-raises.
     """
     locked = locked_coordinates or set()
     ids = list(coordinates.keys())
@@ -286,8 +378,28 @@ def run_coordinate_descent(
         validation_history[-1][2] if validation_history else None
     )
     last_unlocked = unlocked[-1]
-    for it in range(num_iterations):
-        for ci, cid in enumerate(ids):
+    mesh_losses = 0
+    repeated_sweeps = 0
+    it = 0
+    while it < num_iterations:
+        # Sweep-boundary snapshot: what a mesh-loss recovery rolls back to.
+        # Cheap — dict copies of model/score REFERENCES plus a few
+        # scalars; the arrays themselves are immutable. The counters are
+        # snapshotted too: a rejection/collective that happened INSIDE
+        # the interrupted sweep replays deterministically, and counting
+        # it twice would break the "bitwise the uninterrupted fit"
+        # contract for the result record.
+        sweep_snapshot = (
+            dict(models),
+            pass_results,
+            len(validation_history),
+            dict(best_models),
+            best_results,
+        )
+        snap_diverged = diverged_steps
+        snap_collective = collective_bytes
+        try:
+          for ci, cid in enumerate(ids):
             if cid in locked:
                 continue
             step = it * len(ids) + ci
@@ -306,6 +418,18 @@ def run_coordinate_descent(
                 # Fresh subsample per optimize call, as in the reference's
                 # runWithSampling (DistributedOptimizationProblem.scala:144).
                 kwargs["key"] = jax.random.fold_in(root_key, step)
+
+            # Mesh-loss fault site (ISSUE 13): one invocation per
+            # coordinate update. An armed plan simulates part of the mesh
+            # dying mid-update — converted to the typed MeshLoss the
+            # sweep-boundary handler below recovers from.
+            try:
+                faults.fault_point("mesh_loss")
+            except faults.InjectedFault as exc:
+                raise faults.MeshLoss(
+                    f"injected mesh loss at iteration {it} "
+                    f"coordinate {cid!r}"
+                ) from exc
 
             # Divergence guard: an update whose new model or scores carry a
             # non-finite value is REJECTED — committing it would poison every
@@ -334,19 +458,40 @@ def run_coordinate_descent(
                         # an untrained model as a "diverged" counter.
                         finite = False
                     else:
-                        cand_model, _stats = coord.train(
-                            offsets, models.get(cid), **kwargs
-                        )
-                        cand_scores = coord.score(cand_model)
-                        # One fused program: the next summed-scores vector
-                        # and the divergence guard's reduction; one bool
-                        # fetch.
-                        cand_summed, ok = _commit_update(
-                            residual,
-                            cand_scores,
-                            _model_arrays(cand_model, cand_scores),
-                        )
-                        finite = bool(ok)
+                        try:
+                            cand_model, _stats = coord.train(
+                                offsets, models.get(cid), **kwargs
+                            )
+                            cand_scores = coord.score(cand_model)
+                            # One fused program: the next summed-scores
+                            # vector and the divergence guard's reduction;
+                            # one bool fetch.
+                            cand_summed, ok = _commit_update(
+                                residual,
+                                cand_scores,
+                                _model_arrays(cand_model, cand_scores),
+                            )
+                            finite = bool(ok)
+                        except faults.MeshLoss:
+                            raise
+                        except BaseException as exc:
+                            # Escalation to MeshLoss: a device-shaped
+                            # failure that escaped the coordinate's OWN
+                            # failure domain (bounded re-dispatch AND the
+                            # bucket-loop fallback both lost) on an
+                            # entity-sharded coordinate means the shard
+                            # group is dead — in-place retry would re-hit
+                            # the same dead devices, so hand it to the
+                            # sweep-boundary elastic resume instead.
+                            if getattr(
+                                coord, "entity_mesh", None
+                            ) is not None and faults.is_device_error(exc):
+                                raise faults.MeshLoss(
+                                    f"device-shaped failure on the "
+                                    f"entity-sharded coordinate {cid!r} "
+                                    f"at iteration {it}: {exc!r}"
+                                ) from exc
+                            raise
                     if finite:
                         model, new_scores = cand_model, cand_scores
                         new_summed = cand_summed
@@ -452,6 +597,97 @@ def run_coordinate_descent(
                     on_event("checkpoint", step=step + 1, coordinate=cid)
             elif staged_write is not None:  # pragma: no cover - ckpt is set
                 staged_write[4].join()
+        except faults.MeshLoss as exc:
+            mesh_losses += 1
+            faults.COUNTERS.increment("mesh_losses")
+            if mesh_losses > max(0, int(max_mesh_losses)):
+                logger.error(
+                    "mesh loss #%d exceeds max_mesh_losses=%d — giving up",
+                    mesh_losses,
+                    max_mesh_losses,
+                )
+                raise
+            (
+                models,
+                best_models,
+                best_results,
+                pass_results,
+                completed_steps,
+                source,
+            ) = _recover_from_mesh_loss(
+                exc,
+                snapshot=sweep_snapshot,
+                validation_history=validation_history,
+                ckpt=ckpt,
+                ckpt_config_key=ckpt_config_key,
+                task=next(iter(coordinates.values())).task,
+                completed_steps=completed_steps,
+            )
+            if source == "memory":
+                # The rolled-back sweep replays in full, so its counter
+                # increments recur deterministically — restore to the
+                # boundary values or they double-count. The CHECKPOINT
+                # path must NOT restore: its cursor may sit mid-sweep and
+                # the fast-forward skips re-executing those steps, so
+                # their already-counted events would be lost.
+                diverged_steps = snap_diverged
+                collective_bytes = snap_collective
+            # Re-form the mesh from the surviving devices: the caller's
+            # rebuilder supplies coordinates over the new layout (same
+            # ids); None keeps the current ones (replicated fits).
+            if mesh_rebuilder is not None:
+                rebuilt = mesh_rebuilder()
+                if rebuilt is not None:
+                    if list(rebuilt.keys()) != ids:
+                        raise ValueError(
+                            "mesh_rebuilder must return the same coordinate "
+                            f"ids ({ids}), got {list(rebuilt.keys())}"
+                        )
+                    coordinates = rebuilt
+            # Residual state is a pure function of the models — recompute
+            # it through the NEW coordinates (the rebuilt dataset may pad
+            # samples differently on the smaller mesh).
+            first = next(iter(coordinates.values()))
+            base_offsets = first.dataset.offsets
+            n = first.dataset.num_samples
+            dtype = base_offsets.dtype
+            scores = {}
+            summed = jnp.zeros((n,), dtype)
+            for c2 in ids:
+                if c2 in models:
+                    s = coordinates[c2].score(models[c2])
+                    scores[c2] = s
+                    summed = summed + s
+            val_scores = {}
+            if validation_scorer is not None:
+                for c2 in ids:
+                    if c2 in models:
+                        val_scores[c2] = validation_scorer(c2, models[c2])
+            surviving = max(
+                int(m.devices.size)
+                if (m := getattr(c, "entity_mesh", None)) is not None
+                else 1
+                for c in coordinates.values()
+            )
+            repeated_sweeps += 1
+            telemetry.emit_event(
+                "mesh_loss",
+                iteration=it,
+                coordinate=cid,
+                surviving_devices=surviving,
+                source=source,
+            )
+            logger.warning(
+                "mesh loss recovered at the iteration-%d sweep boundary "
+                "(%s; state reassembled from %s, %d surviving device(s)) — "
+                "repeating the sweep",
+                it,
+                exc,
+                source,
+                surviving,
+            )
+            continue  # repeat the interrupted sweep on the surviving mesh
+        it += 1
 
     final = GameModel(dict(models))
     best = GameModel(dict(best_models)) if best_models else final
@@ -464,4 +700,6 @@ def run_coordinate_descent(
         timing=timing,
         diverged_steps=diverged_steps,
         collective_bytes=collective_bytes,
+        mesh_losses=mesh_losses,
+        repeated_sweeps=repeated_sweeps,
     )

@@ -64,6 +64,20 @@ def make_mesh(devices: Optional[Sequence] = None, axis_name: str = DATA_AXIS) ->
     return Mesh(devs, (axis_name,))
 
 
+def surviving_mesh(
+    n_devices: int, axis_name: str = DATA_AXIS
+) -> Optional[Mesh]:
+    """Mesh over the first `n_devices` healthy local devices — the elastic
+    shrink/regrow helper (serving/reshard.py targets, mid-fit mesh-loss
+    rebuilders). Returns None for n <= 1: a one-device layout is the
+    REPLICATED storage mode everywhere in the tree, not a 1-mesh."""
+    devs = jax.devices()
+    n = max(1, min(int(n_devices), len(devs)))
+    if n <= 1:
+        return None
+    return make_mesh(devs[:n], axis_name)
+
+
 def batch_sharding(mesh: Mesh, ndim: int = 1) -> NamedSharding:
     """Shard the leading (sample or entity) axis; replicate the rest."""
     return NamedSharding(mesh, P(mesh.axis_names[0], *([None] * (ndim - 1))))

@@ -380,38 +380,32 @@ def dryrun_multihost(
             )
         env["PHOTON_MH_DATA"] = data_dir
 
-        procs = []
-        for pid in range(n_processes):
-            out_f = open(os.path.join(logdir, f"w{pid}.out"), "w+")
-            err_f = open(os.path.join(logdir, f"w{pid}.err"), "w+")
-            procs.append(
-                (
-                    subprocess.Popen(
-                        [
-                            sys.executable,
-                            os.path.abspath(__file__),
-                            _WORKER_FLAG,
-                            coordinator,
-                            str(n_processes),
-                            str(pid),
-                            str(devices_per_proc),
-                        ],
-                        env=env,
-                        stdout=out_f,
-                        stderr=err_f,
-                        cwd=repo_root,
-                    ),
-                    out_f,
-                    err_f,
-                )
-            )
-
         def _read(f) -> str:
             f.flush()
             f.seek(0)
             return f.read()
 
+        # Child cleanup (ISSUE 13 satellite): the old reaper SIGKILLed
+        # stragglers and returned immediately — on a worker timeout the
+        # killed coordinator (worker 0 owns the jax.distributed
+        # coordinator socket) could still hold the port through kernel
+        # teardown, so a back-to-back invocation that drew the same port
+        # from _free_port flaked on bind. Now EVERY exit path reaps every
+        # child (terminate -> bounded wait -> kill -> wait, files closed)
+        # and then blocks until the coordinator port actually binds again.
+        procs = []
+
         def _reap_all() -> None:
+            for q, _, _ in procs:
+                if q.poll() is None:
+                    q.terminate()
+            deadline_t = time.monotonic() + 5.0
+            for q, _, _ in procs:
+                if q.poll() is None:
+                    try:
+                        q.wait(timeout=max(0.1, deadline_t - time.monotonic()))
+                    except subprocess.TimeoutExpired:
+                        pass
             for q, _, _ in procs:
                 if q.poll() is None:
                     q.kill()
@@ -420,12 +414,61 @@ def dryrun_multihost(
                 of.close()
                 ef.close()
 
+        def _await_port_released() -> None:
+            deadline_p = time.monotonic() + 10.0
+            while time.monotonic() < deadline_p:
+                try:
+                    with socket.socket() as s:
+                        # SO_REUSEADDR: the probe must see through the
+                        # TIME_WAIT entries a CLEAN run's closed worker
+                        # connections leave behind — only a socket still
+                        # actively bound (a surviving coordinator) should
+                        # hold the poll, never a 10 s tax on success.
+                        s.setsockopt(
+                            socket.SOL_SOCKET, socket.SO_REUSEADDR, 1
+                        )
+                        s.bind(("127.0.0.1", port))
+                    return
+                except OSError:
+                    time.sleep(0.1)
+            # Diagnostic only: the next invocation draws a fresh port, so
+            # a lingering TIME_WAIT here must not fail THIS run.
+            print(
+                f"dryrun_multihost: coordinator port {port} still bound "
+                "after reap",
+                file=sys.stderr,
+            )
+
         # Poll all workers rather than wait() in order: if a later process
         # crashes, the earlier ones hang in the collective, and a sequential
         # wait would time out with a generic message while the crashed
         # worker's stderr (the actual explanation) is discarded.
         deadline = time.monotonic() + timeout_s
         try:
+            for pid in range(n_processes):
+                out_f = open(os.path.join(logdir, f"w{pid}.out"), "w+")
+                err_f = open(os.path.join(logdir, f"w{pid}.err"), "w+")
+                procs.append(
+                    (
+                        subprocess.Popen(
+                            [
+                                sys.executable,
+                                os.path.abspath(__file__),
+                                _WORKER_FLAG,
+                                coordinator,
+                                str(n_processes),
+                                str(pid),
+                                str(devices_per_proc),
+                            ],
+                            env=env,
+                            stdout=out_f,
+                            stderr=err_f,
+                            cwd=repo_root,
+                        ),
+                        out_f,
+                        err_f,
+                    )
+                )
             while True:
                 states = [q.poll() for q, _, _ in procs]
                 crashed = [i for i, s in enumerate(states) if s not in (None, 0)]
@@ -445,6 +488,7 @@ def dryrun_multihost(
             outs = [_read(of) for _, of, _ in procs]
         finally:
             _reap_all()
+            _await_port_released()
     ok_lines = [line for out in outs for line in out.splitlines() if "dryrun_multihost OK" in line]
     if not ok_lines:
         raise RuntimeError(f"no OK line from workers: {outs}")

@@ -25,6 +25,12 @@ from photon_ml_tpu.serving.bundle import (
 )
 from photon_ml_tpu.utils.faults import DeviceHang
 from photon_ml_tpu.serving.engine import ScoreResult, ServingEngine
+from photon_ml_tpu.serving.reshard import (
+    MeshReshardOrchestrator,
+    ReshardPlan,
+    plan_rebalance,
+    plan_reshard,
+)
 from photon_ml_tpu.serving.lifecycle import (
     BatcherUnhealthy,
     BundleManager,
@@ -47,8 +53,12 @@ __all__ = [
     "DeviceHang",
     "HbmBudgetExceeded",
     "HealthStateMachine",
+    "MeshReshardOrchestrator",
     "MicroBatcher",
     "Overloaded",
+    "ReshardPlan",
+    "plan_rebalance",
+    "plan_reshard",
     "ScoreRequest",
     "ScoreResult",
     "ServingBundle",

@@ -62,6 +62,16 @@ Queue wait, batch size and latency also feed the process metrics
 registry, and each dispatched batch opens a `serving_batch` trace span
 carrying queue-wait and deadline-budget attribution.
 
+Generation changes are transparent here: a bundle hot-swap OR a live
+mesh reshard (serving/reshard.py) flips the engine's state between
+batches — a batch claimed before the flip scores (and drains) on the
+generation it started on, one claimed after scores on the new one, and
+because both generations answer bitwise-identically the batcher never
+has to know a flip happened. During a reshard's pre-warm the engine's
+device mutex briefly serializes dispatches; the added queue wait rides
+the same decaying service-tail estimate deadline enforcement already
+uses.
+
 The flush thread is named `photon-serving-flush` and MUST be joined via
 `close()` (or the engine's close, or context-manager exit) — the test
 suite's thread-leak fixture asserts no such thread survives a test.

@@ -129,6 +129,10 @@ class ShardHealth:
         self.rows_per_shard = int(rows_per_shard)
         self._lock = threading.Lock()
         self._lost: set = set()
+        # Observed per-shard request load (rows resolved into each shard's
+        # range at lookup time, cold starts excluded) — the telemetry a
+        # reshard/rebalance plan reads to name the overloaded shard.
+        self._loads = [0] * self.n_shards
 
     def _check(self, idx: int) -> int:
         idx = int(idx)
@@ -160,6 +164,24 @@ class ShardHealth:
     def any_lost(self) -> bool:
         with self._lock:
             return bool(self._lost)
+
+    @property
+    def loads(self) -> Tuple[int, ...]:
+        with self._lock:
+            return tuple(self._loads)
+
+    def record_loads(self, rows: np.ndarray, unseen_row: int) -> None:
+        """Count one lookup's rows into their shards' load counters
+        (rows at the pinned zero row are cold starts, not shard load)."""
+        rows = np.asarray(rows, np.int64)
+        rows = rows[rows != int(unseen_row)]
+        if not len(rows):
+            return
+        shard_of = np.clip(rows // self.rows_per_shard, 0, self.n_shards - 1)
+        counts = np.bincount(shard_of, minlength=self.n_shards)
+        with self._lock:
+            for i in range(self.n_shards):
+                self._loads[i] += int(counts[i])
 
     def lost_mask(self, rows: np.ndarray) -> np.ndarray:
         """Bool mask over `rows` of those living in a LOST shard."""
@@ -199,7 +221,12 @@ class TwoTierEntityStore:
     released bundle leaks nothing.
     """
 
-    def __init__(self, cold_matrix: np.ndarray, hot_rows: int):
+    def __init__(
+        self,
+        cold_matrix: np.ndarray,
+        hot_rows: int,
+        preload_rows: Optional[Sequence[int]] = None,
+    ):
         self._cold = np.ascontiguousarray(cold_matrix, dtype=np.float32)
         self.n_rows = int(self._cold.shape[0])  # logical E + 1
         self.dim = int(self._cold.shape[1])
@@ -207,13 +234,34 @@ class TwoTierEntityStore:
         self.capacity = cap
         self.zero_slot = cap
         self._lock = threading.Lock()
-        # Deterministic preload: the first `capacity` logical rows (callers
-        # wanting a measured-hotness preload reorder the entity index).
+        # Deterministic preload: the first `capacity` logical rows by
+        # default, or an explicit measured-hotness row list (the hot-row
+        # rebalance path, serving/reshard.py) — deduped, pinned-row
+        # excluded, truncated to capacity; unfilled slots stay empty and
+        # are the first LRU victims.
+        if preload_rows is None:
+            preload = list(range(cap))
+        else:
+            seen: set = set()
+            preload = []
+            for r in preload_rows:
+                r = int(r)
+                if 0 <= r < self.n_rows - 1 and r not in seen:
+                    seen.add(r)
+                    preload.append(r)
+                if len(preload) >= cap:
+                    break
+        self.preloaded_rows: Tuple[int, ...] = tuple(preload)
         hot = np.zeros((cap + 1, self.dim), np.float32)
-        hot[:cap] = self._cold[:cap]
+        if preload:
+            hot[: len(preload)] = self._cold[preload]
         self._hot = jnp.asarray(hot)
-        self._slot_of_row: Dict[int, int] = {r: r for r in range(cap)}
-        self._row_of_slot: List[Optional[int]] = list(range(cap))
+        self._slot_of_row: Dict[int, int] = {
+            r: s for s, r in enumerate(preload)
+        }
+        self._row_of_slot: List[Optional[int]] = list(preload) + [None] * (
+            cap - len(preload)
+        )
         self._tick = 0
         self._last_used = [0] * cap
         self._pending: Dict[int, bool] = {}
@@ -224,6 +272,21 @@ class TwoTierEntityStore:
         self.promotions = 0
         self.evictions = 0
         self.promote_failures = 0
+        # row -> times it was promoted into the hot set: the observed-
+        # hotness signal a rebalance plan consumes (promotion_stats()).
+        self._promote_count: Dict[int, int] = {}
+
+    @property
+    def cold_matrix(self) -> np.ndarray:
+        """The full host-RAM coefficient matrix (the rebalance path
+        restages a new store over the SAME host rows — no copy)."""
+        return self._cold
+
+    def promotion_stats(self) -> Dict[int, int]:
+        """Observed promotions per logical row — the telemetry feeding the
+        hot-row rebalance plan (serving/reshard.plan_rebalance)."""
+        with self._lock:
+            return dict(self._promote_count)
 
     @property
     def hot_nbytes(self) -> int:
@@ -316,6 +379,7 @@ class TwoTierEntityStore:
                     self._slot_of_row[r] = s
                     self._last_used[s] = self._tick
                     self.promotions += 1
+                    self._promote_count[r] = self._promote_count.get(r, 0) + 1
                     idx.append(s)
                     srcs.append(r)
                 if idx:
@@ -336,6 +400,11 @@ class TwoTierEntityStore:
                             self._slot_of_row.pop(r, None)
                             self._row_of_slot[s] = None
                             self.promotions -= 1
+                            n_p = self._promote_count.get(r, 0) - 1
+                            if n_p > 0:
+                                self._promote_count[r] = n_p
+                            else:
+                                self._promote_count.pop(r, None)
                         self.promote_failures += len(idx)
                         faults.COUNTERS.increment(
                             "promote_failures", len(idx)

@@ -226,6 +226,14 @@ class ServingEngine:
         # Condition, not Lock: the hot-swap drain waits on per-state
         # in-flight counts reaching zero (notified by score_batch exits).
         self._lock = threading.Condition()
+        # Multi-device program dispatches serialize on this mutex: two
+        # host threads concurrently launching collective programs over
+        # overlapping device sets (live traffic + a reshard's pre-warm of
+        # the NEW mesh's pjit programs) can deadlock the runtime's
+        # participant rendezvous — the warm path and the score path must
+        # interleave, never overlap. Uncontended cost: one lock hop per
+        # batch.
+        self._device_mutex = threading.Lock()
         self._state = self._build_state(bundle, version=0)
         self.health = HealthStateMachine()
         self.breaker = CircuitBreaker(
@@ -235,6 +243,7 @@ class ServingEngine:
             on_close=lambda: self.health.clear_degraded("circuit_open"),
         )
         self._bundle_manager: Optional[BundleManager] = None
+        self._reshard_orchestrator = None
         self._requests = 0
         self._batches = 0
         self._lookups = 0
@@ -279,6 +288,22 @@ class ServingEngine:
             if self._bundle_manager is None:
                 self._bundle_manager = BundleManager(self)
             return self._bundle_manager
+
+    @property
+    def reshard_orchestrator(self):
+        """The engine's live mesh-elasticity orchestrator (created on
+        first use; serving/reshard.py): shrink/grow the coefficient shard
+        layout or rebalance the two-tier hot set under live traffic,
+        serialized with bundle hot-swaps on the manager's mutex."""
+        manager = self.bundle_manager  # created first: shares its mutex
+        with self._lock:
+            if self._reshard_orchestrator is None:
+                from photon_ml_tpu.serving.reshard import (
+                    MeshReshardOrchestrator,
+                )
+
+                self._reshard_orchestrator = MeshReshardOrchestrator(self)
+            return self._reshard_orchestrator
 
     def batcher(self, **kwargs) -> "MicroBatcher":  # noqa: F821
         """Create a MicroBatcher bound to this engine; `close()` joins it."""
@@ -587,6 +612,11 @@ class ServingEngine:
                 ids = [r.entity_ids.get(c.random_effect_type) for r in requests]
                 rows, _ = c.lookup_rows(ids)
                 sh = getattr(c, "shard_health", None)
+                if sh is not None:
+                    # Per-shard load telemetry (cold starts excluded) —
+                    # what a reshard/rebalance plan reads to name the
+                    # overloaded shard.
+                    sh.record_loads(rows[:n], c.unseen_row)
                 if sh is not None and sh.any_lost:
                     # Shard-loss degradation: rows living in a LOST shard
                     # resolve to the pinned zero row — bitwise FE-only for
@@ -657,6 +687,12 @@ class ServingEngine:
         return np.asarray(host_total), np.asarray(host_means)
 
     def _dispatch_device(
+        self, packed: dict, state: _EngineState
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        with self._device_mutex:
+            return self._dispatch_device_locked(packed, state)
+
+    def _dispatch_device_locked(
         self, packed: dict, state: _EngineState
     ) -> Tuple[np.ndarray, np.ndarray]:
         dev_buffers = {
@@ -860,6 +896,12 @@ class ServingEngine:
         out["bundle_swaps"] = manager.swaps if manager is not None else 0
         out["bundle_swap_rollbacks"] = (
             manager.rollbacks if manager is not None else 0
+        )
+        orch = self._reshard_orchestrator
+        out["bundle_reshards"] = orch.reshards if orch is not None else 0
+        out["bundle_rebalances"] = orch.rebalances if orch is not None else 0
+        out["bundle_reshard_rollbacks"] = (
+            orch.rollbacks if orch is not None else 0
         )
         out["stage_walls_s"] = {
             k: round(v, 4) for k, v in sorted(self.stages.sections.items())

@@ -442,6 +442,14 @@ class BundleManager:
         self._swaps = 0
         self._rollbacks = 0
 
+    @property
+    def mutex(self) -> threading.Lock:
+        """The generation-change mutex. Shared with the live-reshard
+        orchestrator (serving/reshard.py) so a model push and a mesh
+        reshard serialize instead of racing the engine state — both are
+        rare and ordering them is the correct semantics."""
+        return self._swap_lock
+
     # Public counters (read by engine.metrics()).
     @property
     def swaps(self) -> int:

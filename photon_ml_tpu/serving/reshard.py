@@ -1,0 +1,744 @@
+"""Live mesh elasticity: reshard a READY serving engine under traffic.
+
+PR 10 made the mesh shape survivable OFFLINE: elastic per-shard
+checkpoints reassemble onto any device count, and a lost serving shard
+degrades to pinned-zero answers until restaged. This module is the LIVE
+half (the ROADMAP "Elastic mesh" item): take an engine from an n-shard to
+an m-shard coefficient layout — shrink onto survivors after a device
+loss, regrow when capacity returns, or re-place observed-hot rows —
+without failing a single in-flight request. Spark gets this from dynamic
+allocation + shuffle refetch (executors leave and join, lost map output
+re-fetches); our pjit mesh has fixed program shapes, so elasticity is an
+explicit generation flip:
+
+  1. PLAN — `plan_reshard` computes the row-movement plan from the old
+     and new shard maps: which contiguous row segments of each
+     random-effect coefficient matrix land on a different device under
+     the new layout. Only those rows need to cross the host<->device
+     wire; the plan's moved_rows/moved_bytes are the honest accounting
+     the journal records.
+  2. STAGE — every new shard's row block uploads on its own
+     `photon-reshard-stage<k>` worker under the `reshard_stage` fault
+     site with bounded retries (PHOTON_RESHARD_RETRIES, counted in
+     `reshard_retries`), DOUBLE-BUFFERED beside the live generation: the
+     old bundle never stops serving while the new one stages.
+  3. PRE-WARM — every bucket pjit program compiles against the new
+     layout's parameter shapes/meshes before the flip, so live traffic
+     never waits on XLA.
+  4. FLIP — the `reshard_commit` fault site, then the same atomic
+     `_commit_state` the BundleManager hot-swap uses: in-flight batches
+     finish on the generation they started on, the drain waits them out,
+     and only then is the old generation's device state dropped.
+
+Any failure at any step ROLLS BACK: the flip never happened, the old
+generation kept answering, the staged arrays drop their references,
+`reshard_rollbacks` counts it, and the error propagates — zero failed
+requests by construction (tests/test_elastic_mesh.py injects failures at
+every step and proves it).
+
+`plan_rebalance` / `rebalance` close the telemetry->placement loop: the
+`TwoTierEntityStore`'s observed promotion stats name the rows the cold
+tier keeps paying for; the rebalance stages a NEW store whose hot tier
+preloads exactly those rows and flips it through the same
+stage/warm/commit/rollback machinery. Bitwise-neutral by construction —
+hot vs cold placement never changes an answer, only its cost.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.serving.bundle import (
+    ServingBundle,
+    ServingCoordinate,
+    ShardHealth,
+    TwoTierEntityStore,
+)
+from photon_ml_tpu.serving.lifecycle import SwapIncompatible
+from photon_ml_tpu.utils import faults, telemetry
+from photon_ml_tpu.utils.knobs import get_knob
+
+logger = logging.getLogger(__name__)
+
+
+def _reshard_policy():
+    """Bounded retry for per-shard reshard staging: 1 +
+    PHOTON_RESHARD_RETRIES attempts under the standard backoff."""
+    return faults.bounded_policy(int(get_knob("PHOTON_RESHARD_RETRIES")))
+
+
+# ------------------------------------------------------------------ planning
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardSegment:
+    """One contiguous row range of a NEW shard's block: rows
+    [row_lo, row_hi) sourced from old shard `source_shard` (-1 = padding
+    zeros that exist only in the new layout). `moves` says whether the
+    segment's bytes must cross the wire — the old and new owning devices
+    differ."""
+
+    row_lo: int
+    row_hi: int
+    source_shard: int
+    moves: bool
+
+    @property
+    def rows(self) -> int:
+        return self.row_hi - self.row_lo
+
+
+@dataclasses.dataclass(frozen=True)
+class CoordinateReshardPlan:
+    """The row-movement plan for ONE random-effect coordinate."""
+
+    cid: str
+    old_shards: int
+    new_shards: int
+    logical_rows: int  # E + 1 (the pinned zero row included)
+    padded_rows: int  # rows in the NEW layout (mesh multiple)
+    dim: int
+    # Per NEW shard: the ordered segments tiling its row block.
+    segments: Tuple[Tuple[ShardSegment, ...], ...]
+    moved_rows: int
+    moved_bytes: int
+    # Observed per-OLD-shard request load (ShardHealth counters) — names
+    # the overloaded shard for operators reading the plan.
+    shard_loads: Tuple[int, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class ReshardPlan:
+    old_shards: int
+    new_shards: int
+    coordinates: Tuple[CoordinateReshardPlan, ...]
+
+    @property
+    def moved_rows(self) -> int:
+        return sum(c.moved_rows for c in self.coordinates)
+
+    @property
+    def moved_bytes(self) -> int:
+        return sum(c.moved_bytes for c in self.coordinates)
+
+
+def _coord_devices(coord: ServingCoordinate) -> List[object]:
+    """The per-shard device list of a coordinate's CURRENT layout."""
+    if coord.mesh is not None:
+        return list(np.asarray(coord.mesh.devices).flat)
+    try:
+        return [sorted(coord.params.devices(), key=str)[0]]
+    except Exception:  # noqa: BLE001 - uncommitted arrays: any device
+        return [jax.devices()[0]]
+
+
+def _mesh_devices(new_mesh) -> List[object]:
+    if new_mesh is None:
+        return [jax.devices()[0]]
+    return list(np.asarray(new_mesh.devices).flat)
+
+
+def plan_coordinate_reshard(
+    coord: ServingCoordinate, new_mesh
+) -> CoordinateReshardPlan:
+    """Compute one coordinate's row movement from its current shard map to
+    the `new_mesh` layout (None = replicated single-shard). A row MOVES
+    when the device owning it under the new layout differs from the one
+    holding it now; padding rows (at or past the logical E + 1) are zeros
+    on both sides and never move."""
+    from photon_ml_tpu.parallel.mesh import pad_rows_for_mesh
+
+    if coord.shard_health is None:
+        raise ValueError(
+            f"coordinate {coord.cid!r} has no device-resident shard "
+            "tracking (fixed-effect or two-tier coordinate)"
+        )
+    old_devs = _coord_devices(coord)
+    new_devs = _mesh_devices(new_mesh)
+    n_old, n_new = len(old_devs), len(new_devs)
+    logical = coord.unseen_row + 1
+    rows_per_old = coord.shard_health.rows_per_shard
+    padded = (
+        pad_rows_for_mesh(logical, new_mesh) if new_mesh is not None else logical
+    )
+    rows_per_new = padded // n_new
+    old_rows_total = n_old * rows_per_old
+    segments: List[Tuple[ShardSegment, ...]] = []
+    moved = 0
+    for k in range(n_new):
+        lo, hi = k * rows_per_new, (k + 1) * rows_per_new
+        segs: List[ShardSegment] = []
+        r = lo
+        while r < hi:
+            if r >= old_rows_total:
+                segs.append(ShardSegment(r, hi, -1, False))
+                break
+            j = r // rows_per_old
+            seg_hi = min(hi, (j + 1) * rows_per_old, old_rows_total)
+            moves = old_devs[j] is not new_devs[k]
+            segs.append(ShardSegment(r, seg_hi, j, moves))
+            if moves:
+                # Only LOGICAL rows move; old-layout padding is zeros.
+                moved += max(0, min(seg_hi, logical) - min(r, logical))
+            r = seg_hi
+        segments.append(tuple(segs))
+    return CoordinateReshardPlan(
+        cid=coord.cid,
+        old_shards=n_old,
+        new_shards=n_new,
+        logical_rows=logical,
+        padded_rows=padded,
+        dim=coord.dim,
+        segments=tuple(segments),
+        moved_rows=moved,
+        moved_bytes=moved * coord.dim * 4,
+        shard_loads=coord.shard_health.loads,
+    )
+
+
+def plan_reshard(bundle: ServingBundle, new_mesh) -> ReshardPlan:
+    """The bundle-wide row-movement plan: every shard-tracked
+    random-effect coordinate (replicated or entity-sharded) replans onto
+    `new_mesh`; fixed-effect planes and two-tier stores are not
+    mesh-sharded and carry over untouched."""
+    plans = [
+        plan_coordinate_reshard(c, new_mesh)
+        for c in bundle.coordinates.values()
+        if c.is_random_effect and c.store is None and c.shard_health is not None
+    ]
+    if not plans:
+        raise ValueError(
+            "bundle has no shard-tracked random-effect coordinate to "
+            "reshard (two-tier stores rebalance instead; see rebalance())"
+        )
+    return ReshardPlan(
+        old_shards=max(p.old_shards for p in plans),
+        new_shards=plans[0].new_shards,
+        coordinates=tuple(plans),
+    )
+
+
+def plan_rebalance(
+    coord: ServingCoordinate, *, min_promotions: Optional[int] = None
+) -> Tuple[int, ...]:
+    """Hot rows a rebalance should preload, from the two-tier store's
+    observed promotion stats: rows promoted at least
+    `min_promotions` times (PHOTON_REBALANCE_MIN_PROMOTIONS), hottest
+    first, truncated to the hot-set capacity. Empty = nothing earned a
+    move yet."""
+    store = coord.store
+    if store is None:
+        raise ValueError(
+            f"coordinate {coord.cid!r} has no two-tier store — only "
+            "two-tier coordinates carry the promotion stats a rebalance "
+            "plan reads"
+        )
+    floor = (
+        int(get_knob("PHOTON_REBALANCE_MIN_PROMOTIONS"))
+        if min_promotions is None
+        else int(min_promotions)
+    )
+    stats = store.promotion_stats()
+    hot = sorted(
+        (r for r, n in stats.items() if n >= max(1, floor)),
+        key=lambda r: (-stats[r], r),
+    )
+    return tuple(hot[: store.capacity])
+
+
+# ------------------------------------------------------------- orchestrator
+
+
+class MeshReshardOrchestrator:
+    """Takes a live ServingEngine between mesh layouts with the
+    BundleManager's staging/flip/rollback discipline extended to
+    mesh-shape changes. One orchestrator per engine (created lazily via
+    `engine.reshard_orchestrator`); reshard/rebalance serialize on the
+    same mutex as bundle hot-swaps, so a push and a reshard order
+    cleanly instead of racing the engine state."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        self._reshards = 0
+        self._rebalances = 0
+        self._rollbacks = 0
+
+    # Public counters (read by engine.metrics()).
+    @property
+    def reshards(self) -> int:
+        return self._reshards
+
+    @property
+    def rebalances(self) -> int:
+        return self._rebalances
+
+    @property
+    def rollbacks(self) -> int:
+        return self._rollbacks
+
+    # ------------------------------------------------------------- reshard
+
+    def reshard(
+        self,
+        new_mesh=None,
+        *,
+        drain_timeout_s: float = 30.0,
+        plan: Optional[ReshardPlan] = None,
+    ) -> Dict[str, object]:
+        """Move the engine's shard-tracked coefficient matrices onto
+        `new_mesh` (None = replicated single-shard) under live traffic.
+
+        Sequence: plan -> `reshard_start` journal event -> per-shard
+        staged uploads of each new shard's row block (parallel
+        `photon-reshard-stage<k>` workers, `reshard_stage` fault site,
+        PHOTON_RESHARD_RETRIES bounded retries) double-buffered beside
+        the serving generation -> compatibility check -> pre-warm every
+        bucket program for the new layout -> `reshard_commit` fault site
+        -> atomic flip -> drain in-flight batches -> retire the old
+        generation. ANY failure before the flip rolls back: the old
+        generation never stopped serving, staged arrays are dropped,
+        `reshard_rollbacks` counts it, `reshard_rollback` journals it,
+        and the error propagates."""
+        engine = self.engine
+        with engine.bundle_manager.mutex:
+            old_state = engine._state
+            old_bundle = old_state.bundle
+            if plan is None:
+                plan = plan_reshard(old_bundle, new_mesh)
+            telemetry.emit_event(
+                "reshard_start",
+                old_shards=plan.old_shards,
+                new_shards=plan.new_shards,
+                moved_rows=plan.moved_rows,
+                moved_bytes=plan.moved_bytes,
+            )
+            plan_by_cid = {p.cid: p for p in plan.coordinates}
+
+            def build_new_coords():
+                staged_bytes = 0
+                new_coords: Dict[str, ServingCoordinate] = {}
+                for cid in old_bundle.coordinate_ids:
+                    c = old_bundle.coordinates[cid]
+                    cplan = plan_by_cid.get(cid)
+                    if cplan is None:
+                        # FE planes and two-tier stores are not
+                        # mesh-sharded: the SAME coordinate object serves
+                        # both generations (never released at retire).
+                        new_coords[cid] = c
+                        continue
+                    params, nbytes = self._stage_resharded_params(
+                        c, cplan, new_mesh
+                    )
+                    staged_bytes += nbytes
+                    new_coords[cid] = ServingCoordinate(
+                        cid,
+                        c.shard,
+                        params,
+                        norm=c.norm,
+                        random_effect_type=c.random_effect_type,
+                        entity_index=c.entity_index,
+                        mesh=new_mesh if cplan.new_shards > 1 else None,
+                        logical_rows=cplan.logical_rows,
+                        shard_health=ShardHealth(
+                            cplan.new_shards,
+                            cplan.padded_rows // cplan.new_shards,
+                        ),
+                    )
+                return new_coords, staged_bytes
+
+            return self._stage_and_commit(
+                old_state,
+                plan,
+                build_new_coords,
+                close_stores=(),
+                rebalance=False,
+                drain_timeout_s=drain_timeout_s,
+            )
+
+    def _stage_resharded_params(
+        self, coord: ServingCoordinate, cplan: CoordinateReshardPlan, new_mesh
+    ):
+        """Stage one coordinate's matrix in the NEW layout, double-buffered
+        beside the live generation.
+
+        The old matrix is read PER SURVIVING SHARD BUFFER
+        (`addressable_shards` — plain device->host copies, exactly how the
+        elastic checkpoint reads a sharded matrix), deliberately never
+        through a cross-device slice/gather program: staging runs beside
+        live traffic, and a second thread launching collective programs
+        over the same devices can deadlock the runtime's participant
+        rendezvous (the same hazard the engine's device mutex closes for
+        the pre-warm). Each new shard's row block then uploads to its
+        device on a `photon-reshard-stage<k>` worker under the
+        `reshard_stage` fault site + bounded retries (single-device
+        transfers — no collective in the whole staging phase). The WIRE
+        accounting is the plan's: only segments whose owning device
+        changes count as restaged bytes — a same-device segment's hop is
+        device-local. Returns (new params array, bytes moved across the
+        wire)."""
+        from photon_ml_tpu.parallel.mesh import matrix_row_sharding
+
+        new_devs = _mesh_devices(new_mesh)
+        n_new = cplan.new_shards
+        rows_per_new = cplan.padded_rows // n_new
+        dim = cplan.dim
+        logical = cplan.logical_rows
+        old_rows = int(coord.params.shape[0])
+        # Host bounce of the old matrix, assembled from per-shard device
+        # buffers (the surviving replicas), truncated to the new layout's
+        # rows — the same transient envelope `_load_sharded_model` pays.
+        host = np.zeros((max(cplan.padded_rows, old_rows), dim), np.float32)
+        if coord.mesh is not None:
+            for s in coord.params.addressable_shards:
+                start = int(s.index[0].start or 0)
+                block = np.asarray(s.data, np.float32)
+                host[start : start + block.shape[0]] = block
+        else:
+            host[:old_rows] = np.asarray(coord.params, np.float32)
+        host[logical:] = 0.0  # old-layout padding never leaks forward
+        policy = _reshard_policy()
+        bufs: List[Optional[jax.Array]] = [None] * n_new
+        errors: List[BaseException] = []
+        err_lock = threading.Lock()
+        span_h = telemetry.span_handoff()
+
+        def _stage_one(k: int) -> None:
+            try:
+                lo = k * rows_per_new
+                hi = lo + rows_per_new
+                block = host[lo:hi]
+
+                def attempt():
+                    faults.fault_point("reshard_stage")
+                    buf = jax.device_put(jnp.asarray(block), new_devs[k])
+                    jax.block_until_ready(buf)
+                    return buf
+
+                with telemetry.adopt_span(span_h), telemetry.span(
+                    "reshard_stage", coordinate=cplan.cid, shard=k
+                ):
+                    bufs[k] = faults.retry(
+                        attempt,
+                        policy,
+                        label=f"reshard staging {cplan.cid} shard {k}",
+                        counter="reshard_retries",
+                    )
+            except BaseException as exc:  # noqa: BLE001 - joined below
+                with err_lock:
+                    errors.append(exc)
+
+        threads = [
+            threading.Thread(
+                target=_stage_one,
+                args=(k,),
+                name=f"photon-reshard-stage{k}",
+                daemon=True,
+            )
+            for k in range(n_new)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise errors[0]
+        if n_new == 1:
+            params = bufs[0]
+        else:
+            params = jax.make_array_from_single_device_arrays(
+                (cplan.padded_rows, dim),
+                matrix_row_sharding(new_mesh),
+                bufs,
+            )
+        # The wire accounting is the PLAN's — one source of truth for the
+        # moved-segment arithmetic (plan_coordinate_reshard), never a
+        # second copy here that could drift.
+        return params, cplan.moved_bytes
+
+    # ----------------------------------------------------------- rebalance
+
+    def rebalance(
+        self,
+        cid: str,
+        *,
+        min_promotions: Optional[int] = None,
+        drain_timeout_s: float = 30.0,
+    ) -> Dict[str, object]:
+        """Re-place a two-tier coordinate's hot set from its OBSERVED
+        promotion stats: rows the cold tier kept promoting become the
+        new store's preload, staged and flipped through the same
+        double-buffer/commit/rollback machinery as a mesh reshard
+        (shard count unchanged — the movement is tier placement).
+        Bitwise-neutral: hot vs cold placement never changes a score.
+        Returns {"rebalanced_rows": 0, ...} without flipping anything
+        when no row has earned a move yet."""
+        engine = self.engine
+        with engine.bundle_manager.mutex:
+            old_state = engine._state
+            old_bundle = old_state.bundle
+            c = old_bundle.coordinates[cid]
+            hot_rows = plan_rebalance(c, min_promotions=min_promotions)
+            old_store = c.store
+            if not hot_rows:
+                return {
+                    "rebalanced_rows": 0,
+                    "version": old_state.version,
+                    "committed": False,
+                }
+            moved_bytes = len(hot_rows) * c.dim * 4
+            telemetry.emit_event(
+                "reshard_start",
+                old_shards=1,
+                new_shards=1,
+                moved_rows=len(hot_rows),
+                moved_bytes=moved_bytes,
+            )
+            staged_stores: List[TwoTierEntityStore] = []
+
+            def build_new_coords():
+                def attempt():
+                    faults.fault_point("reshard_stage")
+                    return TwoTierEntityStore(
+                        old_store.cold_matrix,
+                        old_store.capacity,
+                        preload_rows=hot_rows,
+                    )
+
+                with telemetry.span(
+                    "reshard_stage", coordinate=cid, shard=0
+                ):
+                    new_store = faults.retry(
+                        attempt,
+                        _reshard_policy(),
+                        label=f"rebalance staging {cid}",
+                        counter="reshard_retries",
+                    )
+                staged_stores.append(new_store)
+                new_coords = dict(old_bundle.coordinates)
+                new_coords[cid] = ServingCoordinate(
+                    cid,
+                    c.shard,
+                    new_store.snapshot(),
+                    norm=c.norm,
+                    random_effect_type=c.random_effect_type,
+                    entity_index=c.entity_index,
+                    logical_rows=c.logical_rows,
+                    store=new_store,
+                )
+                return new_coords, moved_bytes
+
+            info = self._stage_and_commit(
+                old_state,
+                None,
+                build_new_coords,
+                close_stores=(old_store,),
+                rebalance=True,
+                drain_timeout_s=drain_timeout_s,
+                on_rollback=lambda: [s.close() for s in staged_stores],
+            )
+            faults.COUNTERS.increment("rebalanced_rows", len(hot_rows))
+            info["rebalanced_rows"] = len(hot_rows)
+            info["preloaded_rows"] = list(staged_stores[0].preloaded_rows)
+            return info
+
+    # ------------------------------------------------------------ internals
+
+    def _stage_and_commit(
+        self,
+        old_state,
+        plan,
+        build_new_coords,
+        *,
+        close_stores: Sequence[TwoTierEntityStore],
+        rebalance: bool,
+        drain_timeout_s: float,
+        on_rollback=None,
+    ) -> Dict[str, object]:
+        """The ONE staging/flip/rollback sequence both reshard() and
+        rebalance() run (a fix to the flip discipline lands once):
+        `build_new_coords()` stages the new generation's coordinates
+        double-buffered and returns (coords, restaged_bytes); then
+        compatibility check -> pre-warm (compile-count delta feeds the
+        warmup baseline) -> `reshard_commit` fault site -> atomic flip ->
+        drain -> retire. ANY failure before the flip runs `on_rollback`
+        (close staged stores), counts/journals the rollback, and
+        re-raises — the old generation never stopped serving."""
+        engine = self.engine
+        old_bundle = old_state.bundle
+        t0 = time.perf_counter()
+        try:
+            new_coords, restaged_bytes = build_new_coords()
+            new_bundle = ServingBundle(
+                task=old_bundle.task,
+                coordinates=new_coords,
+                index_maps=old_bundle.index_maps,
+                upload_bytes=restaged_bytes,
+                upload_s=time.perf_counter() - t0,
+            )
+            new_state = engine._build_state(
+                new_bundle, version=old_state.version + 1
+            )
+            self._check_compatible(old_state, new_state)
+            compiles_before = engine.compiles
+            engine._warm_state(new_state)
+            staging_compiles = engine.compiles - compiles_before
+            faults.fault_point("reshard_commit")
+            stage_s = time.perf_counter() - t0
+        except BaseException as exc:
+            if on_rollback is not None:
+                try:
+                    on_rollback()
+                except Exception:  # noqa: BLE001 - rollback best-effort
+                    pass
+            self._roll_back(plan, exc)
+            raise
+        return self._commit(
+            old_state,
+            new_state,
+            plan,
+            staging_compiles=staging_compiles,
+            stage_s=stage_s,
+            restaged_bytes=restaged_bytes,
+            drain_timeout_s=drain_timeout_s,
+            close_stores=close_stores,
+            rebalance=rebalance,
+        )
+
+    def _roll_back(self, plan, exc: BaseException) -> None:
+        self._rollbacks += 1
+        faults.COUNTERS.increment("reshard_rollbacks")
+        telemetry.emit_event(
+            "reshard_rollback",
+            old_shards=plan.old_shards if plan is not None else 1,
+            new_shards=plan.new_shards if plan is not None else 1,
+            reason=repr(exc),
+        )
+        logger.warning(
+            "live reshard rolled back (%s); the old generation never "
+            "stopped serving",
+            exc,
+        )
+
+    def _commit(
+        self,
+        old_state,
+        new_state,
+        plan,
+        *,
+        staging_compiles: int,
+        stage_s: float,
+        restaged_bytes: int,
+        drain_timeout_s: float,
+        close_stores: Sequence[TwoTierEntityStore],
+        rebalance: bool = False,
+    ) -> Dict[str, object]:
+        engine = self.engine
+        engine._commit_state(new_state, baseline_bump=staging_compiles)
+        if rebalance:
+            self._rebalances += 1
+        else:
+            self._reshards += 1
+        telemetry.emit_event(
+            "reshard_commit",
+            old_shards=plan.old_shards if plan is not None else 1,
+            new_shards=plan.new_shards if plan is not None else 1,
+            version=new_state.version,
+            restaged_bytes=restaged_bytes,
+        )
+        telemetry.METRICS.set_gauge(
+            "serving_bundle_generation", new_state.version
+        )
+        drained = engine._drain_state(old_state, timeout_s=drain_timeout_s)
+        if not drained:
+            logger.warning(
+                "old generation %d still has in-flight batches after "
+                "%.1fs; leaving its device state allocated",
+                old_state.version,
+                drain_timeout_s,
+            )
+        else:
+            self._retire(old_state.bundle, new_state.bundle, close_stores)
+        logger.info(
+            "live %s committed: generation %d -> %d (%d bytes restaged "
+            "in %.3fs)",
+            "rebalance" if rebalance else "reshard",
+            old_state.version,
+            new_state.version,
+            restaged_bytes,
+            stage_s,
+        )
+        return {
+            "version": new_state.version,
+            "previous_version": old_state.version,
+            "old_shards": plan.old_shards if plan is not None else 1,
+            "new_shards": plan.new_shards if plan is not None else 1,
+            "moved_rows": plan.moved_rows if plan is not None else 0,
+            "moved_bytes": plan.moved_bytes if plan is not None else 0,
+            "restaged_bytes": int(restaged_bytes),
+            "stage_s": round(stage_s, 4),
+            "old_released": bool(drained),
+            "committed": True,
+        }
+
+    @staticmethod
+    def _retire(
+        old_bundle: ServingBundle,
+        new_bundle: ServingBundle,
+        close_stores,
+    ) -> None:
+        """Retire the OLD generation by turning its bundle OBJECT into a
+        live view of the new one — NOT by `release()`-gutting it: callers
+        that captured the bundle at load time keep working against the
+        CURRENT generation (the CLI's lazy replay stream encodes requests
+        through that handle mid-replay, and its teardown `release()` must
+        close the LIVE generation's stores, not a husk). Coordinates the
+        new generation reuses (FE planes, untouched two-tier stores)
+        carry over untouched; only explicitly replaced stores close, and
+        replaced coefficient matrices free when their last reference (the
+        old generation's former dict) drops here."""
+        for store in close_stores:
+            store.close()
+        old_bundle.coordinates = dict(new_bundle.coordinates)
+        old_bundle.index_maps = new_bundle.index_maps
+        old_bundle.upload_bytes = new_bundle.upload_bytes
+        old_bundle.upload_s = new_bundle.upload_s
+
+    @staticmethod
+    def _check_compatible(old_state, new_state) -> None:
+        """A reshard may change each coordinate's STORAGE MODE (replicated
+        <-> entity-sharded, different mesh) — that is the whole point —
+        but never the coordinate structure the request path is built
+        around: ids and order, feature shards, and feature dims must
+        match, and a coordinate cannot change between fixed-effect /
+        two-tier and shard-tracked kinds mid-flip."""
+        if [c.cid for c in old_state.coords] != [
+            c.cid for c in new_state.coords
+        ]:
+            raise SwapIncompatible(
+                "resharded bundle's coordinate ids differ from the engine's"
+            )
+        if old_state.coord_shards != new_state.coord_shards:
+            raise SwapIncompatible(
+                "resharded bundle maps coordinates to different feature "
+                "shards"
+            )
+        if old_state.shard_dims != new_state.shard_dims:
+            raise SwapIncompatible(
+                f"resharded bundle's shard dims {new_state.shard_dims} "
+                f"differ from the engine's {old_state.shard_dims}"
+            )
+        for ok, nk in zip(old_state.kinds, new_state.kinds):
+            mesh_kinds = ("re", "re_sh")
+            if ok != nk and not (ok in mesh_kinds and nk in mesh_kinds):
+                raise SwapIncompatible(
+                    f"reshard cannot change a coordinate's storage kind "
+                    f"{ok} -> {nk} (only replicated <-> entity-sharded)"
+                )

@@ -122,15 +122,20 @@ SERVING_CLEAN_ZERO_KEYS = (
 
 # Robustness events of the pod-scale mesh failure domain (ISSUE 10) that
 # must be ZERO on a clean run: collective re-dispatches, per-shard
-# staging retries, failed two-tier promotions, and watchdog trips. The
-# bench clean-run contract reads these from faults.COUNTERS; fit_timing
-# ("robustness") and serving-summary.json ("robustness_counters") always
-# carry all four keys so absence is loud.
+# staging retries, failed two-tier promotions, and watchdog trips — plus
+# the live-elasticity events (ISSUE 13): mesh losses recovered mid-fit
+# and reshard staging retries/rollbacks. The bench clean-run contract
+# reads these from faults.COUNTERS; fit_timing ("robustness") and
+# serving-summary.json ("robustness_counters") always carry every key so
+# absence is loud.
 ROBUSTNESS_CLEAN_ZERO_KEYS = (
     "collective_retries",
     "shard_upload_retries",
     "promote_failures",
     "watchdog_trips",
+    "mesh_losses",
+    "reshard_retries",
+    "reshard_rollbacks",
 )
 
 # Top-level serving-summary.json keys written by cli/serve.py.
@@ -164,6 +169,33 @@ CHAOS_MULTICHIP_SECTION_KEYS = (
     "post_recovery_bitwise",
     "shard_loss_fallbacks",
     "restaged_bytes",
+)
+
+# bench.py elastic_mesh section (ISSUE 13): the live-elasticity
+# certificate — an 8-shard serving engine shrinks to 4 and regrows to 8
+# UNDER LIVE REPLAY with zero failed requests and post-reshard scores
+# bitwise-equal to a cold start at the new shape; a hot-row rebalance
+# driven by observed promotion stats flips the same way; and a mid-fit
+# mesh loss resumes bitwise-equal to the uninterrupted fit at the cost of
+# exactly one repeated sweep. The clean (un-injected) phases must leave
+# every reshard/mesh-loss counter at zero.
+ELASTIC_MESH_SECTION_KEYS = (
+    "n_devices",
+    "shrink_to",
+    "moved_rows_shrink",
+    "moved_bytes_shrink",
+    "answered_during_shrink",
+    "answered_during_regrow",
+    "failed_requests",
+    "shrink_bitwise_vs_cold",
+    "regrow_bitwise_vs_cold",
+    "rebalanced_rows",
+    "rebalance_bitwise",
+    "cold_tier_hits_before_rebalance",
+    "cold_tier_hits_after_rebalance",
+    "midfit_repeated_sweeps",
+    "midfit_bitwise_vs_uninterrupted",
+    "clean_counters_zero",
 )
 
 # -------------------------------------------------------------------- sweep
@@ -228,6 +260,13 @@ JOURNAL_EVENT_SCHEMAS = {
     "watchdog_trip": ("label",),
     "shard_loss": ("coordinate", "shard_index"),
     "shard_restage": ("coordinate", "shard_index", "bytes"),
+    # -- live mesh elasticity (serving/reshard.py + elastic resume) --
+    "reshard_start": ("old_shards", "new_shards", "moved_rows",
+                      "moved_bytes"),
+    "reshard_commit": ("old_shards", "new_shards", "version",
+                       "restaged_bytes"),
+    "reshard_rollback": ("old_shards", "new_shards", "reason"),
+    "mesh_loss": ("iteration", "coordinate", "surviving_devices", "source"),
     # -- hyperparameter sweep lifecycle (SweepExecutor / cli/tune.py) --
     "trial_start": ("round", "trial", "mode"),
     "trial_finish": ("round", "trial", "mode", "seconds", "value",
@@ -267,6 +306,7 @@ ALL_CONTRACTS = {
     "ROBUSTNESS_CLEAN_ZERO_KEYS": ROBUSTNESS_CLEAN_ZERO_KEYS,
     "SERVING_SUMMARY_KEYS": SERVING_SUMMARY_KEYS,
     "CHAOS_MULTICHIP_SECTION_KEYS": CHAOS_MULTICHIP_SECTION_KEYS,
+    "ELASTIC_MESH_SECTION_KEYS": ELASTIC_MESH_SECTION_KEYS,
     "SWEEP_SECTION_KEYS": SWEEP_SECTION_KEYS,
     "SWEEP_TRIAL_KEYS": SWEEP_TRIAL_KEYS,
     "JOURNAL_LINE_KEYS": JOURNAL_LINE_KEYS,

@@ -42,9 +42,10 @@ module is the shared machinery:
   `serving_flush_thread_failures`, `quarantined_blocks`, and the pod-scale
   mesh counters `collective_retries` / `collective_fallbacks` /
   `shard_upload_retries` / `promote_failures` / `watchdog_trips` /
-  `shard_loss_fallbacks` — the four in
-  contracts.ROBUSTNESS_CLEAN_ZERO_KEYS are additionally enforced all-zero
-  by the bench clean-run contract). Zero on a clean
+  `shard_loss_fallbacks` and the elastic-mesh counters `mesh_losses` /
+  `reshard_retries` / `reshard_rollbacks` / `rebalanced_rows` — the ones
+  in contracts.ROBUSTNESS_CLEAN_ZERO_KEYS are additionally enforced
+  all-zero by the bench clean-run contract). Zero on a clean
   run by construction, so a nonzero
   value in a bench artifact (bench.py e2e_from_disk) is a loud robustness
   regression signal, and tests assert exact counts.
@@ -106,6 +107,17 @@ SITE_DESCRIPTIONS = {
     "shard restage after loss)",
     "promote": "two-tier serving store promotion (cold row -> HBM hot set)",
     "resume_load": "checkpoint model/shard file reads on resume",
+    # Live mesh elasticity (ISSUE 13): resharding a READY serving engine
+    # between mesh shapes under traffic, and losing part of the training
+    # mesh mid-fit. Reshard staging/commit failures roll back to the old
+    # generation (zero failed requests); a mesh loss is caught at the
+    # coordinate-descent sweep boundary and costs one repeated sweep.
+    "mesh_loss": "device-mesh loss during a sharded coordinate update "
+    "(sweep-boundary elastic resume)",
+    "reshard_stage": "live serving reshard staging (per-shard upload of "
+    "moved coefficient rows)",
+    "reshard_commit": "live serving reshard commit (the atomic generation "
+    "flip between batches)",
 }
 KNOWN_SITES = tuple(SITE_DESCRIPTIONS)
 
@@ -121,6 +133,22 @@ class DeviceHang(RuntimeError):
     a bounded re-dispatch (then the per-bucket fallback), and the serving
     breaker counts it toward opening — the 'stuck forever on a bad device'
     hole becomes a typed, counted degradation instead of a silent stall."""
+
+
+class MeshLoss(RuntimeError):
+    """Part of the device mesh is GONE mid-fit (a dead shard group, a host
+    dropping out of the pod) — the fault no in-place retry can fix, because
+    re-dispatching onto the same mesh re-hits the same dead devices.
+
+    Deliberately NOT in the transient set: `retry()` must never spin on it.
+    The handler lives one level up, at the coordinate-descent sweep
+    boundary (game/coordinate_descent.py): roll the interrupted sweep back,
+    re-form the mesh from the surviving devices, reassemble the coordinate
+    state in memory (the elastic checkpoint's any-shape reassembly without
+    the filesystem round trip), and repeat the sweep — a mesh shrink costs
+    one sweep, not the job. Raised by the armed `mesh_loss` fault site and
+    by watchdog-escalated DeviceHang / exhausted device-shaped failures on
+    an entity-sharded coordinate."""
 
 
 # --------------------------------------------------------------- fault plans

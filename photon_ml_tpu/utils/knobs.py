@@ -354,6 +354,20 @@ _register(
     "before the failure surfaces (hot-swap rollback / shard stays "
     "degraded).",
 )
+_register(
+    "PHOTON_RESHARD_RETRIES",
+    int,
+    2,
+    "Extra attempts a failed per-shard upload gets during a live mesh "
+    "reshard before the whole reshard rolls back to the old generation.",
+)
+_register(
+    "PHOTON_REBALANCE_MIN_PROMOTIONS",
+    int,
+    2,
+    "Observed two-tier promotions a coefficient row needs before a "
+    "hot-row rebalance plan counts it as hot (serving/reshard.py).",
+)
 
 # ------------------------------------------------------------------- serving
 _register(

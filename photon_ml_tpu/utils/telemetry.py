@@ -119,6 +119,10 @@ METRIC_DESCRIPTIONS = {
     "promote_failures": "failed two-tier hot-set promotions",
     "watchdog_trips": "device dispatches past the watchdog deadline",
     "shard_loss_fallbacks": "requests answered pinned-zero for a lost shard",
+    "mesh_losses": "mesh-loss faults recovered at a sweep boundary",
+    "reshard_retries": "per-shard staging retries during a live reshard",
+    "reshard_rollbacks": "live mesh reshards rolled back to the old generation",
+    "rebalanced_rows": "hot coefficient rows re-placed by a rebalance plan",
     # -- histograms (fixed log-spaced buckets, mergeable) --
     "serving_latency_ms": "per-request wall latency through the batcher",
     "serving_queue_wait_ms": "submit-to-claim queue wait per request",

@@ -62,6 +62,12 @@ def pytest_configure(config):
         "workers over virtual CPU devices); always slow-marked — tier-1 "
         "covers the sharded code paths on the single-process 8-device mesh",
     )
+    config.addinivalue_line(
+        "markers",
+        "elastic: live mesh elasticity (reshard under traffic, mid-fit "
+        "mesh-loss resume); the multi-device reshard drills are slow+"
+        "elastic and out of tier-1",
+    )
     _assert_fault_sites_registered()
 
 
@@ -126,7 +132,11 @@ def _failure_domain_hygiene(monkeypatch):
     * no `photon-watchdog` monitor outlives the test — a Watchdog is
       joined by its owner's close() (the serving engine, the sweep's
       per-train instance); a survivor means deadlines kept arming against
-      a torn-down dispatcher.
+      a torn-down dispatcher;
+    * no `photon-reshard` staging worker outlives the test — the live
+      reshard orchestrator joins its per-shard upload workers before the
+      generation flip; a survivor means staged uploads kept running
+      against a rolled-back (or torn-down) generation.
     """
     from photon_ml_tpu.utils import faults, telemetry
 
@@ -140,6 +150,8 @@ def _failure_domain_hygiene(monkeypatch):
         "PHOTON_WATCHDOG_MS",
         "PHOTON_COLLECTIVE_RETRIES",
         "PHOTON_SHARD_UPLOAD_RETRIES",
+        "PHOTON_RESHARD_RETRIES",
+        "PHOTON_REBALANCE_MIN_PROMOTIONS",
     ):
         monkeypatch.delenv(var, raising=False)
     faults.clear()
@@ -159,6 +171,7 @@ def _failure_domain_hygiene(monkeypatch):
                     "photon-serving-promote",
                     "photon-ckpt-write",
                     "photon-watchdog",
+                    "photon-reshard",
                 )
             )
             and t.is_alive()

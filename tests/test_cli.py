@@ -126,7 +126,7 @@ def _write_glmix_avro(path, seed, n, n_entities=8):
 
 
 class TestDriversEndToEnd:
-    def test_train_then_score(self, tmp_path):
+    def test_train_then_score(self, tmp_path, monkeypatch):
         train_avro = str(tmp_path / "train.avro")
         val_avro = str(tmp_path / "val.avro")
         _write_glmix_avro(train_avro, 0, 400)
@@ -202,6 +202,13 @@ class TestDriversEndToEnd:
         # per-row reduction ranges differ).
         from photon_ml_tpu.cli import serve as serve_cli
         serve_out = str(tmp_path / "served")
+        # Small replay windows force the MULTI-window path, so the
+        # --reshard-to drill below runs on its background worker WHILE
+        # later windows stream — the generation flips mid-replay and the
+        # lazily-encoding request iterator must keep working across it
+        # (the retired bundle handle stays a live view of the new
+        # generation).
+        monkeypatch.setattr(serve_cli, "REPLAY_WINDOW", 32)
         serve_cli.main([
             "--model-input-directory", best,
             "--requests", val_avro,
@@ -210,6 +217,7 @@ class TestDriversEndToEnd:
             "name=globalShard,feature.bags=features,intercept=true",
             "--max-batch", "32",
             "--max-wait-ms", "1",
+            "--reshard-to", "4",  # live elasticity drill mid-replay
         ])
         served = {
             it.uid: it.prediction_score
@@ -229,6 +237,15 @@ class TestDriversEndToEnd:
         assert m["degraded_batches"] == 0
         # Validation entities were all seen at training time: no cold starts.
         assert m["cold_start_fraction"] == 0.0
+        # The --reshard-to drill committed (replicated -> 4 entity shards)
+        # with zero failed requests — every per-uid score above already
+        # matched the offline driver across the generation flip.
+        assert ssummary["reshard"]["committed"] is True
+        assert ssummary["reshard"]["new_shards"] == 4
+        assert ssummary["failed_requests"] == 0
+        # The whole stream was encoded and scored ACROSS the flip — no
+        # record silently dropped as malformed by a gutted encoder handle.
+        assert ssummary["malformed_records"] == 0
 
         # JSON-lines replay: named features resolved through the model's
         # index maps.

@@ -450,6 +450,111 @@ class TestShardLossDegradation:
             eng.restage_shard("per-e", 0)
             assert np.array_equal(_scores(eng.score_batch(reqs)), ref)
 
+    def test_two_coordinate_shard_loss_is_isolated(self, rng):
+        """ISSUE 13 satellite: per-coordinate ShardHealth isolation with
+        TWO random-effect coordinates — losing cid_a's shard 0 degrades
+        ONLY cid_a's rows in that range (cid_b keeps every full-fidelity
+        answer, bitwise), and each coordinate's shards recover
+        independently. PR 10's drill only exercised a single-RE bundle,
+        which could not catch a health/loss state accidentally shared
+        across coordinates."""
+        from photon_ml_tpu.parallel.mesh import make_mesh
+
+        n = 16
+        E2 = 16
+        w = rng.normal(size=D_FE).astype(np.float32)
+        Ma = np.zeros((E + 1, D_RE), np.float32)
+        Ma[:E] = rng.normal(size=(E, D_RE))
+        Mb = np.zeros((E2 + 1, D_RE), np.float32)
+        Mb[:E2] = rng.normal(size=(E2, D_RE))
+        task = TASK
+
+        def _model(a, b):
+            return GameModel(
+                {
+                    "fixed": FixedEffectModel(Coefficients(jnp.asarray(w)), task),
+                    "cid_a": RandomEffectModel(jnp.asarray(a), None, task),
+                    "cid_b": RandomEffectModel(jnp.asarray(b), None, task),
+                }
+            )
+
+        specs = {
+            "fixed": CoordinateScoringSpec(shard="g"),
+            "cid_a": CoordinateScoringSpec(
+                shard="ra",
+                random_effect_type="aid",
+                entity_index={str(i): i for i in range(E)},
+            ),
+            "cid_b": CoordinateScoringSpec(
+                shard="rb",
+                random_effect_type="bid",
+                entity_index={str(i): i for i in range(E2)},
+            ),
+        }
+        X = rng.normal(size=(n, D_FE)).astype(np.float32)
+        Xa = rng.normal(size=(n, D_RE)).astype(np.float32)
+        Xb = rng.normal(size=(n, D_RE)).astype(np.float32)
+        reqs = [
+            ScoreRequest(
+                features={"g": X[i], "ra": Xa[i], "rb": Xb[i]},
+                entity_ids={"aid": str(i % E), "bid": str(i % E2)},
+            )
+            for i in range(n)
+        ]
+
+        def _ref(a, b):
+            with ServingEngine(
+                ServingBundle.from_model(_model(a, b), specs, task),
+                max_batch=16,
+            ) as eng:
+                return _scores(eng.score_batch(reqs))
+
+        ref = _ref(Ma, Mb)
+        mesh = make_mesh()
+        bundle = ServingBundle.from_model(
+            _model(Ma, Mb), specs, task, mesh=mesh
+        )
+        ca, cb = bundle.coordinates["cid_a"], bundle.coordinates["cid_b"]
+        assert ca.shard_health is not cb.shard_health
+        with ServingEngine(bundle, max_batch=16) as eng:
+            assert np.array_equal(_scores(eng.score_batch(reqs)), ref)
+            # Lose cid_a shard 0: expected = the reference with cid_a's
+            # lost LOGICAL rows zeroed (lost entities score the pinned
+            # zero row for cid_a ONLY); cid_b untouched.
+            lo_a, hi_a = eng.mark_shard_lost("cid_a", 0)
+            Ma_deg = Ma.copy()
+            Ma_deg[lo_a : min(hi_a, E)] = 0.0
+            expected_a = _ref(Ma_deg, Mb)
+            assert not np.array_equal(expected_a, ref)  # the drill bites
+            assert np.array_equal(_scores(eng.score_batch(reqs)), expected_a)
+            m = eng.metrics()
+            assert m["sharding"]["shards_lost"] == 1
+            assert "shard_loss:cid_a/0" in m["degraded_reasons"]
+            assert cb.shard_health.lost == ()
+            # Lose cid_b shard 1 ON TOP: both degradations compose, each
+            # scoped to its own coordinate's rows.
+            lo_b, hi_b = eng.mark_shard_lost("cid_b", 1)
+            Mb_deg = Mb.copy()
+            Mb_deg[lo_b : min(hi_b, E2)] = 0.0
+            expected_ab = _ref(Ma_deg, Mb_deg)
+            assert np.array_equal(
+                _scores(eng.score_batch(reqs)), expected_ab
+            )
+            assert eng.metrics()["sharding"]["shards_lost"] == 2
+            # Independent recovery: restaging cid_a/0 restores cid_a's
+            # rows while cid_b/1 stays degraded...
+            eng.restage_shard("cid_a", 0)
+            assert np.array_equal(
+                _scores(eng.score_batch(reqs)), _ref(Ma, Mb_deg)
+            )
+            m2 = eng.metrics()
+            assert "shard_loss:cid_a/0" not in m2["degraded_reasons"]
+            assert "shard_loss:cid_b/1" in m2["degraded_reasons"]
+            # ...and recovering cid_b/1 returns the full bitwise answers.
+            eng.restage_shard("cid_b", 1)
+            assert np.array_equal(_scores(eng.score_batch(reqs)), ref)
+            assert eng.metrics()["state"] == "READY"
+
     def test_staging_fault_retried_bitwise(self, rng, monkeypatch):
         from photon_ml_tpu.utils import faults
 

@@ -347,6 +347,15 @@ class TestJournal:
         "mode": "stacked",
         "value": 0.72,
         "diverged_steps": 0,
+        # -- live mesh elasticity (ISSUE 13) --
+        "old_shards": 8,
+        "new_shards": 4,
+        "moved_rows": 167,
+        "moved_bytes": 5344,
+        "restaged_bytes": 5344,
+        "reason": "InjectedFault('reshard_stage')",
+        "surviving_devices": 4,
+        "source": "memory",
     }
 
     def test_every_event_type_round_trips_its_schema(self, tmp_path):
