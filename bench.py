@@ -562,7 +562,7 @@ def _chaos_multichip_child() -> None:
     def replay(engine):
         nonlocal failed_requests, hangs
         out = [None] * n_req
-        with engine.batcher(max_wait_ms=1.0) as b:
+        with engine.batcher(max_wait_ms=1.0) as b:  # photon-lint: disable=planner-constant — deliberate section config: fixed wait pins the measurement, not a runtime default
             futs = [b.submit(r, block=True) for r in reqs]
             for i, f in enumerate(futs):
                 try:
@@ -790,7 +790,7 @@ def _elastic_mesh_child() -> None:
                 failed_requests[0] += 1
             j += 1
 
-    with eng, eng.batcher(max_wait_ms=1.0) as batcher:
+    with eng, eng.batcher(max_wait_ms=1.0) as batcher:  # photon-lint: disable=planner-constant — deliberate section config: fixed wait pins the measurement, not a runtime default
         th = _threading.Thread(
             target=_traffic, args=(batcher,), name="photon-bench-elastic"
         )
@@ -971,6 +971,19 @@ def _child() -> None:
 
     platform = jax.devices()[0].platform
     _mark(f"backend up ({platform})")
+    # Adaptive runtime planner (ISSUE 14): a repeat round with
+    # PHOTON_PLAN_PROFILE pointing at the last round's persisted profile
+    # plans this round from it (the scoring section starts calibrated,
+    # routing/layout decisions adopt the measured run); topology
+    # mismatches refuse loudly rather than mis-plan the round.
+    from photon_ml_tpu import planner as _planner_boot
+
+    _ambient_plan = _planner_boot.ensure_ambient_plan()
+    if _ambient_plan is not None:
+        _mark(
+            f"runtime plan installed ({_ambient_plan.source}: "
+            f"{len(_ambient_plan.decisions)} decision(s))"
+        )
     scale = float(os.environ.get("BENCH_SCALE", "1.0"))
     n = int((1 << 20) * scale)
     d_fixed, d_re = 512, 16
@@ -1266,9 +1279,26 @@ def _child() -> None:
     # The rep count ADAPTS until the rtt correction is <5% of the measured
     # wall (VERDICT r05 weak #6: at 64 reps / 2.4 ms-per-pass the rtt
     # subtraction dominated and the artifact printed 911 GB/s — above the
-    # chip's HBM peak). Start at 64 (r04: tunnel jitter can exceed an
-    # 8-rep wall), cap at 1024 so a slow backend bounds compile count.
-    score_reps = 64
+    # chip's HBM peak). The START count is a planned quantity (ISSUE 14):
+    # a prior round's profile carries its calibrated rep count
+    # (dispatch["bench_score_reps"], written by the e2e section below), so
+    # a repeat round with PHOTON_PLAN_PROFILE set begins calibrated and
+    # skips the doubling ladder; cold rounds start at the default (r04:
+    # tunnel jitter can exceed an 8-rep wall). Cap at 1024 so a slow
+    # backend bounds compile count; the <5% contract is re-verified
+    # either way — a stale planned count that no longer meets it resumes
+    # adapting instead of shipping a bad artifact.
+    from photon_ml_tpu import planner as _planner
+
+    # Clamp to [1, 1024]: a degenerate planned count must not stall the
+    # doubling ladder (0 * 2 == 0 loops forever) and a corrupt profile's
+    # huge count must not dispatch an unbounded scan — 1024 is the same
+    # cap the adaptation loop below enforces.
+    score_reps = min(max(1, int(_planner.planned_value("bench_score_reps"))), 1024)
+    _plan_now = _planner.current_plan()
+    reps_from_plan = (
+        _plan_now is not None and "bench_score_reps" in _plan_now.decisions
+    )
     while True:
 
         @functools.partial(jax.jit, static_argnames=("reps",))
@@ -1299,6 +1329,7 @@ def _child() -> None:
         wall_s=round(score_wall, 4),
         samples_per_s=round(n / score_wall, 1),
         reps=score_reps,
+        reps_from_plan=reps_from_plan,
         rtt_fraction=round(rtt_fraction, 4),
         **_bw_metrics(score_bytes, score_wall, platform),
     )
@@ -1545,6 +1576,192 @@ def _child() -> None:
     except Exception as e:  # noqa: BLE001 - the artifact reports the failure
         variants["sweep"] = dict(error=repr(e))
         _mark(f"sweep section FAILED: {e!r}")
+
+    # ---- planner: profile-driven adaptive-runtime certificate (ISSUE 14) --
+    # A pilot GLMix fit's persisted profile plans a second, planner-on fit
+    # of the same job. Contract: the planned fit is no slower end-to-end
+    # than the hand-tuned default (every decision either adopts what the
+    # pilot measured or moves a bitwise-neutral quantity), the two models
+    # are bitwise-equal, the plan block round-trips through
+    # write_profile/read_profile unchanged, and a topology-mutated profile
+    # refuses loudly naming the field. Walls are min-of-2 on warmed
+    # programs so a contended host's jitter cannot fail a true ≤.
+    try:
+        import tempfile
+
+        from photon_ml_tpu import planner as _pl
+        from photon_ml_tpu.data.game_dataset import (
+            FixedEffectDataConfig as _FEC_pl,
+            RandomEffectDataConfig as _REC_pl,
+        )
+        from photon_ml_tpu.estimators.game_estimator import (
+            GameEstimator as _Est_pl,
+        )
+        from photon_ml_tpu.utils import telemetry as _tel_pl
+        from photon_ml_tpu.utils.contracts import PLANNER_SECTION_KEYS
+
+        n_pl, e_pl = 32768, 256
+        d_fpl, d_repl = 16, 4
+
+        def _pl_data(seed):
+            r = np.random.default_rng(seed)
+            ent = r.integers(0, e_pl, size=n_pl)
+            Xf_ = r.normal(size=(n_pl, d_fpl)).astype(np.float32)
+            Xe_ = r.normal(size=(n_pl, d_repl)).astype(np.float32)
+            wt = r.normal(size=d_fpl).astype(np.float32)
+            ut = r.normal(size=(e_pl, d_repl)).astype(np.float32)
+            mg = Xf_ @ wt + np.einsum("nd,nd->n", Xe_, ut[ent])
+            ys = (r.uniform(size=n_pl) < 1 / (1 + np.exp(-mg))).astype(
+                np.float32
+            )
+            return GameDataset.build(
+                {"g": jnp.asarray(Xf_), "e": jnp.asarray(Xe_)},
+                ys,
+                id_tags={"entityId": ent},
+            )
+
+        cfgs_pl = {
+            "fixed": CoordinateOptimizationConfig(
+                optimizer=OptimizerConfig(max_iterations=12, tolerance=1e-7),
+                regularization=L2,
+                reg_weight=1.0,
+            ),
+            "per-entity": CoordinateOptimizationConfig(
+                optimizer=OptimizerConfig(max_iterations=8, tolerance=1e-7),
+                regularization=L2,
+                reg_weight=10.0,
+            ),
+        }
+
+        def _pl_fit():
+            est_pl = _Est_pl(
+                TaskType.LOGISTIC_REGRESSION,
+                {
+                    "fixed": _FEC_pl("g"),
+                    "per-entity": _REC_pl("entityId", "e", min_bucket=16),
+                },
+                seed=7,
+            )
+            ds_pl = _pl_data(51)
+            t0_pl = time.perf_counter()
+            res_pl = est_pl.fit(ds_pl, None, [cfgs_pl])
+            return est_pl, res_pl[0], time.perf_counter() - t0_pl
+
+        # The pilot must measure the hand-tuned DEFAULT config: stash any
+        # round-ambient plan (PHOTON_PLAN_PROFILE) and restore it after,
+        # and run the pilot fits under plan_suppressed() — without it the
+        # estimator's own ensure_ambient_plan would quietly re-install a
+        # plan from the still-set env and the certificate would compare
+        # planned-vs-planned.
+        _had_plan = _pl.current_plan()
+        if _had_plan is not None:
+            _pl.uninstall_plan()
+        try:
+            with _pl.plan_suppressed():
+                _pl_fit()  # warm: compile every program both runs dispatch
+                est_a, res_a, wall_a1 = _pl_fit()
+                _, _, wall_a2 = _pl_fit()
+            wall_a = min(wall_a1, wall_a2)
+            prof_pl = est_a.run_profile()
+            with tempfile.TemporaryDirectory() as td_pl:
+                path_pl = os.path.join(td_pl, "profile.json")
+                plan_pl = _pl.plan_from_profile(
+                    _tel_pl.read_profile(
+                        _tel_pl.write_profile(path_pl, prof_pl), kind="fit"
+                    ),
+                    path_pl,
+                )
+                _pl.install_plan(plan_pl)
+                try:
+                    est_b, res_b, wall_b1 = _pl_fit()
+                    _, _, wall_b2 = _pl_fit()
+                    wall_b = min(wall_b1, wall_b2)
+                    plan_block_b = dict(est_b.fit_timing["plan"])
+                    # Round trip: the planned run's profile carries its
+                    # plan block and re-reads through the loud contract
+                    # unchanged.
+                    back_b = _tel_pl.read_profile(
+                        _tel_pl.write_profile(
+                            os.path.join(td_pl, "planned.json"),
+                            est_b.run_profile(),
+                        ),
+                        kind="fit",
+                    )
+                    roundtrip_ok = back_b.get("plan") == plan_block_b
+                finally:
+                    _pl.uninstall_plan()
+            # Topology guard: the same profile claiming a different
+            # device count must refuse, naming the field.
+            bad_topo = dict(prof_pl)
+            bad_topo["device_topology"] = dict(prof_pl["device_topology"])
+            bad_topo["device_topology"]["device_count"] = (
+                int(prof_pl["device_topology"]["device_count"]) + 7
+            )
+            try:
+                _pl.plan_from_profile(bad_topo)
+                topo_ok = False
+            except _pl.PlanTopologyError as te_pl:
+                topo_ok = "device_count" in str(te_pl)
+        finally:
+            if _had_plan is not None:
+                _pl.install_plan(_had_plan)
+
+        pl_bitwise = bool(
+            np.array_equal(
+                np.asarray(res_a.model["fixed"].coefficients.means),
+                np.asarray(res_b.model["fixed"].coefficients.means),
+            )
+            and np.array_equal(
+                np.asarray(res_a.model["per-entity"].coefficients_matrix),
+                np.asarray(res_b.model["per-entity"].coefficients_matrix),
+            )
+        )
+        planner_section = dict(
+            shape=dict(
+                n_samples=n_pl, n_entities=e_pl, d_fixed=d_fpl, d_re=d_repl
+            ),
+            default_wall_s=round(wall_a, 3),
+            planned_wall_s=round(wall_b, 3),
+            wall_ratio=round(wall_b / max(wall_a, 1e-9), 3),
+            decisions={
+                k: d.value for k, d in sorted(plan_pl.decisions.items())
+            },
+            sources={
+                k: d.source for k, d in sorted(plan_pl.decisions.items())
+            },
+            plan_vs_default_bitwise=pl_bitwise,
+            profile_roundtrip_ok=bool(roundtrip_ok),
+            topology_guard_ok=bool(topo_ok),
+        )
+        missing_pl = [
+            k for k in PLANNER_SECTION_KEYS if planner_section.get(k) is None
+        ]
+        if missing_pl:
+            raise RuntimeError(
+                f"planner section is missing keys {missing_pl} — the "
+                "adaptive-planner contract regressed"
+            )
+        if not (pl_bitwise and roundtrip_ok and topo_ok):
+            raise RuntimeError(
+                "planner certificate failed: "
+                f"bitwise={pl_bitwise} roundtrip={roundtrip_ok} "
+                f"topology_guard={topo_ok}"
+            )
+        if planner_section["wall_ratio"] > 1.1:
+            raise RuntimeError(
+                "planner-chosen config is slower than the hand-tuned "
+                f"default ({planner_section['wall_ratio']}x) — the plan "
+                "must never lose to the constants it replaces"
+            )
+        variants["planner"] = planner_section
+        _mark(
+            f"planner measured (default {wall_a:.2f}s vs planned "
+            f"{wall_b:.2f}s, bitwise={pl_bitwise}, "
+            f"{len(plan_pl.decisions)} decision(s))"
+        )
+    except Exception as e:  # noqa: BLE001 - the artifact reports the failure
+        variants["planner"] = dict(error=repr(e))
+        _mark(f"planner section FAILED: {e!r}")
 
     # ---- multichip: entity-sharded pod-scale path -------------------------
     # Own subprocess on the 8-virtual-device CPU mesh (this child's backend
@@ -1882,7 +2099,7 @@ def _child() -> None:
             f"serving engine warm ({engine_srv.compiles} bucket programs, "
             f"{time.perf_counter() - t0:.1f}s)"
         )
-        with engine_srv, engine_srv.batcher(max_wait_ms=1.0) as batcher_srv:
+        with engine_srv, engine_srv.batcher(max_wait_ms=1.0) as batcher_srv:  # photon-lint: disable=planner-constant — deliberate section config: fixed wait pins the measurement, not a runtime default
             batcher_srv.score_all(reqs_srv)
             m_srv_metrics = batcher_srv.metrics()
         from photon_ml_tpu.utils.contracts import (
@@ -1997,7 +2214,7 @@ def _child() -> None:
         eng_ol.warmup()
         with eng_ol:
             # Calibrate THIS configuration's clean capacity.
-            with eng_ol.batcher(max_wait_ms=1.0) as b_cal:
+            with eng_ol.batcher(max_wait_ms=1.0) as b_cal:  # photon-lint: disable=planner-constant — deliberate section config: fixed wait pins the measurement, not a runtime default
                 b_cal.score_all(reqs_srv[:4096])
                 cap_qps = float(b_cal.metrics()["qps"] or 0.0)
             if cap_qps <= 0:
@@ -2014,7 +2231,7 @@ def _child() -> None:
             futures_by = [[] for _ in range(n_submitters)]
 
             with eng_ol.batcher(
-                max_wait_ms=1.0,
+                max_wait_ms=1.0,  # photon-lint: disable=planner-constant — deliberate section config: fixed wait pins the measurement, not a runtime default
                 max_pending=ol_pending,
                 default_deadline_ms=deadline_ms,
             ) as b_ol:
@@ -2162,7 +2379,7 @@ def _child() -> None:
                 j += 1
 
         t_swap0 = time.perf_counter()
-        with eng_hs, eng_hs.batcher(max_wait_ms=1.0) as b_hs:
+        with eng_hs, eng_hs.batcher(max_wait_ms=1.0) as b_hs:  # photon-lint: disable=planner-constant — deliberate section config: fixed wait pins the measurement, not a runtime default
             th = _threading.Thread(
                 target=_traffic,
                 args=(b_hs,),
@@ -2528,9 +2745,15 @@ def _child() -> None:
             # e2e section here, not at plan time.
             from photon_ml_tpu.utils import telemetry as _tel
 
+            prof_e2e = est.run_profile()
+            # The scoring section's calibrated rep count rides the
+            # profile as plan evidence (ISSUE 14 satellite): a repeat
+            # round planning from this profile starts calibrated and
+            # skips the rtt-adaptation ladder.
+            prof_e2e["dispatch"]["bench_score_reps"] = score_reps
             profile_back = _tel.read_profile(
                 _tel.write_profile(
-                    os.path.join(td, "profile.json"), est.run_profile()
+                    os.path.join(td, "profile.json"), prof_e2e
                 ),
                 kind="fit",
             )
@@ -2539,6 +2762,12 @@ def _child() -> None:
                 f"({len(profile_back['bucket_shapes'])} coordinate "
                 "bucket-shape set(s))"
             )
+            # Persist outside the tempdir for the NEXT round when the
+            # operator named a plan-profile path.
+            _plan_profile_path = str(_get_knob("PHOTON_PLAN_PROFILE")).strip()
+            if _plan_profile_path:
+                _tel.write_profile(_plan_profile_path, prof_e2e)
+                _mark(f"e2e profile persisted to {_plan_profile_path}")
 
             t0 = time.perf_counter()
             from photon_ml_tpu.transformers.game_transformer import (

@@ -1,6 +1,6 @@
 """photon-lint: self-hosted static analysis for photon-ml-tpu.
 
-Seven AST-based checks, each machine-checking an invariant the repo
+Eight AST-based checks, each machine-checking an invariant the repo
 previously held only by convention (and has shipped bugs against):
 
 * knob-registry       — PHOTON_* env reads go through utils/knobs.py,
@@ -17,6 +17,10 @@ previously held only by convention (and has shipped bugs against):
 * metric-name-sync    — incremented metric names == declared
                         utils/telemetry.METRIC_DESCRIPTIONS, both
                         directions, names statically resolvable
+* planner-constant    — planned runtime quantities (wait-ms, chunk rows,
+                        prefetch depth, fusion caps, bucket shape sets)
+                        come from planner/ or the knob registry, never
+                        magic-number literals
 
 Run `python -m photon_ml_tpu.analysis` (`--list-checks`, `--check
 <name>`, paths for fixture corpora); zero findings on the live tree is a
@@ -42,6 +46,7 @@ from photon_ml_tpu.analysis import (  # noqa: F401  isort: skip
     jit_purity,
     knob_registry,
     metric_name_sync,
+    planner_constant,
     thread_lifecycle,
 )
 

@@ -12,6 +12,12 @@ Three subcommands over the three file artifacts of utils/telemetry.py:
   * `profile <profile.json>` — pretty-print a run profile read through
     the loud `read_profile` contract (stage table, dispatch decisions,
     topology, roofline).
+  * `profile diff <a> <b>` — typed key-wise comparison of two run
+    profiles: per-stage wall deltas, dispatch-decision changes,
+    plan-block decision changes (added/removed/value- or source-
+    changed), and topology changes. The operator tool for "what did the
+    planner change between rounds". Exits nonzero when either profile
+    violates its contract (read_profile refusal) or the kinds differ.
 
 Load the trace itself in Perfetto (https://ui.perfetto.dev) or
 chrome://tracing; this CLI is the headless companion.
@@ -145,6 +151,106 @@ def cmd_profile(args) -> int:
     return 0
 
 
+def _plan_decisions(profile: dict) -> dict:
+    """decision name -> (value, source) from a profile's plan block;
+    empty for unplanned / pre-planner (r06-era) profiles."""
+    block = profile.get("plan") or {}
+    return {
+        d["decision"]: (d.get("value"), d.get("source"))
+        for d in block.get("decisions", [])
+        if isinstance(d, dict) and "decision" in d
+    }
+
+
+def cmd_profile_diff(path_a: str, path_b: str) -> int:
+    """Typed key-wise diff of two run profiles (see module doc). Returns
+    nonzero on contract violations — a profile that cannot be read
+    loudly must fail the operator's comparison, not silently skip."""
+    try:
+        a = telemetry.read_profile(path_a)
+        b = telemetry.read_profile(path_b)
+    except (ValueError, OSError) as exc:
+        print(f"CONTRACT VIOLATION: {exc}")
+        return 1
+    if a.get("kind") != b.get("kind"):
+        print(
+            f"CONTRACT VIOLATION: profile kinds differ "
+            f"({a.get('kind')!r} vs {b.get('kind')!r}) — comparing a fit "
+            "profile to a serve profile is not a round-over-round diff"
+        )
+        return 1
+    print(
+        f"{a['kind']} profiles: {path_a} ({a['wall_s']}s) vs "
+        f"{path_b} ({b['wall_s']}s)"
+    )
+
+    # -- topology (a mismatch here means the diff crosses hardware)
+    topo_a, topo_b = a["device_topology"], b["device_topology"]
+    topo_changed = {
+        k: (topo_a.get(k), topo_b.get(k))
+        for k in sorted({*topo_a, *topo_b})
+        if topo_a.get(k) != topo_b.get(k)
+    }
+    if topo_changed:
+        print("  topology changes:")
+        for k, (va, vb) in topo_changed.items():
+            print(f"    {k}: {va!r} -> {vb!r}")
+
+    # -- stage walls (typed: every key of either side, delta annotated)
+    st_a, st_b = a["stages"], b["stages"]
+    keys = sorted({*st_a, *st_b})
+    width = max((len(k) for k in keys), default=0)
+    print("  stage deltas (a -> b):")
+    for k in keys:
+        va = float(st_a.get(k) or 0.0)
+        vb = float(st_b.get(k) or 0.0)
+        mark = "" if abs(vb - va) < 1e-4 else f"  ({vb - va:+.3f}s)"
+        print(f"    {k.ljust(width)}  {va:10.3f}s -> {vb:10.3f}s{mark}")
+
+    # -- dispatch decisions (the runtime choices each run took)
+    d_a, d_b = a["dispatch"], b["dispatch"]
+    changed = [
+        k for k in sorted({*d_a, *d_b}) if d_a.get(k) != d_b.get(k)
+    ]
+    if changed:
+        print("  dispatch-decision changes:")
+        for k in changed:
+            print(
+                f"    {k}: {json.dumps(d_a.get(k), default=str)} -> "
+                f"{json.dumps(d_b.get(k), default=str)}"
+            )
+    else:
+        print("  dispatch decisions: identical")
+
+    # -- plan blocks (what the planner chose, round over round)
+    plan_a, plan_b = _plan_decisions(a), _plan_decisions(b)
+    added = sorted(set(plan_b) - set(plan_a))
+    removed = sorted(set(plan_a) - set(plan_b))
+    altered = sorted(
+        k for k in set(plan_a) & set(plan_b) if plan_a[k] != plan_b[k]
+    )
+    if not (plan_a or plan_b):
+        print("  plan blocks: none on either side (unplanned runs)")
+    elif not (added or removed or altered):
+        print(f"  plan decisions: identical ({len(plan_b)})")
+    else:
+        print("  plan-block changes:")
+        for k in added:
+            v, s = plan_b[k]
+            print(f"    + {k} = {json.dumps(v, default=str)} [{s}]")
+        for k in removed:
+            v, s = plan_a[k]
+            print(f"    - {k} (was {json.dumps(v, default=str)} [{s}])")
+        for k in altered:
+            va, sa = plan_a[k]
+            vb, sb = plan_b[k]
+            print(
+                f"    ~ {k}: {json.dumps(va, default=str)} [{sa}] -> "
+                f"{json.dumps(vb, default=str)} [{sb}]"
+            )
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="python -m photon_ml_tpu.cli.obs",
@@ -168,17 +274,33 @@ def build_parser() -> argparse.ArgumentParser:
         action="store_true",
         help="exit 1 when any line fails its schema",
     )
-    pr = sub.add_parser("profile", help="pretty-print a run profile")
-    pr.add_argument("path")
+    pr = sub.add_parser(
+        "profile",
+        help="pretty-print a run profile, or `profile diff <a> <b>`",
+    )
+    pr.add_argument(
+        "paths",
+        nargs="+",
+        metavar="ARG",
+        help="<profile.json>  |  diff <a.json> <b.json>",
+    )
     return p
 
 
 def main(argv: Optional[List[str]] = None) -> int:
-    args = build_parser().parse_args(argv)
+    parser = build_parser()
+    args = parser.parse_args(argv)
     if args.cmd == "trace":
         return cmd_trace(args)
     if args.cmd == "journal":
         return cmd_journal(args)
+    if args.paths[0] == "diff":
+        if len(args.paths) != 3:
+            parser.error("profile diff takes exactly two profile paths")
+        return cmd_profile_diff(args.paths[1], args.paths[2])
+    if len(args.paths) != 1:
+        parser.error("profile takes one path (or: profile diff <a> <b>)")
+    args.path = args.paths[0]
     return cmd_profile(args)
 
 

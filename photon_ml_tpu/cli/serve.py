@@ -70,11 +70,20 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--offheap-indexmap-dir", default=None,
                    help="prebuilt feature-index partitions; default: the "
                         "JSON maps saved beside the model")
-    p.add_argument("--max-batch", type=int, default=256,
-                   help="largest micro-batch / compiled bucket size")
-    p.add_argument("--max-wait-ms", type=float, default=2.0,
+    p.add_argument("--max-batch", type=int, default=None,
+                   help="largest micro-batch / compiled bucket size "
+                        "(default: the installed plan's choice, else 256; "
+                        "an explicit value overrides the planner)")
+    p.add_argument("--max-wait-ms", type=float, default=None,
                    help="flush a partial batch once its oldest request has "
-                        "waited this long")
+                        "waited this long (default: the installed plan's "
+                        "choice, else 2.0 ms; explicit overrides the "
+                        "planner)")
+    p.add_argument("--profile", default=None,
+                   help="a persisted run profile (profile.json from a prior "
+                        "run) the adaptive planner consumes for bucket/wait "
+                        "decisions; topology-checked loudly. Overrides "
+                        "PHOTON_PLAN_PROFILE")
     p.add_argument("--max-pending", type=int, default=None,
                    help="admission-control bound on the pending queue "
                         "(default: 4x max-batch); replay submits are "
@@ -196,6 +205,19 @@ def run(args) -> dict:
     out_root = args.root_output_directory
     os.makedirs(out_root, exist_ok=True)
     journal = telemetry.RunJournal(os.path.join(out_root, "journal.jsonl"))
+    # Adaptive runtime planner (ISSUE 14): installed AFTER the journal
+    # (inside the try below) so plan_decision events land in it, owned so
+    # a caller's ambient plan survives this run. Explicit
+    # --max-batch/--max-wait-ms still win.
+    from photon_ml_tpu import planner
+
+    plan_owned = planner.current_plan() is None
+    if not plan_owned and getattr(args, "profile", None):
+        logger.warning(
+            "--profile %s ignored: a runtime plan is already installed "
+            "by the caller (uninstall it to let this run plan itself)",
+            args.profile,
+        )
     # Only adopt the process-ambient slots we own (same discipline for
     # journal and tracer): a caller's pre-installed journal/tracer must
     # survive this run, not be clobbered and uninstalled to None.
@@ -205,10 +227,15 @@ def run(args) -> dict:
     tracer_owned = telemetry.current_tracer() is None
     tracer = telemetry.start_tracing_if_enabled()
 
-    # The ambient journal/tracer uninstall on EVERY exit path — including
-    # a failed bundle load — or the process-global sinks leak into the
-    # next run in this process (and its trace would never export).
+    # The ambient journal/tracer/plan uninstall on EVERY exit path —
+    # including a failed bundle load — or the process-global sinks leak
+    # into the next run in this process (and its trace would never
+    # export).
     try:
+        if plan_owned:
+            # After install_journal so every plan_decision event lands in
+            # THIS run's journal. Loud on topology mismatch by design.
+            planner.ensure_ambient_plan(getattr(args, "profile", None))
         bundle = load_bundle(args.model_input_directory, index_maps=index_maps)
         logger.info(
             "bundle pinned: %d coordinate(s), %.1f MB uploaded in %.3fs",
@@ -227,6 +254,8 @@ def run(args) -> dict:
         finally:
             bundle.release()
     finally:
+        if plan_owned:
+            planner.uninstall_plan()
         if tracer is not None and tracer_owned:
             tracer.export(os.path.join(out_root, "trace.json"))
             telemetry.uninstall_tracer()
@@ -236,6 +265,16 @@ def run(args) -> dict:
 
 
 def _run_with_bundle(args, bundle: ServingBundle) -> dict:
+    from photon_ml_tpu import planner as _planner_mod
+
+    # Explicit CLI flags that override planned serving decisions — fed
+    # into the recorded plan block so it reports source "knob" for them.
+    _cli_plan_overrides = {}
+    if args.max_batch is not None:
+        _cli_plan_overrides["serving_max_batch"] = int(args.max_batch)
+    if args.max_wait_ms is not None:
+        _cli_plan_overrides["serving_max_wait_ms"] = float(args.max_wait_ms)
+
     is_json = args.requests.endswith((".json", ".jsonl"))
     shard_configs = None
     if args.feature_shard_configurations:
@@ -367,6 +406,9 @@ def _run_with_bundle(args, bundle: ServingBundle) -> dict:
         if reshard_thread is not None:
             reshard_thread.join()
         metrics = batcher.metrics()
+        # The PLANNED-or-overridden values actually served with (the
+        # argparse values may be None = "let the planner decide").
+        resolved_wait_ms = batcher.max_wait_s * 1e3
     replay_s = time.perf_counter() - t_replay
     logger.info(
         "replayed %d request(s), %d failed, %d malformed record(s) skipped; "
@@ -395,6 +437,11 @@ def _run_with_bundle(args, bundle: ServingBundle) -> dict:
             **{k: 0 for k in ROBUSTNESS_CLEAN_ZERO_KEYS},
             **faults.counters(),
         },
+        # The adaptive-runtime plan block (ISSUE 14): always present —
+        # inactive on an unplanned replay — mirroring fit_timing["plan"].
+        # Explicit --max-batch/--max-wait-ms flags re-source their
+        # decisions as "knob" so the audit shows what actually served.
+        "plan": _planner_mod.plan_block(overrides=_cli_plan_overrides),
     }
     if reshard_to is not None:
         summary["reshard"] = reshard_info
@@ -410,13 +457,16 @@ def _run_with_bundle(args, bundle: ServingBundle) -> dict:
             "replay_s": round(replay_s, 4),
         },
         dispatch={
-            "max_batch": int(args.max_batch),
-            "max_wait_ms": float(args.max_wait_ms),
+            "max_batch": int(engine.max_batch),
+            "max_wait_ms": float(resolved_wait_ms),
             "sharding": metrics.get("sharding"),
         },
         bucket_shapes={"engine_buckets": list(engine.buckets)},
         serving=metrics,
     )
+    # Plan decisions round-trip through the profile (ISSUE 14), with the
+    # same explicit-flag re-sourcing as the summary block.
+    profile["plan"] = _planner_mod.plan_block(overrides=_cli_plan_overrides)
     telemetry.write_profile(os.path.join(out_root, "profile.json"), profile)
     logger.info("serving metrics: %s", metrics)
     return summary

@@ -157,6 +157,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "BAYESIAN, Sobol batches for RANDOM); evaluations run "
                         "sequentially in this driver but proposals are batched")
     p.add_argument("--random-seed", type=int, default=0)
+    p.add_argument("--profile", default=None,
+                   help="a persisted run profile (profile.json from a prior "
+                        "run) the adaptive planner consumes for layout/"
+                        "routing/batching decisions; refuses loudly on a "
+                        "mismatched device topology. Overrides "
+                        "PHOTON_PLAN_PROFILE; explicit PHOTON_* knobs "
+                        "override individual plan decisions")
     p.add_argument("--logging-level", default="INFO")
     p.add_argument("--application-name", default="photon-ml-tpu-training")
     return p
@@ -319,7 +326,23 @@ def run(args, event_emitter=None) -> Dict[str, object]:
     tracer_owned = telemetry.current_tracer() is None
     tracer = telemetry.start_tracing_if_enabled()
     event_emitter.send(PhotonSetupEvent(args=str(vars(args))))
+    # Adaptive runtime planner (ISSUE 14): installed HERE — after the
+    # journal (plan_decision events land in it) and before ingest (chunk
+    # rows are a planned quantity). --profile beats PHOTON_PLAN_PROFILE;
+    # explicit PHOTON_* knobs beat the plan; owned so a caller's ambient
+    # plan survives this run.
+    from photon_ml_tpu import planner
+
+    plan_owned = planner.current_plan() is None
+    if not plan_owned and getattr(args, "profile", None):
+        logger.warning(
+            "--profile %s ignored: a runtime plan is already installed "
+            "by the caller (uninstall it to let this run plan itself)",
+            args.profile,
+        )
     try:
+        if plan_owned:
+            planner.ensure_ambient_plan(getattr(args, "profile", None))
         return _run_job(
             args, event_emitter, out_root, models_root, timings, Timed,
         )
@@ -330,6 +353,8 @@ def run(args, event_emitter=None) -> Dict[str, object]:
         event_emitter.send(PhotonFailureEvent(error=repr(e)))
         raise
     finally:
+        if plan_owned:
+            planner.uninstall_plan()
         if tracer is not None and tracer_owned:
             tracer.export(os.path.join(out_root, "trace.json"))
             telemetry.uninstall_tracer()

@@ -101,6 +101,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="override PHOTON_SWEEP_MAX_STACK for this run")
     p.add_argument("--shard-groups", type=int, default=None,
                    help="override PHOTON_SWEEP_SHARD_GROUPS for this run")
+    p.add_argument("--profile", default=None,
+                   help="a persisted run profile the adaptive planner "
+                        "consumes for the sweep's fits (layout/routing/"
+                        "prefetch decisions); topology-checked loudly. "
+                        "Overrides PHOTON_PLAN_PROFILE")
     p.add_argument("--random-seed", type=int, default=0)
     p.add_argument("--logging-level", default="INFO")
     return p
@@ -135,9 +140,25 @@ def run(args) -> Dict[str, object]:
         telemetry.install_journal(journal)
     tracer_owned = telemetry.current_tracer() is None
     tracer = telemetry.start_tracing_if_enabled()
+    # Adaptive runtime planner (ISSUE 14): same ownership discipline as
+    # the journal/tracer; installed after the journal so plan_decision
+    # events land in it, before ingest so chunk rows are planned.
+    from photon_ml_tpu import planner
+
+    plan_owned = planner.current_plan() is None
+    if not plan_owned and getattr(args, "profile", None):
+        logger.warning(
+            "--profile %s ignored: a runtime plan is already installed "
+            "by the caller (uninstall it to let this run plan itself)",
+            args.profile,
+        )
     try:
+        if plan_owned:
+            planner.ensure_ambient_plan(getattr(args, "profile", None))
         return _run_job(args, out_root, models_root, time)
     finally:
+        if plan_owned:
+            planner.uninstall_plan()
         if tracer is not None and tracer_owned:
             tracer.export(os.path.join(out_root, "trace.json"))
             telemetry.uninstall_tracer()

@@ -404,12 +404,24 @@ def choose_layout(
     2.01x even at blowup 2.13). Level 2 always stays grouped: its rt=128
     coarse tiles would pay the very 128-row one-hot alignment avoids.
     """
-    env = str(get_knob(_LAYOUT_ENV)).strip().lower()
-    if not env and get_knob("PHOTON_SPARSE_ROWALIGN"):
+    # Planned quantity (ISSUE 14): explicit PHOTON_SPARSE_LAYOUT wins,
+    # else the installed plan's sparse_layout (the layout the profile's
+    # run measured on this hardware), else the legacy bool alias, else
+    # the Poisson economics below. planned_value normalizes the layout
+    # spellings to auto|rowalign|grouped.
+    from photon_ml_tpu import planner
+
+    from photon_ml_tpu.utils.knobs import knob_is_set
+
+    if not knob_is_set(_LAYOUT_ENV) and get_knob("PHOTON_SPARSE_ROWALIGN"):
+        # The legacy bool alias is an explicit operator override too — it
+        # beats the plan, but stays subordinate to PHOTON_SPARSE_LAYOUT.
         env = "rowalign"
-    if env in ("rowalign", "row_aligned", "aligned"):
+    else:
+        env = str(planner.planned_value("sparse_layout")).strip().lower()
+    if env == "rowalign":
         return True, None
-    if env in ("grouped", "feature", "legacy"):
+    if env == "grouped":
         return False, None
     B = max(1, -(-dim // BUCKET))
     T1 = max(1, -(-n_rows // L1_TILE_ROWS))
@@ -482,6 +494,23 @@ def pack_bucketed(
         row_aligned,
     )
     set_stage_note("pack_path", pack_path)
+    # The level-1 layout decision, for the run profile's dispatch block —
+    # the evidence the adaptive planner (ISSUE 14) adopts next run. A fit
+    # whose packs disagree records "mixed": forcing one layout is
+    # results-affecting (rowalign vs grouped are allclose-, not bitwise-,
+    # equivalent), so the planner only ever adopts a UNIFORM choice.
+    # merge_note is atomic under the registry lock — per-shard packs run
+    # concurrently on background threads, and a check-then-set here would
+    # let two disagreeing packs each record their own layout.
+    from photon_ml_tpu.utils.observability import current_stage_registry
+
+    registry = current_stage_registry()
+    if registry is not None:
+        registry.merge_note(
+            "sparse_layout",
+            "rowalign" if row_aligned else "grouped",
+            "mixed",
+        )
 
     level2 = None
     o_rows = rows[spill]

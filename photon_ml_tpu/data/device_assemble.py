@@ -39,18 +39,22 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from photon_ml_tpu.utils.knobs import get_knob
-
 Array = jax.Array
 
 _INT32_LIMIT = 2**31 - 1
 
 
 def enabled() -> bool:
-    env = str(get_knob("PHOTON_DEVICE_ASSEMBLY")).strip().lower()
-    if env in ("0", "false", "off", "no"):
+    """Planned quantity (ISSUE 14): explicit PHOTON_DEVICE_ASSEMBLY wins,
+    else the installed plan's assembly_routing (adopted from the
+    profile's measured re_path), else the backend auto policy — the
+    device and host assembly paths are bitwise-identical either way."""
+    from photon_ml_tpu import planner
+
+    routing = str(planner.planned_value("assembly_routing"))
+    if routing == "host":
         return False
-    if env in ("1", "true", "on", "yes"):
+    if routing == "device":
         return True
     return jax.default_backend() in ("tpu", "gpu")
 

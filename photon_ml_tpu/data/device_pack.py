@@ -36,16 +36,20 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from photon_ml_tpu.utils.knobs import get_knob
-
 Array = jax.Array
 
 
 def enabled() -> bool:
-    env = str(get_knob("PHOTON_DEVICE_PACK")).strip().lower()
-    if env in ("0", "false", "off", "no"):
+    """Planned quantity (ISSUE 14): explicit PHOTON_DEVICE_PACK wins,
+    else the installed plan's pack_routing (adopted from the profile's
+    measured placement), else the backend auto policy — bitwise-safe in
+    every case because all placement paths are bitwise-identical."""
+    from photon_ml_tpu import planner
+
+    routing = str(planner.planned_value("pack_routing"))
+    if routing == "host":
         return False
-    if env in ("1", "true", "on", "yes"):
+    if routing == "device":
         return True
     return jax.default_backend() in ("tpu", "gpu")
 

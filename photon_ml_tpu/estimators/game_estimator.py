@@ -582,27 +582,43 @@ class GameEstimator:
         through it — the same record cli/train jobs get (ISSUE 11).
         """
         emit = self.event_emitter.send if self.event_emitter is not None else None
-        with telemetry.span("fit", num_configs=len(opt_configs)):
-            if emit is not None:
-                emit(TrainingStartEvent(num_samples=int(data.num_samples)))
-            results = self._fit(
-                data, validation_data, opt_configs, initial_model=initial_model
-            )
-            if emit is not None:
-                best_eval = (
-                    select_best_result(results)[1].evaluation if results else None
+        # The adaptive-runtime gate (ISSUE 14): install a plan when
+        # PHOTON_PLAN/PHOTON_PLAN_PROFILE ask for one and none is ambient
+        # (CLI drivers install earlier so ingest is planned too) — OWNED:
+        # a plan this fit installed is uninstalled on every exit path, so
+        # library callers re-fitting under a changed env never silently
+        # reuse a stale plan (the journal/tracer owned-slot discipline).
+        from photon_ml_tpu import planner
+
+        plan_owned = planner.current_plan() is None
+        installed = planner.ensure_ambient_plan()
+        try:
+            with telemetry.span("fit", num_configs=len(opt_configs)):
+                if emit is not None:
+                    emit(TrainingStartEvent(num_samples=int(data.num_samples)))
+                results = self._fit(
+                    data, validation_data, opt_configs, initial_model=initial_model
                 )
-                emit(
-                    TrainingFinishEvent(
-                        num_configs=len(results),
-                        best_metric=(
-                            None
-                            if best_eval is None
-                            else float(best_eval.primary_value)
-                        ),
+                if emit is not None:
+                    best_eval = (
+                        select_best_result(results)[1].evaluation
+                        if results
+                        else None
                     )
-                )
-            return results
+                    emit(
+                        TrainingFinishEvent(
+                            num_configs=len(results),
+                            best_metric=(
+                                None
+                                if best_eval is None
+                                else float(best_eval.primary_value)
+                            ),
+                        )
+                    )
+                return results
+        finally:
+            if plan_owned and installed is not None:
+                planner.uninstall_plan()
 
     def _on_cd_event(self, etype: str, **fields) -> None:
         """run_coordinate_descent's event hook -> typed bus events
@@ -624,6 +640,7 @@ class GameEstimator:
     ) -> List[GameResult]:
         if not opt_configs:
             raise ValueError("at least one optimization configuration required")
+        from photon_ml_tpu import planner
         from photon_ml_tpu.data.pipeline import pipeline_enabled
 
         pipelined = pipeline_enabled(self.pipeline)
@@ -635,6 +652,14 @@ class GameEstimator:
         # glue) recorded by the data-plane functions themselves.
         t0 = time.perf_counter()
         stage_base = dict(self.timing_registry.sections)
+        # Per-fit note evidence: the placement/layout notes describe THIS
+        # fit's decisions (a second fit on cached packs legitimately
+        # reports "none" — it packed nothing), never a previous fit's.
+        # Stage WALLS are delta'd against stage_base instead; notes have
+        # no delta, so they reset.
+        self.timing_registry.clear_notes(
+            "pack_path", "re_path", "sparse_layout"
+        )
         # Snapshot the pod-scale robustness counters so fit_timing reports
         # THIS fit's events (the process-wide counters are cumulative).
         from photon_ml_tpu.utils import faults as _faults
@@ -842,6 +867,11 @@ class GameEstimator:
         # always present — `entity_sharded` False with axis_size 1 on the
         # single-device path — so the bench e2e contract can fail loudly on
         # absence rather than ship an artifact that silently lost it.
+        # The adaptive-runtime plan block (ISSUE 14): always present —
+        # inactive ({"active": False, ...}) on an unplanned fit — so the
+        # bench e2e contract can fail loudly on absence, and an auditor
+        # can tell "planner off" from "block lost".
+        self.fit_timing["plan"] = planner.plan_block()
         re_infos = [i for i in sharding_infos.values() if i is not None]
         self.fit_timing["sharding"] = {
             "entity_sharded": any(i["entity_sharded"] for i in re_infos),
@@ -1109,6 +1139,11 @@ class GameEstimator:
             "re_path": ft["re_path"],
             "sharding": dict(ft["sharding"]),
             "pipeline": bool(pipeline_enabled(self.pipeline)),
+            # The level-1 sparse layout this fit packed ("none" when no
+            # sparse shard packed) — the evidence the planner's
+            # sparse_layout rule adopts next run.
+            "layout": self.timing_registry.get_note("sparse_layout")
+            or "none",
         }
         bucket_shapes: Dict[str, object] = {}
         for cid, prep in (self._prepared or {}).items():
@@ -1120,7 +1155,7 @@ class GameEstimator:
         ingest = dict(
             getattr(self._prepared_dataset, "ingest_timing", None) or {}
         )
-        return telemetry.build_profile(
+        profile = telemetry.build_profile(
             "fit",
             wall_s=float(ft["prepare_s"]) + float(ft["solve_s"]),
             stages=stages,
@@ -1129,6 +1164,12 @@ class GameEstimator:
             fit_timing=ft,
             ingest=ingest,
         )
+        # The plan block rides the profile too (ISSUE 14) so plan
+        # decisions round-trip through write_profile/read_profile —
+        # deliberately NOT a PROFILE_*_KEYS contract key: r06-era
+        # profiles (pre-planner) must keep loading for the cold start.
+        profile["plan"] = dict(ft["plan"])
+        return profile
 
 
 def select_best_result(

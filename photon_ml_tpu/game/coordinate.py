@@ -114,6 +114,24 @@ def sweep_scan_enabled() -> bool:
     return bool(get_knob("PHOTON_SWEEP_SCAN"))
 
 
+def _fusion_chunks(idxs, shape, planned_shapes):
+    """Split one same-shape bucket index list into scan-dispatch chunks
+    per the planned fusion granularity (ISSUE 14): scan_fusion_max 0 =
+    unbounded (the pre-planner default, one program per shape); shapes
+    absent from the plan's proven re_bucket_shapes set additionally cap
+    at NOVEL_SHAPE_FUSE. Consecutive chunks preserve bucket order, so
+    any split is bitwise-identical to the fused program."""
+    from photon_ml_tpu import planner
+    from photon_ml_tpu.planner.plan import NOVEL_SHAPE_FUSE
+
+    cap = max(0, int(planner.planned_value("scan_fusion_max")))
+    if planned_shapes is not None and tuple(shape) not in planned_shapes:
+        cap = min(cap, NOVEL_SHAPE_FUSE) if cap else NOVEL_SHAPE_FUSE
+    if cap <= 0 or len(idxs) <= cap:
+        return [list(idxs)]
+    return [list(idxs[i : i + cap]) for i in range(0, len(idxs), cap)]
+
+
 
 
 class FixedEffectCoordinate:
@@ -948,6 +966,22 @@ class RandomEffectCoordinate:
             bl = self.re_dataset.buckets
             for i, b in enumerate(bl):
                 by_shape.setdefault((b.num_entities, b.capacity), []).append(i)
+            # Scan-fusion granularity is a PLANNED quantity (ISSUE 14):
+            # default 0 = unbounded (one program per shape, the pre-
+            # planner behavior). A plan caps how many same-shape buckets
+            # fuse into one scan dispatch — and shapes the plan's profile
+            # never proved on this hardware (re_bucket_shapes) chunk at
+            # the cap even when proven shapes fuse unboundedly, so a
+            # first-dispatch failure or hang costs one small group.
+            # Chunking preserves per-bucket op order (the scan body runs
+            # buckets sequentially either way), so ANY cap is bitwise-
+            # identical to unbounded fusion.
+            shape_chunks = []
+            for shape, idxs in by_shape.items():
+                for chunk in _fusion_chunks(
+                    idxs, shape, self._planned_shape_set()
+                ):
+                    shape_chunks.append(chunk)
             groups = [
                 (
                     idxs,
@@ -955,7 +989,7 @@ class RandomEffectCoordinate:
                     jnp.stack([bl[i].mask for i in idxs]),
                     jnp.stack([bl[i].entity_rows for i in idxs]),
                 )
-                for idxs in by_shape.values()
+                for idxs in shape_chunks
             ]
             if self._entity_mesh is not None:
                 from jax.sharding import NamedSharding, PartitionSpec as P
@@ -975,6 +1009,23 @@ class RandomEffectCoordinate:
                 ]
             self._scan_groups_cache = groups
         return groups
+
+    def _planned_shape_set(self):
+        """The (entities, capacity) shapes the installed plan's profile
+        proved on this hardware, or None when no plan carries shape
+        evidence (then every shape fuses unboundedly, the default)."""
+        from photon_ml_tpu import planner
+
+        plan = planner.current_plan()
+        if plan is None or "re_bucket_shapes" not in plan.decisions:
+            return None
+        planned = plan.decisions["re_bucket_shapes"].value or {}
+        shapes = {
+            (int(pair[0]), int(pair[1]))
+            for shape_list in planned.values()
+            for pair in shape_list
+        }
+        return shapes or None
 
     @property
     def entity_mesh(self):

@@ -348,16 +348,32 @@ def run_coordinate_descent(
 
     import jax
 
+    # Planned quantity (ISSUE 14): how many upcoming unlocked coordinates
+    # the loop prefetches while the current one solves. Default 1 (the
+    # pre-planner behavior); a plan deepens it when the profile shows the
+    # upload stage un-hidden. Bitwise-neutral: prefetch is an async
+    # upload of shards that upload anyway.
+    from photon_ml_tpu import planner
+
+    prefetch_depth = max(1, int(planner.planned_value("prefetch_depth")))
+
     def _prefetch_after(step: int) -> None:
-        """Kick the next unlocked coordinate's async shard upload so it
-        overlaps the CURRENT coordinate's solve. Best-effort: a prefetch
-        failure surfaces (if real) at the consumer's own access."""
+        """Kick the next `prefetch_depth` DISTINCT upcoming unlocked
+        coordinates' async shard uploads so they overlap the CURRENT
+        coordinate's solve. The currently-solving coordinate (whose
+        shards are already resident) and already-kicked coordinates do
+        not consume depth slots — on a 2-coordinate job a planned depth
+        of 2 honestly degrades to the 1 other coordinate that exists.
+        Best-effort: a prefetch failure surfaces (if real) at the
+        consumer's own access."""
         if not prefetch:
             return
         total = num_iterations * len(ids)
+        current = ids[step % len(ids)]
+        kicked: set = set()
         for s in range(step + 1, total):
             nxt = ids[s % len(ids)]
-            if nxt in locked:
+            if nxt in locked or nxt == current or nxt in kicked:
                 continue
             hook = getattr(coordinates[nxt], "prefetch", None)
             if hook is not None:
@@ -365,7 +381,9 @@ def run_coordinate_descent(
                     hook()
                 except Exception:  # noqa: BLE001 - resurfaces at the gather
                     logger.debug("prefetch of %s failed", nxt, exc_info=True)
-            return
+            kicked.add(nxt)
+            if len(kicked) >= prefetch_depth:
+                return
 
     root_key = jax.random.PRNGKey(seed)
     # Most recent validation results (best-pass selection compares against

@@ -276,10 +276,14 @@ def _read_python_chunked(
     is independent; tests pin bitwise parity across chunk sizes)."""
     from itertools import islice
 
-    from photon_ml_tpu.utils.knobs import get_knob
+    from photon_ml_tpu import planner
     from photon_ml_tpu.utils.observability import set_stage_note, stage_timer
 
-    chunk_rows = max(1, int(get_knob("PHOTON_STREAM_CHUNK_ROWS")))
+    # Planned quantity (ISSUE 14): explicit PHOTON_STREAM_CHUNK_ROWS wins,
+    # else the installed plan's ingest_chunk_rows, else the knob default —
+    # chunk boundaries provably cannot change results (see above), so the
+    # planner is free to move them.
+    chunk_rows = max(1, int(planner.planned_value("ingest_chunk_rows")))
 
     def _records():
         for p in paths:

@@ -115,11 +115,19 @@ class MicroBatcher:
         engine: ServingEngine,
         *,
         max_batch: Optional[int] = None,
-        max_wait_ms: float = 2.0,
+        max_wait_ms: Optional[float] = None,
         max_pending: Optional[int] = None,
         default_deadline_ms: Optional[float] = None,
         latency_reservoir: int = 4096,
     ):
+        # The partial-batch flush wait is a PLANNED quantity (ISSUE 14):
+        # an explicit argument wins; None defers to the installed plan's
+        # serving_max_wait_ms (observed-latency rule) and falls back to
+        # the pre-planner default.
+        if max_wait_ms is None:
+            from photon_ml_tpu import planner
+
+            max_wait_ms = float(planner.planned_value("serving_max_wait_ms"))
         self.engine = engine
         self.max_batch = int(
             engine.max_batch if max_batch is None else max_batch
@@ -155,6 +163,10 @@ class MicroBatcher:
         # request count under sustained traffic, percentiles stay exact
         # for small runs and within one bucket width beyond.
         self._latency = telemetry.LatencyStats(reservoir=latency_reservoir)
+        # Per-batcher batch-size percentiles: the planner's bucket-
+        # ceiling evidence (the process-global serving_batch_size
+        # histogram mixes every batcher in the process).
+        self._batch_sizes = telemetry.LatencyStats(reservoir=latency_reservoir)
         self._completed = 0
         self._failed = 0
         self._shed = 0
@@ -404,6 +416,7 @@ class MicroBatcher:
         for w in waits_ms:
             telemetry.METRICS.observe("serving_queue_wait_ms", w)
         telemetry.METRICS.observe("serving_batch_size", len(batch))
+        self._batch_sizes.record(float(len(batch)))
         budgets = [(e - now) * 1e3 for _, _, _, e in batch if e is not None]
         with telemetry.span(
             "serving_batch",
@@ -574,6 +587,14 @@ class MicroBatcher:
             )
         else:
             out.update(p50_ms=None, p95_ms=None, p99_ms=None)
+        # The observed-batch-size percentile the planner's serving bucket
+        # rule consumes (serve profiles carry metrics(), so this is the
+        # rule's REAL production evidence, not a fixture-only key).
+        out["batch_size_p95"] = (
+            round(float(self._batch_sizes.percentile(95.0)), 2)
+            if self._batch_sizes.count
+            else None
+        )
         wall = (t1 - t0) if (t0 is not None and t1 is not None and t1 > t0) else 0.0
         out["qps"] = round(completed / wall, 1) if wall > 0 else None
         out.update(self.engine.metrics())

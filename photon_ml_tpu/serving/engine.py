@@ -195,12 +195,21 @@ class ServingEngine:
         self,
         bundle: ServingBundle,
         *,
-        max_batch: int = 256,
+        max_batch: Optional[int] = None,
         task: Optional[TaskType] = None,
         circuit_threshold: int = 5,
         circuit_probe_interval_s: float = 1.0,
         watchdog_ms_override: Optional[float] = None,
     ):
+        # The compiled-bucket ceiling is a PLANNED quantity (ISSUE 14):
+        # an explicit argument wins (the operator/test said so); None
+        # defers to the installed plan's serving_max_batch (observed-p95
+        # batch size rounded up) and falls back to the pre-planner
+        # default. The bucket SET is the power-of-two ladder up to it.
+        if max_batch is None:
+            from photon_ml_tpu import planner
+
+            max_batch = int(planner.planned_value("serving_max_batch"))
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
         self.task = task or bundle.task

@@ -46,6 +46,10 @@ FIT_TIMING_REQUIRED_KEYS = (
     # r10: the pod-scale robustness counters for THIS fit (a dict zipping
     # ROBUSTNESS_CLEAN_ZERO_KEYS) — all-zero on a clean fit.
     "robustness",
+    # r14: the adaptive-runtime plan block (PLAN_BLOCK_KEYS) — always
+    # present; {"active": False, ...} on an unplanned fit so a missing
+    # block is loud, never ambiguous with "planner off".
+    "plan",
 )
 
 # ------------------------------------------------------------------- ingest
@@ -138,7 +142,9 @@ ROBUSTNESS_CLEAN_ZERO_KEYS = (
     "reshard_rollbacks",
 )
 
-# Top-level serving-summary.json keys written by cli/serve.py.
+# Top-level serving-summary.json keys written by cli/serve.py. r14
+# appends the adaptive-runtime plan block (PLAN_BLOCK_KEYS), inactive on
+# an unplanned replay.
 SERVING_SUMMARY_KEYS = (
     "num_requests",
     "failed_requests",
@@ -146,6 +152,7 @@ SERVING_SUMMARY_KEYS = (
     "serving",
     "health",
     "robustness_counters",
+    "plan",
 )
 
 # bench.py chaos_multichip section (r10): the pod-scale chaos
@@ -271,6 +278,8 @@ JOURNAL_EVENT_SCHEMAS = {
     "trial_start": ("round", "trial", "mode"),
     "trial_finish": ("round", "trial", "mode", "seconds", "value",
                      "diverged_steps"),
+    # -- adaptive runtime planner (planner/plan.install_plan) --
+    "plan_decision": ("decision", "value", "source", "fallback"),
 }
 
 # ------------------------------------------------------------------- profile
@@ -291,6 +300,34 @@ PROFILE_REQUIRED_KEYS = (
 )
 PROFILE_FIT_KEYS = (*PROFILE_REQUIRED_KEYS, "fit_timing", "ingest")
 PROFILE_SERVE_KEYS = (*PROFILE_REQUIRED_KEYS, "serving")
+
+# ------------------------------------------------------------------- planner
+# The adaptive-runtime plan (ISSUE 14, photon_ml_tpu/planner/). Every
+# fit_timing and serving-summary.json carries a `plan` block zipping
+# PLAN_BLOCK_KEYS; each entry of its `decisions` list zips
+# PLAN_DECISION_KEYS. Profiles written by planned runs ALSO carry the
+# block (top-level "plan" key) so decisions round-trip through
+# read_profile — but it is deliberately NOT in PROFILE_*_KEYS: an
+# r06-era profile (pre-planner) must still load for the cold-start path.
+PLAN_BLOCK_KEYS = ("active", "source", "profile", "decisions")
+PLAN_DECISION_KEYS = ("decision", "value", "source", "evidence", "fallback")
+
+# bench.py `planner` section (r07): the adaptive-planner certificate — a
+# pilot fit's persisted profile plans a second, planner-on fit that must
+# be no slower end-to-end than the hand-tuned default (and bitwise-equal
+# to it: every planned quantity is bitwise-neutral on a matching
+# topology), the plan block must round-trip through write_profile /
+# read_profile unchanged, and a topology-mutated profile must refuse.
+PLANNER_SECTION_KEYS = (
+    "default_wall_s",
+    "planned_wall_s",
+    "wall_ratio",
+    "decisions",
+    "sources",
+    "plan_vs_default_bitwise",
+    "profile_roundtrip_ok",
+    "topology_guard_ok",
+)
 
 # Every schema this module exports, for the analyzer's drift check and
 # for tests that want to iterate all contracts.
@@ -313,4 +350,7 @@ ALL_CONTRACTS = {
     "PROFILE_REQUIRED_KEYS": PROFILE_REQUIRED_KEYS,
     "PROFILE_FIT_KEYS": PROFILE_FIT_KEYS,
     "PROFILE_SERVE_KEYS": PROFILE_SERVE_KEYS,
+    "PLAN_BLOCK_KEYS": PLAN_BLOCK_KEYS,
+    "PLAN_DECISION_KEYS": PLAN_DECISION_KEYS,
+    "PLANNER_SECTION_KEYS": PLANNER_SECTION_KEYS,
 }

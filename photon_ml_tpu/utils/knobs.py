@@ -392,6 +392,26 @@ _register(
     "reported bytes_limit (or skip the check where unknown).",
 )
 
+# ------------------------------------------------------------------- planner
+_register(
+    "PHOTON_PLAN",
+    str,
+    "",
+    "Adaptive runtime planner (photon_ml_tpu/planner/): 1 forces planning "
+    "(from PHOTON_PLAN_PROFILE, else a fast startup calibration), 0 "
+    "disables it entirely; empty = auto (plan only when a profile is "
+    "supplied). Explicit PHOTON_* knobs always override plan decisions.",
+    choices=("", *_TRUE, *_FALSE),
+)
+_register(
+    "PHOTON_PLAN_PROFILE",
+    str,
+    "",
+    "Path to a persisted run profile (telemetry.write_profile / cli "
+    "--profile) the planner consumes; a profile from a mismatched device "
+    "topology refuses loudly naming the field.",
+)
+
 # ------------------------------------------------------------- observability
 _register(
     "PHOTON_TRACE",
@@ -446,6 +466,19 @@ def get_knob(name: str, raw: Optional[str] = None) -> Value:
     if raw is None:
         raw = os.environ.get(name, "")
     return knob.parse(raw)
+
+
+def knob_is_set(name: str) -> bool:
+    """True when the knob is EXPLICITLY set (non-empty) in the
+    environment — the planner's knob-beats-plan precedence test (an
+    operator who typed a PHOTON_* value wins over any plan decision).
+    Raises KeyError for unregistered names like get_knob."""
+    if name not in KNOBS:
+        raise KeyError(
+            f"unregistered knob {name!r} — add it to "
+            f"photon_ml_tpu.utils.knobs.KNOBS (known: {len(KNOBS)} knobs)"
+        )
+    return os.environ.get(name, "").strip() != ""
 
 
 def readme_table() -> str:

@@ -60,8 +60,31 @@ class TimingRegistry:
         with self._lock:
             self.notes[name] = value
 
+    def merge_note(self, name: str, value: str, conflict: str) -> None:
+        """Atomic set-or-conflict: first writer records `value`, a later
+        DIFFERENT value collapses the note to `conflict` (and it stays
+        there). For notes that must reflect every concurrent writer —
+        e.g. the sparse-layout note, where per-shard background packs may
+        disagree and a last-write-wins record would let the planner force
+        one shard's layout onto a genuinely mixed fit."""
+        with self._lock:
+            prior = self.notes.get(name)
+            if prior is None:
+                self.notes[name] = value
+            elif prior != value:
+                self.notes[name] = conflict
+
     def get_note(self, name: str, default: Optional[str] = None) -> Optional[str]:
         return self.notes.get(name, default)
+
+    def clear_notes(self, *names: str) -> None:
+        """Drop the named annotations — per-fit evidence (pack path, RE
+        path, sparse layout) is cleared at fit start so a reused
+        registry/estimator never reports a PREVIOUS fit's decisions as
+        this fit's evidence."""
+        with self._lock:
+            for name in names:
+                self.notes.pop(name, None)
 
     def get(self, name: str, default: float = 0.0) -> float:
         return self.sections.get(name, default)

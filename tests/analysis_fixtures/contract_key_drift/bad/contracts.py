@@ -8,3 +8,6 @@ FIXTURE_INGEST_STAGES = ("fixture_decode", "fixture_assemble", "fixture_ell")
 
 # Sweep-section schema (r12): the pod-parallel hyperparameter sweep keys.
 FIXTURE_SWEEP_KEYS = ("fixture_trials", "fixture_sweep_wall", "fixture_speedup")
+
+# Plan-block schema (r14): the adaptive-runtime planner's audit keys.
+FIXTURE_PLAN_KEYS = ("fixture_plan_source", "fixture_plan_value", "fixture_plan_fallback")

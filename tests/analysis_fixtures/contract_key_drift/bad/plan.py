@@ -1,0 +1,9 @@
+"""Known-bad: re-types two plan-block schema keys (the r14
+FIXTURE_PLAN_KEYS shape) as a literal instead of importing the tuple."""
+
+
+def check_plan(block):
+    audit = {
+        k: block[k] for k in ("fixture_plan_source", "fixture_plan_value")
+    }  # re-typed plan schema
+    return audit

@@ -1,0 +1,10 @@
+"""Known-good: the plan-block schema is imported; single-key reads are
+use, not duplication."""
+
+from contracts import FIXTURE_PLAN_KEYS
+
+
+def check_plan(block):
+    missing = [k for k in FIXTURE_PLAN_KEYS if k not in block]
+    source = block.get("fixture_plan_source")  # one key is vocabulary
+    return missing, source

@@ -1,0 +1,19 @@
+"""Known-bad: hard-codes planned runtime quantities as magic-number
+literals — a function-parameter default, a call keyword, a plain
+assignment, and a bucket-shape tuple — instead of routing them through
+photon_ml_tpu.planner (planned_value/DEFAULTS) or the knob registry."""
+
+
+def flush_batcher(engine, max_wait_ms=2.0):  # parameter-default finding
+    return engine.flush(max_wait_ms)
+
+
+def serve(engine):
+    return engine.batcher(max_wait_ms=1.0)  # call-keyword finding
+
+
+def ingest(reader):
+    chunk_rows = 262144  # assignment finding
+    prefetch_depth = 2  # assignment finding
+    bucket_shapes = (64, 128, 256)  # shape-set tuple finding
+    return reader.read(chunk_rows, prefetch_depth, bucket_shapes)

@@ -1,0 +1,27 @@
+"""Known-good: every planned quantity reaches its site through the
+planner accessor (or an explicit caller argument), and the one
+deliberately pinned measurement value carries a reasoned pragma."""
+
+from photon_ml_tpu import planner
+
+
+def flush_batcher(engine, max_wait_ms=None):
+    if max_wait_ms is None:
+        max_wait_ms = planner.planned_value("serving_max_wait_ms")
+    return engine.flush(max_wait_ms)
+
+
+def serve(engine, wait):
+    return engine.batcher(max_wait_ms=wait)  # caller-supplied, not a literal
+
+
+def ingest(reader):
+    chunk_rows = int(planner.planned_value("ingest_chunk_rows"))
+    prefetch_depth = int(planner.planned_value("prefetch_depth"))
+    bucket_shapes = reader.bucket_shapes()
+    return reader.read(chunk_rows, prefetch_depth, bucket_shapes)
+
+
+def calibrate(engine):
+    # A measurement section pinning its config on purpose documents why:
+    return engine.batcher(max_wait_ms=1.0)  # photon-lint: disable=planner-constant — fixed wait pins this calibration measurement

@@ -152,11 +152,21 @@ def _failure_domain_hygiene(monkeypatch):
         "PHOTON_SHARD_UPLOAD_RETRIES",
         "PHOTON_RESHARD_RETRIES",
         "PHOTON_REBALANCE_MIN_PROMOTIONS",
+        # The adaptive planner (ISSUE 14): an ambient PHOTON_PLAN* in the
+        # developer's shell must never install a plan inside unrelated
+        # tests, and a plan installed by one test never leaks into the
+        # next (estimator fits call ensure_ambient_plan).
+        "PHOTON_PLAN",
+        "PHOTON_PLAN_PROFILE",
     ):
         monkeypatch.delenv(var, raising=False)
+    from photon_ml_tpu import planner as _planner
+
+    _planner.uninstall_plan()
     faults.clear()
     telemetry.METRICS.reset()  # counters AND histograms/gauges start clean
     yield
+    _planner.uninstall_plan()
     faults.clear()
     telemetry.METRICS.reset()
     deadline = time.monotonic() + 10.0

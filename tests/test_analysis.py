@@ -34,6 +34,7 @@ CHECK_DIRS = {
     "donation-aliasing": "donation_aliasing",
     "contract-key-drift": "contract_key_drift",
     "metric-name-sync": "metric_name_sync",
+    "planner-constant": "planner_constant",
 }
 
 
@@ -126,6 +127,19 @@ def test_bad_fixtures_cover_every_direction():
     assert "nothing increments it" in msgs  # declared-but-unincremented
     assert "statically resolvable" in msgs  # computed name
     assert "counter= argument" in msgs  # unresolvable retry counter
+
+    ps = run_checks(
+        paths=[_fixture("planner-constant", "bad")],
+        checks=["planner-constant"],
+    )
+    msgs = "\n".join(f.message for f in ps)
+    # All four binding forms must fire: parameter default, call keyword,
+    # plain assignment, and the bucket-shape tuple literal.
+    assert "max_wait_ms=2.0" in msgs
+    assert "max_wait_ms=1.0" in msgs
+    assert "chunk_rows=262144" in msgs
+    assert "prefetch_depth=2" in msgs
+    assert "bucket_shapes=(64, 128, 256)" in msgs
 
 
 # ------------------------------------------------------------------ pragmas

@@ -271,6 +271,47 @@ class TestDriversEndToEnd:
         jm = json.load(open(os.path.join(serve_out2, "serving-summary.json")))
         assert jm["num_requests"] == 2
         assert jm["serving"]["cold_start_lookups"] == 1
+        # Unplanned replays always carry an INACTIVE plan block (the
+        # SERVING_SUMMARY_KEYS contract: absence must be loud, "planner
+        # off" must be explicit).
+        assert jm["plan"]["active"] is False
+
+        # Planned replay (ISSUE 14): the first replay's persisted serve
+        # profile plans this one — bucket ceiling and micro-batch wait
+        # resolve from the plan, the summary's plan block is active and
+        # carries the full decision audit, and every summary contract
+        # key is present.
+        from photon_ml_tpu.utils.contracts import (
+            PLAN_BLOCK_KEYS,
+            SERVING_SUMMARY_KEYS,
+        )
+
+        serve_out3 = str(tmp_path / "served-planned")
+        serve_cli.main([
+            "--model-input-directory", best,
+            "--requests", jsonl,
+            "--root-output-directory", serve_out3,
+            "--profile", os.path.join(serve_out, "profile.json"),
+        ])
+        pm = json.load(open(os.path.join(serve_out3, "serving-summary.json")))
+        missing = [k for k in SERVING_SUMMARY_KEYS if k not in pm]
+        assert not missing, missing
+        block = pm["plan"]
+        assert tuple(block) == PLAN_BLOCK_KEYS
+        assert block["active"] is True
+        assert block["source"] == "profile"
+        assert {d["decision"] for d in block["decisions"]} == {
+            "serving_max_batch",
+            "serving_max_wait_ms",
+        }
+        assert pm["failed_requests"] == 0 and pm["num_requests"] == 2
+        # The planned run's own profile re-reads loudly WITH its block.
+        from photon_ml_tpu.utils import telemetry as _tel
+
+        back = _tel.read_profile(
+            os.path.join(serve_out3, "profile.json"), kind="serve"
+        )
+        assert back["plan"] == block
 
     def test_warm_start_and_partial_retrain(self, tmp_path):
         train_avro = str(tmp_path / "train.avro")

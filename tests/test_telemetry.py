@@ -356,6 +356,9 @@ class TestJournal:
         "reason": "InjectedFault('reshard_stage')",
         "surviving_devices": 4,
         "source": "memory",
+        # -- adaptive runtime planner (ISSUE 14) --
+        "decision": "prefetch_depth",
+        "fallback": 1,
     }
 
     def test_every_event_type_round_trips_its_schema(self, tmp_path):
