@@ -46,6 +46,7 @@ _CHILD = "--run-child"
 _MULTICHIP_CHILD = "--run-multichip"
 _CHAOS_MULTICHIP_CHILD = "--run-chaos-multichip"
 _ELASTIC_MESH_CHILD = "--run-elastic-mesh"
+_MULTI_TENANT_CHILD = "--run-multi-tenant"
 
 # Physical HBM roofline per chip (GB/s): v5e HBM2 peak ~819 GB/s. Any
 # achieved-bandwidth figure above it is a measurement artifact (rtt
@@ -934,6 +935,287 @@ def _elastic_mesh_child() -> None:
                 midfit_bitwise_vs_uninterrupted=midfit_bitwise,
                 clean_counters=clean_zero,
                 clean_counters_zero=clean_counters_zero,
+            )
+        )
+    )
+
+
+def _multi_tenant_child() -> None:
+    """Multi-tenant serving-platform isolation certificate (ISSUE 15) on
+    an 8-virtual-device fleet. Phases:
+
+      1. TEN TENANTS, ONE FLEET: 10 named bundles (one entity-sharded
+         over the mesh — the fleet is genuinely shared, and that tenant
+         proves the solo-dispatch path rides alongside the co-batched
+         one) admit into one TenantRegistry. Solo replicated engines
+         cold-started per tenant are the bitwise references.
+      2. CHAOS CONFINED TO ONE TENANT: the chaos tenant takes armed
+         lookup/score/admit faults (its engine's injection gate), a
+         10-microsecond watchdog (every fallback dispatch trips ->
+         DeviceHang -> circuit -> FE-only ANSWERS) and a 6x-quota flood
+         on a concurrent thread — while nine clean tenants replay
+         closed-loop traffic. Contract: every clean tenant answers with
+         ZERO failed requests, zero degradations (its LABELED robustness
+         sub-counters stay zero), admitted p99 inside its deadline, and
+         scores bitwise-equal to serving that tenant alone.
+      3. HBM-PRESSURE EVICTION: an 11th tenant admits OVER the fleet
+         budget — the coldest tenant demotes to the host tier (never
+         fails), the newcomer admits, and the demoted tenant still
+         answers bitwise through the TwoTierEntityStore overrides.
+
+    Prints exactly one JSON line."""
+    import threading as _threading
+
+    import numpy as np
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.game.model import (
+        Coefficients,
+        FixedEffectModel,
+        GameModel,
+        RandomEffectModel,
+    )
+    from photon_ml_tpu.parallel.mesh import make_mesh
+    from photon_ml_tpu.serving import (
+        Overloaded,
+        ScoreRequest,
+        ServingBundle,
+        ServingEngine,
+        TenantRegistry,
+    )
+    from photon_ml_tpu.transformers.game_transformer import (
+        CoordinateScoringSpec,
+    )
+    from photon_ml_tpu.types import TaskType
+    from photon_ml_tpu.utils import faults, telemetry
+
+    task = TaskType.LOGISTIC_REGRESSION
+    mesh = make_mesh()
+    ndev = int(mesh.devices.size)
+    d_fe, d_re = 12, 6
+    n_clean_each = 24
+    deadline_ms = 2000.0
+    faults.install("")  # nothing armed until the chaos phase
+    faults.reset_counters()
+
+    def build(seed, n_entities):
+        rng = np.random.default_rng(seed)
+        w = rng.normal(size=d_fe).astype(np.float32)
+        M = np.zeros((n_entities + 1, d_re), np.float32)
+        M[:n_entities] = rng.normal(size=(n_entities, d_re)) * 0.4
+        model = GameModel(
+            {
+                "fixed": FixedEffectModel(Coefficients(jnp.asarray(w)), task),
+                "per-e": RandomEffectModel(jnp.asarray(M), None, task),
+            }
+        )
+        specs = {
+            "fixed": CoordinateScoringSpec(shard="g"),
+            "per-e": CoordinateScoringSpec(
+                shard="re",
+                random_effect_type="eid",
+                entity_index={str(i): i for i in range(n_entities)},
+            ),
+        }
+        return model, specs
+
+    def requests(seed, n, n_entities):
+        rng = np.random.default_rng(seed)
+        X = rng.normal(size=(n, d_fe)).astype(np.float32)
+        Xe = rng.normal(size=(n, d_re)).astype(np.float32)
+        ids = rng.integers(0, n_entities + 4, size=n)
+        return [
+            ScoreRequest(
+                features={"g": X[i], "re": Xe[i]},
+                entity_ids={"eid": str(int(ids[i]))},
+                offset=float(i) * 0.0625,
+                uid=str(i),
+            )
+            for i in range(n)
+        ]
+
+    def scores_of(results):
+        return np.asarray([r.score for r in results], np.float64)
+
+    # ---- phase 1: ten tenants, one fleet ----------------------------------
+    # Entity counts vary per tenant (heterogeneous bundles co-batch); one
+    # clean tenant stages entity-sharded over the mesh.
+    clean_names = [f"clean-{i}" for i in range(1, 9)] + ["clean-sharded"]
+    ent_of = {"chaos": 40}
+    for i, nm in enumerate(clean_names):
+        ent_of[nm] = 24 + 8 * i
+    ent_of["clean-sharded"] = 16 * ndev
+    models = {nm: build(100 + j, ent_of[nm]) for j, nm in enumerate(["chaos"] + clean_names)}
+    reqs = {
+        nm: requests(200 + j, n_clean_each, ent_of[nm])
+        for j, nm in enumerate(["chaos"] + clean_names)
+    }
+    refs = {}
+    for nm in ["chaos"] + clean_names:
+        m, s = models[nm]
+        with ServingEngine(
+            ServingBundle.from_model(m, s, task), max_batch=16
+        ) as eng:
+            refs[nm] = scores_of(eng.score_batch(reqs[nm]))
+
+    bundles = {}
+    for nm in ["chaos"] + clean_names:
+        m, s = models[nm]
+        bundles[nm] = ServingBundle.from_model(
+            m, s, task, mesh=mesh if nm == "clean-sharded" else None
+        )
+    latecomer_model = build(999, 32)
+    latecomer_bundle = ServingBundle.from_model(*latecomer_model, task)
+    resident = sum(b.device_bytes_per_shard() for b in bundles.values())
+    # Budget fits the ten residents but NOT the latecomer: admission must
+    # demote a cold tenant instead of failing anyone.
+    budget = resident + latecomer_bundle.device_bytes_per_shard() // 2
+
+    reg = TenantRegistry(
+        max_batch=16,
+        max_wait_ms=1.0,  # photon-lint: disable=planner-constant — deliberate section config: fixed wait pins the measurement, not a runtime default
+        hbm_budget_bytes=int(budget),
+    )
+    reg.admit(
+        "chaos",
+        bundles["chaos"],
+        max_pending=8,
+        deadline_ms=deadline_ms,
+        inject_faults=True,
+        watchdog_ms_override=0.01,  # every chaos fallback dispatch trips
+    )
+    for nm in clean_names:
+        reg.admit(
+            nm,
+            bundles[nm],
+            deadline_ms=deadline_ms,
+            inject_faults=False,
+        )
+
+    # ---- phase 2: chaos confined to one tenant ----------------------------
+    faults.install("lookup:2,score:3,admit:2")
+    chaos_shed = [0]
+    chaos_answered = [0]
+    chaos_reqs = requests(300, 48, ent_of["chaos"])
+
+    def _chaos_flood():
+        futs = []
+        for r in chaos_reqs:
+            try:
+                futs.append(reg.submit("chaos", r))  # block=False: shed!
+            except Overloaded:
+                chaos_shed[0] += 1
+            except Exception:  # noqa: BLE001 - typed rejections only
+                pass
+        for f in futs:
+            try:
+                f.result(timeout=120)
+                chaos_answered[0] += 1
+            except Exception:  # noqa: BLE001 - chaos tenant may reject
+                pass
+
+    flood = _threading.Thread(target=_chaos_flood, name="bench-mt-chaos")
+    flood.start()
+    clean_futs = {nm: [] for nm in clean_names}
+    for i in range(n_clean_each):
+        for nm in clean_names:
+            clean_futs[nm].append(reg.submit(nm, reqs[nm][i], block=True))
+    clean_scores = {
+        nm: np.asarray([f.result(timeout=120).score for f in fs], np.float64)
+        for nm, fs in clean_futs.items()
+    }
+    flood.join()
+    faults.install("")
+
+    m = reg.metrics()
+    clean_bitwise = all(
+        bool(np.array_equal(clean_scores[nm], refs[nm]))
+        for nm in clean_names
+    )
+    clean_failed = sum(m["tenants"][nm]["failed"] for nm in clean_names)
+    clean_deadline = sum(
+        m["tenants"][nm]["deadline_missed"] for nm in clean_names
+    )
+    clean_degraded = sum(
+        m["tenants"][nm]["degraded_batches"] for nm in clean_names
+    )
+    # The labeled sub-counters are the isolation proof at the metrics
+    # layer: every clean tenant's slice of every serving robustness
+    # counter must be zero even while the aggregate counts chaos events.
+    for counter in (
+        "serving_degraded_batches",
+        "serving_shed_requests",
+        "serving_deadline_misses",
+        "serving_fe_only_requests",
+    ):
+        labeled = telemetry.METRICS.labeled_counters(counter)
+        clean_degraded += sum(
+            labeled.get(f"tenant={nm}", 0) for nm in clean_names
+        )
+    clean_p99_ok = all(
+        m["tenants"][nm]["p99_ms"] is not None
+        and m["tenants"][nm]["p99_ms"] < deadline_ms
+        for nm in clean_names
+    )
+    chaos_hangs = int(
+        telemetry.METRICS.labeled_counters("watchdog_trips").get(
+            "tenant=chaos", 0
+        )
+    )
+
+    # ---- phase 3: HBM-pressure eviction -----------------------------------
+    # Touch everyone except clean-1 so it is the coldest; the latecomer's
+    # admission must demote it (never fail it) and both keep answering.
+    for nm in ["chaos"] + clean_names[1:]:
+        try:
+            reg.score(nm, reqs[nm][0])
+        except Exception:  # noqa: BLE001 - chaos tenant may shed
+            pass
+    admitted_over_budget = False
+    demoted_tenant = None
+    try:
+        reg.admit("latecomer", latecomer_bundle, deadline_ms=deadline_ms)
+        admitted_over_budget = True
+    except Exception:  # noqa: BLE001 - recorded in the artifact
+        pass
+    m3 = reg.metrics()
+    for nm, block in m3["tenants"].items():
+        if block["demoted"]:
+            demoted_tenant = nm
+    evicted_bitwise = False
+    if demoted_tenant is not None:
+        got = scores_of(
+            [reg.score(demoted_tenant, r) for r in reqs[demoted_tenant]]
+        )
+        evicted_bitwise = bool(np.array_equal(got, refs[demoted_tenant]))
+
+    final = reg.metrics()
+    reg.close(release_bundles=True)
+
+    print(
+        json.dumps(
+            dict(
+                n_devices=ndev,
+                n_tenants=10,
+                chaos_tenant="chaos",
+                injected_faults=int(faults.COUNTERS.get("injected_faults")),
+                chaos_shed=int(chaos_shed[0]),
+                chaos_answered=int(chaos_answered[0]),
+                chaos_hangs=chaos_hangs,
+                clean_requests=int(n_clean_each * len(clean_names)),
+                clean_failed_requests=int(clean_failed),
+                clean_deadline_misses=int(clean_deadline),
+                clean_degraded_batches=int(clean_degraded),
+                clean_p99_within_deadline=bool(clean_p99_ok),
+                clean_bitwise_vs_solo=bool(clean_bitwise),
+                cobatch_dispatches=int(final["cobatch_dispatches"]),
+                demoted_tenant=demoted_tenant,
+                admitted_over_budget=bool(admitted_over_budget),
+                evicted_bitwise=bool(evicted_bitwise),
+                tenants={
+                    nm: dict(block)
+                    for nm, block in final["tenants"].items()
+                },
             )
         )
     )
@@ -2022,6 +2304,104 @@ def _child() -> None:
             failed=True, reason=f"{type(exc).__name__}: {exc}"
         )
 
+    # ---- multi-tenant serving: N isolated bundles on one mesh -------------
+    # Own 8-virtual-device subprocess (ISSUE 15): 10 tenant bundles on one
+    # fleet, injected faults/hangs/overload confined to ONE chaos tenant —
+    # every clean tenant must answer with zero failed requests, admitted
+    # p99 inside its deadline, and scores bitwise-equal to serving it
+    # alone; an over-budget 11th admission must demote (never fail) the
+    # coldest tenant, which keeps answering bitwise from the host tier.
+    try:
+        env_mt = dict(os.environ)
+        env_mt["JAX_PLATFORMS"] = "cpu"
+        env_mt.pop("PALLAS_AXON_POOL_IPS", None)
+        flags_mt = env_mt.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags_mt:
+            env_mt["XLA_FLAGS"] = (
+                flags_mt + " --xla_force_host_platform_device_count=8"
+            ).strip()
+        env_mt.pop("PHOTON_FAULTS", None)  # the child arms its own drill
+        env_mt.pop("PHOTON_WATCHDOG_MS", None)
+        out_mt = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), _MULTI_TENANT_CHILD],
+            capture_output=True,
+            text=True,
+            timeout=600,
+            env=env_mt,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        line_mt = next(
+            (l for l in out_mt.stdout.splitlines() if l.startswith("{")), None
+        )
+        if line_mt is None:
+            raise RuntimeError(
+                f"multi_tenant child produced no JSON: {out_mt.stderr[-1500:]}"
+            )
+        mt = json.loads(line_mt)
+        from photon_ml_tpu.utils.contracts import MULTI_TENANT_SECTION_KEYS
+
+        missing_mt = [
+            k for k in MULTI_TENANT_SECTION_KEYS if mt.get(k) is None
+        ]
+        # demoted_tenant is a name (or None on a broken drill) — its
+        # absence is covered by the admitted/evicted flags below.
+        missing_mt = [k for k in missing_mt if k != "demoted_tenant"]
+        if missing_mt:
+            raise RuntimeError(
+                f"multi_tenant section is missing keys {missing_mt} — the "
+                "serving-platform contract is broken"
+            )
+        if mt["injected_faults"] <= 0 or mt["chaos_shed"] <= 0:
+            raise RuntimeError(
+                "multi_tenant chaos phase injected nothing "
+                f"(faults={mt['injected_faults']}, shed={mt['chaos_shed']})"
+                " — the isolation drill tested nothing"
+            )
+        if mt["clean_failed_requests"] or mt["clean_degraded_batches"]:
+            raise RuntimeError(
+                f"chaos leaked across tenants: {mt['clean_failed_requests']}"
+                f" clean failures, {mt['clean_degraded_batches']} clean "
+                "degradations — the isolation contract is broken"
+            )
+        if not mt["clean_bitwise_vs_solo"]:
+            raise RuntimeError(
+                "co-batched clean-tenant scores diverged from solo serving"
+                " — the cross-tenant bitwise contract is broken"
+            )
+        if not mt["clean_p99_within_deadline"]:
+            raise RuntimeError(
+                "a clean tenant's admitted p99 blew its deadline under a "
+                "neighbor's chaos — the latency isolation contract is "
+                "broken"
+            )
+        if mt["cobatch_dispatches"] <= 0:
+            raise RuntimeError(
+                "no cross-tenant co-batched dispatch ran — the section "
+                "measured solo serving only"
+            )
+        if not mt["admitted_over_budget"] or not mt["evicted_bitwise"]:
+            raise RuntimeError(
+                "HBM-pressure eviction drill failed: over-budget admission"
+                f" {mt['admitted_over_budget']}, evicted tenant bitwise "
+                f"{mt['evicted_bitwise']}"
+            )
+        variants["multi_tenant"] = mt
+        _mark(
+            f"multi_tenant survived (10 tenants on {mt['n_devices']} vdev:"
+            f" {mt['injected_faults']} faults + {mt['chaos_shed']} sheds + "
+            f"{mt['chaos_hangs']} hangs confined to '{mt['chaos_tenant']}',"
+            f" {mt['clean_requests']} clean requests 0 failed bitwise, "
+            f"{mt['cobatch_dispatches']} co-batched dispatches, "
+            f"'{mt['demoted_tenant']}' evicted to host tier bitwise)"
+        )
+    except Exception as exc:  # noqa: BLE001 - bench must still print a line
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        variants["multi_tenant"] = dict(
+            failed=True, reason=f"{type(exc).__name__}: {exc}"
+        )
+
     # ---- online serving (pinned bundle + deadline micro-batcher) ----------
     # The north star serves live traffic; this measures the online path the
     # offline scoring number cannot show: per-request latency through the
@@ -2873,6 +3253,9 @@ def main() -> None:
         return
     if _ELASTIC_MESH_CHILD in sys.argv:
         _elastic_mesh_child()
+        return
+    if _MULTI_TENANT_CHILD in sys.argv:
+        _multi_tenant_child()
         return
     if _CHILD in sys.argv:
         _child()

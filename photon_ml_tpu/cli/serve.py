@@ -57,8 +57,18 @@ def build_parser() -> argparse.ArgumentParser:
         description="Replay scoring requests through the online serving "
         "engine (TPU-native Photon ML)",
     )
-    p.add_argument("--model-input-directory", required=True,
-                   help="a model directory written by the training driver")
+    p.add_argument("--model-input-directory", required=False, default=None,
+                   help="a model directory written by the training driver "
+                        "(single-tenant mode; or use --tenant)")
+    p.add_argument("--tenant", action="append", default=None,
+                   metavar="NAME=MODEL_DIR",
+                   help="multi-tenant mode (repeatable): serve N named "
+                        "model bundles on one device fleet through the "
+                        "TenantRegistry — per-tenant admission quotas, "
+                        "deadlines and failure domains, weighted-fair "
+                        "cross-tenant co-batching. Replay traffic is "
+                        "assigned round-robin across tenants; the summary "
+                        "gains a per-tenant block")
     p.add_argument("--requests", required=True,
                    help="request stream: a .json/.jsonl file (one request "
                         "object per line) or an Avro file/part-directory")
@@ -106,43 +116,60 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
-def _iter_json_requests(
-    path: str, bundle: ServingBundle, malformed: List[int]
-) -> Iterator[ScoreRequest]:
+def _encode_json_request(bundle: ServingBundle, doc: dict) -> ScoreRequest:
+    """One parsed JSON request document -> ScoreRequest against `bundle`
+    (shared by the single-tenant stream and the multi-tenant round-robin,
+    which encodes each document against its ASSIGNED tenant's bundle)."""
+    features = {}
+    for shard, payload in (doc.get("features") or {}).items():
+        if isinstance(payload, dict) and "indices" in payload:
+            features[shard] = (
+                np.asarray(payload["indices"], np.int32),
+                np.asarray(payload.get("values", []), np.float32),
+            )
+        elif isinstance(payload, dict):
+            features[shard] = payload  # named features -> index maps
+        else:
+            features[shard] = np.asarray(payload, np.float32)
+    return bundle.encode_request(
+        features,
+        entity_ids=doc.get("ids") or {},
+        offset=float(doc.get("offset") or 0.0),
+        uid=None if doc.get("uid") is None else str(doc["uid"]),
+    )
+
+
+def _iter_json_docs(path: str, malformed: List[int]) -> Iterator[dict]:
+    """Parsed JSON request documents; a malformed line costs ONE record
+    (counted), never the rest of the stream."""
     with open(path) as f:
         for lineno, line in enumerate(f, 1):
             line = line.strip()
             if not line:
                 continue
-            # One malformed line costs ONE record (counted), never the
-            # rest of the stream — same isolation the per-future harvest
-            # gives requests that fail at scoring time.
             try:
-                doc = json.loads(line)
-                features = {}
-                for shard, payload in (doc.get("features") or {}).items():
-                    if isinstance(payload, dict) and "indices" in payload:
-                        features[shard] = (
-                            np.asarray(payload["indices"], np.int32),
-                            np.asarray(payload.get("values", []), np.float32),
-                        )
-                    elif isinstance(payload, dict):
-                        features[shard] = payload  # named features -> index maps
-                    else:
-                        features[shard] = np.asarray(payload, np.float32)
-                req = bundle.encode_request(
-                    features,
-                    entity_ids=doc.get("ids") or {},
-                    offset=float(doc.get("offset") or 0.0),
-                    uid=None if doc.get("uid") is None else str(doc["uid"]),
-                )
+                yield json.loads(line)
             except Exception as exc:  # noqa: BLE001 - per-record isolation
                 malformed[0] += 1
                 logger.warning(
                     "skipping malformed request at %s:%d: %s", path, lineno, exc
                 )
-                continue
-            yield req
+
+
+def _iter_json_requests(
+    path: str, bundle: ServingBundle, malformed: List[int]
+) -> Iterator[ScoreRequest]:
+    for doc in _iter_json_docs(path, malformed):
+        # One malformed line costs ONE record (counted), never the
+        # rest of the stream — same isolation the per-future harvest
+        # gives requests that fail at scoring time.
+        try:
+            req = _encode_json_request(bundle, doc)
+        except Exception as exc:  # noqa: BLE001 - per-record isolation
+            malformed[0] += 1
+            logger.warning("skipping malformed request in %s: %s", path, exc)
+            continue
+        yield req
 
 
 def _iter_avro_requests(
@@ -186,6 +213,29 @@ def run(args) -> dict:
             "Avro request replay needs --feature-shard-configurations "
             "(the bag -> shard mapping offline ingest uses)"
         )
+    tenants = getattr(args, "tenant", None)
+    if bool(tenants) == bool(args.model_input_directory):
+        raise ValueError(
+            "pass exactly one of --model-input-directory (single-tenant) "
+            "or --tenant NAME=MODEL_DIR (repeatable, multi-tenant)"
+        )
+    if tenants and getattr(args, "reshard_to", None) is not None:
+        # Loud refusal, not a silent no-op: the reshard drill drives ONE
+        # engine's orchestrator and has no multi-tenant form yet.
+        raise ValueError(
+            "--reshard-to is a single-tenant drill; it cannot be combined "
+            "with --tenant"
+        )
+    tenant_specs: List[tuple] = []
+    for spec in tenants or []:
+        name, sep, model_dir = spec.partition("=")
+        if not sep or not name or not model_dir:
+            raise ValueError(
+                f"--tenant {spec!r}: expected NAME=MODEL_DIR"
+            )
+        if name in dict(tenant_specs):
+            raise ValueError(f"duplicate tenant name {name!r}")
+        tenant_specs.append((name, model_dir))
     index_maps = None
     if getattr(args, "offheap_indexmap_dir", None):
         from photon_ml_tpu.cli.config import parse_feature_shard_config
@@ -236,6 +286,8 @@ def run(args) -> dict:
             # After install_journal so every plan_decision event lands in
             # THIS run's journal. Loud on topology mismatch by design.
             planner.ensure_ambient_plan(getattr(args, "profile", None))
+        if tenant_specs:
+            return _run_multi_tenant(args, tenant_specs, index_maps)
         bundle = load_bundle(args.model_input_directory, index_maps=index_maps)
         logger.info(
             "bundle pinned: %d coordinate(s), %.1f MB uploaded in %.3fs",
@@ -262,6 +314,35 @@ def run(args) -> dict:
         if journal_owned:
             telemetry.uninstall_journal()
         journal.close()
+
+
+def _write_score_part(scores_dir: str, k: int, results, model_id: str) -> str:
+    """Write one replay window's scores as a crash-safe Avro part file:
+    a dot-prefixed temp name (invisible to list_container_files) then
+    os.replace into place — a SIGKILL mid-write tears the temp file,
+    never a part a reader would pick up. `results` is a list of (stream
+    position, ScoreResult); uids default to the position. Shared by the
+    single-tenant and multi-tenant replay paths."""
+    from photon_ml_tpu.io import avro as avro_io
+    from photon_ml_tpu.io import schemas
+
+    os.makedirs(scores_dir, exist_ok=True)
+    part = os.path.join(scores_dir, f"part-{k:05d}.avro")
+    tmp = os.path.join(scores_dir, f".part-{k:05d}.avro.tmp")
+    avro_io.write_container(
+        tmp,
+        schemas.SCORING_RESULT,
+        score_store.score_records(
+            np.asarray([r.score for _, r in results], np.float64),
+            model_id,
+            uids=[
+                r.uid if r.uid is not None else str(pos)
+                for pos, r in results
+            ],
+        ),
+    )
+    os.replace(tmp, part)
+    return part
 
 
 def _run_with_bundle(args, bundle: ServingBundle) -> dict:
@@ -308,8 +389,6 @@ def _run_with_bundle(args, bundle: ServingBundle) -> dict:
     # O(window) end to end — accumulating the whole stream's scores/uids
     # host-side would re-create exactly the pattern the chunked
     # score_records path removed from cli/score.py.
-    from photon_ml_tpu.io import avro as avro_io
-    from photon_ml_tpu.io import schemas
 
     scores_dir = os.path.join(out_root, "scores")
     os.makedirs(scores_dir, exist_ok=True)
@@ -378,25 +457,7 @@ def _run_with_bundle(args, bundle: ServingBundle) -> dict:
                         exc,
                     )
             if results:
-                # Crash-safe part files: write to a dot-prefixed temp name
-                # (invisible to list_container_files) and os.replace into
-                # place — a SIGKILL mid-write tears the temp file, never a
-                # part a reader would pick up.
-                part = os.path.join(scores_dir, f"part-{k:05d}.avro")
-                tmp = os.path.join(scores_dir, f".part-{k:05d}.avro.tmp")
-                avro_io.write_container(
-                    tmp,
-                    schemas.SCORING_RESULT,
-                    score_store.score_records(
-                        np.asarray([r.score for _, r in results], np.float64),
-                        model_id,
-                        uids=[
-                            r.uid if r.uid is not None else str(pos)
-                            for pos, r in results
-                        ],
-                    ),
-                )
-                os.replace(tmp, part)
+                _write_score_part(scores_dir, k, results, model_id)
             n_requests += len(window)
         if reshard_to is not None and reshard_thread is None:
             # Single-window replay: the drill still runs (and is still
@@ -442,6 +503,10 @@ def _run_with_bundle(args, bundle: ServingBundle) -> dict:
         # Explicit --max-batch/--max-wait-ms flags re-source their
         # decisions as "knob" so the audit shows what actually served.
         "plan": _planner_mod.plan_block(overrides=_cli_plan_overrides),
+        # The per-tenant block (ISSUE 15): always present so absence is
+        # loud — empty on a single-tenant replay, one TENANT_BLOCK_KEYS
+        # dict per tenant under --tenant.
+        "tenants": {},
     }
     if reshard_to is not None:
         summary["reshard"] = reshard_info
@@ -470,6 +535,193 @@ def _run_with_bundle(args, bundle: ServingBundle) -> dict:
     telemetry.write_profile(os.path.join(out_root, "profile.json"), profile)
     logger.info("serving metrics: %s", metrics)
     return summary
+
+
+def _run_multi_tenant(args, tenant_specs, index_maps) -> dict:
+    """Multi-tenant replay (`--tenant NAME=MODEL_DIR` repeatable): every
+    tenant's bundle pins onto ONE device fleet behind a TenantRegistry —
+    per-tenant admission quotas, deadline budgets and failure domains,
+    weighted-fair cross-tenant co-batching — and the replay stream is
+    assigned round-robin across tenants (each record encoded against its
+    assigned tenant's bundle). Scores land under scores/<tenant>/, and
+    the summary carries one TENANT_BLOCK_KEYS dict per tenant."""
+    from photon_ml_tpu import planner as _planner_mod
+    from photon_ml_tpu.serving.tenancy import TenantRegistry
+    from photon_ml_tpu.utils import faults, telemetry
+    from photon_ml_tpu.utils.contracts import ROBUSTNESS_CLEAN_ZERO_KEYS
+
+    _cli_plan_overrides = {}
+    if args.max_batch is not None:
+        _cli_plan_overrides["serving_max_batch"] = int(args.max_batch)
+    if args.max_wait_ms is not None:
+        _cli_plan_overrides["serving_max_wait_ms"] = float(args.max_wait_ms)
+
+    is_json = args.requests.endswith((".json", ".jsonl"))
+    shard_configs = None
+    if args.feature_shard_configurations:
+        from photon_ml_tpu.cli.config import parse_feature_shard_config
+
+        shard_configs = dict(
+            parse_feature_shard_config(s)
+            for s in args.feature_shard_configurations
+        )
+
+    out_root = args.root_output_directory
+    os.makedirs(out_root, exist_ok=True)
+    t_warm = time.perf_counter()
+    registry = TenantRegistry(
+        max_batch=args.max_batch, max_wait_ms=args.max_wait_ms
+    )
+    names: List[str] = []
+    try:
+        for name, model_dir in tenant_specs:
+            bundle = load_bundle(model_dir, index_maps=index_maps)
+            registry.admit(
+                name,
+                bundle,
+                max_pending=args.max_pending,
+                deadline_ms=args.deadline_ms,
+            )
+            names.append(name)
+            logger.info(
+                "tenant %r pinned: %d coordinate(s), %.1f MB",
+                name,
+                len(bundle.coordinates),
+                bundle.upload_bytes / 1e6,
+            )
+        warmup_s = time.perf_counter() - t_warm
+
+        malformed = [0]
+        if is_json:
+            raw_stream = _iter_json_docs(args.requests, malformed)
+        else:
+            raw_stream = _iter_avro_records(args.requests)
+
+
+        scores_root = os.path.join(out_root, "scores")
+        model_id = args.model_id or "game-model"
+        n_requests = 0
+        n_failed = 0
+        assigned = 0  # round-robin cursor over raw records
+        t_replay = time.perf_counter()
+        with telemetry.span("serve_replay", tenants=names):
+            for k in itertools.count():
+                window = []  # (tenant name, request)
+                for raw in itertools.islice(raw_stream, REPLAY_WINDOW):
+                    name = names[assigned % len(names)]
+                    assigned += 1
+                    bundle = registry.tenant(name).bundle
+                    try:
+                        if is_json:
+                            req = _encode_json_request(bundle, raw)
+                        else:
+                            req = request_from_record(
+                                bundle, raw, shard_configs
+                            )
+                    except Exception as exc:  # noqa: BLE001 - per-record
+                        malformed[0] += 1
+                        logger.warning(
+                            "skipping malformed request for tenant %r: %s",
+                            name,
+                            exc,
+                        )
+                        continue
+                    window.append((name, req))
+                if not window:
+                    break
+                futures = [
+                    (name, registry.submit(name, r, block=True))
+                    for name, r in window
+                ]
+                by_tenant: dict = {}
+                for i, (name, fut) in enumerate(futures):
+                    try:
+                        res = fut.result()
+                    except Exception as exc:  # noqa: BLE001 - per-request
+                        n_failed += 1
+                        logger.warning(
+                            "tenant %r request %d failed: %s",
+                            name,
+                            n_requests + i,
+                            exc,
+                        )
+                        continue
+                    by_tenant.setdefault(name, []).append(
+                        (n_requests + i, res)
+                    )
+                for name, results in by_tenant.items():
+                    _write_score_part(
+                        os.path.join(scores_root, name),
+                        k,
+                        results,
+                        model_id,
+                    )
+                n_requests += len(window)
+        replay_s = time.perf_counter() - t_replay
+        metrics = registry.metrics()
+        health = {
+            name: registry.tenant(name).engine.health.snapshot()
+            for name in names
+        }
+    finally:
+        registry.close(release_bundles=True)
+    logger.info(
+        "replayed %d request(s) across %d tenant(s), %d failed, %d "
+        "malformed skipped",
+        n_requests,
+        len(names),
+        n_failed,
+        malformed[0],
+    )
+
+    summary = {
+        "num_requests": n_requests,
+        "failed_requests": n_failed,
+        "malformed_records": malformed[0],
+        "serving": metrics,
+        "health": health,
+        "robustness_counters": {
+            **{k: 0 for k in ROBUSTNESS_CLEAN_ZERO_KEYS},
+            **faults.counters(),
+        },
+        "plan": _planner_mod.plan_block(overrides=_cli_plan_overrides),
+        "tenants": metrics["tenants"],
+    }
+    with open(os.path.join(out_root, "serving-summary.json"), "w") as f:
+        json.dump(summary, f, indent=2, default=str)
+    profile = telemetry.build_profile(
+        "serve",
+        wall_s=warmup_s + replay_s,
+        stages={
+            "warmup_s": round(warmup_s, 4),
+            "replay_s": round(replay_s, 4),
+        },
+        dispatch={
+            "max_batch": int(registry.max_batch),
+            "max_wait_ms": float(registry.max_wait_s * 1e3),
+            "tenants": names,
+        },
+        bucket_shapes={"registry_buckets": list(registry.buckets)},
+        serving=metrics,
+    )
+    profile["plan"] = _planner_mod.plan_block(overrides=_cli_plan_overrides)
+    telemetry.write_profile(os.path.join(out_root, "profile.json"), profile)
+    logger.info("multi-tenant serving metrics: %s", metrics)
+    return summary
+
+
+def _iter_avro_records(path: str) -> Iterator[dict]:
+    """Raw reference-shaped Avro replay records (block-streaming,
+    corrupt blocks quarantined) — the multi-tenant round-robin encodes
+    each against its assigned tenant's bundle."""
+    from photon_ml_tpu.io import avro as avro_io
+
+    paths = (
+        avro_io.list_container_files(path) if os.path.isdir(path) else [path]
+    )
+    for p in paths:
+        for _, rec in avro_io.iter_container(p, quarantine=True):
+            yield rec
 
 
 def main(argv: Optional[List[str]] = None) -> None:

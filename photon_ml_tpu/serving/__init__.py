@@ -10,8 +10,13 @@ management tier that keeps it serving under fire: admission control
 (`DeadlineExceeded`), a circuit breaker that degrades a persistently
 faulting device to fixed-effect-only answers, versioned atomic bundle
 hot-swap (`BundleManager`), and the STARTING → READY ⇄ DEGRADED →
-DRAINING → CLOSED health machine. See PARITY.md "Online serving" and
-"Serving failure semantics".
+DRAINING → CLOSED health machine. `tenancy.py` generalizes the stack to
+N named tenants sharing one device fleet (`TenantRegistry`): per-tenant
+admission quotas and deadlines, weighted-fair cross-tenant co-batching
+(bitwise-equal to solo dispatch), fully per-tenant failure domains, and
+HBM-pressure demotion of cold tenants' RE rows to the host tier. See
+PARITY.md "Online serving", "Serving failure semantics" and
+"Multi-tenant serving".
 """
 
 from photon_ml_tpu.serving.batcher import MicroBatcher
@@ -21,8 +26,10 @@ from photon_ml_tpu.serving.bundle import (
     ServingCoordinate,
     ShardHealth,
     TwoTierEntityStore,
+    demote_bundle_to_host_tier,
     load_bundle,
 )
+from photon_ml_tpu.serving.tenancy import Tenant, TenantRegistry
 from photon_ml_tpu.utils.faults import DeviceHang
 from photon_ml_tpu.serving.engine import ScoreResult, ServingEngine
 from photon_ml_tpu.serving.reshard import (
@@ -67,6 +74,9 @@ __all__ = [
     "ServingState",
     "ShardHealth",
     "SwapIncompatible",
+    "Tenant",
+    "TenantRegistry",
     "TwoTierEntityStore",
+    "demote_bundle_to_host_tier",
     "load_bundle",
 ]

@@ -119,6 +119,8 @@ class MicroBatcher:
         max_pending: Optional[int] = None,
         default_deadline_ms: Optional[float] = None,
         latency_reservoir: int = 4096,
+        thread_name: Optional[str] = None,
+        metric_labels: Optional[Dict[str, str]] = None,
     ):
         # The partial-batch flush wait is a PLANNED quantity (ISSUE 14):
         # an explicit argument wins; None defers to the installed plan's
@@ -184,8 +186,22 @@ class MicroBatcher:
         # metrics when several engines serve in one process)
         self._t_first_submit: Optional[float] = None
         self._t_last_done: Optional[float] = None
+        # Per-tenant attribution (ISSUE 15): a batcher serving one tenant
+        # of a multi-tenant registry carries that tenant's metric labels
+        # — every process-global robustness counter it bumps (shed,
+        # deadline, degraded, FE-only, flush death) lands in both the
+        # aggregate and the tenant's labeled sub-count, whatever thread
+        # fires it. None (the single-tenant default) keeps increments
+        # unlabeled, bit-for-bit the pre-tenancy behavior.
+        self._metric_labels = (
+            tuple(sorted((k, str(v)) for k, v in metric_labels.items()))
+            if metric_labels
+            else None
+        )
         self._thread = threading.Thread(
-            target=self._flush_loop, name="photon-serving-flush", daemon=True
+            target=self._flush_loop,
+            name=thread_name or "photon-serving-flush",
+            daemon=True,
         )
         self._thread.start()
 
@@ -255,13 +271,20 @@ class MicroBatcher:
                     # fault simulates admission failing for a live batcher
                     # — it must never mask the typed closed/unhealthy
                     # rejections (nor count sheds for requests that would
-                    # have been refused regardless). Once per submit.
+                    # have been refused regardless). Once per submit. The
+                    # engine's per-tenant injection gate applies: a chaos
+                    # drill arming `admit` must target one tenant's
+                    # admissions, not every batcher in the process.
                     first_pass = False
                     try:
-                        faults.fault_point("admit")
+                        if getattr(self.engine, "inject_faults", True):
+                            faults.fault_point("admit")
                     except faults.InjectedFault as exc:
                         self._shed += 1
-                        faults.COUNTERS.increment("serving_shed_requests")
+                        faults.COUNTERS.increment(
+                            "serving_shed_requests",
+                            labels=self._metric_labels,
+                        )
                         raise Overloaded(
                             f"admission fault injected: {exc}"
                         ) from exc
@@ -269,7 +292,9 @@ class MicroBatcher:
                     break
                 if not block:
                     self._shed += 1
-                    faults.COUNTERS.increment("serving_shed_requests")
+                    faults.COUNTERS.increment(
+                        "serving_shed_requests", labels=self._metric_labels
+                    )
                     raise Overloaded(
                         f"pending queue full ({self.max_pending} requests); "
                         "shed by admission control"
@@ -299,10 +324,23 @@ class MicroBatcher:
         # batcher unhealthy (typed rejections on later submits + a
         # permanent DEGRADED reason on the engine), stay joinable.
         try:
+            if self._metric_labels is not None:
+                # The tenant attribution scope lives for the thread's
+                # whole life: everything the dispatch path fires from
+                # HERE — including watchdog guards, whose trips are
+                # recorded by the MONITOR thread with the labels captured
+                # at arm time — lands in this tenant's sub-counts.
+                with telemetry.metric_label_scope(
+                    **dict(self._metric_labels)
+                ):
+                    self._flush_loop_inner()
+                return
             self._flush_loop_inner()
         except BaseException as exc:  # noqa: BLE001 - terminal thread guard
             logger.error("serving flush thread died: %r", exc)
-            faults.COUNTERS.increment("serving_flush_thread_failures")
+            faults.COUNTERS.increment(
+                "serving_flush_thread_failures", labels=self._metric_labels
+            )
             with self._cv:
                 self._unhealthy = exc
                 doomed = list(self._pending)
@@ -358,7 +396,9 @@ class MicroBatcher:
                         self._service_tail_s *= 0.5
                 self._cv.notify_all()  # queue space freed: wake submitters
             for fut in expired:
-                faults.COUNTERS.increment("serving_deadline_misses")
+                faults.COUNTERS.increment(
+                    "serving_deadline_misses", labels=self._metric_labels
+                )
                 fut.set_exception(
                     DeadlineExceeded(
                         "request expired in queue before batch assembly"
@@ -447,7 +487,9 @@ class MicroBatcher:
             # once per co-batched request would stall the flush thread
             # for many watchdog periods while the queue blows deadlines.
             breaker.on_failure(permit)
-            faults.COUNTERS.increment("serving_degraded_batches")
+            faults.COUNTERS.increment(
+                "serving_degraded_batches", labels=self._metric_labels
+            )
             with self._cv:
                 self._degraded += 1
             logger.warning(
@@ -469,7 +511,9 @@ class MicroBatcher:
             # request poisons a pack too): the permit is returned and each
             # per-request outcome is judged individually.
             breaker.on_abandon(permit)
-            faults.COUNTERS.increment("serving_degraded_batches")
+            faults.COUNTERS.increment(
+                "serving_degraded_batches", labels=self._metric_labels
+            )
             with self._cv:
                 self._degraded += 1
             logger.warning(
@@ -539,7 +583,9 @@ class MicroBatcher:
             return
         with self._cv:
             self._fe_only += len(batch)
-        faults.COUNTERS.increment("serving_fe_only_requests", len(batch))
+        faults.COUNTERS.increment(
+            "serving_fe_only_requests", len(batch), labels=self._metric_labels
+        )
         now = time.monotonic()
         for (_, fut, t0, _), res in zip(batch, results):
             self._complete(fut, res, now - t0)

@@ -568,7 +568,7 @@ class ServingBundle:
     def coordinate_ids(self) -> List[str]:
         return list(self.coordinates.keys())
 
-    def release(self) -> None:
+    def release(self, close_stores: bool = True) -> None:
         """Drop this bundle's device-resident state (hot-swap retirement).
 
         Drops the coordinate references rather than calling .delete() on
@@ -579,10 +579,14 @@ class ServingBundle:
         dies — for the production artifact path (host-built matrices owned
         solely by the bundle) that is immediately. Scoring a released
         bundle raises; release is idempotent. Two-tier stores close their
-        promotion worker here so a retired bundle leaks no thread."""
-        for c in self.coordinates.values():
-            if getattr(c, "store", None) is not None:
-                c.store.close()
+        promotion worker here so a retired bundle leaks no thread —
+        `close_stores=False` skips that for a retirement whose stores were
+        CARRIED OVER into a successor bundle (the host-tier demotion path:
+        the successor owns them now and closes them at its own release)."""
+        if close_stores:
+            for c in self.coordinates.values():
+                if getattr(c, "store", None) is not None:
+                    c.store.close()
         self.coordinates = {}
         self.index_maps = None
         self.released = True
@@ -917,6 +921,60 @@ class ServingBundle:
             mesh=mesh,
             hot_rows=hot_rows,
         )
+
+
+def demote_bundle_to_host_tier(
+    bundle: ServingBundle, hot_rows: int = 0
+) -> ServingBundle:
+    """Rebuild `bundle` with every single-tier random-effect matrix demoted
+    to a TwoTierEntityStore: `hot_rows` rows stay pinned in HBM (0 = none —
+    every lookup rides the per-request override buffers) and the full
+    matrix moves to host RAM. The multi-tenant registry's HBM-pressure
+    eviction engine (ISSUE 15): a cold tenant demoted this way keeps
+    answering BITWISE — the override row IS the matrix row (see
+    TwoTierEntityStore) — it just pays a host copy per request instead of
+    pinning (E + 1) * dim floats of HBM.
+
+    Fixed-effect coordinates are carried over by reference (their planes
+    are tiny and shared — releasing the OLD bundle only drops its dict,
+    never the arrays the new bundle still holds). Entity-sharded
+    coordinates refuse: their rows already divide over the mesh, and
+    pulling a sharded store whole into host RAM would silently change the
+    placement story (reshard first, then demote).
+    """
+    coords: Dict[str, ServingCoordinate] = {}
+    for cid, c in bundle.coordinates.items():
+        if not c.is_random_effect or c.store is not None:
+            # FE planes and already-demoted stores carry over unchanged.
+            coords[cid] = c
+            continue
+        if c.mesh is not None:
+            raise ValueError(
+                f"coordinate {cid!r} is entity-sharded over a mesh; "
+                "demotion to the host tier only applies to replicated "
+                "single-tier matrices"
+            )
+        logical = c.unseen_row + 1
+        host = np.asarray(c.params[:logical], np.float32)
+        store = TwoTierEntityStore(host, int(hot_rows))
+        coords[cid] = ServingCoordinate(
+            cid,
+            c.shard,
+            store.snapshot(),
+            norm=c.norm,
+            random_effect_type=c.random_effect_type,
+            entity_index=c.entity_index,
+            logical_rows=logical,
+            store=store,
+        )
+    out = ServingBundle(
+        task=bundle.task,
+        coordinates=coords,
+        index_maps=bundle.index_maps,
+        upload_bytes=sum(c.device_nbytes() for c in coords.values()),
+        upload_s=0.0,
+    )
+    return out
 
 
 def serving_entity_mesh():

@@ -200,6 +200,8 @@ class ServingEngine:
         circuit_threshold: int = 5,
         circuit_probe_interval_s: float = 1.0,
         watchdog_ms_override: Optional[float] = None,
+        inject_faults: bool = True,
+        device_mutex: Optional[threading.Lock] = None,
     ):
         # The compiled-bucket ceiling is a PLANNED quantity (ISSUE 14):
         # an explicit argument wins (the operator/test said so); None
@@ -241,8 +243,21 @@ class ServingEngine:
         # the NEW mesh's pjit programs) can deadlock the runtime's
         # participant rendezvous — the warm path and the score path must
         # interleave, never overlap. Uncontended cost: one lock hop per
-        # batch.
-        self._device_mutex = threading.Lock()
+        # batch. The multi-tenant registry (serving/tenancy.py) passes
+        # ONE shared mutex to every tenant engine for the same reason:
+        # N tenant flush threads dispatching collective programs over the
+        # same fleet must interleave across engines too.
+        self._device_mutex = (
+            device_mutex if device_mutex is not None else threading.Lock()
+        )
+        # Per-engine fault-injection gate (ISSUE 15): the process-global
+        # fault plan fires at this engine's lookup/score sites only when
+        # True. The multi-tenant chaos drills use it to CONFINE an armed
+        # plan to one tenant's dispatches — the isolation proof needs
+        # deterministic targeting, and site invocation counters are
+        # process-wide. Production engines leave it True (an unarmed
+        # fault_point is a free no-op).
+        self.inject_faults = bool(inject_faults)
         self._state = self._build_state(bundle, version=0)
         self.health = HealthStateMachine()
         self.breaker = CircuitBreaker(
@@ -586,7 +601,7 @@ class ServingEngine:
                     else:
                         buf[i, :] = payload
         with stage_timer("serve_lookup"):
-            if inject:
+            if inject and self.inject_faults:
                 faults.fault_point("lookup")
             re_coords = [c for c in state.coords if c.is_random_effect]
             cold_flags = np.zeros((n, len(re_coords)), bool)
@@ -672,7 +687,7 @@ class ServingEngine:
         """Upload request buffers, run the fused program, fetch both outputs
         in one transfer."""
         with stage_timer("serve_score"):
-            if inject:
+            if inject and self.inject_faults:
                 faults.fault_point("score")
             # Hang watchdog (live traffic only — warmup/FE-only exempt):
             # the guard wraps upload + fused program + fetch; an

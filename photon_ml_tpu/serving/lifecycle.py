@@ -67,12 +67,26 @@ logger = logging.getLogger(__name__)
 class Overloaded(RuntimeError):
     """Admission control rejected the request: the pending queue is full
     (or an armed `admit` fault shed it). The client should back off —
-    never retry in a tight loop."""
+    never retry in a tight loop.
+
+    `tenant` names the overloaded tenant on the multi-tenant registry
+    path (serving/tenancy.py) — one tenant blowing its quota is ITS
+    typed rejection, never a shared-queue ambiguity; None on the
+    single-tenant batcher path."""
+
+    def __init__(self, *args, tenant: Optional[str] = None):
+        super().__init__(*args)
+        self.tenant = tenant
 
 
 class DeadlineExceeded(TimeoutError):
     """The request's deadline budget expired while it waited in queue; it
-    was failed BEFORE wasting a device slot."""
+    was failed BEFORE wasting a device slot. `tenant` names the owning
+    tenant on the multi-tenant registry path; None otherwise."""
+
+    def __init__(self, *args, tenant: Optional[str] = None):
+        super().__init__(*args)
+        self.tenant = tenant
 
 
 class BatcherUnhealthy(RuntimeError):

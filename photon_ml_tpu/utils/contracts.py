@@ -144,7 +144,9 @@ ROBUSTNESS_CLEAN_ZERO_KEYS = (
 
 # Top-level serving-summary.json keys written by cli/serve.py. r14
 # appends the adaptive-runtime plan block (PLAN_BLOCK_KEYS), inactive on
-# an unplanned replay.
+# an unplanned replay; r15 appends the per-tenant block ({} on a
+# single-tenant replay, one TENANT_BLOCK_KEYS dict per tenant under
+# --tenant) so a missing block is loud, never ambiguous.
 SERVING_SUMMARY_KEYS = (
     "num_requests",
     "failed_requests",
@@ -153,6 +155,59 @@ SERVING_SUMMARY_KEYS = (
     "health",
     "robustness_counters",
     "plan",
+    "tenants",
+)
+
+# -------------------------------------------------------------- multi-tenant
+# Per-tenant metrics block (serving/tenancy.TenantRegistry.metrics() zips
+# exactly these per tenant — the serving-summary "tenants" block and the
+# bench multi_tenant section both consume it; every key always present so
+# absence is loud).
+TENANT_BLOCK_KEYS = (
+    "completed",
+    "failed",
+    "shed",
+    "deadline_missed",
+    "fe_only_answers",
+    "degraded_batches",
+    "cobatched_requests",
+    "p50_ms",
+    "p95_ms",
+    "p99_ms",
+    "state",
+    "degraded_reasons",
+    "circuit_state",
+    "demoted",
+    "device_bytes",
+    "watchdog_trips",
+)
+
+# bench.py multi_tenant section (ISSUE 15): the serving-platform
+# isolation certificate — 10 tenant bundles on one 8-virtual-device
+# fleet; injected faults, hangs, and overload confined to ONE chaos
+# tenant while every clean tenant answers with zero failed requests,
+# admitted-p99 within its deadline, and scores bitwise-equal to serving
+# that tenant alone; and a cold tenant demoted to the host tier under
+# HBM pressure (so an over-budget admission succeeds) still answers
+# bitwise.
+MULTI_TENANT_SECTION_KEYS = (
+    "n_devices",
+    "n_tenants",
+    "chaos_tenant",
+    "injected_faults",
+    "chaos_shed",
+    "chaos_hangs",
+    "clean_requests",
+    "clean_failed_requests",
+    "clean_deadline_misses",
+    "clean_degraded_batches",
+    "clean_p99_within_deadline",
+    "clean_bitwise_vs_solo",
+    "cobatch_dispatches",
+    "demoted_tenant",
+    "admitted_over_budget",
+    "evicted_bitwise",
+    "tenants",
 )
 
 # bench.py chaos_multichip section (r10): the pod-scale chaos
@@ -280,6 +335,10 @@ JOURNAL_EVENT_SCHEMAS = {
                      "diverged_steps"),
     # -- adaptive runtime planner (planner/plan.install_plan) --
     "plan_decision": ("decision", "value", "source", "fallback"),
+    # -- multi-tenant serving (serving/tenancy.TenantRegistry) --
+    "tenant_admit": ("tenant", "device_bytes", "demoted_tenants"),
+    "tenant_evict": ("tenant", "reason", "freed_bytes", "hot_rows"),
+    "tenant_degraded": ("tenant", "reasons"),
 }
 
 # ------------------------------------------------------------------- profile
@@ -342,6 +401,8 @@ ALL_CONTRACTS = {
     "SERVING_CLEAN_ZERO_KEYS": SERVING_CLEAN_ZERO_KEYS,
     "ROBUSTNESS_CLEAN_ZERO_KEYS": ROBUSTNESS_CLEAN_ZERO_KEYS,
     "SERVING_SUMMARY_KEYS": SERVING_SUMMARY_KEYS,
+    "TENANT_BLOCK_KEYS": TENANT_BLOCK_KEYS,
+    "MULTI_TENANT_SECTION_KEYS": MULTI_TENANT_SECTION_KEYS,
     "CHAOS_MULTICHIP_SECTION_KEYS": CHAOS_MULTICHIP_SECTION_KEYS,
     "ELASTIC_MESH_SECTION_KEYS": ELASTIC_MESH_SECTION_KEYS,
     "SWEEP_SECTION_KEYS": SWEEP_SECTION_KEYS,

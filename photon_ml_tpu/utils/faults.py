@@ -118,6 +118,16 @@ SITE_DESCRIPTIONS = {
     "moved coefficient rows)",
     "reshard_commit": "live serving reshard commit (the atomic generation "
     "flip between batches)",
+    # Multi-tenant serving (ISSUE 15): admitting a named tenant's bundle
+    # onto the shared fleet, and demoting/evicting a cold tenant's RE
+    # rows to the host tier under HBM pressure. An admit failure leaves
+    # the registry unchanged (the new tenant simply is not admitted); a
+    # demotion failure rolls back and the tenant keeps serving its old
+    # device-resident generation.
+    "tenant_admit": "multi-tenant registry admission (staging a named "
+    "tenant's bundle onto the shared fleet)",
+    "tenant_evict": "multi-tenant cold-tenant demotion (RE rows to the "
+    "host tier under HBM pressure)",
 }
 KNOWN_SITES = tuple(SITE_DESCRIPTIONS)
 
@@ -322,8 +332,8 @@ class _Counters:
     the build on an undeclared increment) and robustness counters ride
     the same snapshot/merge machinery as every other metric."""
 
-    def increment(self, name: str, by: int = 1) -> None:
-        telemetry.METRICS.increment(name, by)
+    def increment(self, name: str, by: int = 1, labels=None) -> None:
+        telemetry.METRICS.increment(name, by, labels=labels)
 
     def get(self, name: str) -> int:
         return telemetry.METRICS.get_counter(name)

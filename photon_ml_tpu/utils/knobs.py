@@ -391,6 +391,22 @@ _register(
     "HBM budget a bundle hot-swap must fit in; 0 = use the device's "
     "reported bytes_limit (or skip the check where unknown).",
 )
+_register(
+    "PHOTON_TENANT_MAX_PENDING",
+    int,
+    64,
+    "Default per-tenant admission quota in the multi-tenant registry "
+    "(bounded pending requests per tenant; submits past it shed with a "
+    "typed Overloaded naming the tenant).",
+)
+_register(
+    "PHOTON_TENANT_HBM_FRACTION",
+    float,
+    1.0,
+    "Fraction of the device HBM budget the multi-tenant fleet may pin; "
+    "admission past it demotes the coldest READY tenant's RE rows to "
+    "the host tier (never fails the tenant) before refusing.",
+)
 
 # ------------------------------------------------------------------- planner
 _register(

@@ -123,6 +123,9 @@ METRIC_DESCRIPTIONS = {
     "reshard_retries": "per-shard staging retries during a live reshard",
     "reshard_rollbacks": "live mesh reshards rolled back to the old generation",
     "rebalanced_rows": "hot coefficient rows re-placed by a rebalance plan",
+    "tenant_demotions": "cold tenants' RE rows demoted to the host tier "
+    "under HBM pressure",
+    "tenant_cobatch_dispatches": "cross-tenant co-batched device dispatches",
     # -- histograms (fixed log-spaced buckets, mergeable) --
     "serving_latency_ms": "per-request wall latency through the batcher",
     "serving_queue_wait_ms": "submit-to-claim queue wait per request",
@@ -266,15 +269,68 @@ def merge_histogram_snapshots(*snaps: Mapping[str, object]) -> Dict[str, object]
     }
 
 
+# ------------------------------------------------------------- metric labels
+#
+# Ambient per-thread metric labels (ISSUE 15): the multi-tenant serving
+# tier scopes the process-global robustness counters per tenant WITHOUT
+# touching the increment sites — a dispatch path runs inside
+# `metric_label_scope(tenant=...)` and every counter it bumps lands in
+# both the process-wide aggregate (unchanged) and a labeled sub-count.
+# The name stays the declared literal (the metric-name-sync analyzer
+# keeps working); only the attribution dimension is ambient.
+
+_LABEL_TLS = threading.local()
+
+
+def current_metric_labels() -> Optional[Tuple[Tuple[str, str], ...]]:
+    """The thread's ambient metric labels (sorted key/value pairs), or
+    None outside any `metric_label_scope`."""
+    return getattr(_LABEL_TLS, "labels", None)
+
+
+class metric_label_scope:
+    """Context manager attaching labels (e.g. tenant="a") to every
+    counter increment on THIS thread for the scope's duration. Nested
+    scopes replace, not merge — the inner scope's attribution wins."""
+
+    __slots__ = ("_labels", "_prev")
+
+    def __init__(self, **labels: str):
+        self._labels = tuple(sorted((k, str(v)) for k, v in labels.items()))
+        self._prev: Optional[Tuple[Tuple[str, str], ...]] = None
+
+    def __enter__(self) -> "metric_label_scope":
+        self._prev = getattr(_LABEL_TLS, "labels", None)
+        _LABEL_TLS.labels = self._labels
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        _LABEL_TLS.labels = self._prev
+        return False
+
+
+def label_key(labels: Tuple[Tuple[str, str], ...]) -> str:
+    """Canonical string form of a label set ("tenant=a"), the key the
+    labeled sub-counters and snapshots use."""
+    return ",".join(f"{k}={v}" for k, v in labels)
+
+
 class MetricsRegistry:
     """Typed Counter/Gauge/Histogram store over the closed name registry.
 
     Names must be declared in METRIC_DESCRIPTIONS — an undeclared name
     raises (the knob-registry discipline), so a metric cannot be added
-    without landing in the declaration table the analyzer checks."""
+    without landing in the declaration table the analyzer checks.
+
+    Counters additionally carry per-label sub-counts (ISSUE 15): an
+    increment inside a `metric_label_scope` (or with an explicit
+    `labels=`) bumps the aggregate AND the label's sub-count, so one
+    tenant's degradations are visible per tenant without losing the
+    process-wide signal."""
 
     def __init__(self) -> None:
         self._counters: Dict[str, int] = {}
+        self._labeled: Dict[str, Dict[str, int]] = {}
         self._gauges: Dict[str, float] = {}
         self._hists: Dict[str, Histogram] = {}
         self._lock = threading.Lock()
@@ -287,14 +343,32 @@ class MetricsRegistry:
                 "photon_ml_tpu.utils.telemetry.METRIC_DESCRIPTIONS"
             )
 
-    def increment(self, name: str, by: int = 1) -> None:
+    def increment(
+        self,
+        name: str,
+        by: int = 1,
+        labels: Optional[Tuple[Tuple[str, str], ...]] = None,
+    ) -> None:
         self._check(name)
+        if labels is None:
+            labels = current_metric_labels()
         with self._lock:
             self._counters[name] = self._counters.get(name, 0) + by
+            if labels:
+                sub = self._labeled.setdefault(name, {})
+                key = label_key(labels)
+                sub[key] = sub.get(key, 0) + by
 
     def get_counter(self, name: str) -> int:
         with self._lock:
             return self._counters.get(name, 0)
+
+    def labeled_counters(self, name: str) -> Dict[str, int]:
+        """Per-label sub-counts of one counter ({"tenant=a": 3}); empty
+        when nothing labeled incremented it. The aggregate counter is the
+        sum of these plus any unlabeled increments."""
+        with self._lock:
+            return dict(self._labeled.get(name, {}))
 
     def set_gauge(self, name: str, value: float) -> None:
         self._check(name)
@@ -319,11 +393,15 @@ class MetricsRegistry:
 
     def snapshot(self) -> Dict[str, object]:
         """One JSON-serializable snapshot of everything; histograms as
-        mergeable snapshots."""
+        mergeable snapshots, labeled counter sub-counts beside the
+        aggregates."""
         with self._lock:
             hists = dict(self._hists)
             out = {
                 "counters": dict(self._counters),
+                "labeled_counters": {
+                    k: dict(v) for k, v in sorted(self._labeled.items())
+                },
                 "gauges": dict(self._gauges),
             }
         out["histograms"] = {k: h.snapshot() for k, h in sorted(hists.items())}
@@ -332,13 +410,16 @@ class MetricsRegistry:
     def reset_counters(self) -> None:
         """Zero the counters ONLY — the faults.reset_counters contract.
         Callers resetting fault counters at section boundaries (bench)
-        must not destroy unrelated histogram/gauge state mid-run."""
+        must not destroy unrelated histogram/gauge state mid-run. Labeled
+        sub-counts reset with their aggregates (they are the same events)."""
         with self._lock:
             self._counters.clear()
+            self._labeled.clear()
 
     def reset(self) -> None:
         with self._lock:
             self._counters.clear()
+            self._labeled.clear()
             self._gauges.clear()
             self._hists.clear()
 

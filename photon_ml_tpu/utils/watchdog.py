@@ -69,8 +69,11 @@ class Watchdog:
     def __init__(self, on_trip: Optional[Callable[[str], None]] = None):
         self._on_trip = on_trip
         self._cv = threading.Condition()
-        # guard id -> (absolute deadline, label, [tripped] flag holder)
-        self._armed: Dict[int, Tuple[float, str, list]] = {}
+        # guard id -> (absolute deadline, label, [tripped] flag holder,
+        # the dispatching thread's ambient metric labels — captured at
+        # arm time because the trip fires from the MONITOR thread, where
+        # the tenant attribution scope is not ambient)
+        self._armed: Dict[int, Tuple[float, str, list, object]] = {}
         self._ids = itertools.count()
         self._thread: Optional[threading.Thread] = None
         self._closed = False
@@ -94,7 +97,7 @@ class Watchdog:
                     continue
                 now = time.monotonic()
                 pending = [
-                    d for d, _, flag in self._armed.values() if not flag[0]
+                    d for d, _, flag, _ in self._armed.values() if not flag[0]
                 ]
                 if not pending:
                     # Every armed guard already tripped: park until its
@@ -106,14 +109,14 @@ class Watchdog:
                     self._cv.wait(timeout=next_deadline - now)
                     continue
                 tripped = [
-                    (gid, label, flag)
-                    for gid, (d, label, flag) in self._armed.items()
+                    (gid, label, flag, mlabels)
+                    for gid, (d, label, flag, mlabels) in self._armed.items()
                     if d <= now and not flag[0]
                 ]
-                for gid, label, flag in tripped:
+                for gid, label, flag, mlabels in tripped:
                     flag[0] = True
                     self.trips += 1
-                    faults.COUNTERS.increment("watchdog_trips")
+                    faults.COUNTERS.increment("watchdog_trips", labels=mlabels)
                     telemetry.emit_event("watchdog_trip", label=label)
                     logger.warning(
                         "watchdog tripped: %s exceeded its deadline "
@@ -124,7 +127,7 @@ class Watchdog:
                     # Callbacks run with the cv RELEASED: a callback that
                     # takes engine locks must not deadlock against a
                     # dispatching thread arming a guard.
-                    labels = [label for _, label, _ in tripped]
+                    labels = [label for _, label, _, _ in tripped]
                     self._cv.release()
                     try:
                         for label in labels:
@@ -156,6 +159,7 @@ class Watchdog:
                     time.monotonic() + deadline_ms / 1e3,
                     label,
                     flag,
+                    telemetry.current_metric_labels(),
                 )
                 self._ensure_thread_locked()
                 self._cv.notify_all()

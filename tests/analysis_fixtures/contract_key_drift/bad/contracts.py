@@ -11,3 +11,6 @@ FIXTURE_SWEEP_KEYS = ("fixture_trials", "fixture_sweep_wall", "fixture_speedup")
 
 # Plan-block schema (r14): the adaptive-runtime planner's audit keys.
 FIXTURE_PLAN_KEYS = ("fixture_plan_source", "fixture_plan_value", "fixture_plan_fallback")
+
+# Tenant-block schema (r15): the multi-tenant serving platform keys.
+FIXTURE_TENANT_KEYS = ("fixture_tenant_completed", "fixture_tenant_shed", "fixture_tenant_demoted")

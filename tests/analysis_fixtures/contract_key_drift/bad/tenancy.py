@@ -1,0 +1,10 @@
+"""Known-bad: re-types two tenant-block schema keys (the r15
+FIXTURE_TENANT_KEYS shape) as a literal instead of importing the tuple."""
+
+
+def check_tenant(block):
+    report = {
+        k: block[k]
+        for k in ("fixture_tenant_completed", "fixture_tenant_shed")
+    }  # re-typed tenant schema
+    return report

@@ -1,0 +1,10 @@
+"""Known-good: the tenant-block schema is imported; single-key reads are
+use, not duplication."""
+
+from contracts import FIXTURE_TENANT_KEYS
+
+
+def check_tenant(block):
+    missing = [k for k in FIXTURE_TENANT_KEYS if k not in block]
+    demoted = block.get("fixture_tenant_demoted")  # one key is vocabulary
+    return missing, demoted

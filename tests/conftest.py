@@ -136,7 +136,11 @@ def _failure_domain_hygiene(monkeypatch):
     * no `photon-reshard` staging worker outlives the test — the live
       reshard orchestrator joins its per-shard upload workers before the
       generation flip; a survivor means staged uploads kept running
-      against a rolled-back (or torn-down) generation.
+      against a rolled-back (or torn-down) generation;
+    * no `photon-tenant-*` worker outlives the test — the multi-tenant
+      registry's dispatch thread and per-tenant flush threads are joined
+      by `TenantRegistry.close()`; a survivor means one tenant's traffic
+      kept dispatching against a torn-down fleet.
     """
     from photon_ml_tpu.utils import faults, telemetry
 
@@ -152,6 +156,11 @@ def _failure_domain_hygiene(monkeypatch):
         "PHOTON_SHARD_UPLOAD_RETRIES",
         "PHOTON_RESHARD_RETRIES",
         "PHOTON_REBALANCE_MIN_PROMOTIONS",
+        # Multi-tenant serving (ISSUE 15): ambient quota/budget knobs in
+        # the developer's shell must never reshape admission control or
+        # HBM-pressure demotion inside unrelated tests.
+        "PHOTON_TENANT_MAX_PENDING",
+        "PHOTON_TENANT_HBM_FRACTION",
         # The adaptive planner (ISSUE 14): an ambient PHOTON_PLAN* in the
         # developer's shell must never install a plan inside unrelated
         # tests, and a plan installed by one test never leaks into the
@@ -182,6 +191,7 @@ def _failure_domain_hygiene(monkeypatch):
                     "photon-ckpt-write",
                     "photon-watchdog",
                     "photon-reshard",
+                    "photon-tenant",
                 )
             )
             and t.is_alive()

@@ -359,6 +359,12 @@ class TestJournal:
         # -- adaptive runtime planner (ISSUE 14) --
         "decision": "prefetch_depth",
         "fallback": 1,
+        # -- multi-tenant serving (ISSUE 15) --
+        "tenant": "t-a",
+        "device_bytes": 4096,
+        "demoted_tenants": ["t-cold"],
+        "freed_bytes": 2048,
+        "hot_rows": 0,
     }
 
     def test_every_event_type_round_trips_its_schema(self, tmp_path):

@@ -1,0 +1,439 @@
+"""Multi-tenant serving isolation suite (ISSUE 15).
+
+The load-bearing contract is the serving-platform generalization of the
+engine's bitwise story: N named tenants on one device fleet, where
+
+  * a co-batched request (one device dispatch carrying several tenants'
+    slots) scores BITWISE-equal to serving that tenant alone;
+  * one tenant's injected faults, overload, or demotion NEVER degrade
+    another tenant's answers, counters, or typed rejections — the
+    isolation Spark's one-job-per-model deployment gave Photon ML for
+    free, enforced in-process here;
+  * HBM-pressure eviction demotes (never fails) a READY tenant to the
+    host tier, and the demoted tenant keeps answering bitwise through
+    the TwoTierEntityStore override path.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from photon_ml_tpu.game.model import (
+    Coefficients,
+    FixedEffectModel,
+    GameModel,
+    RandomEffectModel,
+)
+from photon_ml_tpu.serving import (
+    DeadlineExceeded,
+    HbmBudgetExceeded,
+    Overloaded,
+    ScoreRequest,
+    ServingBundle,
+    ServingEngine,
+    TenantRegistry,
+)
+from photon_ml_tpu.transformers.game_transformer import CoordinateScoringSpec
+from photon_ml_tpu.types import TaskType
+from photon_ml_tpu.utils import faults, telemetry
+
+pytestmark = pytest.mark.serving
+
+TASK = TaskType.LOGISTIC_REGRESSION
+D_FE, D_RE, E = 7, 5, 24
+
+
+def _make_model(seed: int, n_entities: int = E):
+    rng = np.random.default_rng(seed)
+    w = rng.normal(size=D_FE).astype(np.float32)
+    M = np.zeros((n_entities + 1, D_RE), np.float32)
+    M[:n_entities] = rng.normal(size=(n_entities, D_RE))
+    model = GameModel(
+        {
+            "fixed": FixedEffectModel(Coefficients(jnp.asarray(w)), TASK),
+            "per-e": RandomEffectModel(jnp.asarray(M), None, TASK),
+        }
+    )
+    specs = {
+        "fixed": CoordinateScoringSpec(shard="g"),
+        "per-e": CoordinateScoringSpec(
+            shard="re",
+            random_effect_type="eid",
+            entity_index={str(i): i for i in range(n_entities)},
+        ),
+    }
+    return model, specs
+
+
+def _bundle(seed: int, n_entities: int = E) -> ServingBundle:
+    model, specs = _make_model(seed, n_entities)
+    return ServingBundle.from_model(model, specs, TASK)
+
+
+def _requests(seed: int, n: int, n_entities: int = E):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, D_FE)).astype(np.float32)
+    Xe = rng.normal(size=(n, D_RE)).astype(np.float32)
+    ids = rng.integers(0, n_entities + 6, size=n)  # trained + cold starts
+    return [
+        ScoreRequest(
+            features={"g": X[i], "re": Xe[i]},
+            entity_ids={"eid": str(int(ids[i]))},
+            offset=float(i) * 0.125,
+            uid=str(i),
+        )
+        for i in range(n)
+    ]
+
+
+def _solo_scores(seed: int, reqs, n_entities: int = E) -> np.ndarray:
+    """The parity anchor: that tenant's bundle alone on a plain engine."""
+    with ServingEngine(_bundle(seed, n_entities), max_batch=32) as eng:
+        return np.asarray(
+            [r.score for r in eng.score_batch(reqs)], np.float64
+        )
+
+
+def _scores(results) -> np.ndarray:
+    return np.asarray([r.score for r in results], np.float64)
+
+
+class TestCoBatchParity:
+    def test_cobatched_scores_bitwise_equal_solo(self, rng):
+        """Interleaved traffic from two tenants with DIFFERENT bundles
+        (different entity counts, too) co-batches into shared device
+        dispatches and stays bitwise-equal to serving each alone."""
+        req_a, req_b = _requests(11, 16), _requests(12, 16, 40)
+        ref_a = _solo_scores(1, req_a)
+        ref_b = _solo_scores(2, req_b, 40)
+        with TenantRegistry(max_batch=32, max_wait_ms=5.0) as reg:
+            reg.admit("a", _bundle(1))
+            reg.admit("b", _bundle(2, 40))
+            futs = []
+            for i in range(16):
+                futs.append(("a", reg.submit("a", req_a[i], block=True)))
+                futs.append(("b", reg.submit("b", req_b[i], block=True)))
+            got = {"a": [], "b": []}
+            for name, f in futs:
+                got[name].append(f.result(timeout=30).score)
+            m = reg.metrics()
+            reg.close(release_bundles=True)
+        assert np.array_equal(np.asarray(got["a"], np.float64), ref_a)
+        assert np.array_equal(np.asarray(got["b"], np.float64), ref_b)
+        # The point of co-batching: interleaved cross-tenant traffic
+        # shares device dispatches instead of going one-by-one.
+        assert m["cobatch_dispatches"] >= 1
+        assert m["tenants"]["a"]["cobatched_requests"] == 16
+        assert m["tenants"]["b"]["cobatched_requests"] == 16
+        assert m["tenants"]["a"]["failed"] == 0
+        assert m["tenants"]["b"]["failed"] == 0
+
+    def test_single_tenant_registry_matches_solo(self):
+        reqs = _requests(21, 10)
+        ref = _solo_scores(3, reqs)
+        with TenantRegistry(max_batch=16, max_wait_ms=2.0) as reg:
+            reg.admit("only", _bundle(3))
+            got = _scores([reg.score("only", r) for r in reqs])
+            reg.close(release_bundles=True)
+        assert np.array_equal(got, ref)
+
+    def test_unknown_tenant_raises(self):
+        with TenantRegistry(max_batch=8, max_wait_ms=1.0) as reg:
+            with pytest.raises(KeyError, match="unknown tenant"):
+                reg.submit("ghost", ScoreRequest())
+
+
+@pytest.mark.chaos
+class TestIsolation:
+    def test_faults_in_one_tenant_never_degrade_the_other(self):
+        """Armed lookup/score faults confined to the chaos tenant (its
+        engine's injection gate): the clean tenant's answers stay
+        bitwise, zero failed, zero degraded — including its LABELED
+        robustness sub-counters, the per-tenant clean-run zero contract."""
+        req_c, req_x = _requests(31, 16), _requests(32, 16)
+        ref_c = _solo_scores(5, req_c)
+        with TenantRegistry(max_batch=16, max_wait_ms=2.0) as reg:
+            reg.admit("chaos", _bundle(4), inject_faults=True)
+            reg.admit("clean", _bundle(5), inject_faults=False)
+            with faults.inject("score:2,lookup:1"):
+                futs = []
+                for i in range(16):
+                    futs.append(reg.submit("chaos", req_x[i], block=True))
+                    futs.append(reg.submit("clean", req_c[i], block=True))
+                res = [f.result(timeout=60) for f in futs]
+            m = reg.metrics()
+            reg.close(release_bundles=True)
+        got_clean = _scores([r for i, r in enumerate(res) if i % 2 == 1])
+        assert np.array_equal(got_clean, ref_c)
+        clean = m["tenants"]["clean"]
+        assert clean["failed"] == 0
+        assert clean["degraded_batches"] == 0
+        assert clean["shed"] == 0
+        assert clean["deadline_missed"] == 0
+        assert clean["fe_only_answers"] == 0
+        # The chaos tenant absorbed every injection...
+        assert faults.COUNTERS.get("injected_faults") > 0
+        assert m["tenants"]["chaos"]["degraded_batches"] > 0
+        # ...and the labeled sub-counters prove the blast radius: the
+        # clean tenant's slice of every serving robustness counter is 0.
+        for counter in (
+            "serving_degraded_batches",
+            "serving_shed_requests",
+            "serving_deadline_misses",
+            "serving_fe_only_requests",
+        ):
+            labeled = telemetry.METRICS.labeled_counters(counter)
+            assert labeled.get("tenant=clean", 0) == 0, counter
+        # Every chaos-tenant future still resolved (answers or typed
+        # rejections — no hangs, no co-batched collateral).
+        assert all(r is not None for r in res)
+
+    def test_overload_sheds_typed_naming_the_tenant(self):
+        """A tenant past its admission quota sheds with Overloaded
+        NAMING it; the other tenant keeps admitting."""
+        reqs = _requests(41, 12)
+        with TenantRegistry(max_batch=64, max_wait_ms=250.0) as reg:
+            # max_wait holds the queue open so the quota genuinely fills.
+            reg.admit("small", _bundle(6), max_pending=3)
+            reg.admit("roomy", _bundle(7))
+            for i in range(3):
+                reg.submit("small", reqs[i])
+            with pytest.raises(Overloaded) as exc_info:
+                reg.submit("small", reqs[3])
+            assert exc_info.value.tenant == "small"
+            # The neighbor's admission is untouched by small's overload.
+            fut = reg.submit("roomy", reqs[4])
+            assert fut.result(timeout=30) is not None
+            shed_labeled = telemetry.METRICS.labeled_counters(
+                "serving_shed_requests"
+            )
+            assert shed_labeled.get("tenant=small", 0) == 1
+            assert shed_labeled.get("tenant=roomy", 0) == 0
+            reg.close(release_bundles=True)
+
+    def test_malformed_cobatch_request_never_kills_the_registry(self):
+        """A co-batch-eligible tenant's malformed request (wrong feature
+        width) poisons the shared pack — the dispatch must degrade per
+        tenant (the offending future fails, neighbors answer bitwise)
+        and the dispatch thread must survive for later traffic."""
+        reqs = _requests(81, 8)
+        ref = _solo_scores(21, reqs)
+        with TenantRegistry(max_batch=16, max_wait_ms=2.0) as reg:
+            reg.admit("bad", _bundle(20))
+            reg.admit("good", _bundle(21))
+            poison = ScoreRequest(
+                features={"g": np.zeros(3, np.float32)},  # d_fe is 7
+                entity_ids={"eid": "0"},
+                uid="poison",
+            )
+            bad_fut = reg.submit("bad", poison, block=True)
+            good_futs = [
+                reg.submit("good", r, block=True) for r in reqs
+            ]
+            with pytest.raises(Exception):
+                bad_fut.result(timeout=30)
+            got = _scores([f.result(timeout=30) for f in good_futs])
+            assert np.array_equal(got, ref)
+            # The registry survives: both tenants keep answering.
+            assert reg.score("good", reqs[0]).score == ref[0]
+            m = reg.metrics()
+            assert m["tenants"]["good"]["failed"] == 0
+            reg.close(release_bundles=True)
+
+    def test_cancelled_queued_future_releases_the_admission_slot(self):
+        """Client-cancelled futures claimed out of the tenant queue must
+        release their in_flight slot — a leak would wedge the quota shut
+        and shed every later submit."""
+        reqs = _requests(91, 8)
+        with TenantRegistry(max_batch=64, max_wait_ms=150.0) as reg:
+            reg.admit("t", _bundle(22), max_pending=3)
+            futs = [reg.submit("t", reqs[i]) for i in range(3)]
+            cancelled = [f.cancel() for f in futs]
+            assert all(cancelled)
+            # After the cancelled items are claimed (and dropped), the
+            # quota must be whole again: three fresh submits admit and
+            # answer.
+            import time as _time
+
+            deadline = _time.monotonic() + 10.0
+            while _time.monotonic() < deadline:
+                try:
+                    fresh = [
+                        reg.submit("t", reqs[3 + i]) for i in range(3)
+                    ]
+                    break
+                except Overloaded:
+                    _time.sleep(0.05)
+            else:
+                pytest.fail("cancelled futures leaked the tenant quota")
+            for f in fresh:
+                assert f.result(timeout=30) is not None
+            reg.close(release_bundles=True)
+
+    def test_deadline_budget_enforced_per_tenant(self):
+        with TenantRegistry(max_batch=8, max_wait_ms=50.0) as reg:
+            reg.admit("t", _bundle(8), deadline_ms=0.0)
+            fut = reg.submit("t", _requests(51, 1)[0])
+            with pytest.raises(DeadlineExceeded) as exc_info:
+                fut.result(timeout=30)
+            assert exc_info.value.tenant == "t"
+            assert reg.metrics()["tenants"]["t"]["deadline_missed"] == 1
+            reg.close(release_bundles=True)
+
+
+class TestEviction:
+    def test_hbm_pressure_demotes_coldest_and_stays_bitwise(self, tmp_path):
+        """Admission of tenant N+1 over budget demotes (never fails) the
+        coldest READY tenant to the host tier; the demoted tenant's
+        answers stay bitwise through the TwoTierEntityStore overrides —
+        the eviction round trip. Journal events validate."""
+        reqs = _requests(61, 12)
+        ref = _solo_scores(10, reqs)
+        b0, b1, b2 = _bundle(10), _bundle(11), _bundle(12)
+        per = b0.device_bytes_per_shard()
+        journal_path = str(tmp_path / "journal.jsonl")
+        journal = telemetry.install_journal(
+            telemetry.RunJournal(journal_path)
+        )
+        try:
+            with TenantRegistry(
+                max_batch=16,
+                max_wait_ms=2.0,
+                hbm_budget_bytes=int(per * 2.5),
+            ) as reg:
+                reg.admit("cold", b0)
+                reg.admit("warm", b1)
+                # Touch "warm" so "cold" is the least-recently-active.
+                reg.score("warm", _requests(62, 1)[0])
+                reg.admit("new", b2)  # over budget -> demote, don't fail
+                m = reg.metrics()
+                assert m["tenants"]["cold"]["demoted"]
+                assert not m["tenants"]["warm"]["demoted"]
+                assert not m["tenants"]["new"]["demoted"]
+                # Host-tier answers, bitwise — and the demoted tenant is
+                # now out of the co-batch group (solo dispatch).
+                got = _scores([reg.score("cold", r) for r in reqs])
+                assert np.array_equal(got, ref)
+                m2 = reg.metrics()
+                assert m2["tenants"]["cold"]["cobatched_requests"] == 0
+                assert (
+                    m2["tenants"]["cold"]["device_bytes"]
+                    < m["tenants"]["warm"]["device_bytes"]
+                )
+                assert faults.COUNTERS.get("tenant_demotions") == 1
+                reg.close(release_bundles=True)
+        finally:
+            telemetry.uninstall_journal()
+            journal.close()
+        n_ok, errors = telemetry.validate_journal(journal_path)
+        assert errors == []
+        import json
+
+        events = [json.loads(l) for l in open(journal_path)]
+        admits = [e for e in events if e["type"] == "tenant_admit"]
+        evicts = [e for e in events if e["type"] == "tenant_evict"]
+        assert [e["tenant"] for e in admits] == ["cold", "warm", "new"]
+        assert admits[-1]["demoted_tenants"] == ["cold"]
+        assert len(evicts) == 1 and evicts[0]["tenant"] == "cold"
+        assert evicts[0]["reason"] == "hbm_pressure"
+        assert evicts[0]["freed_bytes"] > 0
+
+    def test_sharded_tenant_is_never_an_eviction_victim(self):
+        """An entity-sharded tenant cannot demote to the host tier;
+        HBM-pressure eviction must skip it (even when it is coldest) and
+        demote the next candidate instead of crashing the admission."""
+        from photon_ml_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh()
+        model_sh, specs_sh = _make_model(23, 16 * int(mesh.devices.size))
+        sharded = ServingBundle.from_model(
+            model_sh, specs_sh, TASK, mesh=mesh
+        )
+        b_rep, b_new = _bundle(24), _bundle(25)
+        budget = (
+            sharded.device_bytes_per_shard()
+            + b_rep.device_bytes_per_shard()
+            + b_new.device_bytes_per_shard() // 2
+        )
+        with TenantRegistry(
+            max_batch=16, max_wait_ms=2.0, hbm_budget_bytes=int(budget)
+        ) as reg:
+            reg.admit("sharded", sharded)  # admitted first: the coldest
+            reg.admit("rep", b_rep)
+            reg.score("rep", _requests(92, 1)[0])
+            reg.admit("new", b_new)  # over budget: must demote "rep"
+            m = reg.metrics()
+            assert not m["tenants"]["sharded"]["demoted"]
+            assert m["tenants"]["rep"]["demoted"]
+            reg.close(release_bundles=True)
+
+    def test_budget_unfit_after_all_demotions_refuses(self):
+        b0, b1 = _bundle(13), _bundle(14)
+        per = b0.device_bytes_per_shard()
+        with TenantRegistry(
+            max_batch=8, max_wait_ms=1.0, hbm_budget_bytes=int(per * 0.5)
+        ) as reg:
+            # Even an empty fleet cannot fit this tenant, and there is
+            # nobody to demote: typed refusal, registry unchanged.
+            with pytest.raises(HbmBudgetExceeded):
+                reg.admit("big", b0)
+            assert reg.tenant_names == []
+            reg.close()
+        b0.release()
+        b1.release()
+
+    def test_admit_fault_leaves_registry_unchanged(self):
+        built = []
+
+        def builder():
+            b = _bundle(15)
+            built.append(b)
+            return b
+
+        with TenantRegistry(max_batch=8, max_wait_ms=1.0) as reg:
+            with faults.inject("tenant_admit:99"):
+                with pytest.raises(faults.InjectedFault):
+                    reg.admit("doomed", builder)
+            assert reg.tenant_names == []
+            # The same admission succeeds once the fault clears (one
+            # bounded-retry trace, no residue).
+            reg.admit("doomed", builder)
+            assert reg.tenant_names == ["doomed"]
+            reg.close(release_bundles=True)
+
+    def test_evict_fault_rolls_back_and_keeps_serving(self):
+        reqs = _requests(71, 8)
+        ref = _solo_scores(16, reqs)
+        with TenantRegistry(max_batch=16, max_wait_ms=2.0) as reg:
+            reg.admit("t", _bundle(16))
+            with faults.inject("tenant_evict:99"):
+                with pytest.raises(faults.InjectedFault):
+                    reg.demote("t", reason="drill")
+            m = reg.metrics()
+            assert not m["tenants"]["t"]["demoted"]
+            got = _scores([reg.score("t", r) for r in reqs])
+            assert np.array_equal(got, ref)
+            # And a clean demotion afterwards still round-trips bitwise.
+            reg.demote("t", reason="drill")
+            got2 = _scores([reg.score("t", r) for r in reqs])
+            assert np.array_equal(got2, ref)
+            reg.close(release_bundles=True)
+
+
+class TestLifecycle:
+    def test_closed_registry_refuses_submits(self):
+        reg = TenantRegistry(max_batch=8, max_wait_ms=1.0)
+        reg.admit("t", _bundle(17))
+        reg.close(release_bundles=True)
+        with pytest.raises(RuntimeError, match="closed"):
+            reg.submit("t", ScoreRequest())
+        reg.close()  # idempotent
+
+    def test_duplicate_admit_refused(self):
+        with TenantRegistry(max_batch=8, max_wait_ms=1.0) as reg:
+            reg.admit("t", _bundle(18))
+            with pytest.raises(ValueError, match="already admitted"):
+                reg.admit("t", _bundle(19))
+            reg.close(release_bundles=True)
