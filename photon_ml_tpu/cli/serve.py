@@ -507,6 +507,9 @@ def _run_with_bundle(args, bundle: ServingBundle) -> dict:
         # loud — empty on a single-tenant replay, one TENANT_BLOCK_KEYS
         # dict per tenant under --tenant.
         "tenants": {},
+        # Bundle lineage (ISSUE 16, BUNDLE_PROVENANCE_KEYS): where the
+        # served model came from and how many delta applies it absorbed.
+        "provenance": dict(engine.bundle.provenance),
     }
     if reshard_to is not None:
         summary["reshard"] = reshard_info
@@ -663,6 +666,10 @@ def _run_multi_tenant(args, tenant_specs, index_maps) -> dict:
             name: registry.tenant(name).engine.health.snapshot()
             for name in names
         }
+        provenance = {
+            name: dict(registry.tenant(name).bundle.provenance)
+            for name in names
+        }
     finally:
         registry.close(release_bundles=True)
     logger.info(
@@ -686,6 +693,8 @@ def _run_multi_tenant(args, tenant_specs, index_maps) -> dict:
         },
         "plan": _planner_mod.plan_block(overrides=_cli_plan_overrides),
         "tenants": metrics["tenants"],
+        # Per-tenant bundle lineage (ISSUE 16, BUNDLE_PROVENANCE_KEYS).
+        "provenance": provenance,
     }
     with open(os.path.join(out_root, "serving-summary.json"), "w") as f:
         json.dump(summary, f, indent=2, default=str)

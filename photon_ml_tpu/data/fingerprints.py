@@ -1,0 +1,232 @@
+"""Per-coordinate / per-entity data fingerprints for incremental refresh.
+
+The continuous-refresh loop (ISSUE 16) closes the data->served freshness
+gap by re-solving ONLY what a streamed delta batch changed. That needs a
+cheap, exact answer to "did this coordinate's training inputs change, and
+for which entities?" — this module computes it as content digests over
+the host-side columnar planes:
+
+* A FIXED-EFFECT coordinate's solve is a function of its whole feature
+  shard plus labels/offsets/weights, so its fingerprint is one digest
+  over those planes. Any appended or updated row changes it.
+
+* A RANDOM-EFFECT coordinate's per-entity solves are independent given
+  the offsets, so its fingerprint is one digest PER ENTITY over that
+  entity's rows (features + label + offset + weight, in sample order).
+  Diffing two fingerprints yields exactly the churned + new entities —
+  the rows the incremental fit re-solves; everything else is carried
+  bitwise from the previous model.
+
+Digests are blake2b over the contiguous bytes of the row content —
+bitwise-change detection, never a float tolerance: the incremental
+contract is "bitwise-equal data => bitwise-equal carried coefficients",
+so the change detector must be exact too. Everything here reads host
+planes through `peek_shard` (no device materialization) and groups rows
+through the ingest-factorized `tag_codes` fast path when present —
+fingerprinting is data-plane work and must not cost a device transfer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+from typing import Dict, Mapping, Optional, Tuple
+
+import numpy as np
+
+from photon_ml_tpu.data.containers import SparseFeatures
+from photon_ml_tpu.data.game_dataset import (
+    FixedEffectDataConfig,
+    GameDataset,
+    RandomEffectDataConfig,
+    _ell_row_planes,
+)
+
+_DIGEST_SIZE = 16
+
+
+def _normalize_key(k):
+    """The entity-index key convention of _build_random_effect_dataset:
+    numpy scalars unwrap to their Python value so fingerprint keys and
+    entity-index keys compare equal."""
+    return k.item() if hasattr(k, "item") else k
+
+
+def _shard_planes(dataset: GameDataset, shard: str):
+    """Host (N, K) index/value planes for a shard (indices None when the
+    shard is dense)."""
+    feats = (
+        dataset.peek_shard(shard)
+        if hasattr(dataset, "peek_shard")
+        else dataset.shards[shard]
+    )
+    if isinstance(feats, SparseFeatures):
+        idx, val = _ell_row_planes(feats)
+        return np.ascontiguousarray(idx), np.ascontiguousarray(val)
+    return None, np.ascontiguousarray(np.asarray(feats))
+
+
+def _row_group_digest(h, idx, val, lbl, off, wgt, rows) -> None:
+    """Fold one row group's content bytes into digest `h` (sample order)."""
+    if idx is not None:
+        h.update(np.ascontiguousarray(idx[rows]).tobytes())
+    h.update(np.ascontiguousarray(val[rows]).tobytes())
+    h.update(np.ascontiguousarray(lbl[rows]).tobytes())
+    h.update(np.ascontiguousarray(off[rows]).tobytes())
+    h.update(np.ascontiguousarray(wgt[rows]).tobytes())
+
+
+def _entity_groups(dataset: GameDataset, tag: str):
+    """(keys, row-index array per key) for one id-tag column, keys in
+    sorted-unique order — the same order _build_random_effect_dataset
+    assigns entity-index rows. Uses the ingest-factorized codes when
+    present (no n_samples string sort)."""
+    ct = getattr(dataset, "tag_codes", {}).get(tag)
+    if ct is not None:
+        codes, tbl = ct
+        uniq = np.asarray(tbl)
+        inv = np.asarray(codes)
+    else:
+        uniq, inv = np.unique(np.asarray(dataset.id_tags[tag]), return_inverse=True)
+    order = np.argsort(inv, kind="stable")
+    counts = np.bincount(inv, minlength=len(uniq))
+    bounds = np.concatenate([[0], np.cumsum(counts)])
+    keys = [_normalize_key(k) for k in uniq]
+    groups = [order[bounds[i] : bounds[i + 1]] for i in range(len(uniq))]
+    return keys, groups
+
+
+@dataclasses.dataclass(frozen=True)
+class CoordinateFingerprint:
+    """One coordinate's data fingerprint.
+
+    `digest` covers the whole coordinate; `entity_digests`/`entity_rows`
+    are per-entity digests and row counts for random-effect coordinates
+    (None for fixed effects).
+    """
+
+    digest: str
+    entity_digests: Optional[Dict[object, str]] = None
+    entity_rows: Optional[Dict[object, int]] = None
+
+    @property
+    def is_random_effect(self) -> bool:
+        return self.entity_digests is not None
+
+
+@dataclasses.dataclass(frozen=True)
+class DatasetFingerprints:
+    """Per-coordinate fingerprints of one GameDataset snapshot."""
+
+    num_samples: int
+    coordinates: Dict[str, CoordinateFingerprint]
+
+
+def fingerprint_dataset(
+    dataset: GameDataset,
+    data_configs: Mapping[str, object],
+) -> DatasetFingerprints:
+    """Fingerprint every coordinate's training inputs.
+
+    `data_configs` maps coordinate id -> FixedEffectDataConfig |
+    RandomEffectDataConfig (the estimator's coordinate_data_configs).
+    """
+    all_rows = np.arange(dataset.num_samples)
+    lbl = np.ascontiguousarray(np.asarray(dataset.labels))
+    off = np.ascontiguousarray(np.asarray(dataset.offsets))
+    wgt = np.ascontiguousarray(np.asarray(dataset.weights))
+    coords: Dict[str, CoordinateFingerprint] = {}
+    for cid, cfg in data_configs.items():
+        idx, val = _shard_planes(dataset, cfg.feature_shard)
+        if isinstance(cfg, RandomEffectDataConfig):
+            keys, groups = _entity_groups(dataset, cfg.random_effect_type)
+            digests: Dict[object, str] = {}
+            rows_per: Dict[object, int] = {}
+            whole = hashlib.blake2b(digest_size=_DIGEST_SIZE)
+            for key, rows in zip(keys, groups):
+                h = hashlib.blake2b(digest_size=_DIGEST_SIZE)
+                _row_group_digest(h, idx, val, lbl, off, wgt, rows)
+                d = h.hexdigest()
+                digests[key] = d
+                rows_per[key] = int(len(rows))
+                whole.update(repr(key).encode())
+                whole.update(d.encode())
+            coords[cid] = CoordinateFingerprint(
+                whole.hexdigest(), digests, rows_per
+            )
+        elif isinstance(cfg, FixedEffectDataConfig):
+            h = hashlib.blake2b(digest_size=_DIGEST_SIZE)
+            _row_group_digest(h, idx, val, lbl, off, wgt, all_rows)
+            coords[cid] = CoordinateFingerprint(h.hexdigest())
+        else:
+            raise TypeError(
+                f"coordinate {cid!r}: unknown data config {type(cfg)}"
+            )
+    return DatasetFingerprints(dataset.num_samples, coords)
+
+
+@dataclasses.dataclass(frozen=True)
+class CoordinateDiff:
+    """One coordinate's data change between two fingerprint snapshots.
+
+    `changed_entities` = churned (content digest differs) + brand-new
+    entity keys of a random-effect coordinate, in the NEW fingerprint's
+    sorted-unique order; `new_entities` is the brand-new subset. Both
+    empty for fixed effects (whose change granularity is the whole
+    coordinate). `delta_rows` counts the NEW dataset's rows belonging to
+    changed entities (RE), or the full row delta (FE).
+    """
+
+    changed: bool
+    changed_entities: Tuple[object, ...] = ()
+    new_entities: Tuple[object, ...] = ()
+    delta_rows: int = 0
+
+
+def diff_fingerprints(
+    prev: DatasetFingerprints, new: DatasetFingerprints
+) -> Dict[str, CoordinateDiff]:
+    """Per-coordinate diff: which coordinates (and which of their
+    entities) a delta batch actually changed. Entity REMOVAL is rejected
+    loudly: merged refresh datasets are append/update-only — an entity
+    vanishing means the caller diffed against the wrong snapshot."""
+    out: Dict[str, CoordinateDiff] = {}
+    if set(prev.coordinates) != set(new.coordinates):
+        raise ValueError(
+            "fingerprints cover different coordinates: "
+            f"{sorted(prev.coordinates)} vs {sorted(new.coordinates)}"
+        )
+    for cid, pf in prev.coordinates.items():
+        nf = new.coordinates[cid]
+        if pf.is_random_effect != nf.is_random_effect:
+            raise ValueError(f"coordinate {cid!r} changed kind between snapshots")
+        if not nf.is_random_effect:
+            changed = pf.digest != nf.digest
+            out[cid] = CoordinateDiff(
+                changed,
+                delta_rows=(new.num_samples if changed else 0),
+            )
+            continue
+        missing = [k for k in pf.entity_digests if k not in nf.entity_digests]
+        if missing:
+            raise ValueError(
+                f"coordinate {cid!r}: entities {missing[:5]!r} present in "
+                "the previous snapshot are missing from the new one — "
+                "refresh datasets are append/update-only"
+            )
+        changed_keys = []
+        new_keys = []
+        for k, d in nf.entity_digests.items():
+            pd = pf.entity_digests.get(k)
+            if pd is None:
+                changed_keys.append(k)
+                new_keys.append(k)
+            elif pd != d:
+                changed_keys.append(k)
+        out[cid] = CoordinateDiff(
+            bool(changed_keys),
+            tuple(changed_keys),
+            tuple(new_keys),
+            delta_rows=int(sum(nf.entity_rows[k] for k in changed_keys)),
+        )
+    return out

@@ -329,6 +329,113 @@ class GameDataset:
         return cls(ShardDict(shards), labels, offsets, weights, tags)
 
 
+def _ell_row_planes(feats: SparseFeatures):
+    """Host (N, K) index/value planes regardless of the stored ELL layout."""
+    idx = np.asarray(feats.indices)
+    val = np.asarray(feats.values)
+    if feats.ell_axis == -2:
+        idx = np.moveaxis(idx, -1, -2)
+        val = np.moveaxis(val, -1, -2)
+    return idx, val
+
+
+def take_rows(dataset: GameDataset, rows) -> GameDataset:
+    """Row-subset of a GameDataset, built entirely host-side.
+
+    The incremental-refresh fast path (game/incremental.py) carves the
+    changed entities' samples out of a merged dataset with this: shards
+    are read through `peek_shard` (no device materialization — the subset
+    uploads lazily like any hand-built dataset) and fancy-indexed per
+    plane; labels/offsets/weights and every id-tag column slice the same
+    `rows`, so the subset preserves sample alignment and relative order.
+    """
+    rows = np.asarray(rows)
+    shards: Dict[str, Features] = {}
+    for name in dataset.shards:
+        feats = dataset.peek_shard(name)
+        if isinstance(feats, SparseFeatures):
+            idx, val = _ell_row_planes(feats)
+            shards[name] = dataclasses.replace(
+                feats, indices=idx[rows], values=val[rows], ell_axis=-1
+            )
+        else:
+            shards[name] = np.asarray(feats)[rows]
+    return GameDataset.build(
+        shards,
+        np.asarray(dataset.labels)[rows],
+        offsets=np.asarray(dataset.offsets)[rows],
+        weights=np.asarray(dataset.weights)[rows],
+        id_tags={k: np.asarray(v)[rows] for k, v in dataset.id_tags.items()},
+    )
+
+
+def concat_datasets(a: GameDataset, b: GameDataset) -> GameDataset:
+    """Append dataset `b`'s samples after `a`'s (the merged view a
+    streamed delta batch trains against). Shard sets, feature dims, and
+    id-tag columns must match; ELL planes pad to the wider K so padding
+    slots (value 0.0) stay inert. Built host-side like `take_rows`."""
+    if set(a.shards) != set(b.shards):
+        raise ValueError(
+            f"cannot concat datasets with different shard sets "
+            f"{sorted(a.shards)} vs {sorted(b.shards)}"
+        )
+    if set(a.id_tags) != set(b.id_tags):
+        raise ValueError(
+            f"cannot concat datasets with different id-tag columns "
+            f"{sorted(a.id_tags)} vs {sorted(b.id_tags)}"
+        )
+    shards: Dict[str, Features] = {}
+    for name in a.shards:
+        fa, fb = a.peek_shard(name), b.peek_shard(name)
+        if isinstance(fa, SparseFeatures) != isinstance(fb, SparseFeatures):
+            raise ValueError(f"shard {name!r}: sparse/dense layouts differ")
+        if isinstance(fa, SparseFeatures):
+            if fa.dim != fb.dim:
+                raise ValueError(
+                    f"shard {name!r}: dims differ ({fa.dim} vs {fb.dim})"
+                )
+            ia, va = _ell_row_planes(fa)
+            ib, vb = _ell_row_planes(fb)
+            k = max(ia.shape[-1], ib.shape[-1])
+            ia, va = _pad_ell_k(ia, va, k)
+            ib, vb = _pad_ell_k(ib, vb, k)
+            shards[name] = dataclasses.replace(
+                fa,
+                indices=np.concatenate([ia, ib]),
+                values=np.concatenate([va, vb]),
+                ell_axis=-1,
+            )
+        else:
+            na, nb = np.asarray(fa), np.asarray(fb)
+            if na.shape[-1] != nb.shape[-1]:
+                raise ValueError(
+                    f"shard {name!r}: dims differ "
+                    f"({na.shape[-1]} vs {nb.shape[-1]})"
+                )
+            shards[name] = np.concatenate([na, nb])
+    return GameDataset.build(
+        shards,
+        np.concatenate([np.asarray(a.labels), np.asarray(b.labels)]),
+        offsets=np.concatenate([np.asarray(a.offsets), np.asarray(b.offsets)]),
+        weights=np.concatenate([np.asarray(a.weights), np.asarray(b.weights)]),
+        id_tags={
+            k: np.concatenate([np.asarray(a.id_tags[k]), np.asarray(b.id_tags[k])])
+            for k in a.id_tags
+        },
+    )
+
+
+def _pad_ell_k(idx: np.ndarray, val: np.ndarray, k: int):
+    """Widen (N, K0) ELL planes to K columns with inert padding slots."""
+    if idx.shape[-1] == k:
+        return idx, val
+    pad = ((0, 0), (0, k - idx.shape[-1]))
+    return (
+        np.pad(idx, pad, constant_values=0),
+        np.pad(val, pad, constant_values=0.0),
+    )
+
+
 def _row_priorities(codes: np.ndarray, n: int) -> np.ndarray:
     """Deterministic per-(entity, row) reservoir priorities, vectorized.
 

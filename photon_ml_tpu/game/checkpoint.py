@@ -733,3 +733,32 @@ class CoordinateDescentCheckpoint:
         return _load_sharded_model(
             self.directory, list(rel), task, self._checksums
         )
+
+
+# --------------------------------------------------- delta-fit audit records
+
+
+def append_delta_record(directory: str, record: Mapping[str, object]) -> str:
+    """Append one incremental-fit audit record (plan + characterized
+    parity — see game/incremental.incremental_fit) to the run's durable
+    `delta_records.jsonl`. Atomic rewrite-and-rename under the standard
+    `checkpoint_write` fault site: a crash mid-append leaves the previous
+    journal intact, never a torn line. Returns the journal path."""
+    path = os.path.join(directory, "delta_records.jsonl")
+    lines = b""
+    if os.path.exists(path):
+        with open(path, "rb") as f:
+            lines = f.read()
+    lines += json.dumps(dict(record), sort_keys=True).encode() + b"\n"
+    _atomic_write(path, lines)
+    return path
+
+
+def read_delta_records(directory: str) -> List[Dict[str, object]]:
+    """The run's incremental-fit audit trail, oldest first ([] when no
+    delta fit has run)."""
+    path = os.path.join(directory, "delta_records.jsonl")
+    if not os.path.exists(path):
+        return []
+    with open(path, "rb") as f:
+        return [json.loads(line) for line in f.read().splitlines() if line]

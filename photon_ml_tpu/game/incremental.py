@@ -1,0 +1,633 @@
+"""Incremental (delta) fits: re-solve only what a delta batch changed.
+
+The reference's production loop retrains GAME models from scratch and
+redeploys whole artifacts (GameTrainingDriver), so freshness is bounded
+by full-fit wall time. This module is the training half of the ISSUE 16
+fast path that closes the gap:
+
+1. `fingerprint_dataset` digests (data/fingerprints.py) decide per
+   coordinate — and per ENTITY for random effects — whether a merged
+   dataset's training inputs actually changed since the previous fit.
+2. `incremental_fit` re-solves ONLY changed coordinates, warm-started
+   from the previous model. An unchanged coordinate's model is carried
+   over UNTOUCHED — bitwise-equal to the previous fit by construction.
+   A changed random-effect coordinate takes the ENTITY fast path: the
+   changed entities' rows are carved out (`take_rows`), solved as a
+   small sub-problem warm-started from their previous rows, and the
+   solved rows scatter back into the grown coefficient matrix; the
+   untouched entities' rows are never re-assembled or re-solved, so
+   they stay bitwise-equal too (per-entity solves are independent given
+   the offsets — the same per-lane determinism the stacked sweep
+   executor's bitwise contract already pins).
+3. `grow_random_effect_model` extends a previous (E + 1, d) matrix and
+   entity index with new/churned entities by a key-mapped row scatter —
+   index-layout-safe (entities may re-sort when new keys interleave) and
+   zero-initialized for the brand-new rows.
+
+Parity contract (journaled as `delta_fit_start`/`delta_fit_finish`):
+carried coordinates and unchanged entities are BITWISE-equal to the
+previous model; re-solved entities report a characterized max relative
+coefficient movement (`max_rel_diff`) — the churn the new data caused,
+persisted alongside checkpoint delta records for audit.
+
+Scope: this layer trains coordinates built directly from data configs —
+no feature projectors and no estimator binding. Projected random-effect
+configs must refresh through a full `GameEstimator.fit` (serving bundles
+reject projected coordinates anyway). Configs with active-row bounds or
+Pearson selection fall back from the entity fast path to a whole-
+coordinate warm re-solve: their row selection keys on GLOBAL sample
+indices, which a carved-out sub-dataset would renumber.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Dict, Mapping, Optional, Set, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.data.fingerprints import (
+    DatasetFingerprints,
+    diff_fingerprints,
+    fingerprint_dataset,
+)
+from photon_ml_tpu.data.game_dataset import (
+    FixedEffectDataConfig,
+    GameDataset,
+    RandomEffectDataConfig,
+    build_random_effect_dataset,
+    take_rows,
+)
+from photon_ml_tpu.game.coordinate import (
+    FixedEffectCoordinate,
+    RandomEffectCoordinate,
+)
+from photon_ml_tpu.game.coordinate_descent import run_coordinate_descent
+from photon_ml_tpu.game.model import GameModel, RandomEffectModel
+from photon_ml_tpu.transformers.game_transformer import (
+    CoordinateScoringSpec,
+    coordinate_margins,
+    prepare_coordinate_data,
+)
+from photon_ml_tpu.types import TaskType
+from photon_ml_tpu.utils import telemetry
+
+logger = logging.getLogger(__name__)
+
+
+# ------------------------------------------------------------------ planning
+
+
+@dataclasses.dataclass(frozen=True)
+class DeltaFitPlan:
+    """What an incremental fit will re-solve.
+
+    mode: "none" (nothing changed — carry the previous model bitwise),
+    "delta" (re-solve changed coordinates only, entity fast path where
+    eligible), or "full" (churn past the max-delta-fraction escape hatch
+    — one warm-started full refit beats per-entity re-solves).
+    """
+
+    mode: str
+    changed_coordinates: Tuple[str, ...]
+    changed_entities: Dict[str, Tuple[object, ...]]
+    new_entities: Dict[str, Tuple[object, ...]]
+    delta_rows: int
+    total_rows: int
+
+    @property
+    def delta_fraction(self) -> float:
+        return self.delta_rows / max(self.total_rows, 1)
+
+
+def plan_delta_fit(
+    prev: DatasetFingerprints,
+    new: DatasetFingerprints,
+    *,
+    max_delta_fraction: Optional[float] = None,
+) -> DeltaFitPlan:
+    """Diff two fingerprint snapshots into a re-solve plan.
+
+    `max_delta_fraction` defaults to the planner-routed
+    PHOTON_REFRESH_MAX_DELTA_FRACTION knob: past that churn fraction the
+    plan forces mode "full"."""
+    from photon_ml_tpu import planner
+
+    diffs = diff_fingerprints(prev, new)
+    changed = tuple(cid for cid, d in diffs.items() if d.changed)
+    changed_entities: Dict[str, Tuple[object, ...]] = {}
+    new_entities: Dict[str, Tuple[object, ...]] = {}
+    delta_rows = max(new.num_samples - prev.num_samples, 0)
+    fe_changed = False
+    for cid in changed:
+        d = diffs[cid]
+        if prev.coordinates[cid].is_random_effect:
+            changed_entities[cid] = d.changed_entities
+            new_entities[cid] = d.new_entities
+            delta_rows = max(delta_rows, d.delta_rows)
+        else:
+            fe_changed = True
+    if delta_rows == 0 and fe_changed:
+        # An FE-only change with no appended rows and no RE churn means
+        # existing rows were edited in place in the FE shard alone — the
+        # digest cannot localize it, so charge the whole dataset.
+        delta_rows = new.num_samples
+    if not changed:
+        mode = "none"
+    else:
+        if max_delta_fraction is None:
+            max_delta_fraction = float(
+                planner.planned_value("refresh_max_delta_fraction")
+            )
+        frac = delta_rows / max(new.num_samples, 1)
+        mode = "full" if frac > max_delta_fraction else "delta"
+    return DeltaFitPlan(
+        mode,
+        changed,
+        changed_entities,
+        new_entities,
+        int(delta_rows),
+        int(new.num_samples),
+    )
+
+
+# ------------------------------------------------------------- model growth
+
+
+def grow_random_effect_model(
+    model: RandomEffectModel,
+    prev_index: Mapping[object, int],
+    new_index: Mapping[object, int],
+) -> RandomEffectModel:
+    """Extend a previous RE model to a new entity index.
+
+    Rows move by KEY (never by position — new entities can re-sort the
+    sorted-unique index), brand-new entities start at zero, and the
+    pinned zero row lands at the new E. Bitwise: a carried row's floats
+    are copied, not recomputed."""
+    prev_mat = np.asarray(model.coefficients_matrix)
+    e_new = len(new_index)
+    mat = np.zeros((e_new + 1, prev_mat.shape[1]), prev_mat.dtype)
+    shared = [k for k in new_index if k in prev_index]
+    if shared:
+        new_pos = np.fromiter(
+            (new_index[k] for k in shared), np.int64, len(shared)
+        )
+        prev_pos = np.fromiter(
+            (prev_index[k] for k in shared), np.int64, len(shared)
+        )
+        mat[new_pos] = prev_mat[prev_pos]
+    var = None
+    if model.variances_matrix is not None:
+        prev_var = np.asarray(model.variances_matrix)
+        var_np = np.zeros_like(mat)
+        if shared:
+            var_np[new_pos] = prev_var[prev_pos]
+        var = jnp.asarray(var_np)
+    return RandomEffectModel(jnp.asarray(mat), var, model.task)
+
+
+# --------------------------------------------------------------- fit driver
+
+
+@dataclasses.dataclass
+class FitState:
+    """Everything the NEXT refresh round needs from a fit: the model, the
+    data fingerprints it was trained on, and the per-coordinate entity
+    indices (None for fixed effects)."""
+
+    model: GameModel
+    fingerprints: DatasetFingerprints
+    entity_indices: Dict[str, Optional[Dict[object, int]]]
+
+
+@dataclasses.dataclass
+class IncrementalFitResult:
+    state: FitState
+    plan: DeltaFitPlan
+    seconds: float
+    # Max relative coefficient movement across re-solved (churned)
+    # parameters vs their warm start — the characterized parity on
+    # CHANGED entities (carried ones are bitwise and contribute 0).
+    max_rel_diff: float
+    carried_coordinates: Tuple[str, ...]
+
+
+def build_coordinates(
+    dataset: GameDataset,
+    data_configs: Mapping[str, object],
+    opt_configs: Mapping[str, object],
+    task: TaskType,
+    *,
+    norms: Optional[Mapping[str, object]] = None,
+):
+    """(coordinate id -> trained-coordinate object, id -> entity index)."""
+    coords: Dict[str, object] = {}
+    indices: Dict[str, Optional[Dict[object, int]]] = {}
+    for cid, cfg in data_configs.items():
+        opt = opt_configs[cid]
+        norm = (norms or {}).get(cid)
+        if isinstance(cfg, RandomEffectDataConfig):
+            red = build_random_effect_dataset(dataset, cfg)
+            coords[cid] = RandomEffectCoordinate(dataset, red, opt, task, norm)
+            indices[cid] = dict(red.entity_index)
+        elif isinstance(cfg, FixedEffectDataConfig):
+            coords[cid] = FixedEffectCoordinate(
+                dataset, cfg.feature_shard, opt, task, norm
+            )
+            indices[cid] = None
+        else:
+            raise TypeError(f"coordinate {cid!r}: unknown config {type(cfg)}")
+    return coords, indices
+
+
+def scoring_specs(
+    data_configs: Mapping[str, object],
+    entity_indices: Mapping[str, Optional[Dict[object, int]]],
+    *,
+    norms: Optional[Mapping[str, object]] = None,
+) -> Dict[str, CoordinateScoringSpec]:
+    """Projector-free scoring specs for this layer's coordinates (also
+    what `ServingBundle.from_model` stages from)."""
+    specs: Dict[str, CoordinateScoringSpec] = {}
+    for cid, cfg in data_configs.items():
+        norm = (norms or {}).get(cid)
+        if isinstance(cfg, RandomEffectDataConfig):
+            specs[cid] = CoordinateScoringSpec(
+                shard=cfg.feature_shard,
+                norm=norm,
+                random_effect_type=cfg.random_effect_type,
+                entity_index=entity_indices[cid],
+            )
+        else:
+            specs[cid] = CoordinateScoringSpec(shard=cfg.feature_shard, norm=norm)
+    return specs
+
+
+def full_fit(
+    dataset: GameDataset,
+    data_configs: Mapping[str, object],
+    opt_configs: Mapping[str, object],
+    task: TaskType,
+    *,
+    num_iterations: int = 1,
+    initial_models: Optional[GameModel] = None,
+    locked_coordinates: Optional[Set[str]] = None,
+    norms: Optional[Mapping[str, object]] = None,
+    seed: int = 0,
+    checkpoint_dir: Optional[str] = None,
+) -> FitState:
+    """A from-scratch (or warm-started) fit at this layer: build every
+    coordinate on `dataset` and run cyclic coordinate descent. Both the
+    refresh loop's round 0 and incremental_fit's mode-"full" escape hatch
+    land here; it is also the baseline the bitwise parity tests compare
+    the delta path against."""
+    coords, indices = build_coordinates(
+        dataset, data_configs, opt_configs, task, norms=norms
+    )
+    result = run_coordinate_descent(
+        coords,
+        num_iterations,
+        initial_models=initial_models,
+        locked_coordinates=locked_coordinates,
+        reg_weights={cid: opt_configs[cid].reg_weight for cid in coords},
+        seed=seed,
+        checkpoint_dir=checkpoint_dir,
+    )
+    return FitState(
+        result.model, fingerprint_dataset(dataset, data_configs), indices
+    )
+
+
+def _entity_fast_path_eligible(
+    cfg: RandomEffectDataConfig, norm: Optional[object]
+) -> bool:
+    """Row carving renumbers global sample indices, so any config whose
+    active-row selection or feature selection keys on them must re-solve
+    the whole coordinate instead (still warm-started, still delta-only at
+    coordinate granularity)."""
+    return (
+        cfg.active_upper_bound is None
+        and cfg.active_lower_bound is None
+        and cfg.num_features_to_samples_ratio_upper_bound is None
+        and norm is None
+    )
+
+
+def _offsets_for(
+    dataset: GameDataset,
+    cid: str,
+    models: Mapping[str, object],
+    specs: Mapping[str, CoordinateScoringSpec],
+) -> jnp.ndarray:
+    """Total margins of every OTHER coordinate's current model — the
+    residual-exchange offsets coordinate `cid` solves against."""
+    total = jnp.asarray(np.asarray(dataset.offsets))
+    for other, model in models.items():
+        if other == cid:
+            continue
+        prep = prepare_coordinate_data(specs[other], dataset)
+        total = total + coordinate_margins(specs[other], model, prep)
+    return total
+
+
+def incremental_fit(
+    dataset: GameDataset,
+    data_configs: Mapping[str, object],
+    opt_configs: Mapping[str, object],
+    task: TaskType,
+    *,
+    prev: FitState,
+    max_delta_fraction: Optional[float] = None,
+    norms: Optional[Mapping[str, object]] = None,
+    seed: int = 0,
+    checkpoint_dir: Optional[str] = None,
+) -> IncrementalFitResult:
+    """Warm-start delta fit of `dataset` (the MERGED previous + delta
+    rows) against the previous fit's state. See the module docstring for
+    the parity contract; the plan's mode decides the work:
+
+    * "none": nothing changed — previous model returned as-is (bitwise).
+    * "full": churn past the escape hatch — one warm-started full refit
+      (every RE model grown to the merged entity index first).
+    * "delta": changed coordinates re-solve in update-sequence order
+      against offsets from the freshest models; changed random-effect
+      coordinates take the entity fast path where eligible.
+    """
+    t0 = time.perf_counter()
+    new_fp = fingerprint_dataset(dataset, data_configs)
+    plan = plan_delta_fit(
+        prev.fingerprints, new_fp, max_delta_fraction=max_delta_fraction
+    )
+    telemetry.emit_event(
+        "delta_fit_start",
+        mode=plan.mode,
+        changed_coordinates=list(plan.changed_coordinates),
+        delta_rows=plan.delta_rows,
+        total_rows=plan.total_rows,
+    )
+    carried = tuple(
+        cid for cid in data_configs if cid not in plan.changed_coordinates
+    )
+    max_rel_diff = 0.0
+
+    if plan.mode == "none":
+        state = FitState(prev.model, new_fp, dict(prev.entity_indices))
+    elif plan.mode == "full":
+        state, max_rel_diff = _warm_full_refit(
+            dataset, data_configs, opt_configs, task, prev, new_fp,
+            norms=norms, seed=seed, checkpoint_dir=checkpoint_dir,
+        )
+    else:
+        state, max_rel_diff = _delta_solve(
+            dataset, data_configs, opt_configs, task, prev, new_fp, plan,
+            norms=norms,
+        )
+    seconds = time.perf_counter() - t0
+    telemetry.emit_event(
+        "delta_fit_finish",
+        mode=plan.mode,
+        changed_coordinates=list(plan.changed_coordinates),
+        carried_coordinates=list(carried),
+        seconds=round(seconds, 4),
+        max_rel_diff=float(max_rel_diff),
+    )
+    if checkpoint_dir is not None:
+        from photon_ml_tpu.game.checkpoint import append_delta_record
+
+        append_delta_record(
+            checkpoint_dir,
+            {
+                "mode": plan.mode,
+                "changed_coordinates": list(plan.changed_coordinates),
+                "carried_coordinates": list(carried),
+                "delta_rows": plan.delta_rows,
+                "total_rows": plan.total_rows,
+                "max_rel_diff": float(max_rel_diff),
+                "seconds": round(seconds, 4),
+            },
+        )
+    return IncrementalFitResult(
+        state, plan, seconds, float(max_rel_diff), carried
+    )
+
+
+def _grown_models(
+    prev: FitState,
+    merged_indices: Mapping[str, Optional[Dict[object, int]]],
+) -> Dict[str, object]:
+    """Every previous model, RE models grown to the merged entity index
+    (a no-op copy when the index is unchanged)."""
+    models: Dict[str, object] = {}
+    for cid, model in prev.model.models.items():
+        prev_idx = prev.entity_indices.get(cid)
+        new_idx = merged_indices.get(cid)
+        if prev_idx is not None and new_idx is not None and prev_idx != new_idx:
+            models[cid] = grow_random_effect_model(model, prev_idx, new_idx)
+        else:
+            models[cid] = model
+    return models
+
+
+def _rel_diff(new: np.ndarray, old: np.ndarray) -> float:
+    """Max relative coefficient movement, CHURN-characterizing: rows
+    whose warm start is all-zero (brand-new entities) are excluded —
+    they have no previous value to move relative to, and would swamp
+    the number with |x| / ~0."""
+    if new.size == 0:
+        return 0.0
+    if new.ndim == 2:
+        keep = np.any(old != 0, axis=1)
+        new, old = new[keep], old[keep]
+        if new.size == 0:
+            return 0.0
+    return float(
+        np.max(np.abs(new - old) / (np.abs(old) + 1e-12))
+    )
+
+
+def _warm_full_refit(
+    dataset, data_configs, opt_configs, task, prev, new_fp,
+    *, norms, seed, checkpoint_dir,
+):
+    coords, indices = build_coordinates(
+        dataset, data_configs, opt_configs, task, norms=norms
+    )
+    warm = GameModel(_grown_models(prev, indices))
+    result = run_coordinate_descent(
+        coords,
+        1,
+        initial_models=warm,
+        reg_weights={cid: opt_configs[cid].reg_weight for cid in coords},
+        seed=seed,
+        checkpoint_dir=checkpoint_dir,
+    )
+    max_rel = 0.0
+    for cid, model in result.model.models.items():
+        old = warm[cid]
+        if isinstance(model, RandomEffectModel):
+            e = min(model.num_entities, old.num_entities)
+            max_rel = max(
+                max_rel,
+                _rel_diff(
+                    np.asarray(model.coefficients_matrix)[:e],
+                    np.asarray(old.coefficients_matrix)[:e],
+                ),
+            )
+        else:
+            max_rel = max(
+                max_rel,
+                _rel_diff(
+                    np.asarray(model.coefficients.means),
+                    np.asarray(old.coefficients.means),
+                ),
+            )
+    return FitState(result.model, new_fp, indices), max_rel
+
+
+def _delta_solve(
+    dataset, data_configs, opt_configs, task, prev, new_fp, plan, *, norms
+):
+    """Mode "delta": re-solve changed coordinates only, in config order."""
+    # Merged entity indices: changed RE coordinates rebuild theirs from
+    # the merged tags (sorted-unique — identical to what a from-scratch
+    # build assigns); unchanged ones keep the previous index by
+    # definition (same entities, same sort).
+    merged_indices: Dict[str, Optional[Dict[object, int]]] = {}
+    for cid, cfg in data_configs.items():
+        if not isinstance(cfg, RandomEffectDataConfig):
+            merged_indices[cid] = None
+        elif cid in plan.changed_coordinates:
+            merged_indices[cid] = _merged_entity_index(
+                dataset, cfg.random_effect_type
+            )
+        else:
+            merged_indices[cid] = prev.entity_indices[cid]
+    models = _grown_models(prev, merged_indices)
+    specs = scoring_specs(data_configs, merged_indices, norms=norms)
+    max_rel = 0.0
+    for cid, cfg in data_configs.items():
+        if cid not in plan.changed_coordinates:
+            continue
+        opt = opt_configs[cid]
+        norm = (norms or {}).get(cid)
+        offsets = _offsets_for(dataset, cid, models, specs)
+        if isinstance(cfg, FixedEffectDataConfig):
+            coord = FixedEffectCoordinate(
+                dataset, cfg.feature_shard, opt, task, norm
+            )
+            new_model, _ = coord.train(
+                offsets, models[cid], reg_weight=opt.reg_weight
+            )
+            max_rel = max(
+                max_rel,
+                _rel_diff(
+                    np.asarray(new_model.coefficients.means),
+                    np.asarray(models[cid].coefficients.means),
+                ),
+            )
+            models[cid] = new_model
+            continue
+        grown = models[cid]
+        if not _entity_fast_path_eligible(cfg, norm):
+            red = build_random_effect_dataset(dataset, cfg)
+            coord = RandomEffectCoordinate(dataset, red, opt, task, norm)
+            new_model, _ = coord.train(
+                offsets, grown, reg_weight=opt.reg_weight
+            )
+            e = min(new_model.num_entities, grown.num_entities)
+            max_rel = max(
+                max_rel,
+                _rel_diff(
+                    np.asarray(new_model.coefficients_matrix)[:e],
+                    np.asarray(grown.coefficients_matrix)[:e],
+                ),
+            )
+            models[cid] = new_model
+            continue
+        # Entity fast path: carve the changed entities' rows, solve the
+        # small sub-problem warm-started from their previous rows, and
+        # scatter the solved rows back. Untouched rows never re-solve.
+        merged_index = merged_indices[cid]
+        changed_keys = plan.changed_entities[cid]
+        changed_pos = np.fromiter(
+            (merged_index[k] for k in changed_keys),
+            np.int64,
+            len(changed_keys),
+        )
+        tags = np.asarray(dataset.id_tags[cfg.random_effect_type])
+        sample_pos = _sample_entity_positions(tags, merged_index)
+        rows = np.nonzero(np.isin(sample_pos, changed_pos))[0]
+        sub_ds = take_rows(dataset, rows)
+        sub_red = build_random_effect_dataset(sub_ds, cfg)
+        sub_index = sub_red.entity_index
+        grown_mat = np.asarray(grown.coefficients_matrix)
+        sub_warm = np.zeros(
+            (len(sub_index) + 1, grown_mat.shape[1]), grown_mat.dtype
+        )
+        sub_keys = list(sub_index.keys())
+        sub_pos = np.fromiter(
+            (sub_index[k] for k in sub_keys), np.int64, len(sub_keys)
+        )
+        from_pos = np.fromiter(
+            (merged_index[k] for k in sub_keys), np.int64, len(sub_keys)
+        )
+        sub_warm[sub_pos] = grown_mat[from_pos]
+        coord = RandomEffectCoordinate(sub_ds, sub_red, opt, task, norm)
+        sub_offsets = jnp.asarray(np.asarray(offsets)[rows])
+        sub_model, _ = coord.train(
+            sub_offsets,
+            RandomEffectModel(jnp.asarray(sub_warm), None, task),
+            reg_weight=opt.reg_weight,
+        )
+        solved = np.asarray(sub_model.coefficients_matrix)[sub_pos]
+        max_rel = max(max_rel, _rel_diff(solved, grown_mat[from_pos]))
+        new_mat = jnp.asarray(grown_mat).at[from_pos].set(jnp.asarray(solved))
+        models[cid] = RandomEffectModel(new_mat, None, task)
+        logger.info(
+            "delta fit %s: re-solved %d/%d entities (%d/%d rows)",
+            cid,
+            len(changed_keys),
+            len(merged_index),
+            len(rows),
+            dataset.num_samples,
+        )
+    return FitState(GameModel(models), new_fp, merged_indices), max_rel
+
+
+def _merged_entity_index(
+    dataset: GameDataset, tag: str
+) -> Dict[object, int]:
+    """Sorted-unique entity index over a dataset's tag column — exactly
+    what _build_random_effect_dataset assigns (tag_codes fast path
+    included, whose value table is already sorted-unique)."""
+    ct = getattr(dataset, "tag_codes", {}).get(tag)
+    uniq = (
+        np.asarray(ct[1])
+        if ct is not None
+        else np.unique(np.asarray(dataset.id_tags[tag]))
+    )
+    return {
+        (k.item() if hasattr(k, "item") else k): i
+        for i, k in enumerate(uniq)
+    }
+
+
+def _sample_entity_positions(
+    tags: np.ndarray, index: Mapping[object, int]
+) -> np.ndarray:
+    """Per-sample entity-index position (vectorized through the unique
+    table; every tag is in the index by construction)."""
+    uniq, inv = np.unique(tags, return_inverse=True)
+    uniq_pos = np.fromiter(
+        (
+            index[k.item() if hasattr(k, "item") else k]
+            for k in uniq
+        ),
+        np.int64,
+        count=len(uniq),
+    )
+    return uniq_pos[inv]

@@ -98,6 +98,11 @@ KNOB_FOR: Dict[str, str] = {
     "sparse_layout": "PHOTON_SPARSE_LAYOUT",
     "pack_routing": "PHOTON_DEVICE_PACK",
     "assembly_routing": "PHOTON_DEVICE_ASSEMBLY",
+    # Continuous refresh (ISSUE 16): how many streamed rows to batch
+    # before an incremental fit + delta swap, and how much churn the
+    # delta path absorbs before forcing a warm full refit.
+    "refresh_batch_rows": "PHOTON_REFRESH_BATCH_ROWS",
+    "refresh_max_delta_fraction": "PHOTON_REFRESH_MAX_DELTA_FRACTION",
 }
 
 # Knob-value -> decision-vocabulary normalizers: tri-state str knobs

@@ -81,6 +81,21 @@ class ScoreRequest:
     deadline_ms: Optional[float] = None
 
 
+def default_provenance(origin: str = "full_fit") -> Dict[str, object]:
+    """A fresh bundle lineage block (contracts.BUNDLE_PROVENANCE_KEYS):
+    where the bundle came from ("full_fit" | "artifact" | "incremental")
+    and how many delta applies it has absorbed. Stamped by the builders,
+    updated IN PLACE by serving/delta.apply_delta at each committed flip,
+    and surfaced by cli/serve in serving-summary.json."""
+    return {
+        "origin": origin,
+        "generation": 0,
+        "deltas_applied": 0,
+        "last_delta_source": None,
+        "last_delta_ts": None,
+    }
+
+
 def _shard_upload_policy():
     """Bounded retry for per-shard model staging/restage: 1 +
     PHOTON_SHARD_UPLOAD_RETRIES attempts under the standard backoff."""
@@ -563,6 +578,11 @@ class ServingBundle:
     upload_s: float = 0.0
     # Set by release(): the hot-swap drain freed this bundle's device state.
     released: bool = False
+    # Lineage block (contracts.BUNDLE_PROVENANCE_KEYS order) — see
+    # `default_provenance`.
+    provenance: Dict[str, object] = dataclasses.field(
+        default_factory=default_provenance
+    )
 
     @property
     def coordinate_ids(self) -> List[str]:
@@ -736,6 +756,7 @@ class ServingBundle:
         index_maps: Optional[Mapping[str, IndexMap]] = None,
         mesh=None,
         hot_rows: Optional[Union[int, Mapping[str, int]]] = None,
+        origin: str = "full_fit",
     ) -> "ServingBundle":
         """Stage an in-memory (model, specs) pair. Projected random-effect
         coordinates are rejected — serving scores in original feature space
@@ -896,6 +917,7 @@ class ServingBundle:
             index_maps=index_maps,
             upload_bytes=int(nbytes),
             upload_s=time.perf_counter() - t0,
+            provenance=default_provenance(origin),
         )
 
     @classmethod
@@ -920,6 +942,7 @@ class ServingBundle:
             index_maps=index_maps,
             mesh=mesh,
             hot_rows=hot_rows,
+            origin="artifact",
         )
 
 

@@ -1,0 +1,411 @@
+"""Delta bundles: ship ONLY what an incremental fit changed to a live engine.
+
+The serving half of the ISSUE 16 continuous-refresh loop. A full model
+swap re-uploads every coordinate; after an incremental fit
+(game/incremental.py) almost all of that traffic is bytes the device
+already holds. `build_delta_bundle` diffs two fit states BITWISE into the
+minimal payload — changed/added random-effect rows and changed
+fixed-effect planes — and `apply_delta` flips a live engine onto it
+through the SAME reshard staging machinery every other live mutation
+uses (`MeshReshardOrchestrator._stage_and_commit`): double-buffered
+staging under the `shard_upload` fault site, compatibility check,
+pre-warm, `reshard_commit` fault site, atomic flip, drain, retire. A
+failure anywhere before the flip rolls back to the old generation —
+which never stopped serving — and journals `delta_rollback`.
+
+Row placement: new entities interleave into the sorted-unique entity
+index, so carried rows can MOVE even though their floats don't change.
+The bundle therefore carries, per coordinate, both the changed rows
+(values that cross the host->device wire) and a carry map (old row ->
+new row) applied as a device-side gather — upload bytes stay
+proportional to the churn, not the matrix. When the index is unchanged
+the carry map is the identity and the apply is a pure functional
+`.at[rows].set` on the resident matrix (per-shard on entity-sharded
+coordinates). Entity-sharded growth must fit the existing mesh padding;
+past it, the apply refuses loudly — grow through a reshard instead.
+
+Provenance: every committed apply updates the live bundle's lineage
+block IN PLACE (origin -> "incremental", deltas_applied += 1,
+last_delta_source/ts) — surfaced by cli/serve in serving-summary.json —
+and journals `delta_apply`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.game.incremental import FitState, grow_random_effect_model
+from photon_ml_tpu.game.model import FixedEffectModel, RandomEffectModel
+from photon_ml_tpu.serving.bundle import (
+    ServingBundle,
+    ServingCoordinate,
+    TwoTierEntityStore,
+    _stage_shard,
+)
+from photon_ml_tpu.utils import faults, telemetry
+from photon_ml_tpu.utils.contracts import DELTA_BUNDLE_KEYS
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class CoordinateDelta:
+    """One coordinate's minimal update payload.
+
+    Fixed effects: `plane` is the full new (dim,) weight plane (FE planes
+    are tiny — shipping whole is already minimal). Random effects:
+    `rows`/`values` are the changed/added coefficient rows in NEW-index
+    row space, `carry_old`/`carry_new` map every carried row's old
+    position to its new one (identity maps are stored as None), and
+    `entity_index`/`logical_rows` are the coordinate's new host indexes.
+    """
+
+    cid: str
+    plane: Optional[np.ndarray] = None
+    rows: Optional[np.ndarray] = None
+    values: Optional[np.ndarray] = None
+    carry_old: Optional[np.ndarray] = None
+    carry_new: Optional[np.ndarray] = None
+    entity_index: Optional[Dict[object, int]] = None
+    logical_rows: Optional[int] = None
+
+    @property
+    def is_random_effect(self) -> bool:
+        return self.plane is None
+
+    @property
+    def nbytes(self) -> int:
+        if self.plane is not None:
+            return int(self.plane.nbytes)
+        return int(self.values.nbytes)
+
+    @property
+    def n_rows(self) -> int:
+        return 0 if self.rows is None else int(len(self.rows))
+
+
+@dataclasses.dataclass(frozen=True)
+class DeltaBundle:
+    """The minimal refresh payload between two fits (manifest keys pinned
+    by contracts.DELTA_BUNDLE_KEYS)."""
+
+    source: str
+    mode: str
+    coordinates: Dict[str, CoordinateDelta]
+    delta_rows: int
+    total_rows: int
+
+    @property
+    def nbytes(self) -> int:
+        return sum(d.nbytes for d in self.coordinates.values())
+
+    @property
+    def is_empty(self) -> bool:
+        return not self.coordinates
+
+    def manifest(self) -> Dict[str, object]:
+        """DELTA_BUNDLE_KEYS-shaped summary for journals and CLI output."""
+        out = {
+            "source": self.source,
+            "mode": self.mode,
+            "coordinates": {
+                cid: {
+                    "kind": "re" if d.is_random_effect else "fe",
+                    "rows": d.n_rows,
+                }
+                for cid, d in self.coordinates.items()
+            },
+            "delta_rows": int(self.delta_rows),
+            "total_rows": int(self.total_rows),
+            "bytes": int(self.nbytes),
+        }
+        assert tuple(out) == DELTA_BUNDLE_KEYS
+        return out
+
+
+def build_delta_bundle(
+    prev: FitState, new: FitState, *, source: str, mode: str = "delta",
+    delta_rows: int = 0, total_rows: int = 0,
+) -> DeltaBundle:
+    """Bitwise-diff two fit states into the minimal update payload.
+
+    Trusting the diff to be bitwise is what makes the payload honest: a
+    coordinate the incremental fit carried over contributes NOTHING (its
+    floats are the same objects), a changed random-effect coordinate
+    contributes exactly its churned + new rows, and carried rows that
+    merely MOVED (index re-sort) ride the carry map, not the wire."""
+    coords: Dict[str, CoordinateDelta] = {}
+    for cid in new.model.coordinate_ids:
+        pm, nm = prev.model[cid], new.model[cid]
+        if isinstance(nm, FixedEffectModel):
+            new_plane = np.ascontiguousarray(
+                np.asarray(nm.coefficients.means), np.float32
+            )
+            old_plane = np.asarray(pm.coefficients.means, np.float32)
+            if new_plane.shape == old_plane.shape and np.array_equal(
+                new_plane, old_plane
+            ):
+                continue
+            coords[cid] = CoordinateDelta(cid, plane=new_plane)
+            continue
+        if not isinstance(nm, RandomEffectModel):
+            raise TypeError(f"unknown model type {type(nm)} for {cid!r}")
+        prev_idx = prev.entity_indices[cid]
+        new_idx = new.entity_indices[cid]
+        # Compare in NEW row space: grow the previous matrix (key-mapped
+        # carry, zero rows for new entities) and keep rows that differ.
+        grown = (
+            pm
+            if prev_idx == new_idx
+            else grow_random_effect_model(pm, prev_idx, new_idx)
+        )
+        e_new = len(new_idx)
+        new_mat = np.asarray(nm.coefficients_matrix)[: e_new + 1]
+        old_mat = np.asarray(grown.coefficients_matrix)[: e_new + 1]
+        changed = np.nonzero(np.any(new_mat != old_mat, axis=1))[0]
+        # Brand-new entities whose solve happened to stay zero still need
+        # their index entry; the row payload covers value changes only.
+        if changed.size == 0 and prev_idx == new_idx:
+            continue
+        carry_old = carry_new = None
+        if prev_idx != new_idx:
+            shared = [k for k in new_idx if k in prev_idx]
+            carry_old = np.fromiter(
+                (prev_idx[k] for k in shared), np.int64, len(shared)
+            )
+            carry_new = np.fromiter(
+                (new_idx[k] for k in shared), np.int64, len(shared)
+            )
+            if np.array_equal(carry_old, carry_new):
+                carry_old = carry_new = None  # pure append: no moves
+        coords[cid] = CoordinateDelta(
+            cid,
+            rows=changed.astype(np.int64),
+            values=np.ascontiguousarray(new_mat[changed], np.float32),
+            carry_old=carry_old,
+            carry_new=carry_new,
+            entity_index=dict(new_idx),
+            logical_rows=e_new + 1,
+        )
+    return DeltaBundle(
+        source, mode, coords, int(delta_rows), int(total_rows)
+    )
+
+
+def _apply_re_delta(
+    c: ServingCoordinate, d: CoordinateDelta, staged_stores: List
+) -> ServingCoordinate:
+    """Stage one random-effect coordinate's new generation from its
+    resident state + the delta rows, per storage mode. Functional updates
+    only: in-flight batches keep scoring their captured params snapshot."""
+    vals = jnp.asarray(d.values)
+    rows = jnp.asarray(d.rows)
+    if c.store is not None:
+        # Two-tier: the cold matrix is host RAM — rebuild it host-side
+        # (carry + scatter) and stage a fresh store; the old store closes
+        # on retire (or on rollback via staged_stores).
+        old_cold = c.store.cold_matrix
+        if d.carry_old is None:
+            new_cold = np.zeros((d.logical_rows, old_cold.shape[1]), np.float32)
+            new_cold[: old_cold.shape[0]] = old_cold
+        else:
+            new_cold = np.zeros((d.logical_rows, old_cold.shape[1]), np.float32)
+            new_cold[d.carry_new] = old_cold[d.carry_old]
+        new_cold[d.rows] = d.values
+        new_store = _stage_shard(
+            f"{d.cid} (delta two-tier rebuild)",
+            lambda: TwoTierEntityStore(new_cold, c.store.capacity),
+        )
+        staged_stores.append(new_store)
+        return ServingCoordinate(
+            d.cid,
+            c.shard,
+            new_store.snapshot(),
+            norm=c.norm,
+            random_effect_type=c.random_effect_type,
+            entity_index=d.entity_index,
+            logical_rows=d.logical_rows,
+            store=new_store,
+        )
+    if c.mesh is not None:
+        # Entity-sharded: growth must fit the existing mesh padding and
+        # carried rows must keep their positions — per-device row blocks
+        # are placement, and placement changes go through reshard().
+        physical = int(c.params.shape[0])
+        if d.logical_rows > physical:
+            raise ValueError(
+                f"coordinate {d.cid!r}: delta grows logical rows to "
+                f"{d.logical_rows} past the mesh-padded {physical} — "
+                "reshard to a larger padding first, then apply"
+            )
+        if d.carry_old is not None:
+            raise ValueError(
+                f"coordinate {d.cid!r}: delta re-sorts carried entity rows; "
+                "an entity-sharded matrix's row placement changes through "
+                "reshard(), not a delta apply"
+            )
+        ndev = int(c.mesh.devices.size)
+        rows_per = physical // ndev
+        shard_of = d.rows // rows_per
+        params = c.params
+        for k in np.unique(shard_of):
+            m = shard_of == int(k)
+            r_k, v_k = rows[np.nonzero(m)[0]], vals[np.nonzero(m)[0]]
+            params = _stage_shard(
+                f"{d.cid} shard {int(k)} (delta rows)",
+                lambda p=params, r=r_k, v=v_k: p.at[r].set(v),
+            )
+        return ServingCoordinate(
+            d.cid,
+            c.shard,
+            params,
+            norm=c.norm,
+            random_effect_type=c.random_effect_type,
+            entity_index=d.entity_index,
+            mesh=c.mesh,
+            logical_rows=d.logical_rows,
+            shard_health=c.shard_health,
+        )
+    # Replicated single-tier: one shard, one staged functional update.
+    old_params = c.params
+    old_rows = int(old_params.shape[0])
+
+    def stage():
+        if d.carry_old is None:
+            base = (
+                old_params
+                if d.logical_rows == old_rows
+                else jnp.pad(
+                    old_params, ((0, d.logical_rows - old_rows), (0, 0))
+                )
+            )
+        else:
+            base = (
+                jnp.zeros((d.logical_rows, old_params.shape[1]), jnp.float32)
+                .at[jnp.asarray(d.carry_new)]
+                .set(old_params[jnp.asarray(d.carry_old)])
+            )
+        return base.at[rows].set(vals)
+
+    params = _stage_shard(f"{d.cid} (delta rows)", stage)
+    from photon_ml_tpu.serving.bundle import ShardHealth
+
+    return ServingCoordinate(
+        d.cid,
+        c.shard,
+        params,
+        norm=c.norm,
+        random_effect_type=c.random_effect_type,
+        entity_index=d.entity_index,
+        shard_health=ShardHealth(1, d.logical_rows),
+    )
+
+
+def apply_delta(
+    engine, delta: DeltaBundle, *, drain_timeout_s: float = 30.0
+) -> Dict[str, object]:
+    """Flip a live engine onto a delta bundle — an in-place generation
+    flip through the reshard stage->pre-warm->commit->rollback primitive
+    (kind="delta"). Zero failed requests: the old generation serves every
+    in-flight and concurrent request until the atomic flip, and keeps
+    serving if anything fails before it. An empty bundle commits nothing
+    and returns immediately."""
+    orch = engine.reshard_orchestrator
+    if delta.is_empty:
+        return {
+            "version": engine._state.version,
+            "committed": False,
+            "delta_rows_staged": 0,
+            "restaged_bytes": 0,
+        }
+    with engine.bundle_manager.mutex:
+        old_state = engine._state
+        old_bundle = old_state.bundle
+        missing = [c for c in delta.coordinates if c not in old_bundle.coordinates]
+        if missing:
+            raise ValueError(
+                f"delta bundle targets unknown coordinates {missing!r}"
+            )
+        staged_stores: List[TwoTierEntityStore] = []
+        close_stores = tuple(
+            old_bundle.coordinates[cid].store
+            for cid, d in delta.coordinates.items()
+            if d.is_random_effect
+            and old_bundle.coordinates[cid].store is not None
+        )
+
+        def build_new_coords() -> Tuple[Dict[str, ServingCoordinate], int]:
+            new_coords = dict(old_bundle.coordinates)
+            for cid, d in delta.coordinates.items():
+                c = old_bundle.coordinates[cid]
+                with telemetry.span("delta_stage", coordinate=cid):
+                    if d.is_random_effect:
+                        new_coords[cid] = _apply_re_delta(c, d, staged_stores)
+                    else:
+                        plane = d.plane
+                        params = _stage_shard(
+                            f"{cid} (delta fixed-effect plane)",
+                            lambda p=plane: jnp.asarray(p, jnp.float32),
+                        )
+                        new_coords[cid] = ServingCoordinate(
+                            cid, c.shard, params, norm=c.norm
+                        )
+            return new_coords, delta.nbytes
+
+        info = orch._stage_and_commit(
+            old_state,
+            None,
+            build_new_coords,
+            close_stores=close_stores,
+            kind="delta",
+            drain_timeout_s=drain_timeout_s,
+            on_rollback=lambda: [s.close() for s in staged_stores],
+        )
+        n_rows = sum(d.n_rows for d in delta.coordinates.values())
+        faults.COUNTERS.increment("delta_applies")
+        if n_rows:
+            faults.COUNTERS.increment("delta_rows_staged", n_rows)
+        live = engine._state.bundle
+        live.provenance["origin"] = "incremental"
+        live.provenance["deltas_applied"] = (
+            int(live.provenance.get("deltas_applied", 0)) + 1
+        )
+        live.provenance["last_delta_source"] = delta.source
+        live.provenance["last_delta_ts"] = time.time()
+        telemetry.emit_event(
+            "delta_apply",
+            version=info["version"],
+            coordinates=sorted(delta.coordinates),
+            rows=int(n_rows),
+            bytes=int(delta.nbytes),
+            source=delta.source,
+        )
+        logger.info(
+            "delta bundle applied: generation %d -> %d (%d rows, %d bytes, "
+            "source %s)",
+            info["previous_version"],
+            info["version"],
+            n_rows,
+            delta.nbytes,
+            delta.source,
+        )
+        info["delta_rows_staged"] = int(n_rows)
+        return info
+
+
+def apply_delta_for_tenant(
+    registry, name: str, delta: DeltaBundle, *, drain_timeout_s: float = 30.0
+) -> Dict[str, object]:
+    """Per-tenant refresh: flip ONE tenant's engine onto a delta bundle.
+    Tenant engines share the fleet's device mutex through their bundle
+    managers, so the flip serializes with every other tenant's dispatch
+    exactly like any other live mutation — and touches no other tenant's
+    generation."""
+    tenant = registry.tenant(name)
+    return apply_delta(tenant.engine, delta, drain_timeout_s=drain_timeout_s)

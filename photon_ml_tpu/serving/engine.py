@@ -924,6 +924,7 @@ class ServingEngine:
         orch = self._reshard_orchestrator
         out["bundle_reshards"] = orch.reshards if orch is not None else 0
         out["bundle_rebalances"] = orch.rebalances if orch is not None else 0
+        out["bundle_deltas"] = orch.deltas if orch is not None else 0
         out["bundle_reshard_rollbacks"] = (
             orch.rollbacks if orch is not None else 0
         )

@@ -269,6 +269,7 @@ class MeshReshardOrchestrator:
         self.engine = engine
         self._reshards = 0
         self._rebalances = 0
+        self._deltas = 0
         self._rollbacks = 0
 
     # Public counters (read by engine.metrics()).
@@ -279,6 +280,10 @@ class MeshReshardOrchestrator:
     @property
     def rebalances(self) -> int:
         return self._rebalances
+
+    @property
+    def deltas(self) -> int:
+        return self._deltas
 
     @property
     def rollbacks(self) -> int:
@@ -359,7 +364,7 @@ class MeshReshardOrchestrator:
                 plan,
                 build_new_coords,
                 close_stores=(),
-                rebalance=False,
+                kind="reshard",
                 drain_timeout_s=drain_timeout_s,
             )
 
@@ -540,7 +545,7 @@ class MeshReshardOrchestrator:
                 None,
                 build_new_coords,
                 close_stores=(old_store,),
-                rebalance=True,
+                kind="rebalance",
                 drain_timeout_s=drain_timeout_s,
                 on_rollback=lambda: [s.close() for s in staged_stores],
             )
@@ -558,19 +563,22 @@ class MeshReshardOrchestrator:
         build_new_coords,
         *,
         close_stores: Sequence[TwoTierEntityStore],
-        rebalance: bool,
+        kind: str,
         drain_timeout_s: float,
         on_rollback=None,
     ) -> Dict[str, object]:
-        """The ONE staging/flip/rollback sequence both reshard() and
-        rebalance() run (a fix to the flip discipline lands once):
-        `build_new_coords()` stages the new generation's coordinates
-        double-buffered and returns (coords, restaged_bytes); then
-        compatibility check -> pre-warm (compile-count delta feeds the
-        warmup baseline) -> `reshard_commit` fault site -> atomic flip ->
-        drain -> retire. ANY failure before the flip runs `on_rollback`
-        (close staged stores), counts/journals the rollback, and
-        re-raises — the old generation never stopped serving."""
+        """The ONE staging/flip/rollback sequence reshard(), rebalance()
+        and the delta-bundle apply (serving/delta.py) all run (a fix to
+        the flip discipline lands once): `build_new_coords()` stages the
+        new generation's coordinates double-buffered and returns (coords,
+        restaged_bytes); then compatibility check -> pre-warm
+        (compile-count delta feeds the warmup baseline) ->
+        `reshard_commit` fault site -> atomic flip -> drain -> retire.
+        `kind` ("reshard" | "rebalance" | "delta") selects which commit
+        counter the flip lands in and which rollback event a failure
+        journals. ANY failure before the flip runs `on_rollback` (close
+        staged stores), counts/journals the rollback, and re-raises — the
+        old generation never stopped serving."""
         engine = self.engine
         old_bundle = old_state.bundle
         t0 = time.perf_counter()
@@ -582,6 +590,7 @@ class MeshReshardOrchestrator:
                 index_maps=old_bundle.index_maps,
                 upload_bytes=restaged_bytes,
                 upload_s=time.perf_counter() - t0,
+                provenance=dict(old_bundle.provenance),
             )
             new_state = engine._build_state(
                 new_bundle, version=old_state.version + 1
@@ -598,7 +607,7 @@ class MeshReshardOrchestrator:
                     on_rollback()
                 except Exception:  # noqa: BLE001 - rollback best-effort
                     pass
-            self._roll_back(plan, exc)
+            self._roll_back(plan, exc, kind=kind, version=old_state.version)
             raise
         return self._commit(
             old_state,
@@ -609,21 +618,30 @@ class MeshReshardOrchestrator:
             restaged_bytes=restaged_bytes,
             drain_timeout_s=drain_timeout_s,
             close_stores=close_stores,
-            rebalance=rebalance,
+            kind=kind,
         )
 
-    def _roll_back(self, plan, exc: BaseException) -> None:
+    def _roll_back(
+        self, plan, exc: BaseException, *, kind: str = "reshard", version: int = 0
+    ) -> None:
         self._rollbacks += 1
-        faults.COUNTERS.increment("reshard_rollbacks")
-        telemetry.emit_event(
-            "reshard_rollback",
-            old_shards=plan.old_shards if plan is not None else 1,
-            new_shards=plan.new_shards if plan is not None else 1,
-            reason=repr(exc),
-        )
+        if kind == "delta":
+            faults.COUNTERS.increment("delta_rollbacks")
+            telemetry.emit_event(
+                "delta_rollback", version=version, reason=repr(exc)
+            )
+        else:
+            faults.COUNTERS.increment("reshard_rollbacks")
+            telemetry.emit_event(
+                "reshard_rollback",
+                old_shards=plan.old_shards if plan is not None else 1,
+                new_shards=plan.new_shards if plan is not None else 1,
+                reason=repr(exc),
+            )
         logger.warning(
-            "live reshard rolled back (%s); the old generation never "
+            "live %s rolled back (%s); the old generation never "
             "stopped serving",
+            kind,
             exc,
         )
 
@@ -638,14 +656,17 @@ class MeshReshardOrchestrator:
         restaged_bytes: int,
         drain_timeout_s: float,
         close_stores: Sequence[TwoTierEntityStore],
-        rebalance: bool = False,
+        kind: str = "reshard",
     ) -> Dict[str, object]:
         engine = self.engine
         engine._commit_state(new_state, baseline_bump=staging_compiles)
-        if rebalance:
+        if kind == "rebalance":
             self._rebalances += 1
+        elif kind == "delta":
+            self._deltas += 1
         else:
             self._reshards += 1
+        new_state.bundle.provenance["generation"] = new_state.version
         telemetry.emit_event(
             "reshard_commit",
             old_shards=plan.old_shards if plan is not None else 1,
@@ -669,7 +690,7 @@ class MeshReshardOrchestrator:
         logger.info(
             "live %s committed: generation %d -> %d (%d bytes restaged "
             "in %.3fs)",
-            "rebalance" if rebalance else "reshard",
+            kind,
             old_state.version,
             new_state.version,
             restaged_bytes,
@@ -710,6 +731,7 @@ class MeshReshardOrchestrator:
         old_bundle.index_maps = new_bundle.index_maps
         old_bundle.upload_bytes = new_bundle.upload_bytes
         old_bundle.upload_s = new_bundle.upload_s
+        old_bundle.provenance = new_bundle.provenance
 
     @staticmethod
     def _check_compatible(old_state, new_state) -> None:

@@ -140,13 +140,18 @@ ROBUSTNESS_CLEAN_ZERO_KEYS = (
     "mesh_losses",
     "reshard_retries",
     "reshard_rollbacks",
+    # ISSUE 16: delta-bundle applies rolled back to the old generation —
+    # zero on a clean continuous-refresh loop.
+    "delta_rollbacks",
 )
 
 # Top-level serving-summary.json keys written by cli/serve.py. r14
 # appends the adaptive-runtime plan block (PLAN_BLOCK_KEYS), inactive on
 # an unplanned replay; r15 appends the per-tenant block ({} on a
 # single-tenant replay, one TENANT_BLOCK_KEYS dict per tenant under
-# --tenant) so a missing block is loud, never ambiguous.
+# --tenant) so a missing block is loud, never ambiguous; r16 appends the
+# bundle provenance block (BUNDLE_PROVENANCE_KEYS) so operators can audit
+# what a swapped engine is actually running.
 SERVING_SUMMARY_KEYS = (
     "num_requests",
     "failed_requests",
@@ -156,6 +161,20 @@ SERVING_SUMMARY_KEYS = (
     "robustness_counters",
     "plan",
     "tenants",
+    "provenance",
+)
+
+# The served bundle's lineage block (ISSUE 16): every ServingBundle
+# carries exactly these, stamped at from_model/from_artifact time and
+# updated in place by each committed delta apply — so an operator reading
+# serving-summary.json can tell a freshly full-fit engine from one that
+# has absorbed N incremental deltas, and where the last delta came from.
+BUNDLE_PROVENANCE_KEYS = (
+    "origin",
+    "generation",
+    "deltas_applied",
+    "last_delta_source",
+    "last_delta_ts",
 )
 
 # -------------------------------------------------------------- multi-tenant
@@ -260,6 +279,46 @@ ELASTIC_MESH_SECTION_KEYS = (
     "clean_counters_zero",
 )
 
+# ------------------------------------------------------- incremental refresh
+# The delta-bundle manifest (serving/delta.DeltaBundle.manifest zips
+# exactly these, ISSUE 16): what an incremental fit shipped to serving —
+# the refresh journal and cli/refresh both persist it, so a delta that
+# silently dropped a coordinate is loud.
+DELTA_BUNDLE_KEYS = (
+    "source",
+    "mode",
+    "coordinates",
+    "delta_rows",
+    "total_rows",
+    "bytes",
+)
+
+# bench.py continuous_loop section (ISSUE 16): the data->served freshness
+# certificate — an 8-virtual-device subprocess runs a full fit, streams a
+# delta batch, re-solves only the changed coordinate's changed entities
+# (unchanged entities bitwise-equal to a from-scratch fit of the merged
+# data), and flips the live engine to the new generation via a delta
+# bundle UNDER LIVE REPLAY with zero failed requests — reporting the
+# data->served wall against the full-refit+full-restage baseline on the
+# same delta.
+CONTINUOUS_SECTION_KEYS = (
+    "n_devices",
+    "total_rows",
+    "delta_rows",
+    "delta_fraction",
+    "changed_coordinates",
+    "full_fit_s",
+    "incremental_fit_s",
+    "delta_apply_s",
+    "data_to_served_s",
+    "full_refresh_baseline_s",
+    "speedup_vs_full",
+    "unchanged_entities_bitwise",
+    "answered_during_refresh",
+    "failed_requests",
+    "generation",
+)
+
 # -------------------------------------------------------------------- sweep
 # bench.py `sweep` section (ISSUE 12): the pod-parallel hyperparameter
 # sweep certificate — a 16-trial Bayesian sweep through the batched trial
@@ -339,6 +398,13 @@ JOURNAL_EVENT_SCHEMAS = {
     "tenant_admit": ("tenant", "device_bytes", "demoted_tenants"),
     "tenant_evict": ("tenant", "reason", "freed_bytes", "hot_rows"),
     "tenant_degraded": ("tenant", "reasons"),
+    # -- incremental refresh (game/incremental.py + serving/delta.py) --
+    "delta_fit_start": ("mode", "changed_coordinates", "delta_rows",
+                        "total_rows"),
+    "delta_fit_finish": ("mode", "changed_coordinates",
+                         "carried_coordinates", "seconds", "max_rel_diff"),
+    "delta_apply": ("version", "coordinates", "rows", "bytes", "source"),
+    "delta_rollback": ("version", "reason"),
 }
 
 # ------------------------------------------------------------------- profile
@@ -401,7 +467,10 @@ ALL_CONTRACTS = {
     "SERVING_CLEAN_ZERO_KEYS": SERVING_CLEAN_ZERO_KEYS,
     "ROBUSTNESS_CLEAN_ZERO_KEYS": ROBUSTNESS_CLEAN_ZERO_KEYS,
     "SERVING_SUMMARY_KEYS": SERVING_SUMMARY_KEYS,
+    "BUNDLE_PROVENANCE_KEYS": BUNDLE_PROVENANCE_KEYS,
     "TENANT_BLOCK_KEYS": TENANT_BLOCK_KEYS,
+    "DELTA_BUNDLE_KEYS": DELTA_BUNDLE_KEYS,
+    "CONTINUOUS_SECTION_KEYS": CONTINUOUS_SECTION_KEYS,
     "MULTI_TENANT_SECTION_KEYS": MULTI_TENANT_SECTION_KEYS,
     "CHAOS_MULTICHIP_SECTION_KEYS": CHAOS_MULTICHIP_SECTION_KEYS,
     "ELASTIC_MESH_SECTION_KEYS": ELASTIC_MESH_SECTION_KEYS,

@@ -408,6 +408,25 @@ _register(
     "the host tier (never fails the tenant) before refusing.",
 )
 
+# ------------------------------------------------------------------- refresh
+_register(
+    "PHOTON_REFRESH_BATCH_ROWS",
+    int,
+    4096,
+    "Continuous-refresh loop (cli/refresh): target rows per streamed "
+    "delta batch before triggering an incremental fit + delta swap; "
+    "smaller batches trade solve efficiency for data->served freshness.",
+)
+_register(
+    "PHOTON_REFRESH_MAX_DELTA_FRACTION",
+    float,
+    0.5,
+    "Incremental fit escape hatch (game/incremental.py): when a delta "
+    "batch churns more than this fraction of the merged dataset's rows, "
+    "the delta path forces a warm-started FULL refit — past that point "
+    "re-solving per changed entity costs more than one fused solve.",
+)
+
 # ------------------------------------------------------------------- planner
 _register(
     "PHOTON_PLAN",

@@ -126,6 +126,11 @@ METRIC_DESCRIPTIONS = {
     "tenant_demotions": "cold tenants' RE rows demoted to the host tier "
     "under HBM pressure",
     "tenant_cobatch_dispatches": "cross-tenant co-batched device dispatches",
+    "delta_applies": "delta-bundle generation flips committed to a live "
+    "engine",
+    "delta_rollbacks": "delta-bundle applies rolled back to the old "
+    "generation",
+    "delta_rows_staged": "changed/added RE rows staged by delta applies",
     # -- histograms (fixed log-spaced buckets, mergeable) --
     "serving_latency_ms": "per-request wall latency through the batcher",
     "serving_queue_wait_ms": "submit-to-claim queue wait per request",

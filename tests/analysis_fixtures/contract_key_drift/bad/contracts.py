@@ -14,3 +14,6 @@ FIXTURE_PLAN_KEYS = ("fixture_plan_source", "fixture_plan_value", "fixture_plan_
 
 # Tenant-block schema (r15): the multi-tenant serving platform keys.
 FIXTURE_TENANT_KEYS = ("fixture_tenant_completed", "fixture_tenant_shed", "fixture_tenant_demoted")
+
+# Delta-bundle schema (r16): the continuous-refresh payload keys.
+FIXTURE_REFRESH_KEYS = ("fixture_delta_rows", "fixture_delta_bytes", "fixture_delta_source")

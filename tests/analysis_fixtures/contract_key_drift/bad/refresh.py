@@ -1,0 +1,10 @@
+"""Known-bad: re-types two delta-bundle schema keys (the r16
+FIXTURE_REFRESH_KEYS shape) as a literal instead of importing the tuple."""
+
+
+def check_delta(manifest):
+    report = {
+        k: manifest[k]
+        for k in ("fixture_delta_rows", "fixture_delta_bytes")
+    }  # re-typed refresh schema
+    return report

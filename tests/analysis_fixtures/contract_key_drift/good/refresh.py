@@ -1,0 +1,10 @@
+"""Known-good: the delta-bundle schema is imported; single-key reads are
+use, not duplication."""
+
+from contracts import FIXTURE_REFRESH_KEYS
+
+
+def check_delta(manifest):
+    missing = [k for k in FIXTURE_REFRESH_KEYS if k not in manifest]
+    source = manifest.get("fixture_delta_source")  # one key is vocabulary
+    return missing, source

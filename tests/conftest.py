@@ -140,7 +140,11 @@ def _failure_domain_hygiene(monkeypatch):
     * no `photon-tenant-*` worker outlives the test — the multi-tenant
       registry's dispatch thread and per-tenant flush threads are joined
       by `TenantRegistry.close()`; a survivor means one tenant's traffic
-      kept dispatching against a torn-down fleet.
+      kept dispatching against a torn-down fleet;
+    * no `photon-refresh-*` worker outlives the test — continuous-refresh
+      loop helpers (traffic replays riding a delta apply) join before the
+      loop returns; a survivor means requests kept scoring against a
+      retired generation.
     """
     from photon_ml_tpu.utils import faults, telemetry
 
@@ -167,6 +171,11 @@ def _failure_domain_hygiene(monkeypatch):
         # next (estimator fits call ensure_ambient_plan).
         "PHOTON_PLAN",
         "PHOTON_PLAN_PROFILE",
+        # Continuous refresh (ISSUE 16): ambient refresh knobs must never
+        # resize delta batches or flip the full-refit escape hatch inside
+        # unrelated tests.
+        "PHOTON_REFRESH_BATCH_ROWS",
+        "PHOTON_REFRESH_MAX_DELTA_FRACTION",
     ):
         monkeypatch.delenv(var, raising=False)
     from photon_ml_tpu import planner as _planner
@@ -192,6 +201,7 @@ def _failure_domain_hygiene(monkeypatch):
                     "photon-watchdog",
                     "photon-reshard",
                     "photon-tenant",
+                    "photon-refresh",
                 )
             )
             and t.is_alive()

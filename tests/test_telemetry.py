@@ -365,6 +365,14 @@ class TestJournal:
         "demoted_tenants": ["t-cold"],
         "freed_bytes": 2048,
         "hot_rows": 0,
+        # -- continuous refresh (ISSUE 16) --
+        "changed_coordinates": ["per-e1"],
+        "carried_coordinates": ["fixed"],
+        "delta_rows": 96,
+        "total_rows": 512,
+        "max_rel_diff": 0.31,
+        "coordinates": ["per-e1"],
+        "rows": 96,
     }
 
     def test_every_event_type_round_trips_its_schema(self, tmp_path):
