@@ -48,6 +48,7 @@ _CHAOS_MULTICHIP_CHILD = "--run-chaos-multichip"
 _ELASTIC_MESH_CHILD = "--run-elastic-mesh"
 _MULTI_TENANT_CHILD = "--run-multi-tenant"
 _CONTINUOUS_LOOP_CHILD = "--run-continuous-loop"
+_MULTIHOST_CHAOS_CHILD = "--run-multihost-chaos"
 
 # Physical HBM roofline per chip (GB/s): v5e HBM2 peak ~819 GB/s. Any
 # achieved-bandwidth figure above it is a measurement artifact (rtt
@@ -2717,6 +2718,101 @@ def _child() -> None:
             failed=True, reason=f"{type(exc).__name__}: {exc}"
         )
 
+    # ---- multihost chaos: whole OS processes as the failure domain --------
+    # The ISSUE 17 production certificate, driven through the real CLI
+    # supervisors: 2-process fit bitwise vs single-process with disjoint
+    # per-host ingest, a host SIGKILLed mid-fit costing exactly one
+    # repeated sweep, and a serving host SIGKILLed mid-replay failing
+    # zero requests (lost rows FE-only through the survivor, resident
+    # rows bitwise). Own subprocess; the child spawns the supervisors.
+    try:
+        env_mh = dict(os.environ)
+        env_mh["JAX_PLATFORMS"] = "cpu"
+        env_mh.pop("PALLAS_AXON_POOL_IPS", None)
+        # The child's supervisors construct worker envs themselves
+        # (hostmesh.worker_env scrubs fault/plan/trace knobs); the child
+        # itself must not inherit an armed plan from a previous section.
+        for leaked in ("PHOTON_FAULTS", "PHOTON_FAULTS_SEED",
+                       "PHOTON_PLAN", "PHOTON_PLAN_PROFILE",
+                       "PHOTON_TRACE", "PHOTON_HOST_LOSS_RETRIES"):
+            env_mh.pop(leaked, None)
+        out_mh = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             _MULTIHOST_CHAOS_CHILD],
+            capture_output=True,
+            text=True,
+            timeout=900,
+            env=env_mh,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        line_mh = next(
+            (l for l in out_mh.stdout.splitlines() if l.startswith("{")),
+            None,
+        )
+        if line_mh is None:
+            raise RuntimeError(
+                "multihost_chaos child produced no JSON: "
+                f"{out_mh.stderr[-1500:]}"
+            )
+        mhc = json.loads(line_mh)
+        from photon_ml_tpu.utils.contracts import MULTIHOST_SECTION_KEYS
+
+        missing_mh = [
+            k for k in MULTIHOST_SECTION_KEYS if mhc.get(k) is None
+        ]
+        if missing_mh:
+            raise RuntimeError(
+                f"multihost_chaos section is missing keys {missing_mh} — "
+                "the DCN production contract is broken"
+            )
+        if not mhc["fit_bitwise_vs_single_process"]:
+            raise RuntimeError(
+                "2-process fit diverged from the single-process fit — "
+                "the multi-host bitwise-parity contract is broken"
+            )
+        if not mhc["ingest_disjoint_ok"]:
+            raise RuntimeError(
+                f"per-host ingest was not disjoint ({mhc['files_per_host']}"
+                " files per host) — one host decoded the whole corpus"
+            )
+        if mhc["host_losses"] != 1 or mhc["repeated_sweeps"] != 1:
+            raise RuntimeError(
+                f"host loss cost {mhc['repeated_sweeps']} repeated "
+                f"sweep(s) over {mhc['host_losses']} loss(es) — the "
+                "one-sweep contract is broken"
+            )
+        if mhc["failed_requests"]:
+            raise RuntimeError(
+                f"{mhc['failed_requests']} request(s) failed with a "
+                "serving host down — the zero-failed-request contract "
+                "is broken"
+            )
+        if mhc["fe_only_answers"] <= 0:
+            raise RuntimeError(
+                "no answers degraded with a serving host down — the "
+                "SIGKILL landed after the replay and tested nothing"
+            )
+        if not mhc["serve_bitwise_resident"]:
+            raise RuntimeError(
+                "a resident row's answer diverged from the single-process "
+                "serve — host loss must only ever degrade the LOST rows"
+            )
+        variants["multihost_chaos"] = mhc
+        _mark(
+            f"multihost_chaos survived ({mhc['n_hosts']}x"
+            f"{mhc['devices_per_host']} hosts, {mhc['files_per_host']} "
+            f"files/host: fit bitwise, 1 host loss = 1 repeated sweep, "
+            f"{mhc['fe_only_answers']} FE-only of 0 failed, resident "
+            f"bitwise, {mhc['dcn_collective_bytes']} DCN bytes)"
+        )
+    except Exception as exc:  # noqa: BLE001 - bench must still print a line
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        variants["multihost_chaos"] = dict(
+            failed=True, reason=f"{type(exc).__name__}: {exc}"
+        )
+
     # ---- online serving (pinned bundle + deadline micro-batcher) ----------
     # The north star serves live traffic; this measures the online path the
     # offline scoring number cannot show: per-request latency through the
@@ -3559,6 +3655,268 @@ def _child() -> None:
     )
 
 
+def _multihost_chaos_child() -> None:
+    """DCN-scale production certificate (ISSUE 17): whole OS processes as
+    the failure domain, driven through the REAL cli entrypoints (the
+    supervisors spawn their own worker processes). Phases:
+
+      1. PARITY: `cli/train --multihost 1` vs `--multihost 2` on the same
+         4-file corpus at the same global device count (1x8 vs 2x4) —
+         the model artifacts must match record for record, with each
+         2-host worker Avro-decoding only its own disjoint file slice.
+      2. CHAOS FIT: a 2-host fit, host 1 SIGKILLed after the first
+         checkpoint commit — the supervisor must journal the host loss,
+         relaunch on the survivor set, and finish having repeated
+         exactly ONE sweep.
+      3. CHAOS SERVE: a 2-host serve fleet (host-local stores: each host
+         stages only its own row partition), host 1 SIGKILLed mid-replay
+         with zero retry budget — every request must still answer (the
+         lost rows FE-only through the survivor, bitwise-checked per
+         answer against a single-process serve reference).
+
+    DCN traffic is measured as the bytes moved through the rendezvous
+    exchange (ingest row planes, barriers, heartbeats, commit markers) —
+    the filesystem stands in for DCN on CPU hosts, so its file sizes ARE
+    the cross-host bytes. Prints exactly one JSON line."""
+    import shutil
+    import signal
+    import tempfile
+
+    import numpy as np
+
+    from photon_ml_tpu.cli import build_index
+    from photon_ml_tpu.io import avro as avro_io
+    from photon_ml_tpu.io.avro_data import write_training_examples
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    shard_dsl = "name=globalShard,feature.bags=features,intercept=true"
+    coord_dsls = [
+        "name=global,feature.shard=globalShard,optimizer=LBFGS,"
+        "tolerance=1e-7,max.iter=25,regularization=L2,reg.weights=0.1",
+        "name=per-member,random.effect.type=memberId,"
+        "feature.shard=globalShard,optimizer=LBFGS,max.iter=15,"
+        "regularization=L2,reg.weights=1,min.bucket=4,projector=IDENTITY",
+    ]
+
+    def _env(**extra):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        env.update(extra)
+        return env
+
+    root = tempfile.mkdtemp(prefix="photon-mh-bench-")
+    try:
+        data = os.path.join(root, "data")
+        os.makedirs(data)
+        w_true = np.random.default_rng(99).normal(size=4)
+        b_true = np.random.default_rng(98).normal(size=(10, 2))
+        for seed, n in enumerate((120, 80, 100, 60)):
+            rng = np.random.default_rng(seed)
+            X = rng.normal(size=(n, 4))
+            entity = rng.integers(0, 10, size=n)
+            margins = X @ w_true + np.einsum(
+                "nd,nd->n", X[:, :2], b_true[entity]
+            )
+            y = (rng.uniform(size=n) < 1 / (1 + np.exp(-margins))).astype(
+                np.float32
+            )
+            write_training_examples(
+                os.path.join(data, f"part-{seed}.avro"),
+                [
+                    [(f"f{j}", float(X[i, j])) for j in range(4)]
+                    for i in range(n)
+                ],
+                y.tolist(),
+                uids=[f"uid{seed}_{i}" for i in range(n)],
+                id_tags={"memberId": [f"m{e}" for e in entity]},
+            )
+        idx = os.path.join(root, "index")
+        build_index.main([
+            "--input-data-directories", data,
+            "--feature-shard-configurations", shard_dsl,
+            "--output-dir", idx,
+        ])
+
+        def train_argv(out, n_hosts, iters):
+            return [
+                sys.executable, "-m", "photon_ml_tpu.cli.train",
+                "--training-task", "LOGISTIC_REGRESSION",
+                "--input-data-directories", data,
+                "--root-output-directory", out,
+                "--feature-shard-configurations", shard_dsl,
+                "--coordinate-configurations", *coord_dsls,
+                "--coordinate-descent-iterations", str(iters),
+                "--offheap-indexmap-dir", idx,
+                "--checkpoint-directory", os.path.join(out, "ckpt"),
+                "--multihost", str(n_hosts),
+                "--multihost-devices-per-host", str(8 // n_hosts),
+                "--random-seed", "7",
+            ]
+
+        def run_fit(out, n_hosts, iters):
+            r = subprocess.run(
+                train_argv(out, n_hosts, iters),
+                env=_env(), capture_output=True, text=True, timeout=600,
+            )
+            if r.returncode != 0:
+                raise RuntimeError(
+                    f"--multihost {n_hosts} fit failed: {r.stderr[-1500:]}"
+                )
+            with open(os.path.join(out, "training-summary.json")) as f:
+                return json.load(f)
+
+        def model_records(out):
+            blobs = {}
+            mdir = os.path.join(out, "models", "best")
+            for dirpath, _, files in os.walk(mdir):
+                for fn in sorted(files):
+                    p = os.path.join(dirpath, fn)
+                    rel = os.path.relpath(p, mdir)
+                    if fn.endswith(".avro"):
+                        blobs[rel] = repr(avro_io.read_container(p)[1])
+                    else:
+                        with open(p, "rb") as f:
+                            blobs[rel] = f.read()
+            return blobs
+
+        # -- 1: parity + disjoint ingest ---------------------------------
+        out1 = os.path.join(root, "fit1")
+        out2 = os.path.join(root, "fit2")
+        s1 = run_fit(out1, 1, 2)
+        s2 = run_fit(out2, 2, 2)
+        b1, b2 = model_records(out1), model_records(out2)
+        fit_bitwise = set(b1) == set(b2) and all(
+            b1[k] == b2[k] for k in b1
+        )
+        files_host0 = int(s2["files_this_host"])
+        n_files = int(s2["num_files"])
+        files_per_host = [files_host0, n_files - files_host0]
+        ingest_disjoint_ok = 0 < files_host0 < n_files
+        dcn_bytes = 0
+        for dirpath, _, files in os.walk(os.path.join(out2, "rendezvous")):
+            for fn in files:
+                try:
+                    dcn_bytes += os.path.getsize(os.path.join(dirpath, fn))
+                except OSError:
+                    pass
+        del s1
+
+        # -- 2: SIGKILL a whole host mid-fit -----------------------------
+        outc = os.path.join(root, "fit_chaos")
+        sup = subprocess.Popen(
+            train_argv(outc, 2, 8),
+            env=_env(), stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True,
+        )
+        state = os.path.join(outc, "ckpt", "state.json")
+        pid_file = os.path.join(outc, "hosts", "attempt0-host1", "pid")
+        deadline = time.time() + 300
+        while time.time() < deadline and not os.path.exists(state):
+            if sup.poll() is not None:
+                raise RuntimeError(
+                    "chaos fit supervisor exited before first commit: "
+                    + sup.communicate()[1][-1500:]
+                )
+            time.sleep(0.05)
+        os.kill(int(open(pid_file).read()), signal.SIGKILL)
+        _, err = sup.communicate(timeout=600)
+        if sup.returncode != 0:
+            raise RuntimeError(f"chaos fit failed: {err[-1500:]}")
+        with open(os.path.join(outc, "training-summary.json")) as f:
+            mh_fit = json.load(f)["multihost"]
+
+        # -- 3: SIGKILL a serving host mid-replay ------------------------
+        model_dir = os.path.join(out1, "models", "best")
+
+        def serve_argv(out):
+            return [
+                sys.executable, "-m", "photon_ml_tpu.cli.serve",
+                "--model-input-directory", model_dir,
+                "--requests", data,
+                "--root-output-directory", out,
+                "--feature-shard-configurations", shard_dsl,
+                "--offheap-indexmap-dir", idx,
+                "--model-id", "bench",
+            ]
+
+        def read_scores(out):
+            recs = {}
+            for p in sorted(
+                avro_io.list_container_files(os.path.join(out, "scores"))
+            ):
+                for r in avro_io.read_container(p)[1]:
+                    recs[r["uid"]] = r["predictionScore"]
+            return recs
+
+        ref_out = os.path.join(root, "serve_ref")
+        r = subprocess.run(
+            serve_argv(ref_out),
+            env=_env(
+                XLA_FLAGS="--xla_force_host_platform_device_count=4",
+                PHOTON_SERVING_ENTITY_SHARD="1",
+            ),
+            capture_output=True, text=True, timeout=600,
+        )
+        if r.returncode != 0:
+            raise RuntimeError(f"reference serve failed: {r.stderr[-1500:]}")
+        ref = read_scores(ref_out)
+
+        mh_out = os.path.join(root, "serve_mh")
+        sup = subprocess.Popen(
+            serve_argv(mh_out) + ["--multihost", "2"],
+            env=_env(PHOTON_HOST_LOSS_RETRIES="0"),
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        pid_file = os.path.join(mh_out, "hosts", "attempt0-host1", "pid")
+        deadline = time.time() + 300
+        while time.time() < deadline and not os.path.exists(pid_file):
+            if sup.poll() is not None:
+                raise RuntimeError(
+                    "serve supervisor exited before workers came up: "
+                    + sup.communicate()[1][-1500:]
+                )
+            time.sleep(0.02)
+        os.kill(int(open(pid_file).read()), signal.SIGKILL)
+        _, err = sup.communicate(timeout=600)
+        if sup.returncode != 0:
+            raise RuntimeError(f"chaos serve failed: {err[-1500:]}")
+        with open(os.path.join(mh_out, "serving-summary.json")) as f:
+            serve_summary = json.load(f)
+        mh_serve = serve_summary["multihost"]
+        # Per-answer residency check against the reference: the survivor's
+        # result lines carry n_lost, so every answer WITHOUT a shard-loss
+        # fallback must be bitwise-identical to the single-process serve.
+        resident_ok = True
+        res_dir = os.path.join(mh_out, "hosts", "attempt0-host0", "results")
+        for fn in sorted(os.listdir(res_dir)):
+            if not fn.endswith(".jsonl"):
+                continue
+            with open(os.path.join(res_dir, fn)) as f:
+                for line in f:
+                    ln = json.loads(line)
+                    if ln["n_lost"] == 0 and ref.get(ln["uid"]) != ln["score"]:
+                        resident_ok = False
+
+        print(json.dumps({
+            "n_hosts": 2,
+            "devices_per_host": 4,
+            "files_per_host": files_per_host,
+            "fit_bitwise_vs_single_process": bool(fit_bitwise),
+            "ingest_disjoint_ok": bool(ingest_disjoint_ok),
+            "host_losses": int(mh_fit["host_losses"]),
+            "repeated_sweeps": int(mh_fit["repeated_sweeps"]),
+            "survivor_hosts": int(mh_serve["survivor_hosts"]),
+            "failed_requests": int(serve_summary["failed_requests"]),
+            "fe_only_answers": int(mh_serve["fe_only_answers"]),
+            "serve_bitwise_resident": bool(resident_ok),
+            "dcn_collective_bytes": int(dcn_bytes),
+        }))
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def main() -> None:
     if _MULTICHIP_CHILD in sys.argv:
         _multichip_child()
@@ -3574,6 +3932,9 @@ def main() -> None:
         return
     if _CONTINUOUS_LOOP_CHILD in sys.argv:
         _continuous_loop_child()
+        return
+    if _MULTIHOST_CHAOS_CHILD in sys.argv:
+        _multihost_chaos_child()
         return
     if _CHILD in sys.argv:
         _child()

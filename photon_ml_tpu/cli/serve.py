@@ -112,6 +112,31 @@ def build_parser() -> argparse.ArgumentParser:
                         "'reshard' block")
     p.add_argument("--model-id", default=None,
                    help="model id tag written into every score record")
+    p.add_argument("--multihost", type=int, default=0, metavar="N",
+                   help="multi-host production serving: N share-nothing "
+                        "OS-process hosts, each staging only its own "
+                        "partition of every random-effect coordinate's "
+                        "rows (host-local two-tier stores); a host killed "
+                        "mid-replay costs fidelity (its rows answer "
+                        "FE-only through the survivors), never a failed "
+                        "request, and rejoins by restaging its partition")
+    p.add_argument("--multihost-devices-per-host", type=int, default=4,
+                   metavar="M",
+                   help="virtual devices per serving host (the per-host "
+                        "shard count of each coordinate's store); only "
+                        "meaningful with --multihost")
+    # Hidden plumbing between the multi-host serve supervisor and the
+    # worker processes it spawns — never passed by operators.
+    p.add_argument("--mh-serve-worker", action="store_true",
+                   help=argparse.SUPPRESS)
+    p.add_argument("--mh-host-id", type=int, default=0,
+                   help=argparse.SUPPRESS)
+    p.add_argument("--mh-num-hosts", type=int, default=0,
+                   help=argparse.SUPPRESS)
+    p.add_argument("--mh-attempt", type=int, default=0,
+                   help=argparse.SUPPRESS)
+    p.add_argument("--mh-resume-window", type=int, default=0,
+                   help=argparse.SUPPRESS)
     p.add_argument("--logging-level", default="INFO")
     return p
 
@@ -212,6 +237,13 @@ def run(args) -> dict:
         raise ValueError(
             "Avro request replay needs --feature-shard-configurations "
             "(the bag -> shard mapping offline ingest uses)"
+        )
+    if getattr(args, "multihost", 0) or getattr(args, "mh_serve_worker", False):
+        # Loud, not a silent single-process fallback: the multi-host
+        # paths are dispatched by main(); run() is one serving host.
+        raise ValueError(
+            "--multihost serving dispatches in serve.main(); run() is "
+            "the single-process path"
         )
     tenants = getattr(args, "tenant", None)
     if bool(tenants) == bool(args.model_input_directory):
@@ -734,7 +766,20 @@ def _iter_avro_records(path: str) -> Iterator[dict]:
 
 
 def main(argv: Optional[List[str]] = None) -> None:
-    run(build_parser().parse_args(argv))
+    raw_argv = list(sys.argv[1:] if argv is None else argv)
+    args = build_parser().parse_args(raw_argv)
+    if args.mh_serve_worker:
+        # Spawned by the multi-host serve supervisor: one share-nothing
+        # serving host (host-local store + mirrored replay).
+        from photon_ml_tpu.cli import serve_multihost
+
+        raise SystemExit(serve_multihost.run_worker(args))
+    if args.multihost:
+        from photon_ml_tpu.cli import serve_multihost
+
+        serve_multihost.run_supervisor(args, raw_argv)
+        return
+    run(args)
 
 
 if __name__ == "__main__":

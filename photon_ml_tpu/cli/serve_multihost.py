@@ -1,0 +1,556 @@
+"""Multi-host serving (`cli/serve --multihost N`): host-local stores,
+whole-host loss as a survivable failure domain.
+
+The Spark-era deployment spread the coefficient table across executors
+and survived executor loss through YARN relaunch; this is the serving
+half of that contract (PARITY.md "Mesh failure semantics", ISSUE 17).
+N OS-process serving hosts each stage the FULL fixed-effect model but
+only their OWN partition of every random-effect coordinate's rows: host
+k owns shard s iff `s % N == k`, and marks every other shard LOST in
+its bundle's ShardHealth at startup — which *is* the host-local
+two-tier store: lookups for a non-owned row resolve to the pinned zero
+row, exactly the PR 10 shard-loss degradation.
+
+Every host replays the full mirrored request stream (the serving twin
+of the fit's mirrored sample arrays) and writes per-window result
+parts; the supervisor routes at merge time — for each request it keeps
+the answer with the FEWEST shard-loss fallbacks (`ScoreResult.n_lost`,
+ties to fewer cold lookups then the lowest host id), so:
+
+  * owner alive  -> its answer is bitwise-identical to a single-process
+    serve of the same artifact (marking OTHER shards lost never touches
+    an owned row's lookup or dispatch);
+  * owner dead   -> every survivor already answered those rows through
+    the pinned-zero FE-only tier, bitwise-identically to each other —
+    the request degrades, it never fails.
+
+A worker that dies (SIGKILL drill) is journaled as `host_loss`; while
+PHOTON_HOST_LOSS_RETRIES allows, the supervisor relaunches it from its
+last durable window (`--mh-resume-window`), and the rejoining worker
+restages its row partition through `hostmesh.restage_host_rows` (the
+`host_join` fault site + journal event). Workers share NOTHING — no
+jax.distributed group, no heartbeat — so a host loss cannot take the
+process group down with it; the supervisor's poll is the detector.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+logger = logging.getLogger("photon_ml_tpu.cli.serve_multihost")
+
+# Worker result parts are JSONL (one answered request per line) rather
+# than Avro score parts: the merge needs per-request fidelity fields
+# (n_lost/n_cold) that the score schema deliberately does not carry.
+_RESULT_FIELDS = ("i", "uid", "score", "mean", "cold", "n_cold", "n_lost", "fe")
+
+
+def _host_dir(out_root: str, attempt: int, host_id: int) -> str:
+    return os.path.join(out_root, "hosts", f"attempt{attempt}-host{host_id}")
+
+
+def _validate_scope(args) -> None:
+    """Refuse, loudly and before any staging, every flag combination the
+    multi-host serve path does not implement — a silent fallback to
+    single-process behavior would invalidate the contract the operator
+    asked for."""
+    refusals = []
+    if getattr(args, "tenant", None):
+        refusals.append("--tenant (multi-tenant) has no multi-host form")
+    if getattr(args, "reshard_to", None) is not None:
+        refusals.append(
+            "--reshard-to is a single-process drill (each multi-host "
+            "worker's layout IS the shard ownership map)"
+        )
+    if not args.model_input_directory:
+        refusals.append("--model-input-directory is required")
+    if refusals:
+        raise ValueError(
+            "--multihost serve scope: " + "; ".join(refusals)
+        )
+
+
+# ------------------------------------------------------------------ worker
+
+
+def _mark_host_local(bundle, host_id: int, num_hosts: int):
+    """Degrade `bundle` to this host's partition: every random-effect
+    shard NOT owned by this host (owner = shard index mod num_hosts) is
+    marked LOST, so its rows answer through the pinned-zero FE-only tier.
+    Returns ({cid: [owned shard indices]}, total owned rows)."""
+    owned: Dict[str, List[int]] = {}
+    owned_rows = 0
+    for cid, c in bundle.coordinates.items():
+        sh = getattr(c, "shard_health", None)
+        if not getattr(c, "is_random_effect", False) or sh is None:
+            continue
+        owned[cid] = []
+        for s in range(sh.n_shards):
+            if s % num_hosts == host_id:
+                owned[cid].append(s)
+                lo, hi = sh.row_range(s)
+                owned_rows += hi - lo
+            else:
+                bundle.mark_shard_lost(cid, s)
+    return owned, owned_rows
+
+
+def run_worker(args) -> int:
+    """One serving host: full artifact load, host-local store (non-owned
+    shards LOST), full-stream mirrored replay from `--mh-resume-window`,
+    crash-safe per-window JSONL result parts + a progress marker the
+    supervisor reads to relaunch a killed worker where it left off."""
+    from photon_ml_tpu.cli import serve as serve_cli
+    from photon_ml_tpu.parallel import hostmesh
+    from photon_ml_tpu.serving.engine import ServingEngine
+    from photon_ml_tpu.utils import telemetry
+
+    host_id, num_hosts = args.mh_host_id, args.mh_num_hosts
+    logging.basicConfig(
+        level=getattr(logging, args.logging_level.upper(), logging.INFO),
+        format=(
+            f"%(asctime)s h{host_id} %(name)s %(levelname)s %(message)s"
+        ),
+    )
+    _validate_scope(args)
+    out_root = args.root_output_directory
+    host_dir = _host_dir(out_root, args.mh_attempt, host_id)
+    results_dir = os.path.join(host_dir, "results")
+    os.makedirs(results_dir, exist_ok=True)
+    # The pid file lands before ANY heavy work: it is the chaos drill's
+    # SIGKILL target, and a kill window that opens only after staging
+    # would never exercise a load-phase loss.
+    with open(os.path.join(host_dir, "pid"), "w") as f:
+        f.write(str(os.getpid()))
+
+    journal = telemetry.RunJournal(os.path.join(host_dir, "journal.jsonl"))
+    journal_owned = telemetry.current_journal() is None
+    if journal_owned:
+        telemetry.install_journal(journal)
+
+    shard_configs = None
+    if args.feature_shard_configurations:
+        from photon_ml_tpu.cli.config import parse_feature_shard_config
+
+        shard_configs = dict(
+            parse_feature_shard_config(s)
+            for s in args.feature_shard_configurations
+        )
+    index_maps = None
+    if args.offheap_indexmap_dir:
+        from photon_ml_tpu.io.paldb import resolve_offheap_index_maps
+
+        index_maps = resolve_offheap_index_maps(
+            args.offheap_indexmap_dir, shard_configs or {}
+        )
+
+    bundle = None
+    try:
+        bundle = serve_cli.load_bundle(
+            args.model_input_directory, index_maps=index_maps
+        )
+        owned, owned_rows = _mark_host_local(bundle, host_id, num_hosts)
+        logger.info(
+            "host-local store: %s (%d owned rows of every RE coordinate)",
+            {cid: len(s) for cid, s in owned.items()},
+            owned_rows,
+        )
+        if args.mh_attempt > 0:
+            # Rejoin after a loss: the partition was just restaged from
+            # the artifact (the load above); the host_join fault site can
+            # still veto it — an injected failure exits nonzero and the
+            # fleet keeps answering this host's rows FE-only.
+            hostmesh.restage_host_rows(host_id, num_hosts, owned_rows)
+
+        is_json = args.requests.endswith((".json", ".jsonl"))
+        malformed = [0]
+        if is_json:
+            stream = serve_cli._iter_json_requests(
+                args.requests, bundle, malformed
+            )
+        else:
+            stream = serve_cli._iter_avro_requests(
+                args.requests, bundle, shard_configs, malformed
+            )
+
+        engine = ServingEngine(bundle, max_batch=args.max_batch)
+        engine.warmup()
+        n_requests = 0
+        n_failed = 0
+        import itertools
+
+        with engine, engine.batcher(
+            max_wait_ms=args.max_wait_ms,
+            max_pending=args.max_pending,
+            default_deadline_ms=args.deadline_ms,
+        ) as batcher:
+            for k in itertools.count():
+                window = list(
+                    itertools.islice(stream, serve_cli.REPLAY_WINDOW)
+                )
+                if not window:
+                    break
+                if k < args.mh_resume_window:
+                    # Already answered durably before this relaunch; the
+                    # stream is still consumed so positions stay global.
+                    n_requests += len(window)
+                    continue
+                futures = [batcher.submit(r, block=True) for r in window]
+                lines = []
+                for i, fut in enumerate(futures):
+                    try:
+                        r = fut.result()
+                    except Exception as exc:  # noqa: BLE001 - per-request isolation
+                        n_failed += 1
+                        logger.warning(
+                            "request %d failed: %s", n_requests + i, exc
+                        )
+                        continue
+                    lines.append({
+                        "i": n_requests + i,
+                        "uid": r.uid,
+                        "score": r.score,
+                        "mean": r.mean,
+                        "cold": bool(r.cold_start),
+                        "n_cold": int(r.n_cold),
+                        "n_lost": int(r.n_lost),
+                        "fe": bool(r.fe_only),
+                    })
+                # Crash-safe part + progress marker: a SIGKILL tears only
+                # the dot-prefixed temp, never a part or marker a merge
+                # or relaunch would trust.
+                part = os.path.join(results_dir, f"part-{k:05d}.jsonl")
+                tmp = part + ".tmp"
+                with open(tmp, "w") as f:
+                    for ln in lines:
+                        f.write(json.dumps(ln) + "\n")
+                os.replace(tmp, part)
+                prog_tmp = os.path.join(host_dir, ".progress.tmp")
+                with open(prog_tmp, "w") as f:
+                    json.dump({"next_window": k + 1}, f)
+                os.replace(prog_tmp, os.path.join(host_dir, "progress"))
+                n_requests += len(window)
+            metrics = batcher.metrics()
+        summary = {
+            "host": host_id,
+            "attempt": args.mh_attempt,
+            "num_requests": n_requests,
+            "failed_requests": n_failed,
+            "malformed_records": malformed[0],
+            "owned_shards": owned,
+            "owned_rows": owned_rows,
+            "serving": metrics,
+            "counters": telemetry.METRICS.counters(),
+        }
+        tmp = os.path.join(host_dir, ".worker-summary.json.tmp")
+        with open(tmp, "w") as f:
+            json.dump(summary, f, indent=2, default=str)
+        os.replace(tmp, os.path.join(host_dir, "worker-summary.json"))
+        return 0
+    except Exception:
+        logger.exception("serve worker h%d failed", host_id)
+        return 1
+    finally:
+        if bundle is not None:
+            bundle.release()
+        if journal_owned:
+            telemetry.uninstall_journal()
+        journal.close()
+
+
+# -------------------------------------------------------------- supervisor
+
+
+def _read_progress(out_root: str, host_id: int, upto_attempt: int) -> int:
+    """Latest durable window marker across a host's attempts (0 if it
+    never completed a window) — where a relaunch resumes."""
+    best = 0
+    for a in range(upto_attempt + 1):
+        p = os.path.join(_host_dir(out_root, a, host_id), "progress")
+        try:
+            with open(p) as f:
+                best = max(best, int(json.load(f)["next_window"]))
+        except (OSError, ValueError, KeyError):
+            continue
+    return best
+
+
+def _collect_parts(
+    out_root: str, host_id: int, upto_attempt: int
+) -> Dict[int, List[dict]]:
+    """One host's answered windows, later attempts overriding earlier
+    (a resumed worker re-answers the window its predecessor died in)."""
+    windows: Dict[int, List[dict]] = {}
+    for a in range(upto_attempt + 1):
+        rdir = os.path.join(_host_dir(out_root, a, host_id), "results")
+        if not os.path.isdir(rdir):
+            continue
+        for fn in sorted(os.listdir(rdir)):
+            if not (fn.startswith("part-") and fn.endswith(".jsonl")):
+                continue
+            k = int(fn[len("part-"):-len(".jsonl")])
+            with open(os.path.join(rdir, fn)) as f:
+                windows[k] = [json.loads(ln) for ln in f if ln.strip()]
+    return windows
+
+
+def _merge_scores(
+    out_root: str,
+    per_host: Dict[int, Dict[int, List[dict]]],
+    model_id: str,
+) -> Tuple[int, int, int]:
+    """Route at merge time: for every request keep the answer with the
+    fewest shard-loss fallbacks (then fewest cold lookups, then lowest
+    host id — survivors' FE-only answers for a lost host's rows are
+    bitwise-identical, so the tie-break is cosmetic). Writes the same
+    crash-safe Avro score parts a single-process replay writes. Returns
+    (merged requests, fe_only_answers, degraded-and-cold answers)."""
+    from photon_ml_tpu.cli.serve import _write_score_part
+    from photon_ml_tpu.serving.engine import ScoreResult
+
+    scores_dir = os.path.join(out_root, "scores")
+    all_windows = sorted({k for w in per_host.values() for k in w})
+    merged = 0
+    fe_only_answers = 0
+    degraded_cold = 0
+    for k in all_windows:
+        best: Dict[int, Tuple[tuple, dict]] = {}
+        for host in sorted(per_host):
+            for ln in per_host[host].get(k, []):
+                rank = (ln["n_lost"], ln["n_cold"], host)
+                cur = best.get(ln["i"])
+                if cur is None or rank < cur[0]:
+                    best[ln["i"]] = (rank, ln)
+        results = []
+        for i in sorted(best):
+            _, ln = best[i]
+            if ln["n_lost"] > 0:
+                fe_only_answers += 1
+                if ln["cold"]:
+                    degraded_cold += 1
+            results.append((
+                i,
+                ScoreResult(
+                    score=ln["score"],
+                    mean=ln["mean"],
+                    uid=ln["uid"],
+                    cold_start=ln["cold"],
+                    n_cold=ln["n_cold"],
+                    fe_only=ln["fe"],
+                    n_lost=ln["n_lost"],
+                ),
+            ))
+        if results:
+            _write_score_part(scores_dir, k, results, model_id)
+            merged += len(results)
+    return merged, fe_only_answers, degraded_cold
+
+
+def run_supervisor(args, raw_argv: List[str]) -> dict:
+    """Spawn N share-nothing serve workers over the same artifact and
+    request stream, absorb whole-host losses (journal + bounded
+    relaunch), merge the durable result parts into the final score
+    parts, and write the serving summary with its `multihost` block."""
+    from photon_ml_tpu.utils import telemetry
+    from photon_ml_tpu.utils.knobs import get_knob
+
+    logging.basicConfig(
+        level=getattr(logging, args.logging_level.upper(), logging.INFO),
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    _validate_scope(args)
+    num_hosts = int(args.multihost)
+    devices_per_host = int(args.multihost_devices_per_host)
+    retries = int(get_knob("PHOTON_HOST_LOSS_RETRIES"))
+    out_root = args.root_output_directory
+    os.makedirs(out_root, exist_ok=True)
+
+    journal = telemetry.RunJournal(os.path.join(out_root, "journal.jsonl"))
+    journal_owned = telemetry.current_journal() is None
+    if journal_owned:
+        telemetry.install_journal(journal)
+    try:
+        return _supervise(args, raw_argv, num_hosts, devices_per_host,
+                          retries, out_root)
+    finally:
+        if journal_owned:
+            telemetry.uninstall_journal()
+        journal.close()
+
+
+def _supervise(
+    args,
+    raw_argv: List[str],
+    num_hosts: int,
+    devices_per_host: int,
+    retries: int,
+    out_root: str,
+) -> dict:
+    from photon_ml_tpu.parallel import hostmesh
+    from photon_ml_tpu.utils import faults, telemetry
+    from photon_ml_tpu.utils.contracts import ROBUSTNESS_CLEAN_ZERO_KEYS
+
+    # attempt/resume/done per host; each host's relaunch counter is its
+    # own, but the RETRY budget is fleet-wide (matches the fit side).
+    attempt = {k: 0 for k in range(num_hosts)}
+    procs: Dict[int, subprocess.Popen] = {}
+    logs: List = []
+    done: Dict[int, bool] = {}
+    dead: Dict[int, bool] = {}
+    losses = 0
+    rejoins = 0
+
+    def _spawn(host_id: int, att: int, resume: int) -> None:
+        host_dir = _host_dir(out_root, att, host_id)
+        os.makedirs(host_dir, exist_ok=True)
+        argv = [
+            sys.executable, "-m", "photon_ml_tpu.cli.serve", *raw_argv,
+            "--mh-serve-worker",
+            "--mh-host-id", str(host_id),
+            "--mh-num-hosts", str(num_hosts),
+            "--mh-attempt", str(att),
+            "--mh-resume-window", str(resume),
+        ]
+        # Entity sharding ON is what makes the store host-LOCAL: each
+        # coordinate stages row-sharded over the worker's devices, so its
+        # ShardHealth has one shard per device for ownership to partition.
+        env = hostmesh.worker_env(
+            num_hosts,
+            devices_per_host,
+            extra={"PHOTON_SERVING_ENTITY_SHARD": "1"},
+        )
+        fo = open(os.path.join(host_dir, "worker.out"), "w")
+        fe = open(os.path.join(host_dir, "worker.err"), "w")
+        logs.extend([fo, fe])
+        procs[host_id] = subprocess.Popen(
+            argv, env=env, stdout=fo, stderr=fe
+        )
+        logger.info(
+            "serve worker h%d up (attempt %d, resume window %d, pid %d)",
+            host_id, att, resume, procs[host_id].pid,
+        )
+
+    try:
+        for k in range(num_hosts):
+            _spawn(k, 0, 0)
+        deadline = time.monotonic() + 900.0
+        while not all(done.get(k) or dead.get(k) for k in range(num_hosts)):
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    "multi-host serve timed out; killing workers"
+                )
+            time.sleep(0.1)
+            for k in range(num_hosts):
+                if done.get(k) or dead.get(k):
+                    continue
+                rc = procs[k].poll()
+                if rc is None:
+                    continue
+                if rc == 0:
+                    done[k] = True
+                    continue
+                # Whole-host loss mid-replay: journal it, and while the
+                # retry budget allows, relaunch from the last durable
+                # window (the rejoin restages the host's partition). Out
+                # of budget, the fleet degrades — survivors keep
+                # answering the lost rows FE-only; nothing fails.
+                losses += 1
+                telemetry.METRICS.increment("host_losses")
+                telemetry.emit_event(
+                    "host_loss",
+                    host=k,
+                    missed_beats=0,
+                    num_hosts=num_hosts,
+                    source="serve-supervisor",
+                )
+                logger.warning(
+                    "serve worker h%d lost (rc %s), loss %d/%d budget",
+                    k, rc, losses, retries,
+                )
+                if losses <= retries:
+                    attempt[k] += 1
+                    rejoins += 1
+                    _spawn(
+                        k,
+                        attempt[k],
+                        _read_progress(out_root, k, attempt[k]),
+                    )
+                else:
+                    dead[k] = True
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+        for p in procs.values():
+            try:
+                p.wait(timeout=10)
+            except Exception:  # noqa: BLE001 - best-effort reap
+                pass
+        for f in logs:
+            f.close()
+
+    survivors = sorted(k for k in range(num_hosts) if done.get(k))
+    if not survivors:
+        raise RuntimeError(
+            "every serve worker died; no durable results to merge "
+            f"(hosts under {os.path.join(out_root, 'hosts')})"
+        )
+
+    per_host = {
+        k: _collect_parts(out_root, k, attempt[k]) for k in range(num_hosts)
+    }
+    model_id = args.model_id or "game-model"
+    merged, fe_only_answers, degraded_cold = _merge_scores(
+        out_root, per_host, model_id
+    )
+
+    # Stream totals come from a worker that finished the whole replay —
+    # by construction at least one survivor did.
+    wsum = {}
+    for k in survivors:
+        p = os.path.join(
+            _host_dir(out_root, attempt[k], k), "worker-summary.json"
+        )
+        with open(p) as f:
+            wsum[k] = json.load(f)
+    ref = wsum[survivors[0]]
+    num_requests = max(w["num_requests"] for w in wsum.values())
+    failed = num_requests - merged
+
+    summary = {
+        "num_requests": num_requests,
+        "failed_requests": failed,
+        "malformed_records": ref["malformed_records"],
+        "serving": ref["serving"],
+        "robustness_counters": {
+            **{k: 0 for k in ROBUSTNESS_CLEAN_ZERO_KEYS},
+            **faults.counters(),
+        },
+        "multihost": {
+            "num_hosts": num_hosts,
+            "devices_per_host": devices_per_host,
+            "attempts": {str(k): attempt[k] + 1 for k in range(num_hosts)},
+            "host_losses": losses,
+            "rejoins": rejoins,
+            "survivor_hosts": len(survivors),
+            "fe_only_answers": fe_only_answers,
+            "degraded_cold_answers": degraded_cold,
+            "owned_rows": {
+                str(k): wsum[k]["owned_rows"] for k in survivors
+            },
+        },
+    }
+    with open(os.path.join(out_root, "serving-summary.json"), "w") as f:
+        json.dump(summary, f, indent=2, default=str)
+    logger.info(
+        "multi-host replay merged: %d request(s), %d failed, %d FE-only "
+        "degraded, %d host loss(es), %d survivor(s)",
+        num_requests, failed, fe_only_answers, losses, len(survivors),
+    )
+    return summary

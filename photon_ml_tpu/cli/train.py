@@ -166,6 +166,28 @@ def build_parser() -> argparse.ArgumentParser:
                         "override individual plan decisions")
     p.add_argument("--logging-level", default="INFO")
     p.add_argument("--application-name", default="photon-ml-tpu-training")
+    p.add_argument("--multihost", type=int, default=0, metavar="N",
+                   help="production multi-host mode: supervise N worker "
+                        "processes forming one global mesh over ICI+DCN; "
+                        "each host ingests a disjoint file slice, a "
+                        "whole-host loss is absorbed by relaunching the "
+                        "survivors from the last committed sweep "
+                        "(requires --checkpoint-directory and "
+                        "--offheap-indexmap-dir; N=1 is the parity "
+                        "baseline running the same worker pipeline)")
+    p.add_argument("--multihost-devices-per-host", type=int, default=4,
+                   metavar="M",
+                   help="devices each multi-host worker drives (virtual "
+                        "CPU devices under JAX_PLATFORMS=cpu; the global "
+                        "mesh has N*M devices)")
+    # Internal worker flags, set only by the supervisor's build_argv —
+    # never by hand (hidden from --help).
+    p.add_argument("--mh-worker", action="store_true", help=argparse.SUPPRESS)
+    p.add_argument("--mh-attempt", type=int, default=0, help=argparse.SUPPRESS)
+    p.add_argument("--mh-coordinator", default=None, help=argparse.SUPPRESS)
+    p.add_argument("--mh-num-hosts", type=int, default=0, help=argparse.SUPPRESS)
+    p.add_argument("--mh-host-id", type=int, default=0, help=argparse.SUPPRESS)
+    p.add_argument("--mh-rendezvous", default=None, help=argparse.SUPPRESS)
     return p
 
 
@@ -651,7 +673,20 @@ def _run_job(
 
 
 def main(argv: Optional[List[str]] = None) -> None:
-    run(build_parser().parse_args(argv))
+    raw_argv = list(sys.argv[1:] if argv is None else argv)
+    args = build_parser().parse_args(raw_argv)
+    if args.mh_worker:
+        # One host of a supervised process group (spawned by
+        # run_supervisor's build_argv; never invoked by hand).
+        from photon_ml_tpu.cli import train_multihost
+
+        raise SystemExit(train_multihost.run_worker(args))
+    if args.multihost:
+        from photon_ml_tpu.cli import train_multihost
+
+        train_multihost.run_supervisor(args, raw_argv)
+        return
+    run(args)
 
 
 if __name__ == "__main__":

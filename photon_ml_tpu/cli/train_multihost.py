@@ -1,0 +1,495 @@
+"""Multi-host production training: the supervisor/worker pair behind
+`cli/train --multihost N`.
+
+The supervisor (`run_supervisor`) owns no JAX state at all: it spawns one
+worker process per host through `parallel/hostmesh.supervise`, classifies
+exits, and on a whole-host loss (a worker signal-killed, or a survivor
+self-exiting `EXIT_HOST_LOSS` after its heartbeat fired) relaunches the
+SURVIVOR set against the durable checkpoint directory — each loss costs
+exactly one repeated sweep, never the job. This is the driver-side
+analogue of the reference's Spark behavior: an executor loss triggers a
+YARN relaunch and lineage recomputes the lost partitions
+(RDD.scala:262-290); here the supervisor relaunch + sweep-boundary
+checkpoint resume replay exactly one sweep of work.
+
+Each worker (`run_worker`) is one host of the process group: it forms the
+global mesh over ICI+DCN (`hostmesh.bringup`), Avro-decodes only ITS
+byte-balanced slice of the input files, exchanges decoded row planes so
+every host assembles the IDENTICAL global dataset (`exchange_ingest` —
+the bitwise-parity keystone), builds the production compute layout
+(fixed effects replicated, random effects entity-sharded over the global
+mesh), and runs the same `run_coordinate_descent` loop the single-host
+estimator uses — with `MultihostCheckpoint` substituting per-host shard
+writes behind a cross-host commit barrier.
+
+Scope: the multi-host mode deliberately supports the production fit path
+only. Anything that would need a second scoring pipeline inside the
+worker (validation, tuning, warm start, variance, normalization,
+non-identity projection, constraints, locked coordinates, reg sweeps) is
+refused LOUDLY at worker start — run those single-host, or extend the
+worker; never let them silently diverge across hosts.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+from typing import Dict, List, Optional
+
+from photon_ml_tpu.cli.config import (
+    parse_coordinate_config,
+    parse_feature_shard_config,
+)
+from photon_ml_tpu.data.game_dataset import (
+    RandomEffectDataConfig,
+    build_random_effect_dataset,
+)
+from photon_ml_tpu.types import NormalizationType, ProjectorType
+
+logger = logging.getLogger(__name__)
+
+# The one artifact subdir the multi-host fit writes (output-mode BEST; the
+# restricted scope has no tuning, so best == the single explicit fit).
+_BEST_SUBDIR = "best"
+_FIT_SUMMARY = "multihost-fit-summary.json"
+
+
+# ---------------------------------------------------------------- supervisor
+
+
+def run_supervisor(args, argv: List[str]) -> Dict[str, object]:
+    """`cli/train --multihost N`: spawn N workers, absorb whole-host
+    losses, and assemble the final training summary from the surviving
+    host 0's fit summary plus the relaunch accounting."""
+    from photon_ml_tpu.parallel import hostmesh
+    from photon_ml_tpu.utils import telemetry
+
+    _validate_scope(args)
+    out_root = args.root_output_directory
+    models_root = os.path.join(out_root, "models")
+    if os.path.exists(models_root):
+        if not args.override_output_directory:
+            raise FileExistsError(
+                f"{models_root} exists; pass --override-output-directory "
+                "to replace"
+            )
+        import shutil
+
+        shutil.rmtree(models_root)
+    os.makedirs(out_root, exist_ok=True)
+    rendezvous = os.path.join(out_root, "rendezvous")
+    if os.path.exists(rendezvous):
+        # Rendezvous state (barriers, heartbeats, exchanged row planes) is
+        # strictly per-run; stale markers from a prior run must never
+        # satisfy this run's barriers. Only the checkpoint dir is durable.
+        import shutil
+
+        shutil.rmtree(rendezvous)
+
+    # The supervisor's journal records the loss/relaunch lifecycle; each
+    # worker keeps its own journal under hosts/ (one RunJournal per
+    # process — the journal file is truncate-on-open and process-locked).
+    journal = telemetry.RunJournal(os.path.join(out_root, "journal.jsonl"))
+    journal_owned = telemetry.current_journal() is None
+    if journal_owned:
+        telemetry.install_journal(journal)
+
+    def build_argv(
+        attempt: int, coordinator: str, hosts: int, host_id: int
+    ) -> List[str]:
+        return [
+            sys.executable,
+            "-m",
+            "photon_ml_tpu.cli.train",
+            *argv,
+            "--mh-worker",
+            "--mh-attempt", str(attempt),
+            "--mh-coordinator", coordinator,
+            "--mh-num-hosts", str(hosts),
+            "--mh-host-id", str(host_id),
+            "--mh-rendezvous",
+            os.path.join(rendezvous, f"attempt{attempt}"),
+        ]
+
+    try:
+        res = hostmesh.supervise(
+            build_argv,
+            num_hosts=args.multihost,
+            devices_per_host=args.multihost_devices_per_host,
+            rendezvous=rendezvous,
+            # The scan-group cache device_puts host arrays, which cannot
+            # cross processes; the per-bucket loop is bitwise-identical
+            # (certified by tests/test_sweep_scan.py), so workers pin it
+            # off. Part of worker_env's contract, not a user choice.
+            env_extra={"PHOTON_SWEEP_SCAN": "0"},
+        )
+    finally:
+        if journal_owned:
+            telemetry.uninstall_journal()
+        journal.close()
+
+    fit_summary: Dict[str, object] = {}
+    fit_path = os.path.join(out_root, _FIT_SUMMARY)
+    try:
+        with open(fit_path) as f:
+            fit_summary = json.load(f)
+    except OSError:
+        raise RuntimeError(
+            f"multi-host fit reported success but {fit_path} is missing — "
+            "host 0 died after the fit-complete barrier?"
+        ) from None
+    summary: Dict[str, object] = dict(fit_summary)
+    summary["multihost"] = {
+        "num_hosts": int(args.multihost),
+        "devices_per_host": int(args.multihost_devices_per_host),
+        "attempts": res.attempts,
+        "host_losses": res.host_losses,
+        # Sweep-boundary resume: each relaunch replays exactly the one
+        # uncommitted sweep, so losses == repeated sweeps.
+        "repeated_sweeps": res.host_losses,
+        "final_hosts": res.final_hosts,
+    }
+    with open(os.path.join(out_root, "training-summary.json"), "w") as f:
+        json.dump(summary, f, indent=2, default=str)
+    logger.info(
+        "multi-host training complete: %d host(s), %d attempt(s), "
+        "%d host loss(es)",
+        res.final_hosts,
+        res.attempts,
+        res.host_losses,
+    )
+    return summary
+
+
+def _validate_scope(args) -> None:
+    """Refuse everything outside the supported multi-host fit scope —
+    loudly, before any process spawns. Every branch here is a feature
+    that would need its own cross-host design (scoring pipeline inside
+    the worker, per-host validation exchange, ...); silently running it
+    host-local would fit N divergent models."""
+    refusals = []
+    if not args.checkpoint_directory:
+        refusals.append(
+            "--checkpoint-directory is required (host-loss recovery "
+            "resumes from the last committed sweep)"
+        )
+    if not getattr(args, "offheap_indexmap_dir", None):
+        refusals.append(
+            "--offheap-indexmap-dir is required (feature ids must agree "
+            "across hosts; build one with cli/build_index.py)"
+        )
+    if args.validation_data_directories:
+        refusals.append("validation data is single-host only")
+    if args.validation_evaluators:
+        refusals.append("validation evaluators are single-host only")
+    if str(getattr(args, "hyper_parameter_tuning", "NONE")).upper().find(
+        "NONE"
+    ) < 0:
+        refusals.append("hyperparameter tuning is single-host only")
+    if str(getattr(args, "variance_computation_type", "NONE")).upper().find(
+        "NONE"
+    ) < 0:
+        refusals.append("coefficient variances are single-host only")
+    if args.normalization != NormalizationType.NONE:
+        refusals.append("normalization is single-host only")
+    if getattr(args, "model_input_directory", None):
+        refusals.append("warm start is single-host only")
+    if getattr(args, "partial_retrain_locked_coordinates", None):
+        refusals.append("partial retrain is single-host only")
+    for s in args.coordinate_configurations:
+        cfg = parse_coordinate_config(s)
+        if cfg.constraint_file:
+            refusals.append(
+                f"coordinate {cfg.name!r}: constraints are single-host only"
+            )
+        if len(set(cfg.reg_weights)) > 1:
+            refusals.append(
+                f"coordinate {cfg.name!r}: reg-weight sweeps are "
+                "single-host only (sweeps need validation)"
+            )
+        dc = cfg.data_config
+        if (
+            isinstance(dc, RandomEffectDataConfig)
+            and dc.projector_type != ProjectorType.IDENTITY
+        ):
+            refusals.append(
+                f"coordinate {cfg.name!r}: only projector=IDENTITY is "
+                "supported multi-host (projected shards are built after "
+                "the global replication step)"
+            )
+    if refusals:
+        raise ValueError(
+            "--multihost: unsupported options:\n  - " + "\n  - ".join(refusals)
+        )
+
+
+# -------------------------------------------------------------------- worker
+
+
+def run_worker(args) -> int:
+    """One host of the process group. Returns the process exit code:
+    0 on success, `EXIT_HOST_LOSS` when a peer loss was detected (the
+    supervisor relaunches the survivors), 1 on a real error."""
+    from photon_ml_tpu.parallel import hostmesh
+    from photon_ml_tpu.utils import telemetry
+    from photon_ml_tpu.utils.faults import HostLoss
+
+    logging.basicConfig(
+        level=getattr(logging, args.logging_level.upper(), logging.INFO),
+        format=f"%(asctime)s h{args.mh_host_id} %(name)s %(levelname)s "
+        "%(message)s",
+    )
+    _validate_scope(args)
+
+    out_root = args.root_output_directory
+    host_dir = os.path.join(
+        out_root, "hosts", f"attempt{args.mh_attempt}-host{args.mh_host_id}"
+    )
+    os.makedirs(host_dir, exist_ok=True)
+    # PID file first: chaos drills need a target to SIGKILL before the
+    # (slow) process-group bring-up completes.
+    with open(os.path.join(host_dir, "pid"), "w") as f:
+        f.write(str(os.getpid()))
+
+    journal = telemetry.RunJournal(os.path.join(host_dir, "journal.jsonl"))
+    telemetry.install_journal(journal)
+
+    def escalate(loss: HostLoss) -> None:
+        # The heartbeat thread declared a peer (or an injected self) lost.
+        # The journal line is already written by _declare_loss; flush it
+        # and die with the typed exit code — collectives over a mesh with
+        # a dead member would otherwise hang until the runtime timeout.
+        try:
+            telemetry.uninstall_journal()
+            journal.close()
+        finally:
+            os._exit(hostmesh.EXIT_HOST_LOSS)
+
+    heartbeat = None
+    try:
+        hm = hostmesh.bringup(
+            args.mh_coordinator,
+            args.mh_num_hosts,
+            args.mh_host_id,
+            args.multihost_devices_per_host,
+            args.mh_rendezvous,
+        )
+        heartbeat = hostmesh.HostHeartbeat(hm, escalate).start()
+        _fit(args, hm)
+        return 0
+    except HostLoss as loss:
+        # Losses surfacing OUTSIDE the heartbeat thread (barrier timeout,
+        # MultihostCheckpoint commit backstop): journal and escalate the
+        # same way.
+        telemetry.METRICS.increment("host_losses")
+        telemetry.emit_event(
+            "host_loss",
+            host=-1,
+            missed_beats=0,
+            num_hosts=args.mh_num_hosts,
+            source="barrier",
+        )
+        logger.error("host loss: %s", loss)
+        telemetry.uninstall_journal()
+        journal.close()
+        return hostmesh.EXIT_HOST_LOSS
+    except Exception:
+        logger.exception("multi-host worker failed")
+        telemetry.uninstall_journal()
+        journal.close()
+        return 1
+    finally:
+        if heartbeat is not None:
+            heartbeat.stop()
+        if telemetry.current_journal() is journal:
+            telemetry.uninstall_journal()
+            journal.close()
+
+
+def _resolve_files(args, shard_configs) -> List[str]:
+    """The global input FILE list (sorted): every host computes the same
+    list, `hostmesh.partition_files` hands each its byte-balanced slice."""
+    from photon_ml_tpu.io.avro import list_container_files
+    from photon_ml_tpu.utils.date_range import paths_for_date_range, resolve_range
+
+    train_range = resolve_range(
+        getattr(args, "input_data_date_range", None),
+        getattr(args, "input_data_days_range", None),
+    )
+    paths = paths_for_date_range(args.input_data_directories, train_range)
+    files: List[str] = []
+    for p in paths:
+        files.extend(list_container_files(p))
+    return sorted(files)
+
+
+def _fit(args, hm) -> None:
+    """The worker fit path: disjoint ingest + exchange, global compute
+    layout, checkpointed coordinate descent, host-0 artifact save."""
+    from photon_ml_tpu.game.coordinate import (
+        FixedEffectCoordinate,
+        RandomEffectCoordinate,
+    )
+    from photon_ml_tpu.game.coordinate_descent import run_coordinate_descent
+    from photon_ml_tpu.game.model import GameModel
+    from photon_ml_tpu.game.projector import project_shard
+    from photon_ml_tpu.io import avro_data, model_bridge, model_store
+    from photon_ml_tpu.io.paldb import resolve_offheap_index_maps
+    from photon_ml_tpu.parallel import hostmesh
+    from photon_ml_tpu.transformers.game_transformer import CoordinateScoringSpec
+    from photon_ml_tpu.utils import telemetry
+
+    coordinate_configs = {}
+    for s in args.coordinate_configurations:
+        cfg = parse_coordinate_config(s)
+        coordinate_configs[cfg.name] = cfg
+    update_sequence = (
+        [c.strip() for c in args.coordinate_update_sequence.split(",")]
+        if args.coordinate_update_sequence
+        else list(coordinate_configs.keys())
+    )
+    shard_configs = dict(
+        parse_feature_shard_config(s) for s in args.feature_shard_configurations
+    )
+    id_tags = [
+        c.data_config.random_effect_type
+        for c in coordinate_configs.values()
+        if isinstance(c.data_config, RandomEffectDataConfig)
+    ]
+    index_maps = resolve_offheap_index_maps(
+        args.offheap_indexmap_dir, shard_configs
+    )
+    columns = (
+        avro_data.InputColumnNames.parse(args.input_column_names)
+        if getattr(args, "input_column_names", None)
+        else None
+    )
+
+    files = _resolve_files(args, shard_configs)
+    dataset, mine = hostmesh.exchange_ingest(
+        hm,
+        files,
+        shard_configs,
+        index_maps=index_maps,
+        id_tag_fields=id_tags,
+        columns=columns,
+    )
+    logger.info(
+        "host %d ingested %d/%d files; global dataset: %d samples",
+        hm.host_id,
+        len(mine),
+        len(files),
+        dataset.num_samples,
+    )
+
+    # Global compute layout: FE columns replicated (every device runs the
+    # identical full solve — bitwise by construction), RE entity stores
+    # sharded over the global mesh (where capacity scaling lives).
+    ds_rep = hostmesh.replicate_dataset_global(dataset, hm)
+    coords: Dict[str, object] = {}
+    specs: Dict[str, CoordinateScoringSpec] = {}
+    opt_configs: Dict[str, dict] = {}
+    for cid in update_sequence:
+        cfg = coordinate_configs[cid]
+        oc = cfg.expand()[0]  # single reg weight (sweeps refused)
+        dc = cfg.data_config
+        if isinstance(dc, RandomEffectDataConfig):
+            red = build_random_effect_dataset(dataset, dc)
+            ps = project_shard(
+                dataset,
+                red,
+                dc.projector_type,
+                projected_dim=dc.projected_dim,
+                seed=args.random_seed,
+            )
+            red_g = hostmesh.shard_random_effect_global(red, hm)
+            coords[cid] = RandomEffectCoordinate(
+                ds_rep, red_g, oc, args.training_task, None
+            )
+            specs[cid] = CoordinateScoringSpec(
+                shard=dc.feature_shard,
+                norm=None,
+                random_effect_type=dc.random_effect_type,
+                entity_index=red.entity_index,
+                projector=ps.projector,
+            )
+        else:
+            coords[cid] = FixedEffectCoordinate(
+                ds_rep, dc.feature_shard, oc, args.training_task, None
+            )
+            specs[cid] = CoordinateScoringSpec(shard=dc.feature_shard, norm=None)
+        opt_configs[cid] = {
+            "optimizer": oc.optimizer.optimizer_type.value,
+            "max_iterations": oc.optimizer.max_iterations,
+            "tolerance": oc.optimizer.tolerance,
+            "regularization": oc.regularization.reg_type.value,
+            "reg_weight": oc.reg_weight,
+        }
+
+    result = run_coordinate_descent(
+        coords,
+        args.coordinate_descent_iterations,
+        seed=args.random_seed,
+        checkpoint_dir=args.checkpoint_directory,
+        checkpoint_factory=lambda d: hostmesh.MultihostCheckpoint(
+            d, hm, attempt=args.mh_attempt
+        ),
+        # In-process mesh shrink cannot help when the lost devices belong
+        # to a dead PROCESS — escalate immediately; the supervisor
+        # relaunches the survivor set against the durable checkpoint.
+        max_mesh_losses=0,
+    )
+    hm.barrier("fit-complete")
+
+    if hm.host_id == 0:
+        # Reassemble the final host-side models from the checkpoint (its
+        # shard files are the durable any-shape layout) rather than
+        # pulling device arrays: entity-sharded matrices are only
+        # partially addressable from any one process.
+        st = hostmesh.MultihostCheckpoint(
+            args.checkpoint_directory, hm, attempt=args.mh_attempt
+        ).load(args.training_task)
+        model = GameModel(dict(st.models))
+        artifact = model_bridge.artifact_from_game_model(
+            model, specs, args.training_task, opt_configs=opt_configs
+        )
+        mdir = os.path.join(
+            args.root_output_directory, "models", _BEST_SUBDIR
+        )
+        model_store.save_game_model(
+            mdir,
+            artifact,
+            index_maps,
+            sparsity_threshold=args.model_sparsity_threshold,
+        )
+        idx_dir = os.path.join(mdir, "feature-indexes")
+        os.makedirs(idx_dir, exist_ok=True)
+        for shard, imap in index_maps.items():
+            imap.save(os.path.join(idx_dir, f"{shard}.json"))
+        summary = {
+            "num_samples": int(dataset.num_samples),
+            "num_files": len(files),
+            "files_this_host": len(mine),
+            "completed_steps": int(
+                args.coordinate_descent_iterations * len(coords)
+            ),
+            "coordinates": list(coords),
+            "timings_s": {
+                name: round(total, 3)
+                for name, total in result.timing.items()
+            },
+            "counters": telemetry.METRICS.counters(),
+        }
+        tmp = os.path.join(args.root_output_directory, _FIT_SUMMARY + ".tmp")
+        with open(tmp, "w") as f:
+            json.dump(summary, f, indent=2, default=str)
+        os.replace(
+            tmp, os.path.join(args.root_output_directory, _FIT_SUMMARY)
+        )
+    # Peers hold the process group open until host 0's artifact save is
+    # durable: a peer exiting early can tear down the distributed runtime
+    # under host 0 (the coordinator service dies with process 0's peers'
+    # connections erroring out).
+    hm.barrier("artifact-saved")

@@ -567,9 +567,7 @@ class CoordinateDescentCheckpoint:
                 with telemetry.adopt_span(span_h), telemetry.span(
                     "ckpt_write", step=completed_steps, coordinate=cid
                 ):
-                    fut.set_result(
-                        _save_model_files(self.directory, rel, model)
-                    )
+                    fut.set_result(self._write_model_files(rel, model))
             except BaseException as exc:  # noqa: BLE001 - joined in save()
                 fut.set_exception(exc)
 
@@ -636,7 +634,7 @@ class CoordinateDescentCheckpoint:
                 continue
             if cid == trained_cid or cid not in self._model_files:
                 rel = os.path.join(step_rel, f"{cid}.npz")
-                rel_files, cks = _save_model_files(self.directory, rel, model)
+                rel_files, cks = self._write_model_files(rel, model)
                 self._checksums.update(cks)
                 self._model_files[cid] = rel_files
         if best_is_current and best_results is not None:
@@ -661,6 +659,23 @@ class CoordinateDescentCheckpoint:
                 [it, cid, _results_to_json(res)] for it, cid, res in validation_history
             ],
         }
+        self._commit(state)
+
+    def _write_model_files(self, rel: str, model):
+        """Write one coordinate's model files under `rel`; returns
+        (rel_or_shard_list, {rel: checksum}). The hook the multi-host
+        checkpoint (parallel/hostmesh.MultihostCheckpoint) overrides so
+        each host writes only its OWN addressable shards — everything
+        about staging, step bookkeeping and the commit protocol above
+        stays shared."""
+        return _save_model_files(self.directory, rel, model)
+
+    def _commit(self, state: dict) -> None:
+        """Write state.json — the commit point for the whole step — then
+        prune unreferenced step directories. The multi-host checkpoint
+        overrides this with a cross-host commit barrier: no host's
+        state.json may name another host's shard before that shard is
+        durably on disk."""
         # state.json LAST: it is the commit point for the whole step.
         state_bytes = json.dumps(state, indent=2).encode()
         state_path = os.path.join(self.directory, STATE_FILE)

@@ -48,6 +48,7 @@ from typing import List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 logger = logging.getLogger(__name__)
 
@@ -754,6 +755,7 @@ class RandomEffectCoordinate:
             from photon_ml_tpu.parallel.mesh import (
                 matrix_row_sharding,
                 pad_rows_for_mesh,
+                put_row_sharded,
                 ring_gather_rows,
                 ring_scatter_rows,
                 sharded_zeros,
@@ -764,9 +766,11 @@ class RandomEffectCoordinate:
         if initial_model is not None:
             matrix = initial_model.coefficients_matrix
             if matrix.shape[0] < n_rows:
-                matrix = jnp.pad(matrix, ((0, n_rows - matrix.shape[0]), (0, 0)))
+                matrix = np.pad(
+                    np.asarray(matrix), ((0, n_rows - matrix.shape[0]), (0, 0))
+                )
             if mesh is not None:
-                matrix = jax.device_put(matrix, row_sh)
+                matrix = put_row_sharded(matrix, row_sh)
         elif mesh is not None:
             matrix = sharded_zeros((n_rows, self.dim), dtype, row_sh)
         else:

@@ -68,6 +68,29 @@ def _residual_offsets(summed, prev_scores, base_offsets):
     return residual, base_offsets + residual
 
 
+def _score_zeros(n: int, dtype, like):
+    """A zero score vector placed WHERE the sample arrays live. On a
+    single process this is exactly `jnp.zeros` (bitwise-identical
+    dispatch). When `like` (the dataset's offsets) is a global array over
+    a multi-process mesh, a process-local zeros array must not enter the
+    residual computation — mixing addressable-only and global operands is
+    the "Multiprocess computations aren't implemented" crash — so the
+    zeros are assembled with the SAME (replicated) sharding."""
+    sharding = getattr(like, "sharding", None)
+    mesh = getattr(sharding, "mesh", None)
+    if mesh is not None:
+        from photon_ml_tpu.parallel.mesh import mesh_spans_processes
+
+        if mesh_spans_processes(mesh):
+            import numpy as np
+
+            z = np.zeros((n,), dtype)
+            return jax.make_array_from_callback(
+                z.shape, sharding, lambda idx: z[idx]
+            )
+    return jnp.zeros((n,), dtype)
+
+
 @jax.jit
 def _commit_update(residual, new_scores, guarded_arrays):
     ok = jnp.bool_(True)
@@ -191,6 +214,7 @@ def run_coordinate_descent(
     on_event=None,
     mesh_rebuilder=None,
     max_mesh_losses: int = 2,
+    checkpoint_factory=None,
 ) -> CoordinateDescentResult:
     """Run cyclic coordinate descent (CoordinateDescent.run, :132-134).
 
@@ -309,7 +333,15 @@ def run_coordinate_descent(
         )
         ckpt_config_key = hashlib.sha256(repr(fp).encode()).hexdigest()
 
-        ckpt = CoordinateDescentCheckpoint(checkpoint_dir)
+        # `checkpoint_factory(checkpoint_dir)` substitutes a checkpoint
+        # implementation with the same commit protocol — the multi-host
+        # mode passes parallel/hostmesh.MultihostCheckpoint so each host
+        # writes only its own shards behind a cross-host commit barrier.
+        ckpt = (
+            checkpoint_factory(checkpoint_dir)
+            if checkpoint_factory is not None
+            else CoordinateDescentCheckpoint(checkpoint_dir)
+        )
         if ckpt.exists():
             task = next(iter(coordinates.values())).task
             state = ckpt.load(task, config_key=ckpt_config_key)
@@ -330,7 +362,7 @@ def run_coordinate_descent(
             )
 
     scores: Dict[str, jnp.ndarray] = {}
-    summed = jnp.zeros((n,), dtype)
+    summed = _score_zeros(n, dtype, base_offsets)
     # Locked coordinates, warm-start and checkpointed models contribute
     # scores immediately (reference seeds summedScores from initial models,
     # :168-220; on resume the residual state is a pure function of models).
@@ -427,7 +459,7 @@ def run_coordinate_descent(
             t0 = time.perf_counter()
             _prefetch_after(step)
             residual, offsets = _residual_offsets(
-                summed, scores.get(cid, jnp.zeros((n,), dtype)), base_offsets
+                summed, scores.get(cid, _score_zeros(n, dtype, base_offsets)), base_offsets
             )
             kwargs = {}
             if reg_weights and cid in reg_weights:
@@ -670,7 +702,7 @@ def run_coordinate_descent(
             n = first.dataset.num_samples
             dtype = base_offsets.dtype
             scores = {}
-            summed = jnp.zeros((n,), dtype)
+            summed = _score_zeros(n, dtype, base_offsets)
             for c2 in ids:
                 if c2 in models:
                     s = coordinates[c2].score(models[c2])

@@ -254,6 +254,32 @@ def matrix_row_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(mesh.axis_names[0], None))
 
 
+def mesh_spans_processes(mesh: Mesh) -> bool:
+    """True when the mesh places devices in more than one OS process —
+    the multi-host production mode (parallel/hostmesh.py), where plain
+    `jax.device_put` onto mesh shardings is unavailable (the CPU/gloo
+    backend refuses cross-process transfers) and global arrays must be
+    assembled per-process via `jax.make_array_from_callback`."""
+    return len({d.process_index for d in mesh.devices.flat}) > 1
+
+
+def put_row_sharded(matrix, sharding: NamedSharding):
+    """`jax.device_put(matrix, sharding)` that also works when the mesh
+    spans multiple processes: every process holds the full host value (the
+    warm-start matrices are replicated by construction), so each builds
+    its addressable shards locally via `make_array_from_callback` — no
+    cross-process transfer. Single-process meshes keep the plain
+    device_put (identical placement, zero behavior change)."""
+    if getattr(matrix, "sharding", None) == sharding:
+        return matrix
+    if not mesh_spans_processes(sharding.mesh):
+        return jax.device_put(matrix, sharding)
+    arr = np.asarray(matrix)
+    return jax.make_array_from_callback(
+        arr.shape, sharding, lambda idx: arr[idx]
+    )
+
+
 def feature_sharding(mesh: Mesh) -> NamedSharding:
     """Shard the FEATURE axis of the fixed-effect design matrix (columns)
     and its coefficient vector over the mesh — the wide-FE option the

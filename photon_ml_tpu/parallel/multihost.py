@@ -338,6 +338,20 @@ def dryrun_multihost(
     coordinator = f"127.0.0.1:{port}"
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)  # never route workers at the TPU tunnel
+    # The dryrun's workers must see a CLEAN knob surface: a caller that
+    # runs the dryrun under an armed fault plan / installed runtime plan /
+    # tracing would otherwise leak those into every worker, where an
+    # injected fault or plan decision makes the optimality check a flake
+    # (ISSUE 17 satellite — the production supervisor in cli/train owns
+    # deliberate worker-env construction instead).
+    for leaked in (
+        "PHOTON_FAULTS",
+        "PHOTON_FAULTS_SEED",
+        "PHOTON_PLAN",
+        "PHOTON_PLAN_PROFILE",
+        "PHOTON_TRACE",
+    ):
+        env.pop(leaked, None)
     env["JAX_PLATFORMS"] = "cpu"
     repo_root = os.path.dirname(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

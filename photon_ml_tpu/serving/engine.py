@@ -91,6 +91,11 @@ class ScoreResult:
     cold_start: bool = False  # any random-effect lookup fell back
     n_cold: int = 0  # how many of the request's RE lookups fell back
     fe_only: bool = False
+    # How many of the fallbacks were shard-loss degradations (the row is
+    # RESIDENT in the artifact but its shard is marked LOST on this
+    # server) — distinct from genuine cold starts, which no replica could
+    # answer. A multi-host merge prefers the answer with the fewest.
+    n_lost: int = 0
 
 
 @dataclasses.dataclass
@@ -527,6 +532,7 @@ class ServingEngine:
                 st.active -= 1
                 self._lock.notify_all()
         flags = packed["cold_flags"]
+        lflags = packed["lost_flags"]
         results = [
             ScoreResult(
                 score=float(scores[i]),
@@ -535,6 +541,7 @@ class ServingEngine:
                 cold_start=bool(flags[i].any()),
                 n_cold=int(flags[i].sum()),
                 fe_only=fe_only,
+                n_lost=int(lflags[i].sum()),
             )
             for i in range(n)
         ]
@@ -605,6 +612,11 @@ class ServingEngine:
                 faults.fault_point("lookup")
             re_coords = [c for c in state.coords if c.is_random_effect]
             cold_flags = np.zeros((n, len(re_coords)), bool)
+            # Which cold flags are shard-loss fallbacks (resident row,
+            # LOST shard) rather than genuinely unseen entities — kept
+            # separate so ScoreResult.n_lost can tell a degraded answer
+            # from one nobody could improve on.
+            lost_flags = np.zeros((n, len(re_coords)), bool)
             rows_by_cid: Dict[str, np.ndarray] = {}
             # Two-tier coordinates: per-batch override buffers (cold-tier
             # rows copied from host RAM) + the hot-matrix snapshot captured
@@ -652,6 +664,7 @@ class ServingEngine:
                     # shard-loss degradation.
                     lost = sh.lost_mask(rows) & (rows != c.unseen_row)
                     if lost.any():
+                        lost_flags[:, k] = lost
                         rows = np.where(lost, c.unseen_row, rows).astype(
                             np.int32
                         )
@@ -679,6 +692,7 @@ class ServingEngine:
             "overrides_by_cid": overrides_by_cid,
             "tier_params": tier_params,
             "cold_flags": cold_flags,
+            "lost_flags": lost_flags,
         }
 
     def _dispatch(

@@ -88,6 +88,30 @@ MULTICHIP_SECTION_KEYS = (
     "overlap_serve_two_tier_bitwise",
 )
 
+# bench.py multihost_chaos section (ISSUE 17): the DCN-scale production
+# certificate — a 2-OS-process fit must be bitwise-equal to the
+# single-process fit of the same data at the same global device count,
+# each host ingesting only its own disjoint file set; SIGKILLing one host
+# mid-sweep must resume on the survivor set with exactly one repeated
+# sweep (host_losses == 1); SIGKILLing one serve host mid-replay must
+# answer every request (lost-host rows FE-only, resident rows bitwise);
+# and the DCN collective traffic the entity-sharded sweep moved is
+# reported.
+MULTIHOST_SECTION_KEYS = (
+    "n_hosts",
+    "devices_per_host",
+    "files_per_host",
+    "fit_bitwise_vs_single_process",
+    "ingest_disjoint_ok",
+    "host_losses",
+    "repeated_sweeps",
+    "survivor_hosts",
+    "failed_requests",
+    "fe_only_answers",
+    "serve_bitwise_resident",
+    "dcn_collective_bytes",
+)
+
 # ------------------------------------------------------------------- serving
 # Latency/quality metrics a serving run must report (batcher.metrics()).
 SERVING_METRIC_KEYS = (
@@ -143,6 +167,12 @@ ROBUSTNESS_CLEAN_ZERO_KEYS = (
     # ISSUE 16: delta-bundle applies rolled back to the old generation —
     # zero on a clean continuous-refresh loop.
     "delta_rollbacks",
+    # ISSUE 17: whole-host losses in the multi-host process group and the
+    # heartbeat misses that detected them — zero on a clean run (any
+    # single-process run is trivially clean; a multi-host run is clean
+    # only when every peer stayed live end to end).
+    "host_losses",
+    "host_heartbeat_misses",
 )
 
 # Top-level serving-summary.json keys written by cli/serve.py. r14
@@ -405,6 +435,10 @@ JOURNAL_EVENT_SCHEMAS = {
                          "carried_coordinates", "seconds", "max_rel_diff"),
     "delta_apply": ("version", "coordinates", "rows", "bytes", "source"),
     "delta_rollback": ("version", "reason"),
+    # -- multi-host production mode (parallel/hostmesh.py, ISSUE 17) --
+    "host_loss": ("host", "missed_beats", "num_hosts", "source"),
+    "host_join": ("host", "num_hosts", "restaged_rows"),
+    "multihost_barrier": ("name", "host", "num_hosts", "seconds"),
 }
 
 # ------------------------------------------------------------------- profile
@@ -462,6 +496,7 @@ ALL_CONTRACTS = {
     "INGEST_STAGES": INGEST_STAGES,
     "INGEST_TIMING_REQUIRED_KEYS": INGEST_TIMING_REQUIRED_KEYS,
     "MULTICHIP_SECTION_KEYS": MULTICHIP_SECTION_KEYS,
+    "MULTIHOST_SECTION_KEYS": MULTIHOST_SECTION_KEYS,
     "SERVING_METRIC_KEYS": SERVING_METRIC_KEYS,
     "SERVING_SHARDING_KEYS": SERVING_SHARDING_KEYS,
     "SERVING_CLEAN_ZERO_KEYS": SERVING_CLEAN_ZERO_KEYS,

@@ -128,6 +128,17 @@ SITE_DESCRIPTIONS = {
     "tenant's bundle onto the shared fleet)",
     "tenant_evict": "multi-tenant cold-tenant demotion (RE rows to the "
     "host tier under HBM pressure)",
+    # Multi-host production mode (ISSUE 17): losing a whole OS process
+    # (one "host" of the DCN-spanning process group) mid-fit, and a lost
+    # host rejoining the serving fleet. A host loss escalates HostLoss
+    # through the MeshLoss sweep-boundary machinery — the supervisor
+    # relaunches on the survivor set and the fit resumes from the
+    # multi-host checkpoint, replaying exactly one sweep. A rejoin
+    # restages the host's row partition back from FE-only degradation.
+    "host_loss": "whole-host loss in the multi-host process group "
+    "(heartbeat-detected dead peer; supervisor relaunch on survivors)",
+    "host_join": "host rejoin into the multi-host serving fleet "
+    "(restage of the lost host's row partition)",
 }
 KNOWN_SITES = tuple(SITE_DESCRIPTIONS)
 
@@ -159,6 +170,24 @@ class MeshLoss(RuntimeError):
     one sweep, not the job. Raised by the armed `mesh_loss` fault site and
     by watchdog-escalated DeviceHang / exhausted device-shaped failures on
     an entity-sharded coordinate."""
+
+
+class HostLoss(MeshLoss):
+    """A whole HOST of the multi-host process group is gone (ISSUE 17) —
+    the DCN-scale specialization of MeshLoss, detected by the host-liveness
+    heartbeat (parallel/hostmesh.py) or a collective dispatch wedging on a
+    dead peer.
+
+    Subclasses MeshLoss so the coordinate-descent sweep boundary already
+    classifies it correctly, but the recovery is NOT in-process: with
+    jax.distributed the surviving processes cannot shrink the global mesh
+    mid-flight, so the worker exits with hostmesh.EXIT_HOST_LOSS after
+    journaling a `host_loss` event, and the multi-host SUPERVISOR
+    (cli/train --multihost) relaunches the survivor set. The relaunched fit
+    resumes from the multi-host checkpoint's last committed sweep — the
+    Spark parity (PARITY.md): executor loss + YARN relaunch + lineage
+    refetch, here as process loss + supervisor relaunch + checkpoint
+    resume. Cost: exactly one repeated sweep."""
 
 
 # --------------------------------------------------------------- fault plans

@@ -466,6 +466,32 @@ _register(
     "for its worker processes; never set by hand.",
 )
 _register(
+    "PHOTON_MULTIHOST",
+    int,
+    0,
+    "Multi-host production mode (parallel/hostmesh.py): the number of "
+    "OS-process hosts a `--multihost N` run spans; 0 = single-process. "
+    "Set by the supervisor for its workers; the CLI flag is the "
+    "operator-facing switch.",
+)
+_register(
+    "PHOTON_HOST_HEARTBEAT_MS",
+    int,
+    500,
+    "Host-liveness heartbeat period (ms) in multi-host mode; a peer whose "
+    "beat counter stalls for hostmesh.MISS_THRESHOLD (20) consecutive "
+    "periods is declared lost (typed HostLoss, supervisor relaunch on the "
+    "survivor set). The generous threshold rides out XLA compilation "
+    "stalls; lower the period, not the threshold, for faster detection.",
+)
+_register(
+    "PHOTON_HOST_LOSS_RETRIES",
+    int,
+    1,
+    "Whole-host losses a multi-host supervisor absorbs before giving up "
+    "(each costs one relaunch on the survivor set + one repeated sweep).",
+)
+_register(
     "PHOTON_TEST_PLATFORM",
     str,
     "cpu",

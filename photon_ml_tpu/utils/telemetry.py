@@ -131,6 +131,10 @@ METRIC_DESCRIPTIONS = {
     "delta_rollbacks": "delta-bundle applies rolled back to the old "
     "generation",
     "delta_rows_staged": "changed/added RE rows staged by delta applies",
+    "host_losses": "whole-host losses detected in the multi-host process "
+    "group (heartbeat or wedged collective)",
+    "host_heartbeat_misses": "per-host heartbeat beats missed by a peer "
+    "before it was declared lost",
     # -- histograms (fixed log-spaced buckets, mergeable) --
     "serving_latency_ms": "per-request wall latency through the batcher",
     "serving_queue_wait_ms": "submit-to-claim queue wait per request",

@@ -1,0 +1,11 @@
+"""Known-bad: re-types two multihost-section schema keys (the r17
+FIXTURE_MULTIHOST_KEYS shape) as a literal instead of importing the
+tuple."""
+
+
+def check_multihost(section):
+    report = {
+        k: section[k]
+        for k in ("fixture_mh_hosts", "fixture_mh_repeated_sweeps")
+    }  # re-typed multihost schema
+    return report

@@ -16,3 +16,6 @@ FIXTURE_TENANT_KEYS = ("fixture_tenant_completed", "fixture_tenant_shed", "fixtu
 
 # Delta-bundle schema (r16): the continuous-refresh payload keys.
 FIXTURE_REFRESH_KEYS = ("fixture_delta_rows", "fixture_delta_bytes", "fixture_delta_source")
+
+# Multihost-section schema (r17): the DCN production-mode section keys.
+FIXTURE_MULTIHOST_KEYS = ("fixture_mh_hosts", "fixture_mh_repeated_sweeps", "fixture_mh_failed")

@@ -1,0 +1,10 @@
+"""Known-good: the multihost-section schema is imported; single-key
+reads are use, not duplication."""
+
+from contracts import FIXTURE_MULTIHOST_KEYS
+
+
+def check_multihost(section):
+    missing = [k for k in FIXTURE_MULTIHOST_KEYS if k not in section]
+    hosts = section.get("fixture_mh_hosts")  # one key is vocabulary
+    return missing, hosts

@@ -144,7 +144,11 @@ def _failure_domain_hygiene(monkeypatch):
     * no `photon-refresh-*` worker outlives the test — continuous-refresh
       loop helpers (traffic replays riding a delta apply) join before the
       loop returns; a survivor means requests kept scoring against a
-      retired generation.
+      retired generation;
+    * no `photon-hostmesh-*` heartbeat outlives the test — a multi-host
+      worker's HostHeartbeat is stopped by its owner (the worker's
+      finally); a survivor would keep writing beat files into a
+      torn-down rendezvous and could declare phantom host losses.
     """
     from photon_ml_tpu.utils import faults, telemetry
 
@@ -176,6 +180,13 @@ def _failure_domain_hygiene(monkeypatch):
         # unrelated tests.
         "PHOTON_REFRESH_BATCH_ROWS",
         "PHOTON_REFRESH_MAX_DELTA_FRACTION",
+        # Multi-host production mode (ISSUE 17): an ambient mode flag or
+        # heartbeat/retry tuning in the developer's shell must never make
+        # unrelated tests believe they run inside a process group (knob
+        # readers branch on PHOTON_MULTIHOST) or reshape loss detection.
+        "PHOTON_MULTIHOST",
+        "PHOTON_HOST_HEARTBEAT_MS",
+        "PHOTON_HOST_LOSS_RETRIES",
     ):
         monkeypatch.delenv(var, raising=False)
     from photon_ml_tpu import planner as _planner
@@ -202,6 +213,7 @@ def _failure_domain_hygiene(monkeypatch):
                     "photon-reshard",
                     "photon-tenant",
                     "photon-refresh",
+                    "photon-hostmesh",
                 )
             )
             and t.is_alive()

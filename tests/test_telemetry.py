@@ -373,6 +373,12 @@ class TestJournal:
         "max_rel_diff": 0.31,
         "coordinates": ["per-e1"],
         "rows": 96,
+        # -- multi-host production mode (ISSUE 17) --
+        "host": 1,
+        "missed_beats": 20,
+        "name": "ckpt-commit",
+        "num_hosts": 2,
+        "restaged_rows": 11,
     }
 
     def test_every_event_type_round_trips_its_schema(self, tmp_path):
