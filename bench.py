@@ -49,6 +49,8 @@ _ELASTIC_MESH_CHILD = "--run-elastic-mesh"
 _MULTI_TENANT_CHILD = "--run-multi-tenant"
 _CONTINUOUS_LOOP_CHILD = "--run-continuous-loop"
 _MULTIHOST_CHAOS_CHILD = "--run-multihost-chaos"
+_SHADOW_DEPLOY_CHILD = "--run-shadow-deploy"
+_SHADOW_PROMOTE_WORKER = "--run-shadow-promote-worker"
 
 # Physical HBM roofline per chip (GB/s): v5e HBM2 peak ~819 GB/s. Any
 # achieved-bandwidth figure above it is a measurement artifact (rtt
@@ -1451,6 +1453,464 @@ def _continuous_loop_child() -> None:
     )
 
 
+def _shadow_sigkill_fixture():
+    """Deterministic numpy-only fixture shared by the shadow_deploy child
+    and its SIGKILL victim: both processes rebuild the SAME champion /
+    challenger weights and probe traffic from fixed seeds, so the child
+    can compute the champion's solo reference and compare it bitwise
+    against scores the victim produced mid-promotion."""
+    import numpy as np
+
+    d_fe, d_re, n_ent, n_req = 8, 6, 32, 24
+    rng = np.random.default_rng(7)
+    w_champ = rng.normal(size=d_fe).astype(np.float32)
+    M_champ = np.zeros((n_ent + 1, d_re), np.float32)
+    M_champ[:n_ent] = rng.normal(size=(n_ent, d_re)).astype(np.float32)
+    w_chall = rng.normal(size=d_fe).astype(np.float32)
+    M_chall = np.zeros((n_ent + 1, d_re), np.float32)
+    M_chall[:n_ent] = rng.normal(size=(n_ent, d_re)).astype(np.float32)
+    Xg = rng.normal(size=(n_req, d_fe)).astype(np.float32)
+    Xre = rng.normal(size=(n_req, d_re)).astype(np.float32)
+    ids = rng.integers(0, n_ent, size=n_req)
+    return (w_champ, M_champ), (w_chall, M_chall), (Xg, Xre, ids), n_ent
+
+
+def _shadow_array_bundle(w, M, n_ent):
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.game.model import (
+        Coefficients,
+        FixedEffectModel,
+        GameModel,
+        RandomEffectModel,
+    )
+    from photon_ml_tpu.serving import ServingBundle
+    from photon_ml_tpu.transformers.game_transformer import (
+        CoordinateScoringSpec,
+    )
+    from photon_ml_tpu.types import TaskType
+
+    task = TaskType.LOGISTIC_REGRESSION
+    model = GameModel(
+        {
+            "fixed": FixedEffectModel(Coefficients(jnp.asarray(w)), task),
+            "per-e": RandomEffectModel(jnp.asarray(M), None, task),
+        }
+    )
+    specs = {
+        "fixed": CoordinateScoringSpec(shard="g"),
+        "per-e": CoordinateScoringSpec(
+            shard="re",
+            random_effect_type="eid",
+            entity_index={str(i): i for i in range(n_ent)},
+        ),
+    }
+    return ServingBundle.from_model(model, specs, task)
+
+
+def _shadow_sigkill_requests(traffic):
+    from photon_ml_tpu.serving import ScoreRequest
+
+    Xg, Xre, ids = traffic
+    return [
+        ScoreRequest(
+            features={"g": Xg[i], "re": Xre[i]},
+            entity_ids={"eid": str(int(ids[i]))},
+            uid=str(i),
+        )
+        for i in range(len(ids))
+    ]
+
+
+def _shadow_promote_worker() -> None:
+    """SIGKILL-mid-promotion victim for the shadow_deploy section. Arms a
+    stall at the BundleManager's `swap_commit` fault point (held under
+    the swap lock only — the champion's serving path never stops),
+    drives a promotion into that stall from a side thread, scores
+    champion traffic THROUGH the registry mid-stall, durably writes the
+    scores + a marker for the parent, then holds the promotion open
+    until the parent SIGKILLs this process. Killed there, the flip never
+    committed: the champion is still on its old generation, which is
+    exactly what the parent's bitwise check certifies."""
+    import threading as _threading
+
+    from photon_ml_tpu.serving import TenantRegistry
+    from photon_ml_tpu.serving.shadow import ShadowController
+    from photon_ml_tpu.utils import faults
+
+    scratch = sys.argv[sys.argv.index(_SHADOW_PROMOTE_WORKER) + 1]
+    faults.install("")
+    faults.reset_counters()
+    champ, chall, traffic, n_ent = _shadow_sigkill_fixture()
+    reqs = _shadow_sigkill_requests(traffic)
+
+    stall_marker = os.path.join(scratch, "stalled")
+    orig_fault_point = faults.fault_point
+
+    def _stalling_fault_point(site):
+        if site == "swap_commit":
+            with open(stall_marker, "w") as fh:
+                fh.write("stalled\n")
+            time.sleep(600.0)  # the parent SIGKILLs long before this ends
+        return orig_fault_point(site)
+
+    faults.fault_point = _stalling_fault_point
+
+    registry = TenantRegistry(max_batch=32)
+    registry.admit("champ", _shadow_array_bundle(*champ, n_ent))
+    controller = ShadowController(
+        registry,
+        "champ",
+        "cand",
+        _shadow_array_bundle(*chall, n_ent),
+        window_size=64,
+    )
+    _threading.Thread(
+        target=lambda: controller.promote(raise_on_failure=False),
+        name="photon-shadow-promote-drive",
+        daemon=True,
+    ).start()
+    deadline = time.monotonic() + 60.0
+    while not os.path.exists(stall_marker):
+        if time.monotonic() > deadline:
+            raise RuntimeError("promotion never reached swap_commit")
+        time.sleep(0.01)
+    scores = [
+        registry.submit("champ", r, block=True).result(timeout=30.0).score
+        for r in reqs
+    ]
+    tmp = os.path.join(scratch, "scores.json.tmp")
+    with open(tmp, "w") as fh:
+        json.dump([float(s) for s in scores], fh)
+    os.replace(tmp, os.path.join(scratch, "scores.json"))
+    time.sleep(600.0)  # hold mid-promotion; the parent's SIGKILL ends us
+
+
+def _shadow_deploy_child() -> None:
+    """Shadow deployment & online evaluation certificate (ISSUE 18) on an
+    8-virtual-device mesh. Four drills, one JSON line:
+
+      A. a deliberately degraded challenger (refit with 40% of its labels
+         flipped) admitted as a shadow tenant is detected from mirrored
+         windowed metrics ALONE and torn down on its reject verdict —
+         zero champion requests failed, champion answers bitwise vs the
+         same weights served solo;
+      B. armed shadow_mirror/label_join faults degrade mirroring to
+         champion-only serving (counted), never a failed client request;
+      C. a healthy challenger (same-data refit: identical weights by
+         determinism, so the windowed regression is exactly 0.0 and the
+         leg certifies the ACTUATION path on every backend — the
+         quality-detection direction is drill A's job) rides the verdict
+         loop to promotion through the atomic generation flip, with
+         every robustness counter zero across the clean phase;
+      D. a worker process SIGKILLed mid-promotion (stalled at
+         swap_commit, pre-flip) leaves its champion serving the old
+         generation bitwise — the flip is atomic under OS-level murder.
+    """
+    import shutil
+    import tempfile
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.data.game_dataset import (
+        FixedEffectDataConfig,
+        GameDataset,
+        RandomEffectDataConfig,
+    )
+    from photon_ml_tpu.game import incremental
+    from photon_ml_tpu.optimize.config import (
+        L2,
+        CoordinateOptimizationConfig,
+        OptimizerConfig,
+    )
+    from photon_ml_tpu.serving import (
+        ScoreRequest,
+        ServingBundle,
+        ServingEngine,
+        TenantRegistry,
+    )
+    from photon_ml_tpu.serving.shadow import ShadowController
+    from photon_ml_tpu.types import TaskType
+    from photon_ml_tpu.utils import faults
+    from photon_ml_tpu.utils.contracts import ROBUSTNESS_CLEAN_ZERO_KEYS
+
+    task = TaskType.LOGISTIC_REGRESSION
+    ndev = len(jax.devices())
+    faults.install("")
+    faults.reset_counters()
+
+    rng = np.random.default_rng(181)
+    d_fe, d_re = 8, 6
+    n_ent = 48
+    n_base = n_ent * 16
+    # Signal-bearing labels: a fixed linear rule + noise. The clean refit
+    # learns it; the label-noised refit learns 40% garbage — that quality
+    # gap is what the shadow windows must see from mirrored traffic.
+    w_true = np.linspace(1.5, -1.5, d_fe).astype(np.float32)
+    ent = np.resize(np.arange(n_ent, dtype=np.int64), n_base)
+    Xg = rng.normal(size=(n_base, d_fe)).astype(np.float32)
+    Xre = rng.normal(size=(n_base, d_re)).astype(np.float32)
+    y = (Xg @ w_true + 0.25 * rng.normal(size=n_base) > 0).astype(np.float32)
+    flip = rng.uniform(size=n_base) < 0.4
+    y_bad = np.where(flip, 1.0 - y, y).astype(np.float32)
+
+    data_configs = {
+        "fixed": FixedEffectDataConfig("g"),
+        "per-entity": RandomEffectDataConfig("eid", "re", min_bucket=8),
+    }
+    oc = CoordinateOptimizationConfig(
+        optimizer=OptimizerConfig(max_iterations=15),
+        regularization=L2,
+        reg_weight=1.0,
+    )
+    opt_configs = {"fixed": oc, "per-entity": oc}
+
+    def fit_bundle(labels):
+        st = incremental.full_fit(
+            GameDataset.build(
+                {"g": jnp.asarray(Xg), "re": jnp.asarray(Xre)},
+                jnp.asarray(labels),
+                id_tags={"eid": ent},
+            ),
+            data_configs,
+            opt_configs,
+            task,
+        )
+        return ServingBundle.from_model(
+            st.model,
+            incremental.scoring_specs(data_configs, st.entity_indices),
+            task,
+        )
+
+    champ_bundle = fit_bundle(y)
+    degraded_bundle = fit_bundle(y_bad)
+    healthy_bundle = fit_bundle(y)  # same data, same seed: same weights
+
+    def probes(seed, n):
+        prng = np.random.default_rng(seed)
+        pe = np.resize(np.arange(n_ent, dtype=np.int64), n)
+        Pg = prng.normal(size=(n, d_fe)).astype(np.float32)
+        Pre = prng.normal(size=(n, d_re)).astype(np.float32)
+        lab = (
+            Pg @ w_true + 0.25 * prng.normal(size=n) > 0
+        ).astype(np.float64)
+        reqs = [
+            ScoreRequest(
+                features={"g": Pg[i], "re": Pre[i]},
+                entity_ids={"eid": int(pe[i])},
+                uid=f"p{seed}-{i}",
+            )
+            for i in range(n)
+        ]
+        return reqs, lab
+
+    reqs_a, lab_a = probes(1, 32)
+    reqs_b, lab_b = probes(2, 24)
+    reqs_c, lab_c = probes(3, 32)
+    reqs_post, _ = probes(4, 16)
+
+    # Solo champion references: the SAME weights (same-data refit, exact
+    # by determinism) alone on a plain engine — the bitwise anchor for
+    # every drill's champion answers.
+    ref = {}
+    solo = ServingEngine(fit_bundle(y), max_batch=32)
+    with solo:
+        for key, rq in (
+            ("a", reqs_a),
+            ("b", reqs_b),
+            ("c", reqs_c),
+            ("post", reqs_post),
+        ):
+            ref[key] = np.asarray(
+                [r.score for r in solo.score_batch(rq)], np.float64
+            )
+    solo.bundle.release()
+
+    registry = TenantRegistry(max_batch=32)
+    registry.admit("champ", champ_bundle)
+
+    def drive(controller, reqs, labels):
+        """The serving loop's shadow hookup: submit to the champion,
+        mirror, join the label. Client answers come ONLY from the
+        champion futures."""
+        futs = []
+        for rq, lb in zip(reqs, labels):
+            fut = registry.submit("champ", rq, block=True)
+            futs.append(fut)
+            if controller.mirror(rq, fut):
+                controller.record_label(rq.uid, float(lb))
+        scores, failed = [], 0
+        for f in futs:
+            try:
+                scores.append(float(f.result(timeout=60.0).score))
+            except Exception:  # noqa: BLE001 - counted as a failed request
+                failed += 1
+        return np.asarray(scores, np.float64), failed
+
+    # ---- drill A: degraded challenger detected + rolled back -------------
+    ctl_a = ShadowController(
+        registry,
+        "champ",
+        "degraded",
+        degraded_bundle,
+        window_size=16,
+        min_windows=2,
+        cooldown_s=0.0,
+    )
+    got_a, failed_a = drive(ctl_a, reqs_a, lab_a)
+    verdict_a = ctl_a.wait_for_verdict(timeout_s=120.0)
+    sum_a = ctl_a.summary()
+    ctl_a.close()
+    degraded_torn_down = False
+    try:
+        registry.tenant("degraded")
+    except KeyError:
+        degraded_torn_down = True
+    degraded_detected = verdict_a == "reject"
+    degraded_rolled_back = (
+        degraded_torn_down
+        and int(faults.COUNTERS.get("shadow_rollbacks")) == 1
+    )
+    degraded_champion_bitwise = bool(
+        failed_a == 0 and np.array_equal(got_a, ref["a"])
+    )
+
+    # ---- drill B: mirror/label-join faults degrade to champion-only ------
+    faults.reset_counters()
+    ctl_b = ShadowController(
+        registry,
+        "champ",
+        "cand-b",
+        fit_bundle(y_bad),
+        window_size=64,
+        min_windows=2,
+    )
+    with faults.inject("shadow_mirror:3,label_join:2"):
+        got_b, failed_b = drive(ctl_b, reqs_b, lab_b)
+    sum_b = ctl_b.summary()
+    ctl_b.close()  # no-opinion exit: shadow torn down, no rollback count
+    mirror_faults_injected = int(
+        faults.COUNTERS.get("shadow_mirror_failures")
+    ) + int(faults.COUNTERS.get("label_join_failures"))
+    mirror_fault_champion_clean = bool(
+        failed_b == 0 and np.array_equal(got_b, ref["b"])
+    )
+
+    # ---- drill C: healthy challenger rides the loop to promotion ---------
+    faults.reset_counters()
+    ctl_c = ShadowController(
+        registry,
+        "champ",
+        "healthy",
+        healthy_bundle,
+        window_size=16,
+        min_windows=2,
+        cooldown_s=0.0,
+    )
+    got_c, failed_c = drive(ctl_c, reqs_c, lab_c)
+    verdict_c = ctl_c.wait_for_verdict(timeout_s=120.0)
+    healthy_promoted = bool(
+        verdict_c == "promote" and ctl_c.status == "promoted"
+    )
+    sum_c = ctl_c.summary()
+    ctl_c.close()
+    promoted_generation = int(registry.tenant("champ").engine._state.version)
+    healthy_champion_bitwise = bool(
+        failed_c == 0 and np.array_equal(got_c, ref["c"])
+    )
+    post_futs = [
+        registry.submit("champ", rq, block=True) for rq in reqs_post
+    ]
+    post = np.asarray(
+        [float(f.result(timeout=60.0).score) for f in post_futs], np.float64
+    )
+    post_promote_bitwise = bool(np.array_equal(post, ref["post"]))
+    clean_counters_zero = all(
+        int(faults.COUNTERS.get(k)) == 0 for k in ROBUSTNESS_CLEAN_ZERO_KEYS
+    )
+    cobatched = int(registry.metrics()["cobatch_dispatches"])
+    mirrored_total = (
+        int(sum_a["mirrored_requests"])
+        + int(sum_b["mirrored_requests"])
+        + int(sum_c["mirrored_requests"])
+    )
+    registry.close(release_bundles=True)
+
+    # ---- drill D: SIGKILL mid-promotion leaves the old generation --------
+    champ_d, _chall_d, traffic_d, n_ent_d = _shadow_sigkill_fixture()
+    reqs_d = _shadow_sigkill_requests(traffic_d)
+    solo_d = ServingEngine(
+        _shadow_array_bundle(*champ_d, n_ent_d), max_batch=32
+    )
+    with solo_d:
+        ref_d = np.asarray(
+            [r.score for r in solo_d.score_batch(reqs_d)], np.float64
+        )
+    solo_d.bundle.release()
+    scratch = tempfile.mkdtemp(prefix="photon-shadow-sigkill-")
+    sigkill_champion_bitwise = False
+    try:
+        proc = subprocess.Popen(
+            [
+                sys.executable,
+                os.path.abspath(__file__),
+                _SHADOW_PROMOTE_WORKER,
+                scratch,
+            ],
+            stdout=subprocess.DEVNULL,  # this child prints ONE JSON line
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        scores_path = os.path.join(scratch, "scores.json")
+        deadline = time.monotonic() + 180.0
+        while (
+            not os.path.exists(scores_path)
+            and proc.poll() is None
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.05)
+        if os.path.exists(scores_path):
+            proc.kill()  # SIGKILL: the swap is still stalled pre-commit
+            proc.wait(timeout=30.0)
+            with open(scores_path) as fh:
+                mid = np.asarray(json.load(fh), np.float64)
+            sigkill_champion_bitwise = bool(np.array_equal(mid, ref_d))
+        else:
+            proc.kill()
+            proc.wait(timeout=30.0)
+    finally:
+        shutil.rmtree(scratch, ignore_errors=True)
+
+    print(
+        json.dumps(
+            dict(
+                n_devices=ndev,
+                mirrored_requests=mirrored_total,
+                shadow_cobatched=cobatched,
+                degraded_detected=bool(degraded_detected),
+                degraded_windows=int(sum_a["windows"]),
+                degraded_rolled_back=bool(degraded_rolled_back),
+                degraded_champion_failed=int(failed_a),
+                degraded_champion_bitwise=degraded_champion_bitwise,
+                healthy_promoted=healthy_promoted,
+                promoted_generation=promoted_generation,
+                post_promote_bitwise=post_promote_bitwise,
+                mirror_faults_injected=mirror_faults_injected,
+                mirror_fault_champion_clean=mirror_fault_champion_clean,
+                sigkill_champion_bitwise=sigkill_champion_bitwise,
+                clean_counters_zero=bool(clean_counters_zero),
+                # Extra diagnostics (beyond the SHADOW_SECTION_KEYS floor).
+                evaluator=str(sum_a["evaluator"]),
+                degraded_champion_metric=sum_a["champion_metric"],
+                degraded_challenger_metric=sum_a["challenger_metric"],
+                healthy_champion_bitwise=healthy_champion_bitwise,
+                mirror_fault_champion_failed=int(failed_b),
+            )
+        )
+    )
+
+
 def _child() -> None:
     import numpy as np
     import jax
@@ -2718,6 +3178,125 @@ def _child() -> None:
             failed=True, reason=f"{type(exc).__name__}: {exc}"
         )
 
+    # ---- shadow deployment: the platform stops being quality-blind --------
+    # Own 8-virtual-device subprocess (ISSUE 18): a challenger admitted as
+    # a shadow tenant sees mirrored live traffic co-batched with the
+    # champion, windowed label joins feed the EXACT offline metric
+    # programs, and the verdict loop actuates the existing machinery —
+    # reject tears the shadow down, promote rides the atomic generation
+    # flip. The contract: a label-noised refit is detected and rolled
+    # back from shadow metrics alone, a healthy challenger is promoted,
+    # and the champion never fails (or changes) a single client answer —
+    # not even when a worker is SIGKILLed mid-promotion.
+    try:
+        env_sd = dict(os.environ)
+        env_sd["JAX_PLATFORMS"] = "cpu"
+        env_sd.pop("PALLAS_AXON_POOL_IPS", None)
+        flags_sd = env_sd.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags_sd:
+            env_sd["XLA_FLAGS"] = (
+                flags_sd + " --xla_force_host_platform_device_count=8"
+            ).strip()
+        env_sd.pop("PHOTON_FAULTS", None)  # drills arm their own faults
+        out_sd = subprocess.run(
+            [
+                sys.executable,
+                os.path.abspath(__file__),
+                _SHADOW_DEPLOY_CHILD,
+            ],
+            capture_output=True,
+            text=True,
+            timeout=600,
+            env=env_sd,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        line_sd = next(
+            (l for l in out_sd.stdout.splitlines() if l.startswith("{")), None
+        )
+        if line_sd is None:
+            raise RuntimeError(
+                f"shadow_deploy child produced no JSON: "
+                f"{out_sd.stderr[-1500:]}"
+            )
+        sd = json.loads(line_sd)
+        from photon_ml_tpu.utils.contracts import SHADOW_SECTION_KEYS
+
+        missing_sd = [k for k in SHADOW_SECTION_KEYS if sd.get(k) is None]
+        if missing_sd:
+            raise RuntimeError(
+                f"shadow_deploy section is missing keys {missing_sd} — the "
+                "online-evaluation contract is broken"
+            )
+        if not sd["degraded_detected"]:
+            raise RuntimeError(
+                "the label-noised challenger was NOT detected from shadow "
+                "metrics — the platform is still quality-blind"
+            )
+        if not sd["degraded_rolled_back"]:
+            raise RuntimeError(
+                "the degraded challenger was not torn down on its reject "
+                "verdict — the rollback actuator is broken"
+            )
+        if sd["degraded_champion_failed"] or not sd[
+            "degraded_champion_bitwise"
+        ]:
+            raise RuntimeError(
+                "champion traffic was damaged while shadowing a degraded "
+                "challenger — mirroring is not isolated"
+            )
+        if not sd["mirror_fault_champion_clean"]:
+            raise RuntimeError(
+                "mirror/label-join faults leaked into champion answers — "
+                "degradation to champion-only serving is broken"
+            )
+        if sd["mirror_faults_injected"] < 5:
+            raise RuntimeError(
+                f"only {sd['mirror_faults_injected']} mirror-path faults "
+                "fired — the isolation drill tested nothing"
+            )
+        if not sd["healthy_promoted"] or sd["promoted_generation"] <= 0:
+            raise RuntimeError(
+                "the healthy challenger was not promoted through the "
+                "generation flip — the promote actuator is broken"
+            )
+        if not sd["post_promote_bitwise"]:
+            raise RuntimeError(
+                "post-promotion answers diverged from the promoted bundle "
+                "served solo — the flip did not install it bitwise"
+            )
+        if not sd["sigkill_champion_bitwise"]:
+            raise RuntimeError(
+                "a SIGKILL mid-promotion changed champion answers — the "
+                "generation flip is not atomic under process murder"
+            )
+        if not sd["clean_counters_zero"]:
+            raise RuntimeError(
+                "robustness counters were nonzero on the clean promotion "
+                "phase — the shadow path hides failures in a healthy run"
+            )
+        if sd["shadow_cobatched"] <= 0:
+            raise RuntimeError(
+                "no mirrored request was ever co-batched with champion "
+                "traffic — the shadow rode a private dispatch path"
+            )
+        variants["shadow_deploy"] = sd
+        _mark(
+            f"shadow_deploy survived ({sd['n_devices']} vdev: degraded "
+            f"challenger rejected after {sd['degraded_windows']} windows "
+            f"and rolled back, healthy challenger promoted to generation "
+            f"{sd['promoted_generation']}, {sd['mirrored_requests']} "
+            f"mirrored / {sd['shadow_cobatched']} co-batched dispatches, "
+            f"{sd['mirror_faults_injected']} mirror faults champion-clean, "
+            "SIGKILL mid-promotion left the old generation bitwise)"
+        )
+    except Exception as exc:  # noqa: BLE001 - bench must still print a line
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        variants["shadow_deploy"] = dict(
+            failed=True, reason=f"{type(exc).__name__}: {exc}"
+        )
+
     # ---- multihost chaos: whole OS processes as the failure domain --------
     # The ISSUE 17 production certificate, driven through the real CLI
     # supervisors: 2-process fit bitwise vs single-process with disjoint
@@ -3935,6 +4514,12 @@ def main() -> None:
         return
     if _MULTIHOST_CHAOS_CHILD in sys.argv:
         _multihost_chaos_child()
+        return
+    if _SHADOW_DEPLOY_CHILD in sys.argv:
+        _shadow_deploy_child()
+        return
+    if _SHADOW_PROMOTE_WORKER in sys.argv:
+        _shadow_promote_worker()
         return
     if _CHILD in sys.argv:
         _child()

@@ -112,6 +112,25 @@ def build_parser() -> argparse.ArgumentParser:
                         "'reshard' block")
     p.add_argument("--model-id", default=None,
                    help="model id tag written into every score record")
+    p.add_argument("--shadow", default=None, metavar="NAME=MODEL_DIR",
+                   help="shadow deployment (single-tenant mode only): admit "
+                        "a challenger bundle as a shadow tenant receiving "
+                        "mirrored traffic co-batched with the champion — its "
+                        "answers are never returned; online evaluation "
+                        "windows (see --labels) drive a journaled "
+                        "promote/reject verdict through the atomic "
+                        "generation flip, and the summary gains a 'shadow' "
+                        "block")
+    p.add_argument("--labels", default=None, metavar="PATH",
+                   help="label stream for the shadow's online evaluation: a "
+                        ".json/.jsonl file of {\"uid\": ..., \"label\": ..., "
+                        "\"weight\"?: ...} joined by uid into the scoring "
+                        "windows; without it the shadow mirrors but no "
+                        "verdict can fire")
+    p.add_argument("--shadow-window", type=int, default=64,
+                   help="joined rows per shadow evaluation window (default "
+                        "64); the verdict needs PHOTON_SHADOW_MIN_WINDOWS "
+                        "consecutive windows agreeing")
     p.add_argument("--multihost", type=int, default=0, metavar="N",
                    help="multi-host production serving: N share-nothing "
                         "OS-process hosts, each staging only its own "
@@ -258,6 +277,22 @@ def run(args) -> dict:
             "--reshard-to is a single-tenant drill; it cannot be combined "
             "with --tenant"
         )
+    shadow_spec = getattr(args, "shadow", None)
+    if shadow_spec:
+        # Loud refusals (ISSUE 18): the shadow rides the SINGLE-tenant
+        # replay (one champion, one challenger); the round-robin
+        # multi-tenant path has no champion to mirror, and the reshard
+        # drill would race the promotion's generation flip.
+        if tenants:
+            raise ValueError(
+                "--shadow mirrors one champion's traffic; it cannot be "
+                "combined with --tenant"
+            )
+        if getattr(args, "reshard_to", None) is not None:
+            raise ValueError(
+                "--shadow and --reshard-to both drive generation flips; "
+                "run them separately"
+            )
     tenant_specs: List[tuple] = []
     for spec in tenants or []:
         name, sep, model_dir = spec.partition("=")
@@ -320,6 +355,8 @@ def run(args) -> dict:
             planner.ensure_ambient_plan(getattr(args, "profile", None))
         if tenant_specs:
             return _run_multi_tenant(args, tenant_specs, index_maps)
+        if shadow_spec:
+            return _run_with_shadow(args, index_maps)
         bundle = load_bundle(args.model_input_directory, index_maps=index_maps)
         logger.info(
             "bundle pinned: %d coordinate(s), %.1f MB uploaded in %.3fs",
@@ -542,6 +579,9 @@ def _run_with_bundle(args, bundle: ServingBundle) -> dict:
         # Bundle lineage (ISSUE 16, BUNDLE_PROVENANCE_KEYS): where the
         # served model came from and how many delta applies it absorbed.
         "provenance": dict(engine.bundle.provenance),
+        # The shadow-deployment block (ISSUE 18): always present so
+        # absence is loud — empty here, SHADOW_BLOCK_KEYS under --shadow.
+        "shadow": {},
     }
     if reshard_to is not None:
         summary["reshard"] = reshard_info
@@ -727,6 +767,8 @@ def _run_multi_tenant(args, tenant_specs, index_maps) -> dict:
         "tenants": metrics["tenants"],
         # Per-tenant bundle lineage (ISSUE 16, BUNDLE_PROVENANCE_KEYS).
         "provenance": provenance,
+        # ISSUE 18: always present, empty off the --shadow path.
+        "shadow": {},
     }
     with open(os.path.join(out_root, "serving-summary.json"), "w") as f:
         json.dump(summary, f, indent=2, default=str)
@@ -748,6 +790,235 @@ def _run_multi_tenant(args, tenant_specs, index_maps) -> dict:
     profile["plan"] = _planner_mod.plan_block(overrides=_cli_plan_overrides)
     telemetry.write_profile(os.path.join(out_root, "profile.json"), profile)
     logger.info("multi-tenant serving metrics: %s", metrics)
+    return summary
+
+
+def _load_labels(path: str) -> dict:
+    """uid -> (label, weight) from a .json/.jsonl label stream; a
+    malformed line costs ONE label (logged), never the join."""
+    labels: dict = {}
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                doc = json.loads(line)
+                labels[str(doc["uid"])] = (
+                    float(doc["label"]),
+                    float(doc.get("weight", 1.0)),
+                )
+            except Exception as exc:  # noqa: BLE001 - per-record isolation
+                logger.warning(
+                    "skipping malformed label at %s:%d: %s", path, lineno, exc
+                )
+    return labels
+
+
+def _run_with_shadow(args, index_maps) -> dict:
+    """Single-tenant replay with a shadow challenger (ISSUE 18,
+    `--shadow NAME=MODEL_DIR`): the champion bundle serves as a tenant on
+    a TenantRegistry, the challenger rides as a shadow tenant receiving
+    mirrored traffic co-batched with the champion — its answers are never
+    returned (scores are written for the champion ONLY) — and `--labels`
+    joins labels into the online evaluation windows that drive the
+    journaled promote/reject verdict. Champion and challenger must share
+    the feature space (one request encoding serves both); that is the
+    refresh-challenger shape by construction."""
+    from photon_ml_tpu import planner as _planner_mod
+    from photon_ml_tpu.serving.shadow import ShadowController
+    from photon_ml_tpu.serving.tenancy import TenantRegistry
+    from photon_ml_tpu.utils import faults, telemetry
+    from photon_ml_tpu.utils.contracts import ROBUSTNESS_CLEAN_ZERO_KEYS
+
+    shadow_name, sep, shadow_dir = args.shadow.partition("=")
+    if not sep or not shadow_name or not shadow_dir:
+        raise ValueError(f"--shadow {args.shadow!r}: expected NAME=MODEL_DIR")
+    champion_name = "champion"
+    if shadow_name == champion_name:
+        raise ValueError(
+            f"--shadow name {shadow_name!r} collides with the champion "
+            "tenant name"
+        )
+
+    _cli_plan_overrides = {}
+    if args.max_batch is not None:
+        _cli_plan_overrides["serving_max_batch"] = int(args.max_batch)
+    if args.max_wait_ms is not None:
+        _cli_plan_overrides["serving_max_wait_ms"] = float(args.max_wait_ms)
+
+    is_json = args.requests.endswith((".json", ".jsonl"))
+    shard_configs = None
+    if args.feature_shard_configurations:
+        from photon_ml_tpu.cli.config import parse_feature_shard_config
+
+        shard_configs = dict(
+            parse_feature_shard_config(s)
+            for s in args.feature_shard_configurations
+        )
+    labels = _load_labels(args.labels) if args.labels else {}
+
+    out_root = args.root_output_directory
+    os.makedirs(out_root, exist_ok=True)
+    t_warm = time.perf_counter()
+    registry = TenantRegistry(
+        max_batch=args.max_batch, max_wait_ms=args.max_wait_ms
+    )
+    controller = None
+    try:
+        champ_bundle = load_bundle(
+            args.model_input_directory, index_maps=index_maps
+        )
+        registry.admit(
+            champion_name,
+            champ_bundle,
+            max_pending=args.max_pending,
+            deadline_ms=args.deadline_ms,
+        )
+        chall_bundle = load_bundle(shadow_dir, index_maps=index_maps)
+        controller = ShadowController(
+            registry,
+            champion_name,
+            shadow_name,
+            chall_bundle,
+            window_size=args.shadow_window,
+            max_pending=args.max_pending,
+            deadline_ms=args.deadline_ms,
+        )
+        warmup_s = time.perf_counter() - t_warm
+        logger.info(
+            "champion pinned; challenger %r riding shadow (window=%d, "
+            "%d label(s) preloaded)",
+            shadow_name,
+            args.shadow_window,
+            len(labels),
+        )
+
+        malformed = [0]
+        if is_json:
+            raw_stream = _iter_json_docs(args.requests, malformed)
+        else:
+            raw_stream = _iter_avro_records(args.requests)
+
+        scores_dir = os.path.join(out_root, "scores")
+        model_id = args.model_id or "game-model"
+        n_requests = 0
+        n_failed = 0
+        t_replay = time.perf_counter()
+        with telemetry.span("serve_replay", shadow=shadow_name):
+            for k in itertools.count():
+                window = []
+                # Encode against the champion's CURRENT bundle: after a
+                # promotion flips the generation, later windows encode
+                # against the promoted challenger.
+                bundle = registry.tenant(champion_name).bundle
+                for raw in itertools.islice(raw_stream, REPLAY_WINDOW):
+                    try:
+                        if is_json:
+                            req = _encode_json_request(bundle, raw)
+                        else:
+                            req = request_from_record(
+                                bundle, raw, shard_configs
+                            )
+                    except Exception as exc:  # noqa: BLE001 - per-record
+                        malformed[0] += 1
+                        logger.warning(
+                            "skipping malformed request: %s", exc
+                        )
+                        continue
+                    window.append(req)
+                if not window:
+                    break
+                futures = []
+                for req in window:
+                    fut = registry.submit(champion_name, req, block=True)
+                    futures.append(fut)
+                    # Mirror AFTER the champion submit so the pair lands
+                    # in the same dispatch round; a False return (fraction
+                    # gate, fault, post-verdict) is champion-only, never
+                    # an error.
+                    if controller.mirror(req, fut) and req.uid in labels:
+                        lab, w = labels[req.uid]
+                        controller.record_label(req.uid, lab, weight=w)
+                results = []
+                for i, fut in enumerate(futures):
+                    try:
+                        results.append((n_requests + i, fut.result()))
+                    except Exception as exc:  # noqa: BLE001 - per-request
+                        n_failed += 1
+                        logger.warning(
+                            "request %d failed: %s", n_requests + i, exc
+                        )
+                if results:
+                    _write_score_part(scores_dir, k, results, model_id)
+                n_requests += len(window)
+        replay_s = time.perf_counter() - t_replay
+        if labels:
+            # A short replay outruns the async evaluation worker (the
+            # first metric compile alone can cost more than the whole
+            # replay): drain the joined-window backlog so the verdict
+            # loop gets its chance to actuate before the snapshot. With
+            # too few joined rows for a verdict this returns as soon as
+            # the backlog is digested, not after the full timeout.
+            controller.drain(timeout_s=120.0)
+        # The shadow block snapshots BEFORE the controller closes (its
+        # champion-generation field reads the live engine); close()
+        # retires a still-observing shadow without a verdict.
+        shadow_block = controller.summary()
+        controller.close()
+        metrics = registry.metrics()
+        health = registry.tenant(champion_name).engine.health.snapshot()
+        provenance = dict(registry.tenant(champion_name).bundle.provenance)
+    finally:
+        if controller is not None:
+            controller.close()
+        registry.close(release_bundles=True)
+    logger.info(
+        "replayed %d request(s), %d failed, %d malformed skipped; shadow "
+        "%r finished %s",
+        n_requests,
+        n_failed,
+        malformed[0],
+        shadow_name,
+        shadow_block["status"],
+    )
+
+    summary = {
+        "num_requests": n_requests,
+        "failed_requests": n_failed,
+        "malformed_records": malformed[0],
+        "serving": metrics,
+        "health": health,
+        "robustness_counters": {
+            **{k: 0 for k in ROBUSTNESS_CLEAN_ZERO_KEYS},
+            **faults.counters(),
+        },
+        "plan": _planner_mod.plan_block(overrides=_cli_plan_overrides),
+        "tenants": metrics["tenants"],
+        "provenance": provenance,
+        # The online-quality-gate evidence (SHADOW_BLOCK_KEYS).
+        "shadow": shadow_block,
+    }
+    with open(os.path.join(out_root, "serving-summary.json"), "w") as f:
+        json.dump(summary, f, indent=2, default=str)
+    profile = telemetry.build_profile(
+        "serve",
+        wall_s=warmup_s + replay_s,
+        stages={
+            "warmup_s": round(warmup_s, 4),
+            "replay_s": round(replay_s, 4),
+        },
+        dispatch={
+            "max_batch": int(registry.max_batch),
+            "max_wait_ms": float(registry.max_wait_s * 1e3),
+            "tenants": [champion_name, shadow_name],
+        },
+        bucket_shapes={"registry_buckets": list(registry.buckets)},
+        serving=metrics,
+    )
+    profile["plan"] = _planner_mod.plan_block(overrides=_cli_plan_overrides)
+    telemetry.write_profile(os.path.join(out_root, "profile.json"), profile)
+    logger.info("shadow serving metrics: %s", metrics)
     return summary
 
 

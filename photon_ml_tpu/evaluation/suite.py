@@ -112,6 +112,46 @@ def better_than(evaluator: EvaluatorType, a: float, b: Optional[float]) -> bool:
     return a < b
 
 
+def regression(
+    evaluator: EvaluatorType, challenger: float, champion: float
+) -> float:
+    """Signed regression of `challenger` vs `champion` — positive means the
+    challenger is WORSE, direction-aware per evaluator (AUC down and RMSE up
+    both come out positive). The shadow decision loop compares this against
+    its tolerance band; keeping the direction logic next to
+    `_LARGER_IS_BETTER` means a new evaluator cannot drift between offline
+    `better_than` ranking and the online gate."""
+    if evaluator.name in _LARGER_IS_BETTER:
+        return champion - challenger
+    return challenger - champion
+
+
+def resolve_metric_fn(
+    et: EvaluatorType, grouped: Optional["GroupedIndex"] = None
+) -> Callable:
+    """The bare metric callable `(scores, labels, weights) -> device scalar`
+    for one evaluator — PRECISION k-binding and grouped-gather wrapping
+    resolved HERE, the single dispatch point shared by offline
+    `EvaluationSuite.evaluate()`, the sweep executor's jitted
+    trial-valuation program (hyperparameter/sweep.py), and the online
+    `StreamingWindowEvaluator` (serving/shadow.py) — so one metric program
+    means the same thing in every world and a new evaluator variant cannot
+    drift between them."""
+    if et.name == "PRECISION":
+        base = lambda s, l, w, _k=et.k: metrics.precision_at_k(_k, s, l, w)
+    else:
+        base = _METRIC_FNS[et.name]
+    if et.is_grouped:
+        if grouped is None:
+            raise ValueError(
+                f"Evaluator {et} is grouped and needs its GroupedIndex"
+            )
+        return lambda s, l, w, _f=base, _i=grouped: _grouped_metric(
+            _f, _i, s, l, w
+        )
+    return base
+
+
 class GroupedIndex(NamedTuple):
     """Precomputed padded group gather for one id tag."""
 
@@ -196,21 +236,10 @@ class EvaluationSuite:
 
     def metric_fn(self, et: EvaluatorType) -> Callable:
         """The bare metric callable `(scores, labels, weights) -> device
-        scalar` for one evaluator — PRECISION k-binding and grouped-gather
-        wrapping resolved HERE, the single dispatch point shared by
-        `evaluate()` and the sweep executor's jitted trial-valuation
-        program (hyperparameter/sweep.py), so a new evaluator variant
-        cannot drift between the two."""
-        if et.name == "PRECISION":
-            base = lambda s, l, w, _k=et.k: metrics.precision_at_k(_k, s, l, w)
-        else:
-            base = _METRIC_FNS[et.name]
-        if et.is_grouped:
-            idx = self._grouped[et.id_tag]
-            return lambda s, l, w, _f=base, _i=idx: _grouped_metric(
-                _f, _i, s, l, w
-            )
-        return base
+        scalar` for one evaluator — delegates to the module-level
+        `resolve_metric_fn` dispatch point, binding this suite's grouped
+        gather when the evaluator is grouped."""
+        return resolve_metric_fn(et, self._grouped.get(et.id_tag))
 
     def evaluate(self, scores: Array) -> "EvaluationResults":
         """Compute every metric, then fetch them in ONE device round trip.
@@ -249,3 +278,69 @@ class EvaluationResults:
         return better_than(
             self.primary, self.primary_value, None if other is None else other.primary_value
         )
+
+
+class StreamingWindowEvaluator:
+    """Online windowed evaluation over the SAME metric programs as offline.
+
+    The shadow decision loop (serving/shadow.py, ISSUE 18) scores each
+    joined (scores, labels) window through the exact callables
+    `resolve_metric_fn` hands `EvaluationSuite.evaluate` — same jitted
+    reductions, same stack-then-fetch single device round trip — so an
+    online regression threshold means precisely what it means against an
+    offline validation set (the photon-lib validator gate taken online).
+    Unlike a suite, labels arrive WITH each window instead of being fixed
+    at construction.
+
+    Grouped evaluators (AUC:<idTag>, PRECISION@k:<idTag>) are refused:
+    their gather matrices are built against one fixed validation sample
+    order, which a streaming window does not have.
+    """
+
+    def __init__(
+        self,
+        evaluator_types: Sequence[EvaluatorType],
+        *,
+        primary: Optional[EvaluatorType] = None,
+    ):
+        if not evaluator_types:
+            raise ValueError(
+                "StreamingWindowEvaluator requires at least one evaluator"
+            )
+        grouped = [str(et) for et in evaluator_types if et.is_grouped]
+        if grouped:
+            raise ValueError(
+                "StreamingWindowEvaluator does not support grouped "
+                f"evaluators (got {grouped}); grouped gathers assume a "
+                "fixed validation sample order"
+            )
+        self.evaluator_types = list(evaluator_types)
+        self.primary = primary or self.evaluator_types[0]
+
+    def evaluate_window(
+        self,
+        scores: Array,
+        labels: Array,
+        weights: Optional[Array] = None,
+    ) -> "EvaluationResults":
+        """Every metric over one window, ONE device round trip — mirrors
+        `EvaluationSuite.evaluate` exactly (bitwise on identical arrays)."""
+        labels = jnp.asarray(labels)
+        if int(labels.shape[0]) == 0:
+            raise ValueError(
+                "empty evaluation window: a windowed metric over zero rows "
+                "is undefined — the caller must skip or carry the window"
+            )
+        scores = jnp.asarray(scores)
+        w = weights if weights is not None else jnp.ones_like(labels)
+        names: List[str] = []
+        vals = []
+        for et in self.evaluator_types:
+            val = resolve_metric_fn(et)(scores, labels, w)
+            names.append(str(et))
+            vals.append(jnp.asarray(val, jnp.float32))
+        fetched = np.asarray(jnp.stack(vals))
+        results: Dict[str, float] = {
+            name: float(v) for name, v in zip(names, fetched)
+        }
+        return EvaluationResults(primary=self.primary, results=results)

@@ -538,6 +538,32 @@ class CoordinateDescentCheckpoint:
     def exists(self) -> bool:
         return os.path.isfile(os.path.join(self.directory, STATE_FILE))
 
+    def stored_config_key(self) -> Optional[str]:
+        """The config fingerprint the on-disk state was committed under
+        (None when no checkpoint exists, it predates config keys, or
+        state.json is unreadable — all of which `load` would reject)."""
+        try:
+            with open(os.path.join(self.directory, STATE_FILE)) as f:
+                key = json.load(f).get("config_key")
+        except (OSError, ValueError):
+            return None
+        return key if isinstance(key, str) else None
+
+    def clear(self) -> None:
+        """Discard the on-disk checkpoint. state.json (the commit point)
+        is removed FIRST so a crash mid-clear leaves no state file
+        referencing deleted steps — `exists()` is already False."""
+        try:
+            os.remove(os.path.join(self.directory, STATE_FILE))
+        except OSError:
+            pass
+        shutil.rmtree(
+            os.path.join(self.directory, STEPS_DIR), ignore_errors=True
+        )
+        self._model_files = {}
+        self._best_files = {}
+        self._checksums = {}
+
     def begin_model_write(
         self, *, completed_steps: int, cid: str, model
     ) -> tuple:

@@ -215,6 +215,7 @@ def run_coordinate_descent(
     mesh_rebuilder=None,
     max_mesh_losses: int = 2,
     checkpoint_factory=None,
+    stale_checkpoint: str = "error",
 ) -> CoordinateDescentResult:
     """Run cyclic coordinate descent (CoordinateDescent.run, :132-134).
 
@@ -242,6 +243,15 @@ def run_coordinate_descent(
     from the checkpointed models, and reproduces the uninterrupted result
     (down-sampling keys derive from (seed, step), so resumed subsamples are
     identical).
+
+    `stale_checkpoint` picks the policy for a checkpoint whose config
+    fingerprint does not match this run's: "error" (default) refuses to
+    resume — the single-run safety contract, an edited config should be
+    loud — while "discard" clears it and starts fresh. The refresh loop
+    uses "discard": each round's full refit is a NEW run configuration
+    (the merged dataset grew), so a leftover checkpoint from a prior
+    completed round can never be resumed, only a crash of THIS round's
+    fit (same fingerprint) can.
 
     MID-FIT MESH ELASTICITY (ISSUE 13): a typed `faults.MeshLoss` raised
     during a coordinate update — the armed `mesh_loss` fault site, or a
@@ -342,6 +352,17 @@ def run_coordinate_descent(
             if checkpoint_factory is not None
             else CoordinateDescentCheckpoint(checkpoint_dir)
         )
+        if (
+            stale_checkpoint == "discard"
+            and ckpt.exists()
+            and ckpt.stored_config_key() != ckpt_config_key
+        ):
+            logger.info(
+                "checkpoint at %s was written for a different run "
+                "configuration — discarding and starting fresh",
+                checkpoint_dir,
+            )
+            ckpt.clear()
         if ckpt.exists():
             task = next(iter(coordinates.values())).task
             state = ckpt.load(task, config_key=ckpt_config_key)

@@ -464,6 +464,11 @@ def _warm_full_refit(
         reg_weights={cid: opt_configs[cid].reg_weight for cid in coords},
         seed=seed,
         checkpoint_dir=checkpoint_dir,
+        # Each round's merged dataset is a new config fingerprint; a
+        # checkpoint left by an earlier round's full refit is stale by
+        # construction and must not block this one (crash-resume of
+        # THIS round still works: same fingerprint resumes).
+        stale_checkpoint="discard",
     )
     max_rel = 0.0
     for cid, model in result.model.models.items():

@@ -411,7 +411,12 @@ class MicroBatcher:
                     # The claimed batch is no longer in _pending — fail its
                     # futures HERE before the terminal guard handles the
                     # queued remainder, or they would hang unanswered.
+                    # Mark unhealthy FIRST: once any client observes its
+                    # future fail with the thread-death error, later
+                    # submits must already be typed-rejected — not race
+                    # the terminal guard a few frames up the unwind.
                     with self._cv:
+                        self._unhealthy = exc
                         self._failed += sum(
                             1 for _, f, _, _ in batch if not f.done()
                         )

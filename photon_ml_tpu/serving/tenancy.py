@@ -185,6 +185,7 @@ class Tenant:
         self.queue: Deque[_Pending] = collections.deque()
         self.in_flight = 0  # both paths: submitted, not yet resolved
         self.demoted = False
+        self.draining = False  # remove() in progress: refuse new submits
         self.last_active = time.monotonic()
         self.completed = 0
         self.failed = 0
@@ -580,6 +581,14 @@ class TenantRegistry:
                             f"tenant dispatch thread died: "
                             f"{self._unhealthy!r}"
                         ) from self._unhealthy
+                    if t.draining:
+                        # remove() is draining this tenant: refuse loudly
+                        # instead of racing the teardown (ISSUE 18 — a
+                        # retired shadow tenant must never accept traffic).
+                        raise KeyError(
+                            f"tenant {name!r} is being removed; no new "
+                            "submits accepted while it drains"
+                        )
                     if first_pass and eligible:
                         # One admission fault per submit, after the
                         # closed/unhealthy checks (the micro-batcher fires
@@ -1232,6 +1241,42 @@ class TenantRegistry:
 
     def tenant(self, name: str) -> Tenant:
         return self._tenant(name)
+
+    def remove(
+        self,
+        name: str,
+        *,
+        release_bundle: bool = False,
+        drain_timeout_s: float = 30.0,
+    ) -> None:
+        """Retire ONE tenant while the rest of the fleet keeps serving
+        (ISSUE 18: a rejected shadow challenger is torn down with zero
+        champion impact). New submits refuse immediately; queued and
+        in-flight requests drain to completion (the dispatch thread may
+        hold claimed items, so the tenant entry stays visible until
+        in-flight hits zero — deleting early would strand them); then the
+        tenant's engine closes (batcher + watchdog join there) and its
+        bundle is optionally released. A tenant that cannot drain within
+        `drain_timeout_s` raises loudly and stays admitted."""
+        t = self._tenant(name)
+        deadline = time.monotonic() + drain_timeout_s
+        with self._cv:
+            t.draining = True
+            self._cv.notify_all()
+            while t.queue or t.in_flight:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    t.draining = False
+                    raise RuntimeError(
+                        f"tenant {name!r} did not drain within "
+                        f"{drain_timeout_s}s ({len(t.queue)} queued, "
+                        f"{t.in_flight} in flight); still admitted"
+                    )
+                self._cv.wait(timeout=min(0.1, remaining))
+            del self._tenants[name]
+        t.engine.close()
+        if release_bundle and not t.engine._state.bundle.released:
+            t.engine._state.bundle.release()
 
     def close(self, release_bundles: bool = False) -> None:
         """Drain the co-batch queue (pending requests still answered),

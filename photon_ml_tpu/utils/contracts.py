@@ -173,6 +173,14 @@ ROBUSTNESS_CLEAN_ZERO_KEYS = (
     # only when every peer stayed live end to end).
     "host_losses",
     "host_heartbeat_misses",
+    # ISSUE 18: shadow deployment — mirror submissions that degraded to
+    # champion-only, label joins that failed (label dropped, champion
+    # untouched), and challengers torn down on a regression verdict (or a
+    # failed promotion). A clean run with a healthy challenger promotes
+    # with all three at zero.
+    "shadow_mirror_failures",
+    "label_join_failures",
+    "shadow_rollbacks",
 )
 
 # Top-level serving-summary.json keys written by cli/serve.py. r14
@@ -181,7 +189,9 @@ ROBUSTNESS_CLEAN_ZERO_KEYS = (
 # single-tenant replay, one TENANT_BLOCK_KEYS dict per tenant under
 # --tenant) so a missing block is loud, never ambiguous; r16 appends the
 # bundle provenance block (BUNDLE_PROVENANCE_KEYS) so operators can audit
-# what a swapped engine is actually running.
+# what a swapped engine is actually running; r18 appends the shadow
+# deployment block ({} on a replay without --shadow, SHADOW_BLOCK_KEYS
+# otherwise).
 SERVING_SUMMARY_KEYS = (
     "num_requests",
     "failed_requests",
@@ -192,6 +202,7 @@ SERVING_SUMMARY_KEYS = (
     "plan",
     "tenants",
     "provenance",
+    "shadow",
 )
 
 # The served bundle's lineage block (ISSUE 16): every ServingBundle
@@ -349,6 +360,57 @@ CONTINUOUS_SECTION_KEYS = (
     "generation",
 )
 
+# --------------------------------------------------------- shadow deployment
+# The shadow block inside serving-summary.json (ISSUE 18):
+# ShadowController.summary() zips exactly these — what challenger
+# mirrored against which champion, how far the decision loop got
+# (status: observing | promote_ready | promoting | promoted | rejected |
+# closed), the evidence the
+# last evaluated window carried, and the champion's serving generation
+# (so a promotion is visible as the generation flip it performed).
+# Every key always present so a quality-blind replay is loud, never
+# silent.
+SHADOW_BLOCK_KEYS = (
+    "champion",
+    "challenger",
+    "status",
+    "windows",
+    "mirrored_requests",
+    "mirror_failures",
+    "label_join_failures",
+    "champion_metric",
+    "challenger_metric",
+    "evaluator",
+    "score_drift_p50",
+    "generation",
+)
+
+# bench.py shadow_deploy section (ISSUE 18): the online-quality-gate
+# certificate — a deliberately degraded challenger (label-noised refit)
+# is detected and rolled back from shadow metrics ALONE while the
+# champion answers every request bitwise-vs-solo with zero failures; a
+# healthy challenger promotes through the atomic BundleManager
+# generation flip; mirror faults degrade to champion-only serving (never
+# a failed client request); and a SIGKILL mid-promotion leaves the old
+# champion serving its old generation bitwise.
+SHADOW_SECTION_KEYS = (
+    "n_devices",
+    "mirrored_requests",
+    "shadow_cobatched",
+    "degraded_detected",
+    "degraded_windows",
+    "degraded_rolled_back",
+    "degraded_champion_failed",
+    "degraded_champion_bitwise",
+    "healthy_promoted",
+    "promoted_generation",
+    "post_promote_bitwise",
+    "mirror_faults_injected",
+    "mirror_fault_champion_clean",
+    "sigkill_champion_bitwise",
+    "clean_counters_zero",
+)
+
 # -------------------------------------------------------------------- sweep
 # bench.py `sweep` section (ISSUE 12): the pod-parallel hyperparameter
 # sweep certificate — a 16-trial Bayesian sweep through the batched trial
@@ -439,6 +501,17 @@ JOURNAL_EVENT_SCHEMAS = {
     "host_loss": ("host", "missed_beats", "num_hosts", "source"),
     "host_join": ("host", "num_hosts", "restaged_rows"),
     "multihost_barrier": ("name", "host", "num_hosts", "seconds"),
+    # -- shadow deployment & online evaluation (serving/shadow.py, ISSUE 18) --
+    "shadow_start": ("champion", "challenger", "window_size", "min_windows",
+                     "mirror_fraction"),
+    "shadow_window": ("champion", "challenger", "window", "rows",
+                      "champion_metric", "challenger_metric", "evaluator",
+                      "healthy"),
+    "shadow_verdict": ("champion", "challenger", "decision", "windows",
+                       "champion_metric", "challenger_metric", "evaluator",
+                       "reason"),
+    "shadow_promote": ("champion", "challenger", "version"),
+    "shadow_rollback": ("champion", "challenger", "reason"),
 }
 
 # ------------------------------------------------------------------- profile
@@ -507,6 +580,8 @@ ALL_CONTRACTS = {
     "DELTA_BUNDLE_KEYS": DELTA_BUNDLE_KEYS,
     "CONTINUOUS_SECTION_KEYS": CONTINUOUS_SECTION_KEYS,
     "MULTI_TENANT_SECTION_KEYS": MULTI_TENANT_SECTION_KEYS,
+    "SHADOW_BLOCK_KEYS": SHADOW_BLOCK_KEYS,
+    "SHADOW_SECTION_KEYS": SHADOW_SECTION_KEYS,
     "CHAOS_MULTICHIP_SECTION_KEYS": CHAOS_MULTICHIP_SECTION_KEYS,
     "ELASTIC_MESH_SECTION_KEYS": ELASTIC_MESH_SECTION_KEYS,
     "SWEEP_SECTION_KEYS": SWEEP_SECTION_KEYS,

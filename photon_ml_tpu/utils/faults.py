@@ -139,6 +139,18 @@ SITE_DESCRIPTIONS = {
     "(heartbeat-detected dead peer; supervisor relaunch on survivors)",
     "host_join": "host rejoin into the multi-host serving fleet "
     "(restage of the lost host's row partition)",
+    # Shadow deployment & online evaluation (ISSUE 18): mirroring champion
+    # traffic to a challenger tenant, joining labels into evaluation
+    # windows, and flipping a promoted challenger to champion. A mirror or
+    # join failure degrades to champion-only serving (counted, NEVER a
+    # failed client request); a promote failure aborts the flip and the
+    # champion keeps serving its old generation bitwise.
+    "shadow_mirror": "shadow traffic mirroring (submit of the challenger's "
+    "co-batched copy of a champion request)",
+    "label_join": "online-evaluation label join (uid -> label arrival into "
+    "the shadow scoring window)",
+    "shadow_promote": "shadow promotion (the challenger -> champion "
+    "BundleManager generation flip)",
 }
 KNOWN_SITES = tuple(SITE_DESCRIPTIONS)
 

@@ -427,6 +427,43 @@ _register(
     "re-solving per changed entity costs more than one fused solve.",
 )
 
+# ----------------------------------------------------------------- shadow
+_register(
+    "PHOTON_SHADOW_MIN_WINDOWS",
+    int,
+    3,
+    "Shadow deployment (serving/shadow): consecutive evaluation windows "
+    "that must agree before a verdict fires — ALL healthy promotes, ALL "
+    "regressed rejects, a mixed run holds (the hysteresis band between "
+    "the two).",
+)
+_register(
+    "PHOTON_SHADOW_REGRESSION_TOL",
+    float,
+    0.02,
+    "Shadow deployment: a window is regressed when the challenger's "
+    "primary metric is worse than the champion's by more than this "
+    "(direction-aware — AUC down or RMSE up); the same tolerance a "
+    "threshold means offline, because online windows run the exact "
+    "jitted EvaluationSuite metric programs.",
+)
+_register(
+    "PHOTON_SHADOW_COOLDOWN_S",
+    float,
+    0.0,
+    "Shadow deployment: minimum seconds between shadow start (or the "
+    "last verdict) and the next verdict — lets windows accumulate past "
+    "a transient before actuating; 0 disables the cooldown.",
+)
+_register(
+    "PHOTON_SHADOW_MIRROR_FRACTION",
+    float,
+    1.0,
+    "Shadow deployment: fraction of champion traffic mirrored to the "
+    "challenger tenant (deterministic credit accumulator, no RNG); 1.0 "
+    "mirrors everything, 0.25 every fourth request.",
+)
+
 # ------------------------------------------------------------------- planner
 _register(
     "PHOTON_PLAN",

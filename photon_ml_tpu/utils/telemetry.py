@@ -135,11 +135,29 @@ METRIC_DESCRIPTIONS = {
     "group (heartbeat or wedged collective)",
     "host_heartbeat_misses": "per-host heartbeat beats missed by a peer "
     "before it was declared lost",
+    "shadow_mirrored_requests": "champion requests mirrored to a shadow "
+    "challenger tenant",
+    "shadow_mirror_failures": "mirror submits degraded to champion-only "
+    "serving (never a failed client request)",
+    "label_join_failures": "online-evaluation label joins dropped (label "
+    "lost, champion path untouched)",
+    "shadow_windows": "shadow evaluation windows scored through the "
+    "jitted metric programs",
+    "shadow_promotions": "challengers promoted to champion via the "
+    "BundleManager generation flip",
+    "shadow_rollbacks": "challengers torn down on a regression verdict "
+    "or a failed promotion",
     # -- histograms (fixed log-spaced buckets, mergeable) --
     "serving_latency_ms": "per-request wall latency through the batcher",
     "serving_queue_wait_ms": "submit-to-claim queue wait per request",
     "serving_batch_size": "requests per dispatched micro-batch",
     "coordinate_update_s": "wall seconds per coordinate-descent update",
+    "shadow_score_drift": "per-request |champion - challenger| mean-score "
+    "drift observed at window evaluation",
+    "shadow_calibration_champion": "per-request |champion mean - label| "
+    "calibration error per evaluated window",
+    "shadow_calibration_challenger": "per-request |challenger mean - label| "
+    "calibration error per evaluated window",
     # -- gauges (last-write-wins) --
     "serving_pending_depth": "batcher queue depth observed at batch claim",
     "serving_bundle_generation": "live bundle generation after a hot-swap",

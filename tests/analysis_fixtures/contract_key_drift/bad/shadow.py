@@ -1,0 +1,11 @@
+"""Known-bad: re-types two shadow-deploy schema keys (the r18
+FIXTURE_SHADOW_KEYS shape) as a literal instead of importing the
+tuple."""
+
+
+def check_shadow(block):
+    evidence = {
+        k: block[k]
+        for k in ("fixture_shadow_windows", "fixture_shadow_verdict")
+    }  # re-typed shadow schema
+    return evidence

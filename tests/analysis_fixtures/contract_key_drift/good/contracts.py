@@ -19,3 +19,6 @@ FIXTURE_REFRESH_KEYS = ("fixture_delta_rows", "fixture_delta_bytes", "fixture_de
 
 # Multihost-section schema (r17): the DCN production-mode section keys.
 FIXTURE_MULTIHOST_KEYS = ("fixture_mh_hosts", "fixture_mh_repeated_sweeps", "fixture_mh_failed")
+
+# Shadow-deploy schema (r18): the online shadow evaluation block keys.
+FIXTURE_SHADOW_KEYS = ("fixture_shadow_windows", "fixture_shadow_verdict", "fixture_shadow_drift")

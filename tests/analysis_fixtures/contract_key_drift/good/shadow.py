@@ -1,0 +1,10 @@
+"""Known-good: the shadow-deploy schema is imported; single-key reads
+are use, not duplication."""
+
+from contracts import FIXTURE_SHADOW_KEYS
+
+
+def check_shadow(block):
+    missing = [k for k in FIXTURE_SHADOW_KEYS if k not in block]
+    drift = block.get("fixture_shadow_drift")  # one key is vocabulary
+    return missing, drift

@@ -148,7 +148,11 @@ def _failure_domain_hygiene(monkeypatch):
     * no `photon-hostmesh-*` heartbeat outlives the test — a multi-host
       worker's HostHeartbeat is stopped by its owner (the worker's
       finally); a survivor would keep writing beat files into a
-      torn-down rendezvous and could declare phantom host losses.
+      torn-down rendezvous and could declare phantom host losses;
+    * no `photon-shadow-*` evaluation worker outlives the test — a
+      ShadowController's window-evaluation thread is joined by
+      `close()`; a survivor means mirrored windows kept scoring (and
+      could journal verdicts) against a torn-down registry.
     """
     from photon_ml_tpu.utils import faults, telemetry
 
@@ -187,6 +191,14 @@ def _failure_domain_hygiene(monkeypatch):
         "PHOTON_MULTIHOST",
         "PHOTON_HOST_HEARTBEAT_MS",
         "PHOTON_HOST_LOSS_RETRIES",
+        # Shadow deployment (ISSUE 18): ambient decision-loop tuning in
+        # the developer's shell must never reshape verdict hysteresis,
+        # regression tolerance, cooldowns, or mirror sampling inside
+        # unrelated tests.
+        "PHOTON_SHADOW_MIN_WINDOWS",
+        "PHOTON_SHADOW_REGRESSION_TOL",
+        "PHOTON_SHADOW_COOLDOWN_S",
+        "PHOTON_SHADOW_MIRROR_FRACTION",
     ):
         monkeypatch.delenv(var, raising=False)
     from photon_ml_tpu import planner as _planner
@@ -214,6 +226,7 @@ def _failure_domain_hygiene(monkeypatch):
                     "photon-tenant",
                     "photon-refresh",
                     "photon-hostmesh",
+                    "photon-shadow",
                 )
             )
             and t.is_alive()

@@ -316,6 +316,34 @@ class TestIncrementalFit:
             np.unique(np.asarray(merged.id_tags["eid"])).tolist()
         )
 
+    def test_full_mode_discards_stale_checkpoint_from_prior_round(
+        self, rng, tmp_path
+    ):
+        """Two consecutive full-mode rounds sharing one checkpoint_dir
+        (the refresh-loop shape): round 2's merged dataset has a new
+        config fingerprint, so round 1's leftover checkpoint is stale by
+        construction — the full refit must discard it and start fresh
+        instead of refusing to resume."""
+        ckpt_dir = str(tmp_path / "ckpt")
+        base = _base(rng)
+        st = _fit(base)
+        merged1 = concat_datasets(base, _delta_batch(rng))
+        res1 = _refit(
+            merged1, st, max_delta_fraction=0.01, checkpoint_dir=ckpt_dir
+        )
+        assert res1.plan.mode == "full"
+        merged2 = concat_datasets(merged1, _delta_batch(rng))
+        res2 = _refit(
+            merged2, res1.state,
+            max_delta_fraction=0.01, checkpoint_dir=ckpt_dir,
+        )
+        assert res2.plan.mode == "full"
+        # The second round refit everything over the bigger index —
+        # stale state from round 1 neither resumed nor blocked it.
+        assert set(res2.state.entity_indices["per-e"]) == set(
+            np.unique(np.asarray(merged2.id_tags["eid"])).tolist()
+        )
+
     def test_delta_records_and_journal(self, rng, tmp_path):
         base = _base(rng)
         st = _fit(base)

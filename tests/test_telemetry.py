@@ -379,6 +379,18 @@ class TestJournal:
         "name": "ckpt-commit",
         "num_hosts": 2,
         "restaged_rows": 11,
+        # -- shadow deployment & online evaluation (ISSUE 18) --
+        "champion": "live",
+        "challenger": "cand",
+        "window_size": 64,
+        "min_windows": 3,
+        "mirror_fraction": 1.0,
+        "window": 2,
+        "champion_metric": 0.93,
+        "challenger_metric": 0.88,
+        "evaluator": "AUC",
+        "healthy": False,
+        "windows": 3,
     }
 
     def test_every_event_type_round_trips_its_schema(self, tmp_path):
