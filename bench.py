@@ -51,6 +51,7 @@ _CONTINUOUS_LOOP_CHILD = "--run-continuous-loop"
 _MULTIHOST_CHAOS_CHILD = "--run-multihost-chaos"
 _SHADOW_DEPLOY_CHILD = "--run-shadow-deploy"
 _SHADOW_PROMOTE_WORKER = "--run-shadow-promote-worker"
+_AUTOPILOT_CHILD = "--run-autopilot"
 
 # Physical HBM roofline per chip (GB/s): v5e HBM2 peak ~819 GB/s. Any
 # achieved-bandwidth figure above it is a measurement artifact (rtt
@@ -1911,6 +1912,369 @@ def _shadow_deploy_child() -> None:
     )
 
 
+def _autopilot_child() -> None:
+    """Closed-loop autoscaling certificate (ISSUE 19) on an 8-virtual-
+    device mesh. Three drills against live fleets, one JSON line:
+
+      A. a load shift between two tenants — a request burst onto a
+         replicated tenant plus cold-row pressure on a two-tier tenant —
+         makes the autopilot reshard the hot tenant across the mesh AND
+         re-place the two-tier hot set from measured promotion stats,
+         with zero failed client requests, bitwise-unchanged answers,
+         and a post-reshard p99 inside the probe's regression bound;
+      B. an induced HBM squeeze (the fleet budget clamped so pinned
+         bytes sit at 0.9 of it) walks the capacity ladder: the coldest
+         tenant is demoted to the host tier, and on the next tick the
+         reclaimed headroom restores it — answers bitwise through both
+         legs, ladder ceiling respected;
+      C. a deliberately bad rule (retunes the flush wait to an absurd
+         250 ms) is caught by the post-action contract probe, rolled
+         back (planner value and registry wait restored), and
+         QUARANTINED — its still-screaming signal is suppressed on the
+         next tick, and clients never see a changed answer.
+
+    Every decision is journaled; the journal must validate against the
+    contracts schemas, and every robustness counter must be zero across
+    the clean drills (A and B).
+    """
+    import shutil
+    import tempfile
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from photon_ml_tpu import planner
+    from photon_ml_tpu.autopilot import (
+        Action,
+        Autopilot,
+        ControlRule,
+        hbm_demote_rule,
+        hbm_restore_rule,
+        rebalance_rule,
+        shard_grow_rule,
+    )
+    from photon_ml_tpu.game.model import (
+        Coefficients,
+        FixedEffectModel,
+        GameModel,
+        RandomEffectModel,
+    )
+    from photon_ml_tpu.serving import (
+        ScoreRequest,
+        ServingBundle,
+        ServingEngine,
+        TenantRegistry,
+    )
+    from photon_ml_tpu.transformers.game_transformer import (
+        CoordinateScoringSpec,
+    )
+    from photon_ml_tpu.types import TaskType
+    from photon_ml_tpu.utils import faults, telemetry
+    from photon_ml_tpu.utils.contracts import ROBUSTNESS_CLEAN_ZERO_KEYS
+
+    task = TaskType.LOGISTIC_REGRESSION
+    ndev = len(jax.devices())
+    faults.install("")
+    faults.reset_counters()
+
+    scratch = tempfile.mkdtemp(prefix="photon-autopilot-")
+    journal_path = os.path.join(scratch, "journal.jsonl")
+    journal = telemetry.RunJournal(journal_path)
+    telemetry.install_journal(journal)
+
+    d_fe, d_re, n_ent = 8, 6, 48
+
+    def build_bundle(seed):
+        rng = np.random.default_rng(seed)
+        w = rng.normal(size=d_fe).astype(np.float32)
+        M = np.zeros((n_ent + 1, d_re), np.float32)
+        M[:n_ent] = rng.normal(size=(n_ent, d_re))
+        model = GameModel(
+            {
+                "fixed": FixedEffectModel(Coefficients(jnp.asarray(w)), task),
+                "per-e": RandomEffectModel(jnp.asarray(M), None, task),
+            }
+        )
+        specs = {
+            "fixed": CoordinateScoringSpec(shard="g"),
+            "per-e": CoordinateScoringSpec(
+                shard="re",
+                random_effect_type="eid",
+                entity_index={str(i): i for i in range(n_ent)},
+            ),
+        }
+        return ServingBundle.from_model(model, specs, task)
+
+    def requests(seed, n, lo=0, hi=n_ent):
+        rng = np.random.default_rng(seed)
+        X = rng.normal(size=(n, d_fe)).astype(np.float32)
+        Xe = rng.normal(size=(n, d_re)).astype(np.float32)
+        ids = rng.integers(lo, hi, size=n)
+        return [
+            ScoreRequest(
+                features={"g": X[i], "re": Xe[i]},
+                entity_ids={"eid": str(int(ids[i]))},
+                offset=float(i) * 0.125,
+                uid=f"r{seed}-{i}",
+            )
+            for i in range(n)
+        ]
+
+    def solo(seed, reqs):
+        """Reference answers: the same weights served alone."""
+        eng = ServingEngine(build_bundle(seed), max_batch=32)
+        with eng:
+            out = np.asarray(
+                [r.score for r in eng.score_batch(reqs)], np.float64
+            )
+        return out
+
+    def scores(reg, name, reqs):
+        return np.asarray(
+            [reg.score(name, r).score for r in reqs], np.float64
+        )
+
+    def walls(reg, name, reqs):
+        out = []
+        for r in reqs:
+            t0 = time.monotonic()
+            reg.score(name, r)
+            out.append(time.monotonic() - t0)
+        return np.asarray(out, np.float64)
+
+    def counters_now(name):
+        return telemetry.METRICS.snapshot()["counters"].get(name, 0)
+
+    reqs_a = requests(191, 16)
+    reqs_b_cold = requests(193, 24, lo=8)  # beyond b's hot set: pressure
+    ref_a = solo(1, reqs_a)
+    ref_b_cold = solo(2, reqs_b_cold)
+
+    # ---- drill A: load shift -> shard grow + hot-row rebalance ----------
+    registry = TenantRegistry(max_batch=32, max_wait_ms=2.0)  # photon-lint: disable=planner-constant — deliberate section config: fixed wait pins the measurement, not a runtime default
+    registry.admit("a", build_bundle(1))
+    registry.admit("b", build_bundle(2))
+    registry.demote("b", hot_rows=8, reason="bench-setup")
+
+    pilot = Autopilot(
+        registry,
+        rules=[
+            shard_grow_rule(fire_above=32.0, rearm_below=4.0),
+            rebalance_rule(fire_above=4.0, rearm_below=1.0),
+        ],
+        cooldown_s=30.0,
+        max_actions=4,
+        probe_requests={"a": reqs_a[0], "b": reqs_b_cold[0]},
+        start=False,
+    )
+
+    pilot.tick()  # baseline snapshot: deltas need a `prev`
+    base_walls = walls(registry, "a", reqs_a)
+
+    # The shift: a burst onto tenant a, cold-row traffic onto tenant b.
+    for r in requests(194, 96):
+        registry.score("a", r)
+    got_b = scores(registry, "b", reqs_b_cold)
+
+    def promotions():
+        t = registry.tenant("b")
+        return sum(
+            sum(c.store.promotion_stats().values())
+            for c in t.engine._state.bundle.coordinates.values()
+            if getattr(c, "store", None) is not None
+        )
+
+    deadline = time.monotonic() + 30.0  # promote worker is async
+    while promotions() < 4 and time.monotonic() < deadline:
+        time.sleep(0.05)
+
+    pilot.tick()  # the loop reacts: reshard a, rebalance b
+    promotions_seen = int(promotions())
+
+    t_a = registry.tenant("a")
+    resharded = any(
+        c.mesh is not None
+        for c in t_a.engine._state.bundle.coordinates.values()
+    )
+    post_walls = walls(registry, "a", reqs_a)
+    got_a = scores(registry, "a", reqs_a)
+    got_b2 = scores(registry, "b", reqs_b_cold)
+    pre_p99 = float(np.quantile(base_walls, 0.99))
+    post_p99 = float(np.quantile(post_walls, 0.99))
+    # Same bound the in-loop contract probe enforces.
+    p99_recovered = bool(post_p99 <= max(pre_p99 * 5.0, pre_p99 + 0.05))
+    load_shift_bitwise = bool(
+        np.array_equal(got_a, ref_a)
+        and np.array_equal(got_b, ref_b_cold)
+        and np.array_equal(got_b2, ref_b_cold)
+    )
+    sum_a = pilot.summary()
+    pilot.close()
+
+    # ---- drill B: HBM squeeze -> demote, then headroom -> restore -------
+    reqs_b2 = requests(195, 8)
+    reg2 = TenantRegistry(max_batch=32, max_wait_ms=2.0)  # photon-lint: disable=planner-constant — deliberate section config: fixed wait pins the measurement, not a runtime default
+    reg2.admit("a2", build_bundle(3))
+    reg2.admit("b2", build_bundle(4))
+    ref_b2 = scores(reg2, "b2", reqs_b2)
+    _ = scores(reg2, "a2", requests(196, 8))  # a2 most recent: b2 coldest
+    used = sum(reg2.tenant(n).device_bytes() for n in ("a2", "b2"))
+    # Induce the squeeze: clamp the fleet budget so pinned bytes sit at
+    # 0.9 of it — above the demote rule's 0.85 fire band.
+    reg2._hbm_budget_override = int(used / 0.9)
+    pilot2 = Autopilot(
+        reg2,
+        rules=[hbm_demote_rule(), hbm_restore_rule()],
+        cooldown_s=0.0,
+        max_actions=4,
+        probe_requests={"b2": reqs_b2[0]},
+        start=False,
+    )
+    pilot2.tick()  # pressure 0.9 -> demote the coldest tenant
+    t_b2 = reg2.tenant("b2")
+    hbm_demoted = bool(t_b2.demoted)
+    mid_b2 = scores(reg2, "b2", reqs_b2)  # host-tier answers, mid-squeeze
+    pilot2.tick()  # headroom ~0.55 -> restore under the 0.8 ceiling
+    t_b2 = reg2.tenant("b2")
+    restored_single_tier = not t_b2.demoted and all(
+        getattr(c, "store", None) is None
+        for c in t_b2.engine._state.bundle.coordinates.values()
+    )
+    post_b2 = scores(reg2, "b2", reqs_b2)
+    hbm_restored_bitwise = bool(
+        restored_single_tier
+        and np.array_equal(mid_b2, ref_b2)
+        and np.array_equal(post_b2, ref_b2)
+    )
+    sum_b = pilot2.summary()
+    pilot2.close()
+
+    # Clean phase ends here: A and B must not have tripped a single
+    # robustness counter (demote/restore ladder actions are *policy*,
+    # not failures — they are deliberately not clean-zero keys).
+    counters = telemetry.METRICS.snapshot()["counters"]
+    clean_counters_zero = all(
+        int(counters.get(k, 0)) == 0 for k in ROBUSTNESS_CLEAN_ZERO_KEYS
+    )
+
+    # ---- drill C: a bad rule is rolled back and quarantined -------------
+    # On the drill-B fleet: its tenants end the ladder single-tier and
+    # un-resharded, so they still ride the co-batch path the wait
+    # retune governs (a mesh-sharded or demoted tenant dispatches solo
+    # through its own batcher and would never feel the bad wait).
+    wait_before_ms = reg2.max_wait_s * 1e3
+    plan_before = planner.planned_value("serving_max_wait_ms")
+
+    def bad_decide(cur, prev, sig):
+        return Action(
+            kind="retune",
+            params={"serving_max_wait_ms": 250.0},
+            evidence={"note": "deliberately absurd flush wait", "sig": sig},
+        )
+
+    # Scripted signal: scream, dip below the re-arm band, scream again —
+    # the dip re-arms the rule so the third tick exercises the
+    # quarantine SUPPRESSION path (a quarantined rule never actuates,
+    # however loud its signal).
+    sig_script = iter([999.0, 0.0, 999.0])
+    bad = ControlRule(
+        name="bad-wait-spike",
+        signal=lambda cur, prev: next(sig_script),
+        fire_above=1.0,
+        rearm_below=0.0,
+        decide=bad_decide,
+    )
+    pilot3 = Autopilot(
+        reg2,
+        rules=[bad],
+        cooldown_s=0.0,
+        max_actions=4,
+        probe_requests={"b2": reqs_b2[0]},
+        start=False,
+    )
+    pilot3.tick()  # applies the 250 ms wait -> probe latency blows up
+    plan_after = planner.planned_value("serving_max_wait_ms")
+    bad_rule_rolled_back = bool(
+        int(counters_now("autopilot_rollbacks")) == 1
+        and abs(reg2.max_wait_s * 1e3 - wait_before_ms) < 1e-9
+        and plan_after == plan_before
+    )
+    pilot3.tick()  # calm signal re-arms the (still-quarantined) rule
+    pilot3.tick()  # screaming again: quarantine suppresses it
+    sum_c = pilot3.summary()
+    bad_rule_quarantined = bool(
+        bad.quarantined
+        and sum_c["last_outcome"] == "suppressed_quarantined"
+        and int(counters_now("autopilot_quarantines")) == 1
+    )
+    post_c = scores(reg2, "b2", reqs_b2)
+    bad_rule_client_bitwise = bool(np.array_equal(post_c, ref_b2))
+    pilot3.close()
+
+    failed_requests = 0
+    for reg in (registry, reg2):
+        for tb in reg.metrics()["tenants"].values():
+            failed_requests += int(tb["failed"])
+
+    registry.close(release_bundles=True)
+    reg2.close(release_bundles=True)
+
+    telemetry.uninstall_journal()
+    journal.close()
+    _n_ok, errors = telemetry.validate_journal(journal_path)
+    with open(journal_path, "r", encoding="utf-8") as fh:
+        events = [json.loads(l) for l in fh if l.strip()]
+    decisions = [e for e in events if e["type"] == "autopilot_decision"]
+    applied = [e for e in decisions if e["outcome"] == "applied"]
+
+    def applied_kind(kind):
+        return sum(
+            1
+            for e in applied
+            if (e.get("action") or {}).get("kind") == kind
+        )
+
+    reshard_actions = applied_kind("reshard")
+    rebalance_actions = applied_kind("rebalance")
+    evidenced = all(
+        isinstance(e.get("evidence"), dict) and e["evidence"]
+        for e in decisions
+    )
+    shutil.rmtree(scratch, ignore_errors=True)
+
+    print(
+        json.dumps(
+            dict(
+                n_devices=ndev,
+                ticks=int(sum_a["ticks"] + sum_b["ticks"] + sum_c["ticks"]),
+                load_shift_detected=bool(
+                    resharded and reshard_actions >= 1
+                ),
+                reshard_actions=reshard_actions,
+                rebalance_actions=rebalance_actions,
+                failed_requests=failed_requests,
+                p99_recovered=p99_recovered,
+                hbm_demoted=hbm_demoted,
+                hbm_restored_bitwise=hbm_restored_bitwise,
+                bad_rule_rolled_back=bad_rule_rolled_back,
+                bad_rule_quarantined=bad_rule_quarantined,
+                decisions_journaled=len(decisions),
+                decisions_valid=bool(not errors and evidenced),
+                clean_counters_zero=bool(clean_counters_zero),
+                # Extra diagnostics (beyond the AUTOPILOT_SECTION_KEYS
+                # floor).
+                load_shift_bitwise=load_shift_bitwise,
+                bad_rule_client_bitwise=bad_rule_client_bitwise,
+                pre_p99_ms=pre_p99 * 1e3,
+                post_p99_ms=post_p99 * 1e3,
+                promotions_seen=promotions_seen,
+                journal_errors=errors[:3],
+            )
+        )
+    )
+
+
 def _child() -> None:
     import numpy as np
     import jax
@@ -3297,6 +3661,123 @@ def _child() -> None:
             failed=True, reason=f"{type(exc).__name__}: {exc}"
         )
 
+    # ---- autopilot: closed-loop autoscaling — the planner goes online -----
+    # Own 8-virtual-device subprocess (ISSUE 19): the supervised control
+    # loop reads live telemetry, evaluates declarative rules behind
+    # hysteresis bands, and drives the EXISTING actuators — reshard,
+    # hot-row rebalance, the HBM demote/restore ladder, the planner's
+    # online retune. The contract: a load shift triggers automatic
+    # reshard + rebalance with zero failed requests and a recovered p99,
+    # an HBM squeeze demotes and later restores the cold tenant bitwise,
+    # a deliberately bad rule is rolled back by the post-action contract
+    # probe and quarantined, every decision is journaled with evidence,
+    # and the clean phases trip no robustness counter.
+    try:
+        env_ap = dict(os.environ)
+        env_ap["JAX_PLATFORMS"] = "cpu"
+        env_ap.pop("PALLAS_AXON_POOL_IPS", None)
+        flags_ap = env_ap.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags_ap:
+            env_ap["XLA_FLAGS"] = (
+                flags_ap + " --xla_force_host_platform_device_count=8"
+            ).strip()
+        env_ap.pop("PHOTON_FAULTS", None)  # drills arm their own faults
+        out_ap = subprocess.run(
+            [
+                sys.executable,
+                os.path.abspath(__file__),
+                _AUTOPILOT_CHILD,
+            ],
+            capture_output=True,
+            text=True,
+            timeout=600,
+            env=env_ap,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        line_ap = next(
+            (l for l in out_ap.stdout.splitlines() if l.startswith("{")), None
+        )
+        if line_ap is None:
+            raise RuntimeError(
+                f"autopilot child produced no JSON: {out_ap.stderr[-1500:]}"
+            )
+        ap = json.loads(line_ap)
+        from photon_ml_tpu.utils.contracts import AUTOPILOT_SECTION_KEYS
+
+        missing_ap = [k for k in AUTOPILOT_SECTION_KEYS if ap.get(k) is None]
+        if missing_ap:
+            raise RuntimeError(
+                f"autopilot section is missing keys {missing_ap} — the "
+                "closed-loop contract is broken"
+            )
+        if not ap["load_shift_detected"] or ap["reshard_actions"] < 1:
+            raise RuntimeError(
+                "the load shift did NOT trigger an automatic reshard — "
+                "the planner never went online"
+            )
+        if ap["rebalance_actions"] < 1:
+            raise RuntimeError(
+                "promotion pressure did not trigger a hot-row rebalance — "
+                "the two-tier placement loop is open"
+            )
+        if ap["failed_requests"]:
+            raise RuntimeError(
+                f"{ap['failed_requests']} client requests failed while the "
+                "autopilot actuated — actuation is not transparent"
+            )
+        if not ap["p99_recovered"]:
+            raise RuntimeError(
+                f"post-reshard p99 ({ap['post_p99_ms']:.1f} ms) blew the "
+                f"probe bound over the baseline ({ap['pre_p99_ms']:.1f} ms)"
+            )
+        if not ap["hbm_demoted"]:
+            raise RuntimeError(
+                "the induced HBM squeeze did not demote the cold tenant — "
+                "the capacity ladder's downward leg is broken"
+            )
+        if not ap["hbm_restored_bitwise"]:
+            raise RuntimeError(
+                "the demoted tenant was not restored bitwise when headroom "
+                "returned — the capacity ladder's upward leg is broken"
+            )
+        if not ap["bad_rule_rolled_back"]:
+            raise RuntimeError(
+                "the bad rule's retune survived the post-action contract "
+                "probe — rollback is broken"
+            )
+        if not ap["bad_rule_quarantined"]:
+            raise RuntimeError(
+                "the bad rule was not quarantined after its rollback — "
+                "the loop will keep re-firing a known-bad policy"
+            )
+        if ap["decisions_journaled"] <= 0 or not ap["decisions_valid"]:
+            raise RuntimeError(
+                "autopilot decisions missing from the journal or invalid "
+                "against the contracts schemas — the loop is unauditable"
+            )
+        if not ap["clean_counters_zero"]:
+            raise RuntimeError(
+                "robustness counters were nonzero across the clean drills — "
+                "the autopilot hides failures in a healthy run"
+            )
+        variants["autopilot"] = ap
+        _mark(
+            f"autopilot survived ({ap['n_devices']} vdev, {ap['ticks']} "
+            f"ticks: load shift -> {ap['reshard_actions']} reshard + "
+            f"{ap['rebalance_actions']} rebalance with 0 failed requests "
+            f"and p99 {ap['pre_p99_ms']:.1f}->{ap['post_p99_ms']:.1f} ms, "
+            "HBM squeeze demoted and restored the cold tenant bitwise, "
+            "bad rule rolled back and quarantined, "
+            f"{ap['decisions_journaled']} decisions journaled valid)"
+        )
+    except Exception as exc:  # noqa: BLE001 - bench must still print a line
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        variants["autopilot"] = dict(
+            failed=True, reason=f"{type(exc).__name__}: {exc}"
+        )
+
     # ---- multihost chaos: whole OS processes as the failure domain --------
     # The ISSUE 17 production certificate, driven through the real CLI
     # supervisors: 2-process fit bitwise vs single-process with disjoint
@@ -4520,6 +5001,9 @@ def main() -> None:
         return
     if _SHADOW_PROMOTE_WORKER in sys.argv:
         _shadow_promote_worker()
+        return
+    if _AUTOPILOT_CHILD in sys.argv:
+        _autopilot_child()
         return
     if _CHILD in sys.argv:
         _child()

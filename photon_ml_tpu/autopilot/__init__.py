@@ -1,0 +1,52 @@
+"""photon-autopilot: closed-loop autoscaling (ISSUE 19).
+
+The planner (ISSUE 14) decides once at startup; this package puts it
+online. A supervised control loop (`Autopilot`, the `photon-autopilot`
+thread) reads live telemetry through a typed `SensorSnapshot` —
+per-tenant labeled latency histograms, ShardHealth request loads,
+two-tier promotion pressure, HBM budget headroom — evaluates declarative
+`ControlRule`s (shard grow from load skew, hot-row rebalance on
+promotion pressure, the HBM demote/restore ladder, batch-wait retune
+from fresh p95s), and drives the existing serving actuators with
+control-theory hygiene: hysteresis bands, cooldowns, a bounded action
+budget, one actuator mutex, every decision journaled with its evidence,
+and automatic rollback + rule quarantine when the post-action contract
+probe regresses. See `sensors.py`, `rules.py`, `loop.py`.
+"""
+
+from photon_ml_tpu.autopilot.loop import OUTCOMES, Autopilot  # noqa: F401
+from photon_ml_tpu.autopilot.rules import (  # noqa: F401
+    ACTION_KINDS,
+    Action,
+    ControlRule,
+    default_rules,
+    hbm_demote_rule,
+    hbm_restore_rule,
+    rebalance_rule,
+    retune_rule,
+    shard_grow_rule,
+)
+from photon_ml_tpu.autopilot.sensors import (  # noqa: F401
+    CoordinateSensors,
+    SensorSnapshot,
+    TenantSensors,
+    read_sensors,
+)
+
+__all__ = [
+    "ACTION_KINDS",
+    "Action",
+    "Autopilot",
+    "ControlRule",
+    "CoordinateSensors",
+    "OUTCOMES",
+    "SensorSnapshot",
+    "TenantSensors",
+    "default_rules",
+    "hbm_demote_rule",
+    "hbm_restore_rule",
+    "read_sensors",
+    "rebalance_rule",
+    "retune_rule",
+    "shard_grow_rule",
+]

@@ -1,0 +1,177 @@
+"""Typed sensor surface of the closed-loop autopilot (ISSUE 19).
+
+`read_sensors` distills everything the control rules are allowed to see
+into one immutable `SensorSnapshot`: per-tenant latency quantiles from
+the LABELED telemetry histograms (the ISSUE 19 label extension — the
+controller reads tenant p95s, not process-global ones), per-shard
+request loads from each coordinate's ShardHealth, two-tier promotion
+pressure from the store's promotion stats, HBM budget vs. pinned bytes
+from the tenant registry, and the aggregate queue-wait/batch-size
+quantiles the retune rule consumes.
+
+Snapshots are CUMULATIVE — loads, promotions, and request counts are
+monotone counters, and the control loop hands each rule the previous
+snapshot beside the current one so rules work on deltas (rates), never
+on absolute totals that grow forever. A rule that receives `prev=None`
+(the loop's first tick) must decline to fire: there is no rate yet.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+from photon_ml_tpu.utils import telemetry
+
+__all__ = [
+    "CoordinateSensors",
+    "TenantSensors",
+    "SensorSnapshot",
+    "read_sensors",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class CoordinateSensors:
+    """One random-effect coordinate's placement + load facts."""
+
+    cid: str
+    n_shards: int
+    sharded: bool  # entity-sharded over a mesh
+    two_tier: bool  # demoted to a TwoTierEntityStore
+    shard_loads: Tuple[int, ...]  # cumulative per-shard request rows
+    promotions: int  # cumulative cold->hot promotions (two-tier only)
+    device_bytes: int
+
+    @property
+    def total_load(self) -> int:
+        return sum(self.shard_loads)
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantSensors:
+    """One tenant's health/load/capacity facts."""
+
+    name: str
+    demoted: bool
+    can_demote: bool
+    last_active: float  # monotonic seconds of the last submit
+    completed: int
+    failed: int
+    in_flight: int
+    pending: int
+    device_bytes: int
+    p95_ms: Optional[float]  # per-tenant, from the labeled histogram
+    p99_ms: Optional[float]
+    coords: Tuple[CoordinateSensors, ...]
+
+    @property
+    def requests(self) -> int:
+        return self.completed + self.failed
+
+
+@dataclasses.dataclass(frozen=True)
+class SensorSnapshot:
+    """Everything one control-loop tick may base a decision on."""
+
+    tenants: Dict[str, TenantSensors]
+    hbm_budget: Optional[int]  # None = unknown (no device budget)
+    hbm_used: int
+    latency_p95_ms: Optional[float]  # process-global aggregates
+    latency_p99_ms: Optional[float]
+    queue_wait_p95_ms: Optional[float]
+    batch_p50: Optional[float]
+    failed_requests: int
+
+    @property
+    def hbm_pressure(self) -> Optional[float]:
+        """Pinned bytes / budget, or None when the budget is unknown."""
+        if self.hbm_budget is None or self.hbm_budget <= 0:
+            return None
+        return self.hbm_used / float(self.hbm_budget)
+
+
+def _quantile(name: str, q: float) -> Optional[float]:
+    hist = telemetry.METRICS.histogram(name)
+    return None if hist is None else hist.quantile(q)
+
+
+def _labeled_quantiles(name: str, q: float) -> Dict[str, float]:
+    """Per-label quantiles of one histogram, keyed by label
+    ("tenant=a" -> p_q)."""
+    out: Dict[str, float] = {}
+    for key, snap in telemetry.METRICS.labeled_histograms(name).items():
+        v = telemetry.snapshot_quantile(snap, q)
+        if v is not None:
+            out[key] = v
+    return out
+
+
+def read_sensors(registry) -> SensorSnapshot:
+    """One coherent sensor read over a TenantRegistry fleet.
+
+    Reads only published surfaces: telemetry histograms (aggregate +
+    labeled), Tenant bookkeeping fields, and each engine's live bundle
+    coordinates (shard health loads, two-tier promotion stats). Never
+    takes an engine's swap mutex — sensing must not serialize with the
+    actuators it feeds."""
+    p95_by_label = _labeled_quantiles("serving_latency_ms", 0.95)
+    p99_by_label = _labeled_quantiles("serving_latency_ms", 0.99)
+    tenants: Dict[str, TenantSensors] = {}
+    hbm_used = 0
+    failed_total = 0
+    for name in registry.tenant_names:
+        try:
+            t = registry.tenant(name)
+        except KeyError:  # removed between the listing and the read
+            continue
+        coords = []
+        bundle = t.engine._state.bundle
+        for cid, c in bundle.coordinates.items():
+            if not c.is_random_effect:
+                continue
+            sh = c.shard_health
+            store = c.store
+            coords.append(
+                CoordinateSensors(
+                    cid=cid,
+                    n_shards=sh.n_shards if sh is not None else 1,
+                    sharded=c.mesh is not None,
+                    two_tier=store is not None,
+                    shard_loads=sh.loads if sh is not None else (),
+                    promotions=(
+                        sum(store.promotion_stats().values())
+                        if store is not None
+                        else 0
+                    ),
+                    device_bytes=c.device_nbytes(),
+                )
+            )
+        device_bytes = t.device_bytes()
+        hbm_used += device_bytes
+        failed_total += t.failed
+        label = f"tenant={t.name}"
+        tenants[name] = TenantSensors(
+            name=t.name,
+            demoted=t.demoted,
+            can_demote=t.can_demote(),
+            last_active=t.last_active,
+            completed=t.completed,
+            failed=t.failed,
+            in_flight=t.in_flight,
+            pending=len(t.queue),
+            device_bytes=device_bytes,
+            p95_ms=p95_by_label.get(label),
+            p99_ms=p99_by_label.get(label),
+            coords=tuple(coords),
+        )
+    return SensorSnapshot(
+        tenants=tenants,
+        hbm_budget=registry._fleet_budget(),
+        hbm_used=hbm_used,
+        latency_p95_ms=_quantile("serving_latency_ms", 0.95),
+        latency_p99_ms=_quantile("serving_latency_ms", 0.99),
+        queue_wait_p95_ms=_quantile("serving_queue_wait_ms", 0.95),
+        batch_p50=_quantile("serving_batch_size", 0.5),
+        failed_requests=failed_total,
+    )

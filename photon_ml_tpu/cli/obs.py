@@ -12,6 +12,12 @@ Three subcommands over the three file artifacts of utils/telemetry.py:
   * `profile <profile.json>` — pretty-print a run profile read through
     the loud `read_profile` contract (stage table, dispatch decisions,
     topology, roofline).
+  * `decisions <journal.jsonl>` — the control-plane timeline (ISSUE 19):
+    every `plan_decision`, `autopilot_decision`, and `shadow_verdict`
+    event in emit order, with the evidence each decision carried and
+    its outcome, plus the autopilot's rollback/quarantine annotations.
+    Exits nonzero when ANY journal line is schema-invalid — an operator
+    auditing the controller must not read a corrupt journal as clean.
   * `profile diff <a> <b>` — typed key-wise comparison of two run
     profiles: per-stage wall deltas, dispatch-decision changes,
     plan-block decision changes (added/removed/value- or source-
@@ -117,6 +123,107 @@ def cmd_journal(args) -> int:
     for err in errors[:20]:
         print(f"  INVALID: {err}")
     if args.validate and errors:
+        return 1
+    return 0
+
+
+# Event types rendered as first-class timeline rows; the autopilot's
+# rollback/quarantine events ride along as indented annotations so the
+# operator sees WHY a rule went quiet right under the decision stream.
+_DECISION_TYPES = ("plan_decision", "autopilot_decision", "shadow_verdict")
+_ANNOTATION_TYPES = ("autopilot_rollback", "rule_quarantined")
+
+
+def _fmt_evidence(ev) -> str:
+    if not ev:
+        return ""
+    if isinstance(ev, dict):
+        parts = []
+        for k in sorted(ev):
+            v = ev[k]
+            if isinstance(v, float):
+                parts.append(f"{k}={v:.4g}")
+            else:
+                parts.append(f"{k}={json.dumps(v, default=str)}")
+        return " ".join(parts)
+    return json.dumps(ev, default=str)
+
+
+def cmd_decisions(args) -> int:
+    n_ok, errors = telemetry.validate_journal(args.path)
+    rows: List[dict] = []
+    with open(args.path) as f:
+        for raw in f:
+            raw = raw.strip()
+            if not raw:
+                continue
+            try:
+                doc = json.loads(raw)
+            except ValueError:
+                continue  # already reported by validate_journal
+            if doc.get("type") in _DECISION_TYPES + _ANNOTATION_TYPES:
+                rows.append(doc)
+    counts: dict = {}
+    for doc in rows:
+        counts[doc["type"]] = counts.get(doc["type"], 0) + 1
+    print(
+        f"decisions: {len(rows)} control-plane event(s) "
+        f"({', '.join(f'{counts[t]} {t}' for t in sorted(counts)) or 'none'})"
+    )
+    t0 = rows[0].get("ts", 0.0) if rows else 0.0
+    for doc in rows:
+        try:
+            dt = float(doc.get("ts", t0)) - float(t0)
+        except (TypeError, ValueError):
+            dt = 0.0
+        etype = doc["type"]
+        if etype == "plan_decision":
+            line = (
+                f"plan      {doc.get('decision')} = "
+                f"{json.dumps(doc.get('value'), default=str)} "
+                f"[{doc.get('source')}] "
+                f"(fallback {json.dumps(doc.get('fallback'), default=str)})"
+            )
+        elif etype == "autopilot_decision":
+            action = doc.get("action") or {}
+            what = (
+                f"{action.get('kind')}"
+                + (f" tenant={action.get('tenant')}" if action.get("tenant") else "")
+                if isinstance(action, dict)
+                else "(no action)"
+            )
+            line = (
+                f"autopilot {doc.get('rule')}: {what} -> {doc.get('outcome')}"
+            )
+            ev = _fmt_evidence(doc.get("evidence"))
+            if ev:
+                line += f"  | {ev}"
+        elif etype == "shadow_verdict":
+            line = (
+                f"shadow    {doc.get('challenger')} vs "
+                f"{doc.get('champion')}: {doc.get('decision')} "
+                f"after {doc.get('windows')} window(s) "
+                f"({doc.get('evaluator')}: "
+                f"{doc.get('challenger_metric')} vs "
+                f"{doc.get('champion_metric')}) — {doc.get('reason')}"
+            )
+        elif etype == "autopilot_rollback":
+            action = doc.get("action") or {}
+            kind = action.get("kind") if isinstance(action, dict) else action
+            line = (
+                f"  ROLLBACK  {doc.get('rule')} ({kind}): "
+                f"{doc.get('reason')}"
+            )
+        else:  # rule_quarantined
+            line = (
+                f"  QUARANTINE {doc.get('rule')} after "
+                f"{doc.get('rollbacks')} rollback(s): {doc.get('reason')}"
+            )
+        print(f"  +{dt:9.3f}s  {line}")
+    if errors:
+        print(f"{len(errors)} schema-invalid journal line(s):")
+        for err in errors[:20]:
+            print(f"  INVALID: {err}")
         return 1
     return 0
 
@@ -274,6 +381,12 @@ def build_parser() -> argparse.ArgumentParser:
         action="store_true",
         help="exit 1 when any line fails its schema",
     )
+    d = sub.add_parser(
+        "decisions",
+        help="control-plane timeline: plan / autopilot / shadow decisions "
+        "with evidence and outcome (exits 1 on schema-invalid lines)",
+    )
+    d.add_argument("path")
     pr = sub.add_parser(
         "profile",
         help="pretty-print a run profile, or `profile diff <a> <b>`",
@@ -294,6 +407,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return cmd_trace(args)
     if args.cmd == "journal":
         return cmd_journal(args)
+    if args.cmd == "decisions":
+        return cmd_decisions(args)
     if args.paths[0] == "diff":
         if len(args.paths) != 3:
             parser.error("profile diff takes exactly two profile paths")

@@ -131,6 +131,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="joined rows per shadow evaluation window (default "
                         "64); the verdict needs PHOTON_SHADOW_MIN_WINDOWS "
                         "consecutive windows agreeing")
+    p.add_argument("--autopilot", action="store_true",
+                   help="closed-loop autoscaling (multi-tenant mode only): "
+                        "run the photon-autopilot control loop over the "
+                        "tenant fleet — shard grow from load skew, hot-row "
+                        "rebalance, the HBM demote/restore ladder, batch-"
+                        "wait retune — with hysteresis/cooldown/budget "
+                        "hygiene (PHOTON_AUTOPILOT_* knobs), every decision "
+                        "journaled; the summary gains an 'autopilot' block")
     p.add_argument("--multihost", type=int, default=0, metavar="N",
                    help="multi-host production serving: N share-nothing "
                         "OS-process hosts, each staging only its own "
@@ -292,6 +300,21 @@ def run(args) -> dict:
             raise ValueError(
                 "--shadow and --reshard-to both drive generation flips; "
                 "run them separately"
+            )
+    if getattr(args, "autopilot", False):
+        # Loud refusals (ISSUE 19): the autopilot supervises a tenant
+        # FLEET — its sensors and actuators are the TenantRegistry's;
+        # and it owns the reshard actuator, so the manual drill and the
+        # controller must not both drive generation flips.
+        if not tenants:
+            raise ValueError(
+                "--autopilot supervises a multi-tenant fleet; combine it "
+                "with --tenant"
+            )
+        if getattr(args, "reshard_to", None) is not None:
+            raise ValueError(
+                "--autopilot owns the reshard actuator; it cannot be "
+                "combined with the --reshard-to drill"
             )
     tenant_specs: List[tuple] = []
     for spec in tenants or []:
@@ -582,6 +605,8 @@ def _run_with_bundle(args, bundle: ServingBundle) -> dict:
         # The shadow-deployment block (ISSUE 18): always present so
         # absence is loud — empty here, SHADOW_BLOCK_KEYS under --shadow.
         "shadow": {},
+        # ISSUE 19: the autopilot block — empty on this open-loop path.
+        "autopilot": {},
     }
     if reshard_to is not None:
         summary["reshard"] = reshard_info
@@ -648,6 +673,8 @@ def _run_multi_tenant(args, tenant_specs, index_maps) -> dict:
         max_batch=args.max_batch, max_wait_ms=args.max_wait_ms
     )
     names: List[str] = []
+    pilot = None
+    autopilot_block: dict = {}
     try:
         for name, model_dir in tenant_specs:
             bundle = load_bundle(model_dir, index_maps=index_maps)
@@ -665,6 +692,19 @@ def _run_multi_tenant(args, tenant_specs, index_maps) -> dict:
                 bundle.upload_bytes / 1e6,
             )
         warmup_s = time.perf_counter() - t_warm
+
+        if getattr(args, "autopilot", False):
+            # Closed-loop autoscaling (ISSUE 19): the photon-autopilot
+            # worker ticks beside the replay, every decision journaled;
+            # knob-deferred hygiene (PHOTON_AUTOPILOT_*).
+            from photon_ml_tpu.autopilot import Autopilot
+
+            pilot = Autopilot(registry)
+            logger.info(
+                "autopilot armed: %d rule(s), tick %dms",
+                len(pilot.rules),
+                pilot.tick_ms,
+            )
 
         malformed = [0]
         if is_json:
@@ -743,6 +783,9 @@ def _run_multi_tenant(args, tenant_specs, index_maps) -> dict:
             for name in names
         }
     finally:
+        if pilot is not None:
+            pilot.close()
+            autopilot_block = pilot.summary()
         registry.close(release_bundles=True)
     logger.info(
         "replayed %d request(s) across %d tenant(s), %d failed, %d "
@@ -769,6 +812,9 @@ def _run_multi_tenant(args, tenant_specs, index_maps) -> dict:
         "provenance": provenance,
         # ISSUE 18: always present, empty off the --shadow path.
         "shadow": {},
+        # ISSUE 19: always present — AUTOPILOT_BLOCK_KEYS under
+        # --autopilot, empty on an open-loop replay.
+        "autopilot": autopilot_block,
     }
     with open(os.path.join(out_root, "serving-summary.json"), "w") as f:
         json.dump(summary, f, indent=2, default=str)
@@ -998,6 +1044,9 @@ def _run_with_shadow(args, index_maps) -> dict:
         "provenance": provenance,
         # The online-quality-gate evidence (SHADOW_BLOCK_KEYS).
         "shadow": shadow_block,
+        # ISSUE 19: the autopilot block — empty on the shadow path (the
+        # shadow controller owns this run's actuations).
+        "autopilot": {},
     }
     with open(os.path.join(out_root, "serving-summary.json"), "w") as f:
         json.dump(summary, f, indent=2, default=str)

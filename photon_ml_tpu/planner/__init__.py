@@ -23,6 +23,7 @@ from photon_ml_tpu.planner.plan import (  # noqa: F401
     Plan,
     PlanDecision,
     PlanTopologyError,
+    apply_online_decision,
     current_plan,
     default_for,
     inactive_block,
@@ -50,6 +51,7 @@ __all__ = [
     "PlanDecision",
     "PlanTopologyError",
     "TOPOLOGY_MATCH_FIELDS",
+    "apply_online_decision",
     "calibration_probe",
     "check_topology",
     "current_plan",

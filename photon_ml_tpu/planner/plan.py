@@ -278,6 +278,64 @@ def uninstall_plan() -> None:
         _ACTIVE = None
 
 
+def apply_online_decision(
+    name: str,
+    value: object,
+    *,
+    evidence: Optional[Dict[str, object]] = None,
+) -> Optional[PlanDecision]:
+    """The autopilot's online re-plan (ISSUE 19): update ONE planned
+    quantity mid-run, with exactly the startup precedence — an EXPLICITLY
+    SET `PHOTON_*` knob for the quantity pins it (operator intent
+    outranks the controller; returns None, nothing changes), otherwise
+    the decision lands in the ambient plan (installing a minimal
+    `source="autopilot"` plan when none is active) where every future
+    `planned_value` consult sees it, and is journaled as a
+    `plan_decision` with `source: "autopilot"` like any other decision.
+    Under `plan_suppressed` (the hand-tuned-default measurement scope)
+    this is a no-op. Returns the applied PlanDecision, whose `fallback`
+    is the value the decision displaced — what a rollback restores."""
+    global _ACTIVE
+    from photon_ml_tpu.utils import telemetry
+
+    knob = KNOB_FOR.get(name)
+    if knob is not None and knob_is_set(knob):
+        return None
+    if plan_suppression_active():
+        return None
+    with _LOCK:
+        plan = _ACTIVE
+        prior = plan.decisions.get(name) if plan is not None else None
+        fallback = prior.value if prior is not None else default_for(name)
+        d = PlanDecision(
+            decision=name,
+            value=normalize(name, value),
+            source="autopilot",
+            evidence=dict(evidence or {}),
+            fallback=fallback,
+        )
+        if plan is None:
+            plan = Plan(
+                source="autopilot",
+                profile_path=None,
+                topology={},
+                decisions={name: d},
+            )
+        else:
+            decisions = dict(plan.decisions)
+            decisions[name] = d
+            plan = dataclasses.replace(plan, decisions=decisions)
+        _ACTIVE = plan
+    telemetry.emit_event(
+        "plan_decision",
+        decision=d.decision,
+        value=d.value,
+        source=d.source,
+        fallback=d.fallback,
+    )
+    return d
+
+
 def current_plan() -> Optional[Plan]:
     return _ACTIVE
 

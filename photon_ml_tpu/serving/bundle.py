@@ -1000,6 +1000,40 @@ def demote_bundle_to_host_tier(
     return out
 
 
+def promote_bundle_from_host_tier(bundle: ServingBundle) -> ServingBundle:
+    """The exact inverse of `demote_bundle_to_host_tier`: rebuild every
+    two-tier coordinate as a single-tier device-resident matrix from the
+    store's host-RAM cold tier. BITWISE — the cold matrix IS the
+    original float32 rows (the two-tier store scores overrides straight
+    out of it), so a demote/restore round trip answers identically at
+    every step. The autopilot's HBM restore ladder (ISSUE 19): a cold
+    tenant demoted under pressure moves back up when headroom returns.
+    Single-tier and fixed-effect coordinates carry over by reference;
+    the OLD bundle still owns its stores (release them with the bundle,
+    close_stores=True, once the new generation serves)."""
+    coords: Dict[str, ServingCoordinate] = {}
+    for cid, c in bundle.coordinates.items():
+        if c.store is None:
+            coords[cid] = c
+            continue
+        full = jnp.asarray(c.store.cold_matrix)
+        coords[cid] = ServingCoordinate(
+            cid,
+            c.shard,
+            full,
+            norm=c.norm,
+            random_effect_type=c.random_effect_type,
+            entity_index=c.entity_index,
+        )
+    return ServingBundle(
+        task=bundle.task,
+        coordinates=coords,
+        index_maps=bundle.index_maps,
+        upload_bytes=sum(c.device_nbytes() for c in coords.values()),
+        upload_s=0.0,
+    )
+
+
 def serving_entity_mesh():
     """Env-gated serving mesh: PHOTON_SERVING_ENTITY_SHARD=1 stages RE
     matrices row-sharded over all local devices (no-op on one device)."""

@@ -90,6 +90,7 @@ from photon_ml_tpu.serving.bundle import (
     ScoreRequest,
     ServingBundle,
     demote_bundle_to_host_tier,
+    promote_bundle_from_host_tier,
 )
 from photon_ml_tpu.serving.engine import (
     ScoreResult,
@@ -530,6 +531,78 @@ class TenantRegistry:
         )
         return int(freed)
 
+    def restore(self, name: str, *, reason: str = "manual") -> int:
+        """Promote a demoted tenant's random-effect rows back to full
+        HBM residency (the exact inverse of `demote` — the rebuilt
+        single-tier matrices come bitwise from the two-tier store's cold
+        tier). Same discipline as demotion: serialized with hot-swaps on
+        the engine's swap mutex, the restored generation pre-warms before
+        the atomic flip, in-flight batches drain on the old one. The
+        autopilot's HBM-ladder restore actuator (ISSUE 19). Returns the
+        device bytes the restore re-pinned (0 if not demoted)."""
+        t = self._tenant(name)
+        if not t.demoted:
+            return 0
+        with t.engine.bundle_manager.mutex:
+            old_state = t.engine._state
+            old_bytes = _bundle_device_bytes(old_state.bundle)
+
+            def _build():
+                return promote_bundle_from_host_tier(old_state.bundle)
+
+            with telemetry.metric_label_scope(tenant=name):
+                restored_bundle = faults.retry(
+                    _build, label=f"tenant {name} restore"
+                )
+                new_state = t.engine._build_state(
+                    restored_bundle, version=old_state.version + 1
+                )
+                # The kinds changed back re2 -> re: these are new bucket
+                # programs — pre-warm so the flip compiles nothing on
+                # live traffic (the demotion's own discipline, inverted).
+                before = t.engine.compiles
+                t.engine._warm_state(new_state)
+                t.engine._commit_state(
+                    new_state, baseline_bump=t.engine.compiles - before
+                )
+                t.demoted = False
+                t.engine._drain_state(old_state, timeout_s=30.0)
+                # close_stores=True: the restored generation owns plain
+                # device matrices — the old bundle's two-tier stores (and
+                # their promotion workers) retire with it.
+                old_state.bundle.release(close_stores=True)
+                faults.COUNTERS.increment("tenant_restores")
+        repinned = _bundle_device_bytes(restored_bundle) - old_bytes
+        telemetry.emit_event(
+            "tenant_restore",
+            tenant=name,
+            reason=reason,
+            device_bytes=int(repinned),
+        )
+        logger.info(
+            "tenant %r restored to HBM residency (%s): %.2f MB re-pinned",
+            name,
+            reason,
+            repinned / 1e6,
+        )
+        return int(repinned)
+
+    def retune(self, *, max_wait_ms: Optional[float] = None) -> Dict[str, float]:
+        """Live-adjust the micro-batching flush wait (the autopilot's
+        batch/wait retune actuator, ISSUE 19). Only the WAIT is mutable
+        online: the bucket ladder is compiled state — changing max_batch
+        live would recompile every program, which is a reshard-class
+        action, not a retune. Returns the displaced values so a rollback
+        can restore them."""
+        with self._cv:
+            prev = {"max_wait_ms": self.max_wait_s * 1e3}
+            if max_wait_ms is not None:
+                if max_wait_ms < 0:
+                    raise ValueError("max_wait_ms must be >= 0")
+                self.max_wait_s = float(max_wait_ms) / 1e3
+                self._cv.notify_all()
+        return prev
+
     # -------------------------------------------------------------- scoring
 
     def _tenant(self, name: str) -> Tenant:
@@ -708,7 +781,12 @@ class TenantRegistry:
         if fut.done():
             return
         if error is None:
-            telemetry.METRICS.observe("serving_latency_ms", wall_ms)
+            # Labeled observe (ISSUE 19): the aggregate series is
+            # unchanged; the per-tenant sub-histogram is what the
+            # autopilot's p95 retune rule reads.
+            telemetry.METRICS.observe(
+                "serving_latency_ms", wall_ms, labels=(("tenant", t.name),)
+            )
             fut.set_result(result)
         else:
             fut.set_exception(error)

@@ -181,6 +181,11 @@ ROBUSTNESS_CLEAN_ZERO_KEYS = (
     "shadow_mirror_failures",
     "label_join_failures",
     "shadow_rollbacks",
+    # ISSUE 19: autopilot — actions reverted because the post-action
+    # contract probe regressed, and rules quarantined after their
+    # rollback. A clean closed-loop run adapts without ever reverting.
+    "autopilot_rollbacks",
+    "autopilot_quarantines",
 )
 
 # Top-level serving-summary.json keys written by cli/serve.py. r14
@@ -191,7 +196,8 @@ ROBUSTNESS_CLEAN_ZERO_KEYS = (
 # bundle provenance block (BUNDLE_PROVENANCE_KEYS) so operators can audit
 # what a swapped engine is actually running; r18 appends the shadow
 # deployment block ({} on a replay without --shadow, SHADOW_BLOCK_KEYS
-# otherwise).
+# otherwise); r19 appends the autopilot block ({} without --autopilot,
+# AUTOPILOT_BLOCK_KEYS otherwise).
 SERVING_SUMMARY_KEYS = (
     "num_requests",
     "failed_requests",
@@ -203,6 +209,7 @@ SERVING_SUMMARY_KEYS = (
     "tenants",
     "provenance",
     "shadow",
+    "autopilot",
 )
 
 # The served bundle's lineage block (ISSUE 16): every ServingBundle
@@ -411,6 +418,52 @@ SHADOW_SECTION_KEYS = (
     "clean_counters_zero",
 )
 
+# ---------------------------------------------------------------- autopilot
+# The closed-loop controller block (ISSUE 19, photon_ml_tpu/autopilot/):
+# Autopilot.summary() zips exactly these, and serving-summary.json
+# carries the block under "autopilot" ({} on a run without --autopilot)
+# so an operator can always tell open-loop from self-operating. Counts
+# are cumulative over the controller's lifetime; "quarantined" lists the
+# rules currently benched after a rollback (empty on a healthy loop).
+AUTOPILOT_BLOCK_KEYS = (
+    "status",
+    "ticks",
+    "rules",
+    "decisions",
+    "actions",
+    "suppressed",
+    "rollbacks",
+    "quarantined",
+    "tick_ms",
+    "cooldown_s",
+    "action_budget",
+    "last_outcome",
+)
+
+# bench.py autopilot section (ISSUE 19): the self-operation certificate —
+# a load shift between two live tenants triggers automatic reshard +
+# hot-row rebalance with zero failed requests and recovered p99, an
+# induced HBM squeeze demotes the cold tenant and later restores it
+# bitwise, and a deliberately bad rule is rolled back and quarantined by
+# the post-action probe — every decision journaled with its evidence and
+# the clean-phase autopilot counters zero.
+AUTOPILOT_SECTION_KEYS = (
+    "n_devices",
+    "ticks",
+    "load_shift_detected",
+    "reshard_actions",
+    "rebalance_actions",
+    "failed_requests",
+    "p99_recovered",
+    "hbm_demoted",
+    "hbm_restored_bitwise",
+    "bad_rule_rolled_back",
+    "bad_rule_quarantined",
+    "decisions_journaled",
+    "decisions_valid",
+    "clean_counters_zero",
+)
+
 # -------------------------------------------------------------------- sweep
 # bench.py `sweep` section (ISSUE 12): the pod-parallel hyperparameter
 # sweep certificate — a 16-trial Bayesian sweep through the batched trial
@@ -489,6 +542,7 @@ JOURNAL_EVENT_SCHEMAS = {
     # -- multi-tenant serving (serving/tenancy.TenantRegistry) --
     "tenant_admit": ("tenant", "device_bytes", "demoted_tenants"),
     "tenant_evict": ("tenant", "reason", "freed_bytes", "hot_rows"),
+    "tenant_restore": ("tenant", "reason", "device_bytes"),
     "tenant_degraded": ("tenant", "reasons"),
     # -- incremental refresh (game/incremental.py + serving/delta.py) --
     "delta_fit_start": ("mode", "changed_coordinates", "delta_rows",
@@ -512,6 +566,10 @@ JOURNAL_EVENT_SCHEMAS = {
                        "reason"),
     "shadow_promote": ("champion", "challenger", "version"),
     "shadow_rollback": ("champion", "challenger", "reason"),
+    # -- closed-loop autoscaling (photon_ml_tpu/autopilot/, ISSUE 19) --
+    "autopilot_decision": ("rule", "action", "evidence", "outcome"),
+    "autopilot_rollback": ("rule", "action", "reason"),
+    "rule_quarantined": ("rule", "reason", "rollbacks"),
 }
 
 # ------------------------------------------------------------------- profile
@@ -582,6 +640,8 @@ ALL_CONTRACTS = {
     "MULTI_TENANT_SECTION_KEYS": MULTI_TENANT_SECTION_KEYS,
     "SHADOW_BLOCK_KEYS": SHADOW_BLOCK_KEYS,
     "SHADOW_SECTION_KEYS": SHADOW_SECTION_KEYS,
+    "AUTOPILOT_BLOCK_KEYS": AUTOPILOT_BLOCK_KEYS,
+    "AUTOPILOT_SECTION_KEYS": AUTOPILOT_SECTION_KEYS,
     "CHAOS_MULTICHIP_SECTION_KEYS": CHAOS_MULTICHIP_SECTION_KEYS,
     "ELASTIC_MESH_SECTION_KEYS": ELASTIC_MESH_SECTION_KEYS,
     "SWEEP_SECTION_KEYS": SWEEP_SECTION_KEYS,

@@ -151,6 +151,14 @@ SITE_DESCRIPTIONS = {
     "the shadow scoring window)",
     "shadow_promote": "shadow promotion (the challenger -> champion "
     "BundleManager generation flip)",
+    # Closed-loop autoscaling (ISSUE 19): the autopilot actuation site —
+    # armed between a ControlRule's decision and its effect, so every
+    # actuator path (reshard, rebalance, demote/restore, batch retune)
+    # exercises the rollback + quarantine machinery under injection. A
+    # faulted actuation rolls back to the pre-action state and counts
+    # toward the rule's quarantine threshold; client requests never fail.
+    "autopilot_act": "autopilot actuation (applying a ControlRule's "
+    "decided action through the serving actuators)",
 }
 KNOWN_SITES = tuple(SITE_DESCRIPTIONS)
 

@@ -464,6 +464,33 @@ _register(
     "mirrors everything, 0.25 every fourth request.",
 )
 
+# --------------------------------------------------------------- autopilot
+_register(
+    "PHOTON_AUTOPILOT_MS",
+    int,
+    500,
+    "Closed-loop autoscaling (photon_ml_tpu/autopilot/): control-loop "
+    "tick period in milliseconds — each tick snapshots the sensors and "
+    "evaluates every armed ControlRule against fresh evidence.",
+)
+_register(
+    "PHOTON_AUTOPILOT_MAX_ACTIONS",
+    int,
+    4,
+    "Autopilot: bounded-actions budget — the most actuations the "
+    "controller may apply within one cooldown window; rules that fire "
+    "past the budget are journaled as suppressed, never applied.",
+)
+_register(
+    "PHOTON_AUTOPILOT_COOLDOWN_S",
+    float,
+    2.0,
+    "Autopilot: per-rule cooldown — minimum seconds between two "
+    "actuations of the SAME rule (and the width of the global action-"
+    "budget window), so the loop settles between interventions instead "
+    "of oscillating; 0 disables the cooldown.",
+)
+
 # ------------------------------------------------------------------- planner
 _register(
     "PHOTON_PLAN",

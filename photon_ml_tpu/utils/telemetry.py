@@ -125,6 +125,8 @@ METRIC_DESCRIPTIONS = {
     "rebalanced_rows": "hot coefficient rows re-placed by a rebalance plan",
     "tenant_demotions": "cold tenants' RE rows demoted to the host tier "
     "under HBM pressure",
+    "tenant_restores": "demoted tenants promoted back to full HBM "
+    "residency when headroom returned",
     "tenant_cobatch_dispatches": "cross-tenant co-batched device dispatches",
     "delta_applies": "delta-bundle generation flips committed to a live "
     "engine",
@@ -147,6 +149,14 @@ METRIC_DESCRIPTIONS = {
     "BundleManager generation flip",
     "shadow_rollbacks": "challengers torn down on a regression verdict "
     "or a failed promotion",
+    "autopilot_actions": "control-rule actuations applied by the "
+    "autopilot loop (reshard, rebalance, demote/restore, retune)",
+    "autopilot_suppressed": "control-rule firings suppressed by "
+    "hysteresis, cooldown, quarantine, or the action budget",
+    "autopilot_rollbacks": "autopilot actions reverted because the "
+    "post-action contract probe regressed",
+    "autopilot_quarantines": "control rules benched after a rollback "
+    "until an operator reset",
     # -- histograms (fixed log-spaced buckets, mergeable) --
     "serving_latency_ms": "per-request wall latency through the batcher",
     "serving_queue_wait_ms": "submit-to-claim queue wait per request",
@@ -353,13 +363,20 @@ class MetricsRegistry:
     increment inside a `metric_label_scope` (or with an explicit
     `labels=`) bumps the aggregate AND the label's sub-count, so one
     tenant's degradations are visible per tenant without losing the
-    process-wide signal."""
+    process-wide signal. ISSUE 19 extends the same attribution to gauges
+    and histograms — a labeled observe records into the aggregate
+    histogram AND a per-label one over the same fixed bucket bounds, so
+    labeled sub-series merge exactly as associatively as the aggregates
+    and the autopilot can read per-tenant p95s instead of process-global
+    ones."""
 
     def __init__(self) -> None:
         self._counters: Dict[str, int] = {}
         self._labeled: Dict[str, Dict[str, int]] = {}
         self._gauges: Dict[str, float] = {}
+        self._labeled_gauges: Dict[str, Dict[str, float]] = {}
         self._hists: Dict[str, Histogram] = {}
+        self._labeled_hists: Dict[str, Dict[str, Histogram]] = {}
         self._lock = threading.Lock()
 
     @staticmethod
@@ -397,22 +414,70 @@ class MetricsRegistry:
         with self._lock:
             return dict(self._labeled.get(name, {}))
 
-    def set_gauge(self, name: str, value: float) -> None:
+    def set_gauge(
+        self,
+        name: str,
+        value: float,
+        labels: Optional[Tuple[Tuple[str, str], ...]] = None,
+    ) -> None:
         self._check(name)
+        if labels is None:
+            labels = current_metric_labels()
         with self._lock:
             self._gauges[name] = float(value)
+            if labels:
+                sub = self._labeled_gauges.setdefault(name, {})
+                sub[label_key(labels)] = float(value)
 
-    def observe(self, name: str, value: float) -> None:
+    def labeled_gauges(self, name: str) -> Dict[str, float]:
+        """Per-label last-write-wins values of one gauge; empty when
+        nothing labeled set it."""
+        with self._lock:
+            return dict(self._labeled_gauges.get(name, {}))
+
+    def observe(
+        self,
+        name: str,
+        value: float,
+        labels: Optional[Tuple[Tuple[str, str], ...]] = None,
+    ) -> None:
         self._check(name)
+        if labels is None:
+            labels = current_metric_labels()
         with self._lock:
             hist = self._hists.get(name)
             if hist is None:
                 hist = self._hists[name] = Histogram()
+            labeled = None
+            if labels:
+                sub = self._labeled_hists.setdefault(name, {})
+                key = label_key(labels)
+                labeled = sub.get(key)
+                if labeled is None:
+                    labeled = sub[key] = Histogram()
         hist.record(value)
+        if labeled is not None:
+            labeled.record(value)
 
     def histogram(self, name: str) -> Optional[Histogram]:
         with self._lock:
             return self._hists.get(name)
+
+    def labeled_histogram(
+        self, name: str, labels: Tuple[Tuple[str, str], ...]
+    ) -> Optional[Histogram]:
+        """One label's live sub-histogram, or None if never observed."""
+        with self._lock:
+            return self._labeled_hists.get(name, {}).get(label_key(labels))
+
+    def labeled_histograms(self, name: str) -> Dict[str, Dict[str, object]]:
+        """Per-label mergeable snapshots of one histogram
+        ({"tenant=a": {...}}); empty when nothing labeled observed it.
+        The aggregate histogram covers these plus unlabeled observes —
+        same fixed bucket bounds, so sub-series merge associatively."""
+        with self._lock:
+            sub = dict(self._labeled_hists.get(name, {}))
+        return {k: h.snapshot() for k, h in sorted(sub.items())}
 
     def counters(self) -> Dict[str, int]:
         with self._lock:
@@ -424,14 +489,25 @@ class MetricsRegistry:
         aggregates."""
         with self._lock:
             hists = dict(self._hists)
+            labeled_hists = {
+                k: dict(v) for k, v in sorted(self._labeled_hists.items())
+            }
             out = {
                 "counters": dict(self._counters),
                 "labeled_counters": {
                     k: dict(v) for k, v in sorted(self._labeled.items())
                 },
                 "gauges": dict(self._gauges),
+                "labeled_gauges": {
+                    k: dict(v)
+                    for k, v in sorted(self._labeled_gauges.items())
+                },
             }
         out["histograms"] = {k: h.snapshot() for k, h in sorted(hists.items())}
+        out["labeled_histograms"] = {
+            k: {lk: h.snapshot() for lk, h in sorted(v.items())}
+            for k, v in labeled_hists.items()
+        }
         return out
 
     def reset_counters(self) -> None:
@@ -448,7 +524,9 @@ class MetricsRegistry:
             self._counters.clear()
             self._labeled.clear()
             self._gauges.clear()
+            self._labeled_gauges.clear()
             self._hists.clear()
+            self._labeled_hists.clear()
 
 
 METRICS = MetricsRegistry()

@@ -1,0 +1,10 @@
+"""Known-bad: re-types two autopilot decision-schema keys (the r19
+FIXTURE_AUTOPILOT_KEYS shape) as a literal instead of importing the
+tuple."""
+
+
+def check_autopilot(block):
+    decision = {
+        k: block[k] for k in ("fixture_ap_rule", "fixture_ap_outcome")
+    }  # re-typed autopilot schema
+    return decision

@@ -23,3 +23,6 @@ FIXTURE_MULTIHOST_KEYS = ("fixture_mh_hosts", "fixture_mh_repeated_sweeps", "fix
 
 # Shadow-deploy schema (r18): the online shadow evaluation block keys.
 FIXTURE_SHADOW_KEYS = ("fixture_shadow_windows", "fixture_shadow_verdict", "fixture_shadow_drift")
+
+# Autopilot decision schema (r19): the closed-loop controller keys.
+FIXTURE_AUTOPILOT_KEYS = ("fixture_ap_rule", "fixture_ap_outcome", "fixture_ap_rollbacks")

@@ -1,0 +1,10 @@
+"""Known-good: the autopilot decision schema is imported; single-key
+reads are use, not duplication."""
+
+from contracts import FIXTURE_AUTOPILOT_KEYS
+
+
+def check_autopilot(block):
+    missing = [k for k in FIXTURE_AUTOPILOT_KEYS if k not in block]
+    rule = block.get("fixture_ap_rule")  # one key is vocabulary
+    return missing, rule

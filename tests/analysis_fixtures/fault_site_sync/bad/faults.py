@@ -3,4 +3,6 @@
 SITE_DESCRIPTIONS = {
     "fixture_decode": "planted by app.py",
     "fixture_upload": "described but never planted (a finding)",
+    "fixture_autopilot_act": "described but never planted "
+    "(the r19 actuation-site flavor of the same finding)",
 }

@@ -9,3 +9,4 @@ def fault_point(site):
 def run():
     fault_point("fixture_decode")
     fault_point("fixture_upload")
+    fault_point("fixture_autopilot_act")

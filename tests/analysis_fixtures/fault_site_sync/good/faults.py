@@ -3,4 +3,5 @@
 SITE_DESCRIPTIONS = {
     "fixture_decode": "planted by app.py",
     "fixture_upload": "planted by app.py",
+    "fixture_autopilot_act": "planted by app.py",
 }

@@ -7,3 +7,7 @@ def _register(name, type_, default, doc):
 
 
 _register("PHOTON_FIXTURE_TILE", int, 8, "a knob the README forgot")
+_register(
+    "PHOTON_FIXTURE_AUTOPILOT_MS", int, 500,
+    "a control-loop tick knob the README also forgot",
+)

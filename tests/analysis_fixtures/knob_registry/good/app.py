@@ -11,6 +11,8 @@ def get_knob(name):  # stand-in accessor so the call parses standalone
 
 def configure():
     tile = get_knob("PHOTON_FIXTURE_TILE")
+    tick = get_knob("PHOTON_FIXTURE_AUTOPILOT_MS")  # registered read
+    del tick
     os.environ["PHOTON_FIXTURE_TILE"] = "16"  # write: child-process setup
     path = os.environ.get("HOME", "/")  # non-PHOTON read: out of scope
     return tile, path

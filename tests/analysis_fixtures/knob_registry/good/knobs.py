@@ -6,3 +6,7 @@ def _register(name, type_, default, doc):
 
 
 _register("PHOTON_FIXTURE_TILE", int, 8, "documented in the fixture README")
+_register(
+    "PHOTON_FIXTURE_AUTOPILOT_MS", int, 500,
+    "control-loop tick, documented in the fixture README",
+)

@@ -3,4 +3,6 @@
 METRIC_DESCRIPTIONS = {
     "fixture_hits": "incremented by app.py",
     "fixture_ghost": "declared but never incremented (a finding)",
+    "fixture_autopilot_rollbacks": "declared but never incremented "
+    "(the r19 controller flavor of the same finding)",
 }

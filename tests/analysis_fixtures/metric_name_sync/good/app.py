@@ -29,6 +29,7 @@ def retry(fn, counter="fixture_retries"):
 
 def run(mesh, hist: _Hist):
     METRICS.increment("fixture_hits")
+    METRICS.increment("fixture_autopilot_rollbacks")
     METRICS.observe("fixture_latency_ms", 1.5)
     METRICS.set_gauge("fixture_depth", 3)
     retry(

@@ -6,4 +6,5 @@ METRIC_DESCRIPTIONS = {
     "fixture_retries": "planted via a counter= default and keyword",
     "fixture_alt_retries": "planted via the conditional counter= branch",
     "fixture_depth": "gauged by app.py",
+    "fixture_autopilot_rollbacks": "incremented by app.py (r19 flavor)",
 }

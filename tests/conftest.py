@@ -199,6 +199,12 @@ def _failure_domain_hygiene(monkeypatch):
         "PHOTON_SHADOW_REGRESSION_TOL",
         "PHOTON_SHADOW_COOLDOWN_S",
         "PHOTON_SHADOW_MIRROR_FRACTION",
+        # Closed-loop autoscaling (ISSUE 19): ambient control-loop tuning
+        # in the developer's shell must never reshape tick cadence,
+        # action budgets, or cooldowns inside unrelated tests.
+        "PHOTON_AUTOPILOT_MS",
+        "PHOTON_AUTOPILOT_MAX_ACTIONS",
+        "PHOTON_AUTOPILOT_COOLDOWN_S",
     ):
         monkeypatch.delenv(var, raising=False)
     from photon_ml_tpu import planner as _planner
@@ -227,6 +233,7 @@ def _failure_domain_hygiene(monkeypatch):
                     "photon-refresh",
                     "photon-hostmesh",
                     "photon-shadow",
+                    "photon-autopilot",
                 )
             )
             and t.is_alive()

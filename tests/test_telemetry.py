@@ -312,6 +312,107 @@ class TestLatencyStats:
             assert exact / est < _BUCKET_RATIO * 1.01
 
 
+# ----------------------------------------------------------- labeled metrics
+
+
+class TestLabeledMetrics:
+    """ISSUE 19: gauges and histograms carry the same per-label
+    attribution counters grew in ISSUE 15 — aggregates intact, labeled
+    sub-series over the SAME fixed bucket bounds (so they merge exactly
+    as order-independently as the aggregates)."""
+
+    def test_labeled_observe_keeps_aggregate_intact(self, rng):
+        vals_a = [float(v) for v in np.exp(rng.normal(size=400))]
+        vals_b = [float(v) for v in np.exp(rng.normal(size=300) + 1.0)]
+        for v in vals_a:
+            telemetry.METRICS.observe(
+                "serving_latency_ms", v, labels=(("tenant", "a"),)
+            )
+        for v in vals_b:
+            telemetry.METRICS.observe(
+                "serving_latency_ms", v, labels=(("tenant", "b"),)
+            )
+        telemetry.METRICS.observe("serving_latency_ms", 1.0)  # unlabeled
+        agg = telemetry.METRICS.histogram("serving_latency_ms")
+        assert agg.snapshot()["count"] == len(vals_a) + len(vals_b) + 1
+        labeled = telemetry.METRICS.labeled_histograms("serving_latency_ms")
+        assert set(labeled) == {"tenant=a", "tenant=b"}
+        assert labeled["tenant=a"]["count"] == len(vals_a)
+        assert labeled["tenant=b"]["count"] == len(vals_b)
+        # Per-label quantiles differ the way the data does.
+        qa = telemetry.snapshot_quantile(labeled["tenant=a"], 0.95)
+        qb = telemetry.snapshot_quantile(labeled["tenant=b"], 0.95)
+        assert qb > qa
+        # The live per-label handle agrees with the snapshot.
+        h = telemetry.METRICS.labeled_histogram(
+            "serving_latency_ms", (("tenant", "a"),)
+        )
+        assert h is not None and h.snapshot()["count"] == len(vals_a)
+
+    def test_labeled_merge_is_order_independent(self, rng):
+        """Labeled sub-snapshots share the aggregate's fixed bucket
+        bounds: merging them in ANY order reproduces the aggregate
+        (when every observe was labeled)."""
+        vals = [float(v) for v in np.exp(rng.normal(size=2000))]
+        tenants = ("a", "b", "c", "d")
+        for i, v in enumerate(vals):
+            telemetry.METRICS.observe(
+                "serving_queue_wait_ms",
+                v,
+                labels=(("tenant", tenants[i % 4]),),
+            )
+        labeled = telemetry.METRICS.labeled_histograms(
+            "serving_queue_wait_ms"
+        )
+        snaps = [labeled[f"tenant={t}"] for t in tenants]
+        m = telemetry.merge_histogram_snapshots
+        fwd = m(snaps[0], snaps[1], snaps[2], snaps[3])
+        rev = m(snaps[3], snaps[2], snaps[1], snaps[0])
+        nested = m(m(snaps[2], snaps[0]), m(snaps[1], snaps[3]))
+        _assert_snapshots_equal(fwd, rev)
+        _assert_snapshots_equal(fwd, nested)
+        agg = telemetry.METRICS.histogram("serving_queue_wait_ms")
+        _assert_snapshots_equal(fwd, agg.snapshot())
+
+    def test_label_scope_routes_gauges_and_histograms(self):
+        with telemetry.metric_label_scope(tenant="a"):
+            telemetry.METRICS.set_gauge("serving_pending_depth", 3.0)
+            telemetry.METRICS.observe("serving_batch_size", 8.0)
+        telemetry.METRICS.set_gauge("serving_pending_depth", 5.0)
+        gauges = telemetry.METRICS.labeled_gauges("serving_pending_depth")
+        assert gauges == {"tenant=a": 3.0}
+        labeled = telemetry.METRICS.labeled_histograms("serving_batch_size")
+        assert labeled["tenant=a"]["count"] == 1
+        snap = telemetry.METRICS.snapshot()
+        assert snap["gauges"]["serving_pending_depth"] == 5.0
+        assert (
+            snap["labeled_gauges"]["serving_pending_depth"]["tenant=a"]
+            == 3.0
+        )
+        assert (
+            snap["labeled_histograms"]["serving_batch_size"]["tenant=a"][
+                "count"
+            ]
+            == 1
+        )
+
+    def test_undeclared_names_refused_and_reset_clears_labels(self):
+        with pytest.raises(KeyError):
+            telemetry.METRICS.observe("no_such_metric", 1.0)
+        with pytest.raises(KeyError):
+            telemetry.METRICS.set_gauge("no_such_metric", 1.0)
+        telemetry.METRICS.observe(
+            "serving_batch_size", 4.0, labels=(("tenant", "a"),)
+        )
+        telemetry.METRICS.reset_counters()  # counters only: labels stay
+        assert telemetry.METRICS.labeled_histograms("serving_batch_size")
+        telemetry.METRICS.reset()
+        assert (
+            telemetry.METRICS.labeled_histograms("serving_batch_size") == {}
+        )
+        assert telemetry.METRICS.labeled_gauges("serving_pending_depth") == {}
+
+
 # ------------------------------------------------------------------ journal
 
 
@@ -391,6 +492,11 @@ class TestJournal:
         "evaluator": "AUC",
         "healthy": False,
         "windows": 3,
+        # -- closed-loop autoscaling (ISSUE 19) --
+        "rule": "hbm-demote",
+        "action": {"kind": "demote", "tenant": "t-cold", "params": {}},
+        "evidence": {"signal": 0.91, "fire_above": 0.85},
+        "rollbacks": 1,
     }
 
     def test_every_event_type_round_trips_its_schema(self, tmp_path):
