@@ -967,6 +967,15 @@ def _multi_tenant_child() -> None:
          budget — the coldest tenant demotes to the host tier (never
          fails), the newcomer admits, and the demoted tenant still
          answers bitwise through the TwoTierEntityStore overrides.
+      4. PRECISION-LADDER HBM SQUEEZE (ISSUE 20): a second fleet under
+         a budget that fits only a handful of f32 tenants; with
+         PHOTON_TIER_LADDER opted in, quantize-in-place (f32 -> bf16 ->
+         int8) keeps >= 3x as many tenants device-resident, every
+         quantized tenant's replay stays within the pinned
+         TIER_TOLERANCES, a terminal mid-quantize fault stays confined
+         to its tenant with ZERO failed requests across every ladder
+         transition, and a restored tenant answers bitwise vs its
+         pre-demotion self.
 
     Prints exactly one JSON line."""
     import threading as _threading
@@ -1197,13 +1206,182 @@ def _multi_tenant_child() -> None:
     final = reg.metrics()
     reg.close(release_bundles=True)
 
+    # ---- phase 4: precision-ladder HBM squeeze (ISSUE 20) -----------------
+    from photon_ml_tpu.serving.bundle import quantize_bundle_rows
+    from photon_ml_tpu.utils.contracts import TIER_TOLERANCES
+
+    lad_d_re = 32  # wide RE rows: the regime where int8 + scales pays
+    lad_ents = 64
+    n_lad = 13
+    lad_names = [f"lad-{i}" for i in range(n_lad)]
+
+    def build_wide(seed):
+        rng = np.random.default_rng(seed)
+        w = rng.normal(size=d_fe).astype(np.float32)
+        M = np.zeros((lad_ents + 1, lad_d_re), np.float32)
+        M[:lad_ents] = rng.normal(size=(lad_ents, lad_d_re)) * 0.4
+        model = GameModel(
+            {
+                "fixed": FixedEffectModel(Coefficients(jnp.asarray(w)), task),
+                "per-e": RandomEffectModel(jnp.asarray(M), None, task),
+            }
+        )
+        specs = {
+            "fixed": CoordinateScoringSpec(shard="g"),
+            "per-e": CoordinateScoringSpec(
+                shard="re",
+                random_effect_type="eid",
+                entity_index={str(i): i for i in range(lad_ents)},
+            ),
+        }
+        return model, specs
+
+    def requests_wide(seed, n):
+        rng = np.random.default_rng(seed)
+        X = rng.normal(size=(n, d_fe)).astype(np.float32)
+        Xe = rng.normal(size=(n, lad_d_re)).astype(np.float32)
+        ids = rng.integers(0, lad_ents + 4, size=n)
+        return [
+            ScoreRequest(
+                features={"g": X[i], "re": Xe[i]},
+                entity_ids={"eid": str(int(ids[i]))},
+                offset=float(i) * 0.0625,
+                uid=str(i),
+            )
+            for i in range(n)
+        ]
+
+    lad_models = {nm: build_wide(800 + j) for j, nm in enumerate(lad_names)}
+    lad_reqs = {
+        nm: requests_wide(900 + j, 16) for j, nm in enumerate(lad_names)
+    }
+    lad_refs = {}
+    for nm in lad_names:
+        mdl, spc = lad_models[nm]
+        with ServingEngine(
+            ServingBundle.from_model(mdl, spc, task), max_batch=16
+        ) as eng:
+            lad_refs[nm] = scores_of(eng.score_batch(lad_reqs[nm]))
+
+    # Measure the per-tenant footprint at both ends of the ladder, then
+    # set a budget that fits ONE f32 newcomer beside an int8 fleet.
+    probe = ServingBundle.from_model(*build_wide(777), task)
+    per_f32 = probe.device_bytes_per_shard()
+    q_probe, _ = quantize_bundle_rows(probe, "int8")
+    per_i8 = q_probe.device_bytes_per_shard()
+    q_probe.release(close_stores=False)
+    probe.release(close_stores=False)
+    lad_budget = per_f32 + (n_lad - 1) * per_i8 + per_i8 // 2
+
+    def _squeeze(ladder_on):
+        """Admit the 13 wide tenants under the squeeze budget; return
+        (resident count, registry metrics, registry)."""
+        if ladder_on:
+            os.environ["PHOTON_TIER_LADDER"] = "1"
+        else:
+            os.environ.pop("PHOTON_TIER_LADDER", None)
+        r = TenantRegistry(
+            max_batch=16,
+            max_wait_ms=1.0,  # photon-lint: disable=planner-constant — deliberate section config: fixed wait pins the measurement, not a runtime default
+            hbm_budget_bytes=int(lad_budget),
+        )
+        for nm in lad_names:
+            mdl, spc = lad_models[nm]
+            r.admit(
+                nm,
+                ServingBundle.from_model(mdl, spc, task),
+                deadline_ms=deadline_ms,
+                inject_faults=False,
+            )
+        mm = r.metrics()
+        res = sum(
+            1 for blk in mm["tenants"].values() if not blk["demoted"]
+        )
+        return res, mm, r
+
+    # The f32-only baseline capacity, MEASURED: same budget, ladder off.
+    f32_capacity, _, reg_f32 = _squeeze(ladder_on=False)
+    reg_f32.close(release_bundles=True)
+
+    injected_phase2 = int(faults.COUNTERS.get("injected_faults"))
+    faults.reset_counters()  # isolate the ladder-phase transition counts
+    ladder_resident, m4, reg4 = _squeeze(ladder_on=True)
+
+    # Quantized replay: every resident tenant answers within its rung's
+    # pinned tolerance. The tier sub-block keeps the rung even beside
+    # demoted=True, so a quantized-then-evicted tenant compares under its
+    # rung's tolerance and a never-quantized one under f32's exact zeros.
+    quant_ok = True
+    for nm in lad_names:
+        tol = TIER_TOLERANCES[m4["tenants"][nm]["tier"]["tier"]]
+        got = scores_of([reg4.score(nm, r) for r in lad_reqs[nm]])
+        quant_ok = quant_ok and bool(
+            np.allclose(got, lad_refs[nm], rtol=tol["rtol"], atol=tol["atol"])
+        )
+
+    # Chaos on a ladder transition: a terminal mid-quantize fault on the
+    # newest (still-f32) tenant leaves its generation serving and stays
+    # confined — neighbors keep answering, zero failed requests anywhere.
+    chaos_confined = True
+    faults.install("quantize_stage:99")
+    try:
+        reg4.demote_tier(lad_names[-1], reason="bench_chaos")
+        chaos_confined = False  # the injected terminal fault vanished
+    except Exception:  # noqa: BLE001 - the expected terminal injection
+        pass
+    faults.install("")
+    got = scores_of(
+        [reg4.score(lad_names[-1], r) for r in lad_reqs[lad_names[-1]]]
+    )
+    chaos_confined = chaos_confined and bool(
+        np.array_equal(got, lad_refs[lad_names[-1]])
+    )
+    for nm in lad_names[:2]:
+        tol = TIER_TOLERANCES[m4["tenants"][nm]["tier"]["tier"]]
+        got = scores_of([reg4.score(nm, r) for r in lad_reqs[nm]])
+        chaos_confined = chaos_confined and bool(
+            np.allclose(got, lad_refs[nm], rtol=tol["rtol"], atol=tol["atol"])
+        )
+
+    # Restore: retire part of the fleet to make room, walk the coldest
+    # (most-degraded) tenant back to f32 — bitwise vs its pre-demotion
+    # self (the solo reference: it was admitted at f32). Failed-request
+    # counts for the retired tenants are snapshotted first — remove()
+    # drops their metrics blocks.
+    m4c = reg4.metrics()
+    retired = lad_names[5:10]
+    for nm in retired:
+        reg4.remove(nm, release_bundle=True)
+    reg4.restore_tier(lad_names[0], reason="bench_restore")
+    got0 = scores_of(
+        [reg4.score(lad_names[0], r) for r in lad_reqs[lad_names[0]]]
+    )
+    restored_bitwise = bool(np.array_equal(got0, lad_refs[lad_names[0]]))
+    restored_bitwise = restored_bitwise and (
+        reg4.metrics()["tenants"][lad_names[0]]["tier"]["tier"] == "f32"
+    )
+
+    m4f = reg4.metrics()
+    ladder_failed = sum(
+        blk["failed"] for blk in m4f["tenants"].values()
+    ) + sum(m4c["tenants"][nm]["failed"] for nm in retired)
+    ladder_transitions = int(
+        faults.COUNTERS.get("tier_demotions")
+        + faults.COUNTERS.get("tier_restores")
+        + faults.COUNTERS.get("tier_rollbacks")
+        + faults.COUNTERS.get("tenant_demotions")
+        + faults.COUNTERS.get("tenant_restores")
+    )
+    reg4.close(release_bundles=True)
+    os.environ.pop("PHOTON_TIER_LADDER", None)
+
     print(
         json.dumps(
             dict(
                 n_devices=ndev,
                 n_tenants=10,
                 chaos_tenant="chaos",
-                injected_faults=int(faults.COUNTERS.get("injected_faults")),
+                injected_faults=injected_phase2,
                 chaos_shed=int(chaos_shed[0]),
                 chaos_answered=int(chaos_answered[0]),
                 chaos_hangs=chaos_hangs,
@@ -1217,6 +1395,18 @@ def _multi_tenant_child() -> None:
                 demoted_tenant=demoted_tenant,
                 admitted_over_budget=bool(admitted_over_budget),
                 evicted_bitwise=bool(evicted_bitwise),
+                ladder_resident_tenants=int(ladder_resident),
+                f32_capacity_tenants=int(f32_capacity),
+                ladder_capacity_ratio=float(
+                    ladder_resident / max(1, f32_capacity)
+                ),
+                # Covers the post-chaos neighbor replays too: a confined
+                # terminal quantize fault must leave every OTHER tenant
+                # answering inside its rung's pinned tolerance.
+                quantized_within_tolerance=bool(quant_ok and chaos_confined),
+                ladder_failed_requests=int(ladder_failed),
+                ladder_transitions=ladder_transitions,
+                ladder_restored_bitwise=bool(restored_bitwise),
                 tenants={
                     nm: dict(block)
                     for nm, block in final["tenants"].items()
@@ -3376,6 +3566,9 @@ def _child() -> None:
             ).strip()
         env_mt.pop("PHOTON_FAULTS", None)  # the child arms its own drill
         env_mt.pop("PHOTON_WATCHDOG_MS", None)
+        # The ladder drill measures the f32 baseline with the ladder OFF;
+        # an ambient opt-in would fake the capacity ratio.
+        env_mt.pop("PHOTON_TIER_LADDER", None)
         out_mt = subprocess.run(
             [sys.executable, os.path.abspath(__file__), _MULTI_TENANT_CHILD],
             capture_output=True,
@@ -3439,6 +3632,35 @@ def _child() -> None:
                 f" {mt['admitted_over_budget']}, evicted tenant bitwise "
                 f"{mt['evicted_bitwise']}"
             )
+        # Precision-ladder squeeze (ISSUE 20): the quantize-in-place
+        # ladder must beat whole-tenant host eviction by >= 3x residency
+        # on the same fleet, with the characterized-parity and
+        # zero-failed-request contracts holding through every transition.
+        if mt["ladder_capacity_ratio"] < 3.0:
+            raise RuntimeError(
+                "precision ladder fit only "
+                f"{mt['ladder_resident_tenants']} resident tenants vs "
+                f"{mt['f32_capacity_tenants']} at f32 (ratio "
+                f"{mt['ladder_capacity_ratio']:.2f} < 3.0) — quantize-in-"
+                "place bought almost nothing over host eviction"
+            )
+        if not mt["quantized_within_tolerance"]:
+            raise RuntimeError(
+                "a quantized tenant's replay left its rung's pinned "
+                "TIER_TOLERANCES (or a mid-quantize fault leaked to a "
+                "neighbor) — the characterized-parity contract is broken"
+            )
+        if mt["ladder_failed_requests"]:
+            raise RuntimeError(
+                f"{mt['ladder_failed_requests']} requests failed across "
+                f"{mt['ladder_transitions']} ladder transitions — a "
+                "quantize/restore flip dropped traffic"
+            )
+        if not mt["ladder_restored_bitwise"]:
+            raise RuntimeError(
+                "a tenant restored from the ladder diverged from its "
+                "pre-demotion self — the restore-bitwise contract is broken"
+            )
         variants["multi_tenant"] = mt
         _mark(
             f"multi_tenant survived (10 tenants on {mt['n_devices']} vdev:"
@@ -3446,7 +3668,12 @@ def _child() -> None:
             f"{mt['chaos_hangs']} hangs confined to '{mt['chaos_tenant']}',"
             f" {mt['clean_requests']} clean requests 0 failed bitwise, "
             f"{mt['cobatch_dispatches']} co-batched dispatches, "
-            f"'{mt['demoted_tenant']}' evicted to host tier bitwise)"
+            f"'{mt['demoted_tenant']}' evicted to host tier bitwise; "
+            f"ladder: {mt['ladder_resident_tenants']} resident vs "
+            f"{mt['f32_capacity_tenants']} f32-only "
+            f"({mt['ladder_capacity_ratio']:.2f}x) across "
+            f"{mt['ladder_transitions']} transitions, 0 failed, restored "
+            "bitwise)"
         )
     except Exception as exc:  # noqa: BLE001 - bench must still print a line
         import traceback

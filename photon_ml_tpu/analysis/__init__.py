@@ -1,6 +1,6 @@
 """photon-lint: self-hosted static analysis for photon-ml-tpu.
 
-Eight AST-based checks, each machine-checking an invariant the repo
+Nine AST-based checks, each machine-checking an invariant the repo
 previously held only by convention (and has shipped bugs against):
 
 * knob-registry       — PHOTON_* env reads go through utils/knobs.py,
@@ -21,6 +21,10 @@ previously held only by convention (and has shipped bugs against):
                         prefetch depth, fusion caps, bucket shape sets)
                         come from planner/ or the knob registry, never
                         magic-number literals
+* tolerance-pin       — allclose-style parity comparisons take rtol/atol
+                        from utils/contracts.py pinned tolerance tables
+                        (TIER_TOLERANCES, PALLAS_GATE_TOLERANCES), never
+                        inline numeric literals
 
 Run `python -m photon_ml_tpu.analysis` (`--list-checks`, `--check
 <name>`, paths for fixture corpora); zero findings on the live tree is a
@@ -48,6 +52,7 @@ from photon_ml_tpu.analysis import (  # noqa: F401  isort: skip
     metric_name_sync,
     planner_constant,
     thread_lifecycle,
+    tolerance_pin,
 )
 
 __all__ = [

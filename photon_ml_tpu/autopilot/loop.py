@@ -46,7 +46,7 @@ import numpy as np
 from photon_ml_tpu.autopilot.rules import Action, ControlRule, default_rules
 from photon_ml_tpu.autopilot.sensors import SensorSnapshot, read_sensors
 from photon_ml_tpu.utils import faults, telemetry
-from photon_ml_tpu.utils.contracts import AUTOPILOT_BLOCK_KEYS
+from photon_ml_tpu.utils.contracts import AUTOPILOT_BLOCK_KEYS, TIER_TOLERANCES
 from photon_ml_tpu.utils.knobs import get_knob
 
 logger = logging.getLogger(__name__)
@@ -74,9 +74,11 @@ class Autopilot:
     knobs — the same deferral every serving ctor uses.
 
     `probe_requests` maps tenant name -> a ScoreRequest whose answers
-    must stay BITWISE across any action (all built-in actions are
-    bitwise-neutral by construction); without it the probe still checks
-    failed-request and latency regressions.
+    must stay BITWISE across any action (all built-in actions except the
+    precision ladder are bitwise-neutral by construction; ladder steps
+    are held to the pinned TIER_TOLERANCES for the rung instead);
+    without it the probe still checks failed-request and latency
+    regressions.
     """
 
     def __init__(
@@ -279,7 +281,7 @@ class Autopilot:
                 )
                 return
             post = self._probe()
-            regression = self._probe_regressed(pre, post)
+            regression = self._probe_regressed(pre, post, action)
             if regression is not None:
                 self._rollback(rule, action, regression, undo)
                 return
@@ -321,9 +323,41 @@ class Autopilot:
             return lambda: self.registry.demote(
                 name, reason="autopilot-rollback"
             )
+        if kind == "tier_demote":
+            return self._apply_tier_demote(action)
+        if kind == "tier_restore":
+            name = action.tenant
+            prior = getattr(self.registry.tenant(name), "tier", "f32")
+            self.registry.restore_tier(
+                name, to=str(action.params.get("to", "f32")), reason="autopilot"
+            )
+            return lambda: self.registry.demote_tier(
+                name, to=prior, reason="autopilot-rollback"
+            )
         if kind == "retune":
             return self._apply_retune(action)
         raise ValueError(f"unknown action kind {kind!r}")
+
+    def _apply_tier_demote(self, action: Action) -> Callable[[], None]:
+        from photon_ml_tpu.serving.tenancy import TierErrorCeilingExceeded
+
+        name = action.tenant
+        prior = getattr(self.registry.tenant(name), "tier", "f32")
+        try:
+            self.registry.demote_tier(
+                name, to=action.params.get("to"), reason="autopilot"
+            )
+        except TierErrorCeilingExceeded:
+            # The quantize rung would breach the characterized error
+            # ceiling — relieve the pressure through the bitwise host
+            # tier instead, exactly what the valve does.
+            self.registry.demote(name, reason="autopilot")
+            return lambda: self.registry.restore(
+                name, reason="autopilot-rollback"
+            )
+        return lambda: self.registry.restore_tier(
+            name, to=prior, reason="autopilot-rollback"
+        )
 
     def _apply_reshard(self, action: Action) -> Callable[[], None]:
         import jax
@@ -377,7 +411,13 @@ class Autopilot:
 
     def _probe(self) -> Dict[str, object]:
         """The contract probe: per-tenant failed-request counts, and for
-        each probe request the bitwise scores + best-of-3 wall."""
+        each probe request the bitwise scores + best-of-3 wall.
+
+        Precision-ladder actions (`tier_demote`/`tier_restore`) are the
+        one characterized exception: their scores are compared under the
+        pinned ``TIER_TOLERANCES`` for the coarser rung involved instead
+        of bitwise — quantization deliberately trades the bitwise
+        contract for a characterized one."""
         failed = {}
         for name in self.registry.tenant_names:
             try:
@@ -399,10 +439,14 @@ class Autopilot:
         return {"failed": failed, "probes": probes}
 
     def _probe_regressed(
-        self, pre: Dict[str, object], post: Dict[str, object]
+        self,
+        pre: Dict[str, object],
+        post: Dict[str, object],
+        action: Optional[Action] = None,
     ) -> Optional[str]:
         """None when the post-action probe holds the contract, else the
         human-readable regression reason."""
+        tol = self._probe_tolerance(action)
         for name, n_pre in pre["failed"].items():
             n_post = post["failed"].get(name, n_pre)
             if n_post > n_pre:
@@ -414,7 +458,18 @@ class Autopilot:
             q = post["probes"].get(name)
             if q is None:
                 continue
-            if not np.array_equal(p["scores"], q["scores"]):
+            if tol is not None:
+                if not np.allclose(
+                    q["scores"],
+                    p["scores"],
+                    rtol=tol["rtol"],
+                    atol=tol["atol"],
+                ):
+                    return (
+                        "characterized spot-check failed for tenant "
+                        f"{name!r}"
+                    )
+            elif not np.array_equal(p["scores"], q["scores"]):
                 return f"bitwise spot-check failed for tenant {name!r}"
             bound = max(
                 p["wall_s"] * self._probe_factor,
@@ -427,6 +482,31 @@ class Autopilot:
                     f"{q['wall_s'] * 1e3:.2f}ms)"
                 )
         return None
+
+    @staticmethod
+    def _probe_tolerance(
+        action: Optional[Action],
+    ) -> Optional[Dict[str, float]]:
+        """The pinned tolerance a precision-ladder action's probe scores
+        are held to, or None for the default bitwise contract. Uses the
+        coarser of the from/to rungs — a restore's PRE probe answered on
+        the quantized generation."""
+        if action is None or action.kind not in (
+            "tier_demote",
+            "tier_restore",
+        ):
+            return None
+        order = {"f32": 0, "bf16": 1, "int8": 2}
+        rungs = [
+            str(action.params.get("to", "f32")),
+            str(action.evidence.get("from_tier", "f32")),
+        ]
+        rung = max(
+            (r for r in rungs if r in order),
+            key=lambda r: order[r],
+            default="int8",
+        )
+        return TIER_TOLERANCES[rung]
 
     # ----------------------------------------------- rollback / quarantine
 

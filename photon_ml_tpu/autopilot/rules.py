@@ -27,7 +27,17 @@ from photon_ml_tpu.autopilot.sensors import SensorSnapshot
 __all__ = ["Action", "ControlRule", "default_rules"]
 
 # Action kinds the loop's actuator dispatch understands.
-ACTION_KINDS = ("reshard", "rebalance", "demote", "restore", "retune")
+ACTION_KINDS = (
+    "reshard",
+    "rebalance",
+    "demote",
+    "restore",
+    "retune",
+    # Precision-ladder steps (ISSUE 20): quantize one rung down /
+    # restore one rung up via TenantRegistry.demote_tier/restore_tier.
+    "tier_demote",
+    "tier_restore",
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -232,12 +242,48 @@ def hbm_demote_rule(
     hot_rows: int = 0,
 ) -> ControlRule:
     """HBM ladder, downward: under budget pressure, demote the COLDEST
-    demotable tenant (least-recently-active) to the host tier."""
+    demotable tenant (least-recently-active) to the host tier. With
+    PHOTON_TIER_LADDER on (ISSUE 20) the rule is ladder-aware: before
+    any host demotion it tries quantize-in-place — the coldest
+    quantizable tenant steps ONE precision rung down (f32 -> bf16 once
+    pressure clears the planned `tier_bf16_pressure`, bf16 -> int8 past
+    `tier_int8_pressure`); only when no quantize step is available (or
+    allowed at this pressure) does the host tier fire."""
 
     def signal(cur, prev):
         return cur.hbm_pressure
 
     def decide(cur, prev, sig):
+        from photon_ml_tpu.utils.knobs import get_knob
+
+        if bool(get_knob("PHOTON_TIER_LADDER")):
+            from photon_ml_tpu import planner
+
+            rung_at = {
+                "bf16": float(planner.planned_value("tier_bf16_pressure")),
+                "int8": float(planner.planned_value("tier_int8_pressure")),
+            }
+            steppable = sorted(
+                (t for t in cur.tenants.values() if t.can_quantize),
+                key=lambda t: t.last_active,
+            )
+            for t in steppable:
+                to = "bf16" if t.tier == "f32" else "int8"
+                if sig < rung_at[to]:
+                    continue
+                return Action(
+                    kind="tier_demote",
+                    tenant=t.name,
+                    params={"to": to},
+                    evidence={
+                        "hbm_pressure": sig,
+                        "hbm_used": cur.hbm_used,
+                        "hbm_budget": cur.hbm_budget,
+                        "victim_bytes": t.device_bytes,
+                        "from_tier": t.tier,
+                        "rung_threshold": rung_at[to],
+                    },
+                )
         victims = [
             t for t in cur.tenants.values() if t.can_demote
         ]
@@ -272,24 +318,33 @@ def hbm_restore_rule(
     ceiling: float = 0.8,
 ) -> ControlRule:
     """HBM ladder, upward: when headroom returns (signal = free
-    fraction of the budget) and a demoted tenant exists, restore the
-    most-recently-active one — but only if the restore's re-pinned bytes
-    would keep pressure under `ceiling` (restoring straight back into
-    the demote band is the oscillation this ladder exists to avoid)."""
+    fraction of the budget) and a degraded tenant exists — host-demoted
+    OR on a quantized precision rung (ISSUE 20) — walk the
+    most-recently-active one back up under the same ceiling gate: a
+    host-demoted tenant restores to residency, a quantized one steps ONE
+    rung toward f32 (`tier_restore`). Only if the step keeps pressure
+    under `ceiling` (restoring straight back into the demote band is the
+    oscillation this ladder exists to avoid)."""
 
     def signal(cur, prev):
         p = cur.hbm_pressure
         if p is None:
             return None
-        if not any(t.demoted for t in cur.tenants.values()):
+        if not any(
+            t.demoted or t.tier != "f32" for t in cur.tenants.values()
+        ):
             return None  # nothing to restore — no evidence either way
         return 1.0 - p
 
     def decide(cur, prev, sig):
-        demoted = [t for t in cur.tenants.values() if t.demoted]
-        if not demoted or cur.hbm_budget is None:
+        degraded = [
+            t
+            for t in cur.tenants.values()
+            if t.demoted or t.tier != "f32"
+        ]
+        if not degraded or cur.hbm_budget is None:
             return None
-        t = max(demoted, key=lambda t: t.last_active)
+        t = max(degraded, key=lambda t: t.last_active)
         # The demoted coordinate's hot tier stands in for its footprint;
         # the full matrix re-pins roughly the cold-tier byte volume. A
         # cheap upper bound: assume restore re-pins what demotion freed,
@@ -300,14 +355,27 @@ def hbm_restore_rule(
         p = cur.hbm_pressure
         if p is not None and p >= ceiling:
             return None
+        if t.demoted:
+            return Action(
+                kind="restore",
+                tenant=t.name,
+                params={},
+                evidence={
+                    "hbm_headroom": sig,
+                    "hbm_used": cur.hbm_used,
+                    "hbm_budget": cur.hbm_budget,
+                },
+            )
+        to = "f32" if t.tier == "bf16" else "bf16"
         return Action(
-            kind="restore",
+            kind="tier_restore",
             tenant=t.name,
-            params={},
+            params={"to": to},
             evidence={
                 "hbm_headroom": sig,
                 "hbm_used": cur.hbm_used,
                 "hbm_budget": cur.hbm_budget,
+                "from_tier": t.tier,
             },
         )
 

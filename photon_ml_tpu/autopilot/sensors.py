@@ -64,6 +64,11 @@ class TenantSensors:
     p95_ms: Optional[float]  # per-tenant, from the labeled histogram
     p99_ms: Optional[float]
     coords: Tuple[CoordinateSensors, ...]
+    # Precision-ladder facts (ISSUE 20): the tenant's current rung and
+    # whether a quantize step may pick it — what the ladder-aware
+    # hbm-demote/hbm-restore rules read.
+    tier: str = "f32"
+    can_quantize: bool = False
 
     @property
     def requests(self) -> int:
@@ -164,6 +169,10 @@ def read_sensors(registry) -> SensorSnapshot:
             p95_ms=p95_by_label.get(label),
             p99_ms=p99_by_label.get(label),
             coords=tuple(coords),
+            tier=getattr(t, "tier", "f32"),
+            can_quantize=(
+                t.can_quantize() if hasattr(t, "can_quantize") else False
+            ),
         )
     return SensorSnapshot(
         tenants=tenants,

@@ -130,7 +130,15 @@ def cmd_journal(args) -> int:
 # Event types rendered as first-class timeline rows; the autopilot's
 # rollback/quarantine events ride along as indented annotations so the
 # operator sees WHY a rule went quiet right under the decision stream.
-_DECISION_TYPES = ("plan_decision", "autopilot_decision", "shadow_verdict")
+_DECISION_TYPES = (
+    "plan_decision",
+    "autopilot_decision",
+    "shadow_verdict",
+    # Precision-ladder transitions (ISSUE 20): every quantize/restore
+    # step is a first-class, auditable control-plane decision.
+    "tier_demote",
+    "tier_restore",
+)
 _ANNOTATION_TYPES = ("autopilot_rollback", "rule_quarantined")
 
 
@@ -207,6 +215,20 @@ def cmd_decisions(args) -> int:
                 f"{doc.get('challenger_metric')} vs "
                 f"{doc.get('champion_metric')}) — {doc.get('reason')}"
             )
+        elif etype in ("tier_demote", "tier_restore"):
+            arrow = "v" if etype == "tier_demote" else "^"
+            bytes_key = (
+                "freed_bytes" if etype == "tier_demote" else "repinned_bytes"
+            )
+            line = (
+                f"tier {arrow}    tenant={doc.get('tenant')} "
+                f"{doc.get('from_tier')} -> {doc.get('to_tier')} "
+                f"[{doc.get('reason')}] "
+                f"({bytes_key}={doc.get(bytes_key)})"
+            )
+            ev = _fmt_evidence(doc.get("evidence"))
+            if ev:
+                line += f"  | {ev}"
         elif etype == "autopilot_rollback":
             action = doc.get("action") or {}
             kind = action.get("kind") if isinstance(action, dict) else action

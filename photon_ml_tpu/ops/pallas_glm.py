@@ -249,15 +249,20 @@ def kernels_healthy() -> bool:
         # (default matmul precision) while the kernels run at HIGHEST, so
         # the two legitimately differ at bf16 rounding level (~0.4%).
         # The probe discriminates broken kernels (garbage/layout bugs are
-        # orders of magnitude off), not rounding regimes.
+        # orders of magnitude off), not rounding regimes. Bars pinned in
+        # contracts.PALLAS_GATE_TOLERANCES (ISSUE 20 tolerance-pin).
+        from photon_ml_tpu.utils.contracts import PALLAS_GATE_TOLERANCES
+
         g_scale = jnp.max(jnp.abs(g_ref))
         hv_scale = jnp.max(jnp.abs(hv_ref))
         ok = (
-            bool(jnp.allclose(val, val_ref, rtol=1e-2))
+            bool(jnp.allclose(val, val_ref, **PALLAS_GATE_TOLERANCES["f32"]))
             and bool(jnp.max(jnp.abs(g - g_ref)) < 2e-2 * g_scale + 1e-3)
             and bool(jnp.max(jnp.abs(hv - hv_ref)) < 2e-2 * hv_scale + 1e-3)
             # bf16 inputs round at ~0.4%; same broken-vs-rounding bar.
-            and bool(jnp.allclose(val_bf, val_ref, rtol=3e-2))
+            and bool(
+                jnp.allclose(val_bf, val_ref, **PALLAS_GATE_TOLERANCES["bf16"])
+            )
             and bool(jnp.max(jnp.abs(g_bf - g_ref)) < 5e-2 * g_scale + 1e-2)
         )
         if not ok:

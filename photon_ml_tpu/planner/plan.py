@@ -103,6 +103,10 @@ KNOB_FOR: Dict[str, str] = {
     # delta path absorbs before forcing a warm full refit.
     "refresh_batch_rows": "PHOTON_REFRESH_BATCH_ROWS",
     "refresh_max_delta_fraction": "PHOTON_REFRESH_MAX_DELTA_FRACTION",
+    # Precision ladder (ISSUE 20): the HBM-pressure thresholds at which
+    # the autopilot quantizes a tenant down one rung.
+    "tier_bf16_pressure": "PHOTON_TIER_BF16_PRESSURE",
+    "tier_int8_pressure": "PHOTON_TIER_INT8_PRESSURE",
 }
 
 # Knob-value -> decision-vocabulary normalizers: tri-state str knobs

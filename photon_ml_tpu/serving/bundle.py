@@ -509,6 +509,15 @@ class ServingCoordinate:
     # requests resolving into a LOST shard's row range degrade to the
     # pinned zero row until the shard is restaged.
     shard_health: Optional[ShardHealth] = None
+    # Precision-ladder rung (ISSUE 20): "f32" (bitwise), or a quantized
+    # plane — "bf16" (params are bfloat16 rows) / "int8" (params are int8
+    # rows dequantized by the per-row `scales` inside the bucket
+    # program). Quantized coordinates retain `host_f32`, the ORIGINAL
+    # float32 rows in host RAM: the bitwise restore source, and what any
+    # further ladder step quantizes from (never the lossy plane).
+    tier: str = "f32"
+    scales: Optional[Array] = None  # (E + 1,) f32, int8 tier only
+    host_f32: Optional[np.ndarray] = None
 
     @property
     def is_random_effect(self) -> bool:
@@ -529,10 +538,16 @@ class ServingCoordinate:
 
     def device_nbytes(self) -> int:
         """Device-resident bytes of this coordinate's model state (the hot
-        tier only for two-tier coordinates — the cold tier is host RAM)."""
+        tier only for two-tier coordinates — the cold tier is host RAM;
+        itemsize-aware, so a bf16 plane charges half and an int8 plane a
+        quarter + its f32 scale vector; the retained `host_f32` restore
+        copy is host RAM and charges nothing)."""
         if self.store is not None:
             return self.store.hot_nbytes
-        return int(self.params.size) * self.params.dtype.itemsize
+        nb = int(self.params.size) * self.params.dtype.itemsize
+        if self.scales is not None:
+            nb += int(self.scales.size) * self.scales.dtype.itemsize
+        return nb
 
     def device_nbytes_per_shard(self) -> int:
         """Peak bytes on any ONE device: sharded matrices divide over the
@@ -978,7 +993,14 @@ def demote_bundle_to_host_tier(
                 "single-tier matrices"
             )
         logical = c.unseen_row + 1
-        host = np.asarray(c.params[:logical], np.float32)
+        if c.host_f32 is not None:
+            # Quantized coordinate (ISSUE 20): the host tier is built from
+            # the retained ORIGINAL f32 rows, never the lossy plane — a
+            # tenant demoted off the ladder's last quantized rung answers
+            # bitwise vs. its pre-quantization self again.
+            host = np.asarray(c.host_f32[:logical], np.float32)
+        else:
+            host = np.asarray(c.params[:logical], np.float32)
         store = TwoTierEntityStore(host, int(hot_rows))
         coords[cid] = ServingCoordinate(
             cid,
@@ -1024,6 +1046,142 @@ def promote_bundle_from_host_tier(bundle: ServingBundle) -> ServingBundle:
             norm=c.norm,
             random_effect_type=c.random_effect_type,
             entity_index=c.entity_index,
+        )
+    return ServingBundle(
+        task=bundle.task,
+        coordinates=coords,
+        index_maps=bundle.index_maps,
+        upload_bytes=sum(c.device_nbytes() for c in coords.values()),
+        upload_s=0.0,
+    )
+
+
+# The precision ladder's rung order (ISSUE 20), best fidelity first. The
+# host tier is deliberately NOT a rung here: it is the PR 15 whole-bundle
+# demotion (bitwise, host-RAM latency) that the ladder falls through to
+# once int8 cannot relieve pressure.
+PRECISION_LADDER = ("f32", "bf16", "int8")
+
+
+def _quantize_rows(host: np.ndarray, tier: str):
+    """Quantize one coordinate's (E + 1, dim) f32 rows to `tier`.
+
+    Returns (plane, scales, max_rel_err): the device plane, the per-row
+    f32 dequant scales (None for bf16 — its dequant is a pure dtype
+    widen), and the worst relative round-trip error against the f32 rows
+    (max |dequant - host| / max |host|, the number the per-tenant
+    `tier_quant_error` histogram records and the int8 error ceiling
+    judges). int8 is per-row symmetric: scale = max|row| / 127, zero rows
+    pinned to scale 1.0 so the zero cold-start row stays exactly zero.
+    """
+    denom = float(np.max(np.abs(host))) or 1.0
+    if tier == "bf16":
+        plane = jnp.asarray(host, jnp.bfloat16)
+        deq = np.asarray(plane.astype(jnp.float32))
+        return plane, None, float(np.max(np.abs(deq - host))) / denom
+    if tier != "int8":
+        raise ValueError(f"unknown quantized tier {tier!r}")
+    row_max = np.max(np.abs(host), axis=1)
+    scales = np.where(row_max > 0.0, row_max / 127.0, 1.0).astype(np.float32)
+    q = np.clip(np.rint(host / scales[:, None]), -127, 127).astype(np.int8)
+    deq = q.astype(np.float32) * scales[:, None]
+    return (
+        jnp.asarray(q),
+        jnp.asarray(scales),
+        float(np.max(np.abs(deq - host))) / denom,
+    )
+
+
+def quantize_bundle_rows(
+    bundle: ServingBundle, tier: str
+) -> Tuple[ServingBundle, Dict[str, float]]:
+    """Rebuild `bundle` with every replicated random-effect matrix on the
+    `tier` rung ("bf16" or "int8") — the precision ladder's demotion
+    build (ISSUE 20), run inside the `quantize_stage` fault site by
+    `TenantRegistry.demote_tier`. Always quantizes from the ORIGINAL f32
+    rows (the retained `host_f32` for an already-quantized coordinate),
+    never re-quantizes a lossy plane, so walking bf16 -> int8 costs one
+    rounding, not two. Returns (new bundle, {cid: max relative round-trip
+    error}) — the evidence the transition journals and the int8 ceiling
+    gate judges BEFORE anything commits.
+
+    Fixed-effect planes carry over by reference (quantizing them would
+    change every answer for ~nothing: they are (dim,) vectors, not
+    (E + 1, dim) matrices). Two-tier coordinates carry over too — they
+    already stopped pinning their matrix, the ladder's rung BELOW int8.
+    Entity-sharded coordinates refuse loudly, like the host-tier builder:
+    reshard to a replicated layout first."""
+    if tier not in PRECISION_LADDER or tier == "f32":
+        raise ValueError(
+            f"quantized tier must be one of {PRECISION_LADDER[1:]}, "
+            f"got {tier!r}"
+        )
+    coords: Dict[str, ServingCoordinate] = {}
+    errors: Dict[str, float] = {}
+    for cid, c in bundle.coordinates.items():
+        if not c.is_random_effect or c.store is not None:
+            coords[cid] = c
+            continue
+        if c.mesh is not None:
+            raise ValueError(
+                f"coordinate {cid!r} is entity-sharded over a mesh; "
+                "precision-tier quantization only applies to replicated "
+                "single-tier matrices (reshard first)"
+            )
+        if c.tier == tier:
+            coords[cid] = c
+            continue
+        logical = c.unseen_row + 1
+        host = (
+            np.asarray(c.host_f32[:logical], np.float32)
+            if c.host_f32 is not None
+            else np.asarray(c.params[:logical], np.float32)
+        )
+        plane, scales, err = _quantize_rows(host, tier)
+        errors[cid] = err
+        coords[cid] = ServingCoordinate(
+            cid,
+            c.shard,
+            plane,
+            norm=c.norm,
+            random_effect_type=c.random_effect_type,
+            entity_index=c.entity_index,
+            shard_health=c.shard_health,
+            tier=tier,
+            scales=scales,
+            host_f32=host,
+        )
+    out = ServingBundle(
+        task=bundle.task,
+        coordinates=coords,
+        index_maps=bundle.index_maps,
+        upload_bytes=sum(c.device_nbytes() for c in coords.values()),
+        upload_s=0.0,
+    )
+    return out, errors
+
+
+def restore_bundle_precision(bundle: ServingBundle) -> ServingBundle:
+    """The exact inverse of `quantize_bundle_rows`: rebuild every
+    quantized coordinate as a full-precision f32 matrix from its retained
+    `host_f32` rows — BITWISE vs. the pre-quantization generation (the
+    retained copy IS the original rows; quantization never touched it).
+    The `tier_restore` fault-site build run by
+    `TenantRegistry.restore_tier`. Un-quantized coordinates carry over by
+    reference."""
+    coords: Dict[str, ServingCoordinate] = {}
+    for cid, c in bundle.coordinates.items():
+        if c.tier == "f32" or c.host_f32 is None:
+            coords[cid] = c
+            continue
+        coords[cid] = ServingCoordinate(
+            cid,
+            c.shard,
+            jnp.asarray(c.host_f32),
+            norm=c.norm,
+            random_effect_type=c.random_effect_type,
+            entity_index=c.entity_index,
+            shard_health=c.shard_health,
         )
     return ServingBundle(
         task=bundle.task,

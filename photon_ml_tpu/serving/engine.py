@@ -107,7 +107,9 @@ class _EngineState:
     `kinds` name each coordinate's storage mode and pick its margin kernel:
     "fe" (weight vector), "re" (single-tier matrix), "re_sh" (row-sharded
     matrix over `meshes[k]` — the fused program becomes a pjit program over
-    the mesh), "re2" (two-tier hot/cold store)."""
+    the mesh), "re2" (two-tier hot/cold store), "re_bf16"/"re_i8"
+    (precision-ladder quantized planes, dequantized inside the fused
+    program — ISSUE 20)."""
 
     bundle: ServingBundle
     coords: List[ServingCoordinate]
@@ -168,6 +170,19 @@ def _score_program(
             ovr_vals, ovr_flags = overrides[k]
             w = params[k][rows[k]]
             w = jnp.where(ovr_flags[:, None], ovr_vals, w)
+            total = total + gathered_row_margins(feats, w, norms[k])
+        elif kind == "re_bf16":
+            # Quantized rung (ISSUE 20): the gathered bf16 rows widen to
+            # f32 INSIDE the fused program — one extra cast on (B, dim)
+            # request rows, never a host-side dequant of the full matrix.
+            w = params[k][rows[k]].astype(jnp.float32)
+            total = total + gathered_row_margins(feats, w, norms[k])
+        elif kind == "re_i8":
+            # int8 rung: params[k] is (int8 plane, per-row f32 scales);
+            # dequant is fused per gathered row — widen + one broadcast
+            # multiply by the row's symmetric scale.
+            plane, scales = params[k]
+            w = plane[rows[k]].astype(jnp.float32) * scales[rows[k]][:, None]
             total = total + gathered_row_margins(feats, w, norms[k])
         else:
             total = total + random_effect_margins(
@@ -421,6 +436,11 @@ class ServingEngine:
                 return "re2"
             if getattr(c, "mesh", None) is not None:
                 return "re_sh"
+            tier = getattr(c, "tier", "f32")
+            if tier == "bf16":
+                return "re_bf16"
+            if tier == "int8":
+                return "re_i8"
             return "re"
 
         return _EngineState(
@@ -753,11 +773,14 @@ class ServingEngine:
             for c in state.coords
         )
         # Two-tier coordinates score against the hot-matrix snapshot
-        # the pack stage captured with the slots; everyone else serves
-        # the bundle's pinned planes.
+        # the pack stage captured with the slots; int8 coordinates pass
+        # (plane, per-row scales) so the program's fused dequant gathers
+        # both; everyone else serves the bundle's pinned planes.
         params = tuple(
-            packed["tier_params"].get(c.cid, c.params)
-            for c in state.coords
+            (c.params, c.scales)
+            if state.kinds[k] == "re_i8"
+            else packed["tier_params"].get(c.cid, c.params)
+            for k, c in enumerate(state.coords)
         )
         norms = tuple(c.norm for c in state.coords)
         total, means = self._jit(

@@ -161,6 +161,15 @@ def plan_coordinate_reshard(
             f"coordinate {coord.cid!r} has no device-resident shard "
             "tracking (fixed-effect or two-tier coordinate)"
         )
+    if getattr(coord, "tier", "f32") != "f32":
+        # ISSUE 20: the movement plan assumes f32 row planes (4-byte
+        # rows, params-only staging); a quantized plane carries scales
+        # alongside. Restore full precision first, then reshard.
+        raise ValueError(
+            f"coordinate {coord.cid!r} is quantized to "
+            f"{coord.tier!r} — resharding requires full-precision rows "
+            "(restore_bundle_precision first)"
+        )
     old_devs = _coord_devices(coord)
     new_devs = _mesh_devices(new_mesh)
     n_old, n_new = len(old_devs), len(new_devs)

@@ -63,12 +63,29 @@ serving platform" (the ROADMAP's multi-tenant open item):
   demote, never fail, a READY tenant; only a fleet that cannot fit even
   after demoting every candidate refuses with `HbmBudgetExceeded`.
 
+* **Precision-tier graceful degradation (ISSUE 20).** With
+  `PHOTON_TIER_LADDER` on, the pressure valve (and the autopilot's
+  hbm rules) walks a tenant DOWN a ladder instead of leaping to the
+  host tier: f32 -> bf16 -> int8 -> host (`demote_tier`), each quantize
+  rung halving/quartering the pinned RE bytes via planes dequantized
+  INSIDE the bucket programs, and each step the same stage->pre-warm->
+  commit->drain generation flip as a hot-swap. Quantization always
+  reads the retained ORIGINAL f32 rows, so `restore_tier` walks back up
+  and the final f32 step — and any host-tier round trip — is BITWISE
+  vs. the pre-demotion self. A quantized tenant answers under the
+  CHARACTERIZED contract (contracts.TIER_TOLERANCES), not the bitwise
+  one; that trade is opt-in, journaled (`tier_demote`/`tier_restore`
+  with evidence), error-histogrammed per tenant, and refused outright
+  when int8's measured error would exceed the configured ceiling.
+
 Fault sites: `tenant_admit` (staging a tenant onto the fleet — bounded
-retry, an exhausted failure leaves the registry unchanged) and
+retry, an exhausted failure leaves the registry unchanged),
 `tenant_evict` (the demotion build — bounded retry, a terminal failure
 rolls back and the tenant keeps serving its device-resident
-generation). Journal events `tenant_admit`/`tenant_evict`/
-`tenant_degraded` record the platform's lifecycle per tenant.
+generation), and `quantize_stage`/`tier_restore` (the ladder builds —
+same rollback story, counted in `tier_rollbacks`). Journal events
+`tenant_admit`/`tenant_evict`/`tenant_degraded`/`tier_demote`/
+`tier_restore` record the platform's lifecycle per tenant.
 """
 
 from __future__ import annotations
@@ -87,10 +104,13 @@ import numpy as np
 from photon_ml_tpu.game.model import gathered_row_margins
 from photon_ml_tpu.ops.losses import mean_for_task
 from photon_ml_tpu.serving.bundle import (
+    PRECISION_LADDER,
     ScoreRequest,
     ServingBundle,
     demote_bundle_to_host_tier,
     promote_bundle_from_host_tier,
+    quantize_bundle_rows,
+    restore_bundle_precision,
 )
 from photon_ml_tpu.serving.engine import (
     ScoreResult,
@@ -107,7 +127,7 @@ from photon_ml_tpu.serving.lifecycle import (
 )
 from photon_ml_tpu.transformers.game_transformer import dense_margins
 from photon_ml_tpu.utils import faults, telemetry
-from photon_ml_tpu.utils.contracts import TENANT_BLOCK_KEYS
+from photon_ml_tpu.utils.contracts import TENANT_BLOCK_KEYS, TIER_BLOCK_KEYS
 from photon_ml_tpu.utils.knobs import get_knob
 from photon_ml_tpu.utils.watchdog import Watchdog, watchdog_ms
 
@@ -116,6 +136,14 @@ logger = logging.getLogger(__name__)
 # One queued request: (request, future, submit time, absolute expiry or None)
 # — the micro-batcher's pending shape, kept per tenant.
 _Pending = Tuple[ScoreRequest, Future, float, Optional[float]]
+
+
+class TierErrorCeilingExceeded(RuntimeError):
+    """An int8 quantization's measured round-trip error exceeded
+    PHOTON_TIER_INT8_ERROR_CEILING: the build is discarded BEFORE commit,
+    the tenant stays on its current rung, and ladder walkers fall through
+    to the (bitwise) host tier for pressure relief instead of serving
+    answers outside the characterized tolerance."""
 
 
 def _cobatch_program(offsets, tids, feats, rows, params, *, kinds, task):
@@ -196,6 +224,16 @@ class Tenant:
         self.cobatch_degraded = 0  # co-batches this tenant degraded out of
         self.latency = telemetry.LatencyStats()
         self._seen_reasons: Tuple[str, ...] = ()
+        # Precision-ladder bookkeeping (ISSUE 20): the tenant's current
+        # rung ("f32"/"bf16"/"int8" — the host rung keeps the last
+        # quantized rung beside demoted=True), per-tenant transition
+        # tallies, and the worst quantization error ever measured (None
+        # until the first quantization) — the metrics() tier sub-block.
+        self.tier = "f32"
+        self.tier_demotions = 0
+        self.tier_restores = 0
+        self.tier_rollbacks = 0
+        self.quant_error_max: Optional[float] = None
 
     @property
     def bundle(self) -> ServingBundle:
@@ -214,6 +252,19 @@ class Tenant:
             return False
         st = self.engine._state
         return all(kind != "re_sh" for kind in st.kinds)
+
+    def can_quantize(self) -> bool:
+        """Whether a precision-ladder step down may pick this tenant: not
+        demoted, not already on the last quantized rung, no entity-
+        sharded coordinate (quantize_bundle_rows refuses it loudly), and
+        at least one replicated RE matrix left to shrink — an all-FE or
+        all-two-tier tenant frees nothing by quantizing."""
+        if self.demoted or self.tier == PRECISION_LADDER[-1]:
+            return False
+        st = self.engine._state
+        if any(kind == "re_sh" for kind in st.kinds):
+            return False
+        return any(kind in ("re", "re_bf16") for kind in st.kinds)
 
     def signature(self) -> Optional[tuple]:
         """The co-batch compatibility key, or None when this tenant must
@@ -364,7 +415,12 @@ class TenantRegistry:
             raise ValueError(f"tenant {name!r} bundle is already released")
 
         # HBM pressure: demote, never fail, resident tenants to fit the
-        # newcomer; refuse only when no demotion can free enough.
+        # newcomer; refuse only when no demotion can free enough. With
+        # PHOTON_TIER_LADDER on (ISSUE 20), each relief step walks the
+        # coldest steppable tenant ONE precision rung down (quantize-in-
+        # place before host-tier demotion); off keeps the PR 15 all-or-
+        # nothing host demotion and the bitwise contract.
+        ladder = bool(get_knob("PHOTON_TIER_LADDER"))
         demoted: List[str] = []
         need = _bundle_device_bytes(staged)
         budget = self._fleet_budget()
@@ -379,6 +435,7 @@ class TenantRegistry:
                             t
                             for t in self._tenants.values()
                             if t.can_demote()
+                            or (ladder and t.can_quantize())
                         ),
                         key=lambda t: (t.last_active, t.order),
                     )
@@ -391,8 +448,28 @@ class TenantRegistry:
                         "every demotable resident tenant is already on "
                         "the host tier"
                     )
-                victim = victims[0]
-                self.demote(victim.name, reason="hbm_pressure")
+                # Quantize-in-place is tried before host-tier demotion
+                # FLEET-WIDE: the coldest quantizable tenant steps a rung
+                # down first, even when an already-int8 tenant is colder
+                # — otherwise the valve would walk one tenant straight
+                # through to host while its neighbors still had lossless-
+                # er rungs to give.
+                victim = next(
+                    (t for t in victims if ladder and t.can_quantize()),
+                    victims[0],
+                )
+                if ladder and victim.can_quantize():
+                    try:
+                        self.demote_tier(
+                            victim.name, reason="hbm_pressure"
+                        )
+                    except TierErrorCeilingExceeded:
+                        # int8 would answer outside the characterized
+                        # tolerance: fall through to the bitwise host
+                        # tier for this victim's relief instead.
+                        self.demote(victim.name, reason="hbm_pressure")
+                else:
+                    self.demote(victim.name, reason="hbm_pressure")
                 demoted.append(victim.name)
         except BaseException:
             if builder is not None and staged is not None:
@@ -566,6 +643,12 @@ class TenantRegistry:
                     new_state, baseline_bump=t.engine.compiles - before
                 )
                 t.demoted = False
+                # The cold tier holds the ORIGINAL f32 rows (a quantized
+                # tenant's host demotion was built from its retained
+                # host_f32 copy), so a host restore always lands on the
+                # full-precision rung — quantized rungs are only
+                # re-entered by a new demote_tier() (ISSUE 20).
+                t.tier = "f32"
                 t.engine._drain_state(old_state, timeout_s=30.0)
                 # close_stores=True: the restored generation owns plain
                 # device matrices — the old bundle's two-tier stores (and
@@ -582,6 +665,229 @@ class TenantRegistry:
         logger.info(
             "tenant %r restored to HBM residency (%s): %.2f MB re-pinned",
             name,
+            reason,
+            repinned / 1e6,
+        )
+        return int(repinned)
+
+    # ------------------------------------------------------ precision ladder
+
+    def demote_tier(
+        self, name: str, *, to: Optional[str] = None, reason: str = "manual"
+    ) -> int:
+        """Walk tenant `name` DOWN the precision ladder (ISSUE 20):
+        f32 -> bf16 -> int8 -> host, one rung per call by default, or to
+        the named rung `to` ("bf16"/"int8"/"host"). Each quantize step is
+        the same stage->pre-warm->commit->drain generation flip as a
+        hot-swap, under the `quantize_stage` fault site with the bounded
+        retry policy — a terminal mid-quantize failure (or SIGKILL)
+        leaves the OLD generation serving and counts `tier_rollbacks`.
+        An int8 step whose measured round-trip error exceeds
+        PHOTON_TIER_INT8_ERROR_CEILING raises `TierErrorCeilingExceeded`
+        before commit (when walking past it to "host", the ceiling trip
+        falls through to the bitwise host tier instead). The host rung
+        delegates to `demote()` — the PR 15 whole-bundle host demotion,
+        built from the retained ORIGINAL f32 rows, never a lossy plane.
+        Returns total device bytes freed."""
+        t = self._tenant(name)
+        ladder = (*PRECISION_LADDER, "host")
+        if to is not None and to not in ladder[1:]:
+            raise ValueError(
+                f"unknown precision rung {to!r} (ladder: {ladder[1:]})"
+            )
+        if t.demoted:
+            return 0
+        idx = ladder.index(t.tier)
+        tgt = idx + 1 if to is None else ladder.index(to)
+        if tgt <= idx:
+            return 0
+        freed = 0
+        for rung in ladder[idx + 1 : tgt + 1]:
+            if rung == "host":
+                freed += self.demote(name, reason=reason)
+                continue
+            try:
+                freed += self._quantize_step(t, rung, reason)
+            except TierErrorCeilingExceeded:
+                if tgt > ladder.index(rung):
+                    # Walking past int8 anyway: the host rung below is
+                    # bitwise — skip the refused rung, keep descending.
+                    continue
+                raise
+        return int(freed)
+
+    def restore_tier(
+        self, name: str, *, to: str = "f32", reason: str = "manual"
+    ) -> int:
+        """Walk tenant `name` back UP the ladder toward `to` (default all
+        the way to f32): host -> int8 -> bf16 -> f32, under the existing
+        demote/restore discipline per step. The host rung delegates to
+        `restore()`; quantized rungs rebuild under the `tier_restore`
+        fault site — the final step to f32 is BITWISE (rebuilt from the
+        retained original rows), intermediate re-quantizations
+        (int8 -> bf16) re-round the same originals. Returns total device
+        bytes re-pinned."""
+        t = self._tenant(name)
+        ladder = (*PRECISION_LADDER, "host")
+        if to not in PRECISION_LADDER:
+            raise ValueError(
+                f"unknown precision rung {to!r} (ladder: {PRECISION_LADDER})"
+            )
+        repinned = 0
+        if t.demoted:
+            repinned += self.restore(name, reason=reason)
+        tgt = ladder.index(to)
+        while ladder.index(t.tier) > tgt:
+            repinned += self._restore_step(
+                t, ladder[ladder.index(t.tier) - 1], reason
+            )
+        return int(repinned)
+
+    def _quantize_step(self, t: Tenant, rung: str, reason: str) -> int:
+        """One committed rung down: quantize, pre-warm, flip, drain.
+        Serialized with hot-swaps on the engine's swap mutex, like
+        `demote()` — a model push and a ladder step must order, never
+        race, the state flip."""
+        from_tier = t.tier
+        with t.engine.bundle_manager.mutex:
+            old_state = t.engine._state
+            old_bytes = _bundle_device_bytes(old_state.bundle)
+
+            def _build():
+                faults.fault_point("quantize_stage")
+                return quantize_bundle_rows(old_state.bundle, rung)
+
+            with telemetry.metric_label_scope(tenant=t.name):
+                try:
+                    new_bundle, errors = faults.retry(
+                        _build, label=f"tenant {t.name} {rung} quantization"
+                    )
+                except BaseException:
+                    # Retry exhausted mid-stage: nothing committed, the
+                    # old generation never stopped serving.
+                    t.tier_rollbacks += 1
+                    faults.COUNTERS.increment("tier_rollbacks")
+                    raise
+                err_max = max(errors.values(), default=0.0)
+                ceiling = float(
+                    get_knob("PHOTON_TIER_INT8_ERROR_CEILING")
+                )
+                if rung == "int8" and err_max > ceiling:
+                    new_bundle.release(close_stores=False)
+                    t.tier_rollbacks += 1
+                    faults.COUNTERS.increment("tier_rollbacks")
+                    raise TierErrorCeilingExceeded(
+                        f"tenant {t.name!r}: int8 round-trip error "
+                        f"{err_max:.4g} exceeds the "
+                        f"PHOTON_TIER_INT8_ERROR_CEILING of {ceiling}; "
+                        f"staying at {from_tier!r}"
+                    )
+                for err in errors.values():
+                    # Ambient tenant label: the per-tenant quantization-
+                    # error histogram the characterized contract audits.
+                    telemetry.METRICS.observe("tier_quant_error", err)
+                new_state = t.engine._build_state(
+                    new_bundle, version=old_state.version + 1
+                )
+                # The kinds changed re -> re_bf16/re_i8: new bucket
+                # programs — pre-warm so the flip compiles nothing on
+                # live traffic (the demotion's own discipline).
+                before = t.engine.compiles
+                t.engine._warm_state(new_state)
+                t.engine._commit_state(
+                    new_state, baseline_bump=t.engine.compiles - before
+                )
+                t.tier = rung
+                t.tier_demotions += 1
+                t.quant_error_max = max(t.quant_error_max or 0.0, err_max)
+                t.engine._drain_state(old_state, timeout_s=30.0)
+                old_state.bundle.release(close_stores=False)
+                faults.COUNTERS.increment("tier_demotions")
+        freed = old_bytes - _bundle_device_bytes(new_bundle)
+        telemetry.emit_event(
+            "tier_demote",
+            tenant=t.name,
+            from_tier=from_tier,
+            to_tier=rung,
+            reason=reason,
+            freed_bytes=int(freed),
+            evidence={
+                "quant_error_max": err_max,
+                "quantized_coordinates": len(errors),
+            },
+        )
+        logger.info(
+            "tenant %r stepped down the precision ladder %s -> %s (%s): "
+            "%.2f MB HBM freed, worst round-trip error %.4g",
+            t.name,
+            from_tier,
+            rung,
+            reason,
+            freed / 1e6,
+            err_max,
+        )
+        return int(freed)
+
+    def _restore_step(self, t: Tenant, rung: str, reason: str) -> int:
+        """One committed rung up: rebuild toward `rung` from the retained
+        original rows, pre-warm, flip, drain — under the `tier_restore`
+        fault site. The step to "f32" is bitwise; int8 -> bf16 re-rounds
+        the same originals (never the int8 plane)."""
+        from_tier = t.tier
+        with t.engine.bundle_manager.mutex:
+            old_state = t.engine._state
+            old_bytes = _bundle_device_bytes(old_state.bundle)
+
+            def _build():
+                faults.fault_point("tier_restore")
+                if rung == "f32":
+                    return restore_bundle_precision(old_state.bundle), {}
+                return quantize_bundle_rows(old_state.bundle, rung)
+
+            with telemetry.metric_label_scope(tenant=t.name):
+                try:
+                    new_bundle, errors = faults.retry(
+                        _build, label=f"tenant {t.name} {rung} restore"
+                    )
+                except BaseException:
+                    t.tier_rollbacks += 1
+                    faults.COUNTERS.increment("tier_rollbacks")
+                    raise
+                for err in errors.values():
+                    telemetry.METRICS.observe("tier_quant_error", err)
+                new_state = t.engine._build_state(
+                    new_bundle, version=old_state.version + 1
+                )
+                before = t.engine.compiles
+                t.engine._warm_state(new_state)
+                t.engine._commit_state(
+                    new_state, baseline_bump=t.engine.compiles - before
+                )
+                t.tier = rung
+                t.tier_restores += 1
+                if errors:
+                    t.quant_error_max = max(
+                        t.quant_error_max or 0.0, max(errors.values())
+                    )
+                t.engine._drain_state(old_state, timeout_s=30.0)
+                old_state.bundle.release(close_stores=False)
+                faults.COUNTERS.increment("tier_restores")
+        repinned = _bundle_device_bytes(new_bundle) - old_bytes
+        telemetry.emit_event(
+            "tier_restore",
+            tenant=t.name,
+            from_tier=from_tier,
+            to_tier=rung,
+            reason=reason,
+            repinned_bytes=int(repinned),
+            evidence={"quantized_coordinates": len(errors)},
+        )
+        logger.info(
+            "tenant %r stepped up the precision ladder %s -> %s (%s): "
+            "%.2f MB re-pinned",
+            t.name,
+            from_tier,
+            rung,
             reason,
             repinned / 1e6,
         )
@@ -1302,10 +1608,28 @@ class TenantRegistry:
                 "watchdog_trips": int(
                     wd_labeled.get(f"tenant={t.name}", 0)
                 ),
+                # Precision-ladder sub-block (ISSUE 20): the tenant's
+                # rung + ladder history, TIER_BLOCK_KEYS order.
+                "tier": {
+                    "tier": t.tier,
+                    "quantized_coords": sum(
+                        1
+                        for k in t.engine._state.kinds
+                        if k in ("re_bf16", "re_i8")
+                    ),
+                    "demotions": t.tier_demotions,
+                    "restores": t.tier_restores,
+                    "rollbacks": t.tier_rollbacks,
+                    "quant_error_max": t.quant_error_max,
+                },
             }
             assert set(block) == set(TENANT_BLOCK_KEYS), (
                 "tenant metrics block drifted from utils/contracts."
                 "TENANT_BLOCK_KEYS"
+            )
+            assert set(block["tier"]) == set(TIER_BLOCK_KEYS), (
+                "tenant tier sub-block drifted from utils/contracts."
+                "TIER_BLOCK_KEYS"
             )
             out["tenants"][t.name] = block
         return out

@@ -186,6 +186,12 @@ ROBUSTNESS_CLEAN_ZERO_KEYS = (
     # rollback. A clean closed-loop run adapts without ever reverting.
     "autopilot_rollbacks",
     "autopilot_quarantines",
+    # ISSUE 20: precision-tier ladder — a clean fit/replay never walks
+    # the ladder, so demotions, restores, AND rollbacks are all zero;
+    # a bench ladder drill asserts the exact non-zero counts it caused.
+    "tier_demotions",
+    "tier_restores",
+    "tier_rollbacks",
 )
 
 # Top-level serving-summary.json keys written by cli/serve.py. r14
@@ -247,7 +253,52 @@ TENANT_BLOCK_KEYS = (
     "demoted",
     "device_bytes",
     "watchdog_trips",
+    "tier",
 )
+
+# Per-tenant precision-ladder sub-block (ISSUE 20): nested under the
+# tenant block's "tier" key — the tenant's current rung plus its ladder
+# history, so serving-summary.json and the bench multi_tenant section can
+# audit HOW a tenant got to the precision it serves at. "tier" is the
+# rung name ("f32"/"bf16"/"int8"; the host rung keeps the tenant's last
+# quantized rung beside demoted=True), "quantized_coords" counts RE
+# coordinates currently serving dequantized rows, and "quant_error_max"
+# is the worst recorded per-coordinate relative round-trip error (None
+# until the first quantization).
+TIER_BLOCK_KEYS = (
+    "tier",
+    "quantized_coords",
+    "demotions",
+    "restores",
+    "rollbacks",
+    "quant_error_max",
+)
+
+# The characterized-parity contract (ISSUE 20): per-rung allclose
+# tolerances for scores served from quantized RE rows, compared against
+# the same tenant's f32 answers. THE one home for these numbers — the
+# photon-lint `tolerance-pin` check flags any allclose-style tolerance
+# literal outside this module, so the characterized contract cannot
+# drift test-by-test. f32 pins zeros: an un-quantized tenant is still
+# bitwise. int8 is per-row symmetric (scale = max|row|/127), so its
+# worst case is half an LSB of the largest row entry — the atol term
+# absorbs near-zero margins where rtol alone is meaningless.
+TIER_TOLERANCES = {
+    "f32": {"rtol": 0.0, "atol": 0.0},
+    "bf16": {"rtol": 1e-2, "atol": 1e-3},
+    "int8": {"rtol": 8e-2, "atol": 3e-2},
+}
+
+# The pallas_glm kernel-health smoke gate's discrimination thresholds
+# (ops/pallas_glm.kernels_healthy): broken-kernel detection bars, NOT
+# parity tolerances — the XLA reference itself runs bf16 MXU passes on
+# TPU, so the f32-input bar sits at bf16 rounding level and the
+# bf16-input bar at ~3x it. Pinned here for the same tolerance-pin
+# reason as TIER_TOLERANCES.
+PALLAS_GATE_TOLERANCES = {
+    "f32": {"rtol": 1e-2},
+    "bf16": {"rtol": 3e-2},
+}
 
 # bench.py multi_tenant section (ISSUE 15): the serving-platform
 # isolation certificate — 10 tenant bundles on one 8-virtual-device
@@ -275,6 +326,18 @@ MULTI_TENANT_SECTION_KEYS = (
     "admitted_over_budget",
     "evicted_bitwise",
     "tenants",
+    # ISSUE 20: the precision-ladder HBM-squeeze drill — how many tenants
+    # the ladder fit on the same fleet vs. f32-only residency, whether
+    # quantized replay stayed within TIER_TOLERANCES, that every ladder
+    # transition completed with zero failed requests, and that a tenant
+    # walked down and back answers bitwise vs. its pre-demotion self.
+    "ladder_resident_tenants",
+    "f32_capacity_tenants",
+    "ladder_capacity_ratio",
+    "quantized_within_tolerance",
+    "ladder_failed_requests",
+    "ladder_transitions",
+    "ladder_restored_bitwise",
 )
 
 # bench.py chaos_multichip section (r10): the pod-scale chaos
@@ -570,6 +633,11 @@ JOURNAL_EVENT_SCHEMAS = {
     "autopilot_decision": ("rule", "action", "evidence", "outcome"),
     "autopilot_rollback": ("rule", "action", "reason"),
     "rule_quarantined": ("rule", "reason", "rollbacks"),
+    # -- precision-tier ladder (serving/tenancy.py, ISSUE 20) --
+    "tier_demote": ("tenant", "from_tier", "to_tier", "reason",
+                    "freed_bytes", "evidence"),
+    "tier_restore": ("tenant", "from_tier", "to_tier", "reason",
+                     "repinned_bytes", "evidence"),
 }
 
 # ------------------------------------------------------------------- profile
@@ -635,6 +703,7 @@ ALL_CONTRACTS = {
     "SERVING_SUMMARY_KEYS": SERVING_SUMMARY_KEYS,
     "BUNDLE_PROVENANCE_KEYS": BUNDLE_PROVENANCE_KEYS,
     "TENANT_BLOCK_KEYS": TENANT_BLOCK_KEYS,
+    "TIER_BLOCK_KEYS": TIER_BLOCK_KEYS,
     "DELTA_BUNDLE_KEYS": DELTA_BUNDLE_KEYS,
     "CONTINUOUS_SECTION_KEYS": CONTINUOUS_SECTION_KEYS,
     "MULTI_TENANT_SECTION_KEYS": MULTI_TENANT_SECTION_KEYS,

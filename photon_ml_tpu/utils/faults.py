@@ -159,6 +159,17 @@ SITE_DESCRIPTIONS = {
     # toward the rule's quarantine threshold; client requests never fail.
     "autopilot_act": "autopilot actuation (applying a ControlRule's "
     "decided action through the serving actuators)",
+    # Precision-tier ladder (ISSUE 20): both sites fire inside the
+    # stage->pre-warm->commit->drain transition, BEFORE anything is
+    # committed — an injected (or real) mid-quantize death leaves the
+    # old generation serving bitwise.
+    "quantize_stage": "precision-ladder demotion build (quantizing a "
+    "tenant's RE row planes to bf16/int8 — bounded retry; a terminal "
+    "failure rolls back with the old generation still serving)",
+    "tier_restore": "precision-ladder restore build (walking a tenant's "
+    "RE row planes back toward f32 from the retained host copies — "
+    "bounded retry; a terminal failure leaves the quantized generation "
+    "serving)",
 }
 KNOWN_SITES = tuple(SITE_DESCRIPTIONS)
 

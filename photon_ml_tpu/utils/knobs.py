@@ -491,6 +491,47 @@ _register(
     "of oscillating; 0 disables the cooldown.",
 )
 
+# --------------------------------------------------------- precision tiers
+_register(
+    "PHOTON_TIER_LADDER",
+    bool,
+    False,
+    "Precision-tier graceful degradation (ISSUE 20): 1 makes the HBM "
+    "pressure valve and the autopilot's hbm-demote rule walk the "
+    "f32 -> bf16 -> int8 -> host ladder (quantize-in-place before host-"
+    "tier demotion); 0 (default) keeps the PR 15 all-or-nothing host "
+    "demotion and the bitwise serving contract. Opt-in because a "
+    "quantized tenant answers under a CHARACTERIZED tolerance "
+    "(contracts.TIER_TOLERANCES), not bitwise.",
+)
+_register(
+    "PHOTON_TIER_BF16_PRESSURE",
+    float,
+    0.85,
+    "Precision ladder: HBM pressure (pinned bytes / fleet budget) above "
+    "which the autopilot's ladder-aware hbm-demote rule quantizes the "
+    "coldest f32 tenant's RE rows to bf16 (the first, cheapest rung).",
+)
+_register(
+    "PHOTON_TIER_INT8_PRESSURE",
+    float,
+    0.92,
+    "Precision ladder: HBM pressure above which a bf16 tenant steps down "
+    "to int8 rows (per-row symmetric scales); past int8 the only rung "
+    "left is the PR 15 host tier. Must be >= PHOTON_TIER_BF16_PRESSURE "
+    "for the ladder to walk in order.",
+)
+_register(
+    "PHOTON_TIER_INT8_ERROR_CEILING",
+    float,
+    0.1,
+    "Precision ladder: refuse an int8 quantization whose measured worst "
+    "per-coordinate relative round-trip error exceeds this ceiling — the "
+    "tenant stays at bf16 and pressure relief falls through to the host "
+    "tier instead of serving answers outside the characterized "
+    "tolerance.",
+)
+
 # ------------------------------------------------------------------- planner
 _register(
     "PHOTON_PLAN",

@@ -157,6 +157,17 @@ METRIC_DESCRIPTIONS = {
     "post-action contract probe regressed",
     "autopilot_quarantines": "control rules benched after a rollback "
     "until an operator reset",
+    # Precision-tier ladder (ISSUE 20): every completed ladder step in
+    # either direction, plus transitions that exhausted their retry
+    # policy and rolled back to the generation still serving. All three
+    # are ROBUSTNESS_CLEAN_ZERO_KEYS — a clean run never walks the
+    # ladder.
+    "tier_demotions": "precision-ladder steps down (f32->bf16->int8->"
+    "host) committed on a serving tenant",
+    "tier_restores": "precision-ladder steps back up toward f32 "
+    "committed on a serving tenant",
+    "tier_rollbacks": "ladder transitions abandoned after retry "
+    "exhaustion, the old generation still serving",
     # -- histograms (fixed log-spaced buckets, mergeable) --
     "serving_latency_ms": "per-request wall latency through the batcher",
     "serving_queue_wait_ms": "submit-to-claim queue wait per request",
@@ -168,6 +179,9 @@ METRIC_DESCRIPTIONS = {
     "calibration error per evaluated window",
     "shadow_calibration_challenger": "per-request |challenger mean - label| "
     "calibration error per evaluated window",
+    "tier_quant_error": "per-coordinate worst relative round-trip error "
+    "measured at each quantization (labeled per tenant) — the "
+    "characterized-parity evidence behind contracts.TIER_TOLERANCES",
     # -- gauges (last-write-wins) --
     "serving_pending_depth": "batcher queue depth observed at batch claim",
     "serving_bundle_generation": "live bundle generation after a hot-swap",

@@ -26,3 +26,6 @@ FIXTURE_SHADOW_KEYS = ("fixture_shadow_windows", "fixture_shadow_verdict", "fixt
 
 # Autopilot decision schema (r19): the closed-loop controller keys.
 FIXTURE_AUTOPILOT_KEYS = ("fixture_ap_rule", "fixture_ap_outcome", "fixture_ap_rollbacks")
+
+# Tier-ladder schema (r20): the precision-ladder tenant block keys.
+FIXTURE_TIER_KEYS = ("fixture_tier_name", "fixture_tier_demotions", "fixture_tier_restores")

@@ -1,0 +1,9 @@
+"""Known-bad: re-types two precision-ladder schema keys (the r20
+FIXTURE_TIER_KEYS shape) as a literal instead of importing the tuple."""
+
+
+def check_tier(block):
+    ladder = {
+        k: block[k] for k in ("fixture_tier_name", "fixture_tier_demotions")
+    }  # re-typed tier schema
+    return ladder

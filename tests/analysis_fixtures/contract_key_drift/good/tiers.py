@@ -1,0 +1,10 @@
+"""Known-good: the precision-ladder schema is imported; single-key
+reads are use, not duplication."""
+
+from contracts import FIXTURE_TIER_KEYS
+
+
+def check_tier(block):
+    missing = [k for k in FIXTURE_TIER_KEYS if k not in block]
+    rung = block.get("fixture_tier_name")  # one key is vocabulary
+    return missing, rung

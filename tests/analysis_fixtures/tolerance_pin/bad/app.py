@@ -1,0 +1,22 @@
+"""Known-bad: inlines parity tolerances at allclose-style call sites —
+rtol/atol keywords and the positional numpy spellings — instead of
+pinning them in utils/contracts.py's tolerance tables."""
+
+import numpy as np
+
+
+def gate(val, ref):
+    return bool(np.allclose(val, ref, rtol=1e-2))  # keyword finding
+
+
+def gate_bf16(val, ref):
+    # Both tolerance keywords inline: two findings on one call.
+    return bool(np.allclose(val, ref, rtol=3e-2, atol=1e-3))
+
+
+def spot_check(scores, ref):
+    return np.isclose(scores, ref, 5e-2)  # positional rtol finding
+
+
+def assert_parity(actual, desired):
+    np.testing.assert_allclose(actual, desired, 1e-2, 1e-3)  # positional

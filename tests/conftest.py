@@ -152,7 +152,11 @@ def _failure_domain_hygiene(monkeypatch):
     * no `photon-shadow-*` evaluation worker outlives the test — a
       ShadowController's window-evaluation thread is joined by
       `close()`; a survivor means mirrored windows kept scoring (and
-      could journal verdicts) against a torn-down registry.
+      could journal verdicts) against a torn-down registry;
+    * no `photon-tier-*` worker outlives the test — precision-ladder
+      helpers (traffic replays riding a quantize/restore flip) join
+      before the transition commits; a survivor means requests kept
+      scoring against a drained generation.
     """
     from photon_ml_tpu.utils import faults, telemetry
 
@@ -205,6 +209,14 @@ def _failure_domain_hygiene(monkeypatch):
         "PHOTON_AUTOPILOT_MS",
         "PHOTON_AUTOPILOT_MAX_ACTIONS",
         "PHOTON_AUTOPILOT_COOLDOWN_S",
+        # Precision ladder (ISSUE 20): an ambient ladder opt-in or
+        # pressure/ceiling tuning in the developer's shell must never
+        # switch unrelated tests from host-tier demotion to quantization
+        # or reshape the characterized-error gate.
+        "PHOTON_TIER_LADDER",
+        "PHOTON_TIER_BF16_PRESSURE",
+        "PHOTON_TIER_INT8_PRESSURE",
+        "PHOTON_TIER_INT8_ERROR_CEILING",
     ):
         monkeypatch.delenv(var, raising=False)
     from photon_ml_tpu import planner as _planner
@@ -234,6 +246,7 @@ def _failure_domain_hygiene(monkeypatch):
                     "photon-hostmesh",
                     "photon-shadow",
                     "photon-autopilot",
+                    "photon-tier",
                 )
             )
             and t.is_alive()

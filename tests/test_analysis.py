@@ -35,6 +35,7 @@ CHECK_DIRS = {
     "contract-key-drift": "contract_key_drift",
     "metric-name-sync": "metric_name_sync",
     "planner-constant": "planner_constant",
+    "tolerance-pin": "tolerance_pin",
 }
 
 

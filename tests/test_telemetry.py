@@ -497,6 +497,10 @@ class TestJournal:
         "action": {"kind": "demote", "tenant": "t-cold", "params": {}},
         "evidence": {"signal": 0.91, "fire_above": 0.85},
         "rollbacks": 1,
+        # -- precision ladder (ISSUE 20) --
+        "from_tier": "f32",
+        "to_tier": "bf16",
+        "repinned_bytes": 2048,
     }
 
     def test_every_event_type_round_trips_its_schema(self, tmp_path):
